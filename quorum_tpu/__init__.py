@@ -1,0 +1,27 @@
+"""quorum_tpu — a TPU-native k-mer based Illumina error-correction framework.
+
+A ground-up rebuild of the capabilities of Quorum (alekseyzimin/Quorum
+v1.1.1) designed for TPU hardware: the two hot loops (k-mer database
+construction and batched read correction) run as JAX/XLA programs over
+HBM-resident hash tables, with multi-chip scaling via `jax.sharding.Mesh`
+and XLA collectives instead of shared-memory pthreads.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  ops/       — device primitives: 2-bit k-mer arithmetic, the HBM hash
+               table (build/query kernels), Poisson terms.
+  models/    — the two pipeline stages as jittable programs
+               (create_database, error_correct) plus a pure-Python
+               oracle transcription of the reference semantics used as
+               a test oracle.
+  parallel/  — device-mesh sharding: hash-prefix sharded tables,
+               all-to-all mer routing, data-parallel read streams.
+  io/        — FASTQ/FASTA ingestion, 2-bit batch encoding, the
+               self-describing on-disk database (checkpoint) format.
+  cli/       — the user surface: `quorum` driver plus the per-stage
+               tools, flag-compatible with the reference binaries.
+  native/    — C++ host runtime (FASTQ parsing / encoding) bound via
+               ctypes, with a pure-Python fallback.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import mer, table, poisson  # noqa: F401

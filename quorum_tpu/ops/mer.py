@@ -1,0 +1,192 @@
+"""2-bit packed k-mer arithmetic, host (numpy) and device (jnp) variants.
+
+TPU-native equivalent of the reference's `mer_dna` / `kmer_t` layer
+(reference: src/kmer.hpp:11-116 and Jellyfish's mer_dna, cited from
+src/mer_database.hpp:27).  A k-mer (k <= 31) is a 2k-bit integer held as a
+pair of uint32 lanes ``(hi, lo)`` — TPUs are 32-bit-int native and JAX
+defaults to 32-bit mode, so we never materialise uint64 on device.
+
+Bit layout matches the reference's semantics: ``shift_left`` appends the
+new base at the least-significant 2 bits (base index 0 = the most recently
+shifted-in base at the 3' end), so integer comparison of the packed value
+is lexicographic comparison of the string, and ``canonical = min(fwd,
+revcomp)`` (src/kmer.hpp:43).
+
+Base codes are Jellyfish's: A=0, C=1, G=2, T=3, complement(x) = 3-x,
+non-ACGT = -1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+MAX_K = 31
+
+# ASCII -> 2-bit code lookup (-1 for non-ACGT). Accepts lower case like
+# the reference's mer_dna::code.
+_CODE_TABLE = np.full(256, -1, dtype=np.int8)
+for _c, _v in (("A", 0), ("C", 1), ("G", 2), ("T", 3)):
+    _CODE_TABLE[ord(_c)] = _v
+    _CODE_TABLE[ord(_c.lower())] = _v
+_REV_CODE = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+
+def seq_to_codes(seq: bytes | str) -> np.ndarray:
+    """ASCII sequence -> int8 code array (-1 for non-ACGT)."""
+    if isinstance(seq, str):
+        seq = seq.encode()
+    return _CODE_TABLE[np.frombuffer(seq, dtype=np.uint8)]
+
+
+def codes_to_seq(codes: np.ndarray) -> str:
+    """int8/int32 code array (values 0..3) -> ASCII string."""
+    return _REV_CODE[np.asarray(codes, dtype=np.int64)].tobytes().decode()
+
+
+def _masks(k: int) -> tuple[int, int]:
+    """(hi_mask, lo_mask) for a 2k-bit value split into two uint32 lanes."""
+    bits = 2 * k
+    if bits <= 32:
+        return 0, (1 << bits) - 1 if bits < 32 else 0xFFFFFFFF
+    return (1 << (bits - 32)) - 1, 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing (numpy, uint64 for convenience)
+# ---------------------------------------------------------------------------
+
+def pack_kmer(seq: str, k: int | None = None) -> tuple[int, int]:
+    """String of ACGT -> (hi, lo) uint32 pair. Leftmost char is most
+    significant (base index k-1), like repeated shift_left."""
+    k = len(seq) if k is None else k
+    assert len(seq) == k <= MAX_K
+    v = 0
+    for ch in seq:
+        code = int(_CODE_TABLE[ord(ch)])
+        assert code >= 0, f"non-ACGT base {ch!r}"
+        v = (v << 2) | code
+    return (v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF
+
+
+def unpack_kmer(hi: int, lo: int, k: int) -> str:
+    v = (int(hi) << 32) | int(lo)
+    return "".join("ACGT"[(v >> (2 * (k - 1 - i))) & 3] for i in range(k))
+
+
+def revcomp_py(hi: int, lo: int, k: int) -> tuple[int, int]:
+    v = (int(hi) << 32) | int(lo)
+    r = 0
+    for _ in range(k):
+        r = (r << 2) | (3 - (v & 3))
+        v >>= 2
+    return (r >> 32) & 0xFFFFFFFF, r & 0xFFFFFFFF
+
+
+def canonical_py(hi: int, lo: int, k: int) -> tuple[int, int]:
+    rhi, rlo = revcomp_py(hi, lo, k)
+    f = (int(hi) << 32) | int(lo)
+    r = (int(rhi) << 32) | int(rlo)
+    m = min(f, r)
+    return (m >> 32) & 0xFFFFFFFF, m & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Device-side lane arithmetic (jnp; all functions are shape-polymorphic and
+# jit-safe; k is static)
+# ---------------------------------------------------------------------------
+
+def u32(x):
+    return jnp.asarray(x, dtype=jnp.uint32)
+
+
+def shift_left(hi, lo, code_u32, k: int):
+    """Append base at the low end: value = ((value << 2) | code) & mask."""
+    hi_mask, lo_mask = _masks(k)
+    nhi = ((hi << 2) | (lo >> 30)) & u32(hi_mask)
+    nlo = ((lo << 2) | code_u32) & u32(lo_mask)
+    return nhi, nlo
+
+
+def shift_right(hi, lo, code_u32, k: int):
+    """Drop the low base, insert `code` at the top (base index k-1)."""
+    bits = 2 * k
+    nlo = (lo >> 2) | (hi << 30)
+    nhi = hi >> 2
+    if bits - 2 >= 32:
+        nhi = nhi | (code_u32 << (bits - 2 - 32))
+    else:
+        nlo = nlo | (code_u32 << (bits - 2))
+    hi_mask, lo_mask = _masks(k)
+    return nhi & u32(hi_mask), nlo & u32(lo_mask)
+
+
+def get_base(hi, lo, i: int, k: int):
+    """2-bit code of base index i (0 = last shifted-left base, LSBs)."""
+    if 2 * i >= 32:
+        return (hi >> (2 * i - 32)) & u32(3)
+    if 2 * i + 2 <= 32:
+        return (lo >> (2 * i)) & u32(3)
+    # straddles the lane boundary: impossible since positions are even
+    raise AssertionError("unreachable: 2-bit fields are lane-aligned")
+
+
+def set_base(hi, lo, i: int, code_u32, k: int):
+    """Return (hi, lo) with base index i replaced by `code`."""
+    if 2 * i >= 32:
+        sh = 2 * i - 32
+        nhi = (hi & ~u32(3 << sh)) | (code_u32 << sh)
+        return nhi, lo
+    sh = 2 * i
+    nlo = (lo & ~u32(3 << sh)) | (code_u32 << sh)
+    return hi, nlo
+
+
+def lt(ahi, alo, bhi, blo):
+    """Lexicographic (hi, lo) <: 64-bit unsigned compare in 32-bit lanes."""
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def canonical(fhi, flo, rhi, rlo):
+    """min(fwd, rev) — reference picks `m < rm ? m : rm`
+    (src/create_database.cc:86, src/kmer.hpp:43)."""
+    take_f = lt(fhi, flo, rhi, rlo) | ((fhi == rhi) & (flo == rlo))
+    return jnp.where(take_f, fhi, rhi), jnp.where(take_f, flo, rlo)
+
+
+def rolling_kmers(codes, k: int):
+    """All k-mer windows of a batch of code sequences, via one scan.
+
+    TPU-native replacement for the per-base rolling loop of
+    create_database.cc:72-91: instead of one thread walking one read, the
+    scan advances every read in the batch one base per step.
+
+    Args:
+      codes: int32[B, L] base codes, -1 for non-ACGT/padding.
+      k: k-mer length (static).
+
+    Returns:
+      (fhi, flo, rhi, rlo, valid): uint32[B, L] x4 + bool[B, L].
+      Position p describes the k-mer covering bases [p-k+1, p]; valid[p]
+      iff that window contains k consecutive ACGT bases (run-length >= k,
+      matching the low_len logic of create_database.cc:80-85).
+    """
+    B, L = codes.shape
+    codes_t = jnp.transpose(codes)  # [L, B]
+
+    def step(carry, c):
+        fhi, flo, rhi, rlo, run = carry
+        ok = c >= 0
+        cc = u32(jnp.where(ok, c, 0))
+        nfhi, nflo = shift_left(fhi, flo, cc, k)
+        nrhi, nrlo = shift_right(rhi, rlo, u32(3) - cc, k)
+        nrun = jnp.where(ok, run + 1, 0)
+        out = (nfhi, nflo, nrhi, nrlo, nrun >= k)
+        return (nfhi, nflo, nrhi, nrlo, nrun), out
+
+    zero = jnp.zeros((B,), dtype=jnp.uint32)
+    init = (zero, zero, zero, zero, jnp.zeros((B,), dtype=jnp.int32))
+    _, (fhi, flo, rhi, rlo, valid) = jax.lax.scan(step, init, codes_t)
+    tr = lambda a: jnp.transpose(a)
+    return tr(fhi), tr(flo), tr(rhi), tr(rlo), tr(valid)

@@ -1,0 +1,64 @@
+"""Poisson terms for the ambiguity test and cutoff computation.
+
+Replicates the reference formula exactly (error_correct_reads.cc:53-61):
+a factorial table for i < 11, Stirling-with-correction beyond. The
+reference computes in double; on TPU we compute in float32 (the values
+compared against thresholds like 1e-6 are far from float32's resolution
+limits in the regimes that matter; the host-side cutoff computation uses
+float64).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_FACTS = np.array(
+    [1, 1, 2, 6, 24, 120, 720, 5040, 40320, 362880, 3628800], dtype=np.float64
+)
+_TAU = 6.283185307179583
+
+
+def poisson_term_np(lam: float, i: int) -> float:
+    """Host scalar version (float64, matches the reference C++ double)."""
+    if i < 11:
+        return float(np.exp(-lam) * lam**i / _FACTS[int(i)])
+    return float(np.exp(-lam + i) * (lam / i) ** i / np.sqrt(_TAU * i))
+
+
+def poisson_term(lam, i):
+    """Device version: elementwise over arrays. `lam` float, `i` int array."""
+    i = jnp.asarray(i, dtype=jnp.int32)
+    lam = jnp.asarray(lam, dtype=jnp.float32)
+    ii = jnp.clip(i, 0, None)
+    small = ii < 11
+    facts = jnp.asarray(_FACTS, dtype=jnp.float32)
+    f_small = jnp.exp(-lam) * lam ** ii.astype(jnp.float32) / facts[
+        jnp.clip(ii, 0, 10)
+    ]
+    i_f = jnp.maximum(ii.astype(jnp.float32), 1.0)
+    f_big = (
+        jnp.exp(-lam + i_f)
+        * (lam / i_f) ** i_f
+        / jnp.sqrt(jnp.float32(_TAU) * i_f)
+    )
+    return jnp.where(small, f_small, f_big)
+
+
+def compute_poisson_cutoff(
+    distinct: int, total: int, collision_prob: float, poisson_threshold: float
+) -> int:
+    """Auto cutoff from DB coverage stats (error_correct_reads.cc:650-668).
+
+    `distinct`/`total` are counts over high-quality mers with count >= 1
+    (value word & 1 and encoded value >= 2). Returns 0 on failure, like
+    the reference (caller dies unless -p given).
+    """
+    if distinct == 0:
+        return 0
+    coverage = float(total) / float(distinct)
+    lam = coverage * collision_prob
+    for x in range(2, 1000):
+        if poisson_term_np(lam, x) < poisson_threshold:
+            return x + 1
+    return 0

@@ -1,0 +1,358 @@
+"""HBM-resident k-mer hash table: the TPU-native `hash_with_quality` /
+`database_query` (reference: src/mer_database.hpp:65-188, :251-362).
+
+Design (TPU-first, not a translation):
+
+* Open addressing, linear probing, power-of-two size. Keys are stored in
+  full as two uint32 lanes; values are uint32 words encoded exactly like
+  the reference: bit 0 = quality bit, bits 1.. = count saturating at
+  ``2^bits - 1`` (src/mer_database.hpp:94-113). A value word of 0 marks
+  an empty slot (any occupied slot has count >= 1, so value >= 2).
+
+* The reference's lock-free CAS insert loop does not map to XLA. Instead
+  we exploit that Quorum's quality-counting rule is **order independent**
+  (the reference's own unit test pins LQ-then-HQ == HQ-only,
+  unit_tests/test_mer_database.cc:117-118): a whole batch of (mer,
+  quality) observations can be aggregated first (sort + segment-sum) and
+  merged into the table in one functional update. Slot contention is
+  resolved with a scatter-min "claim" array instead of CAS — at most one
+  lane wins a slot per probe round, others advance, all under
+  `lax.while_loop` with static shapes.
+
+* Resize is host-orchestrated (allocate 2x, re-scatter), replacing the
+  reference's barrier-choreographed cooperative rehash
+  (src/mer_database.hpp:137-187). The FULL contract survives: if a probe
+  chain exceeds max_reprobe the insert reports full and the caller
+  resizes or dies with the reference's "Hash is full" error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import mer
+
+EMPTY_VAL = 0
+_CLAIM_NONE = jnp.uint32(0xFFFFFFFF)
+
+
+class TableState(NamedTuple):
+    """Device arrays of one table (a pytree)."""
+
+    keys_hi: jax.Array  # uint32[size]
+    keys_lo: jax.Array  # uint32[size]
+    vals: jax.Array  # uint32[size]
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMeta:
+    """Static geometry (hashable; passed as a static arg to jits)."""
+
+    k: int
+    bits: int  # value bits (count field width), reference -b flag
+    size_log2: int
+    max_reprobe: int = 126
+
+    @property
+    def size(self) -> int:
+        return 1 << self.size_log2
+
+    @property
+    def max_val(self) -> int:
+        return (1 << self.bits) - 1
+
+
+def make_table(meta: TableMeta, device=None) -> TableState:
+    # three distinct buffers (donation requires unaliased arguments)
+    return TableState(
+        jnp.zeros((meta.size,), dtype=jnp.uint32),
+        jnp.zeros((meta.size,), dtype=jnp.uint32),
+        jnp.zeros((meta.size,), dtype=jnp.uint32),
+    )
+
+
+def required_size_log2(requested_size: int) -> int:
+    return max(4, int(requested_size - 1).bit_length())
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_kmer(khi, klo):
+    """Mix the two key lanes into a 32-bit hash (murmur3-style finalizers
+    with cross mixing). Plays the role of the reference's GF(2) matrix
+    hash (Jellyfish RectangularBinaryMatrix, src/mer_database.hpp:28) —
+    we store full keys, so invertibility is not needed, only mixing."""
+    h1 = _fmix32(klo)
+    h2 = _fmix32(khi ^ jnp.uint32(0x5BD1E995))
+    return _fmix32(h1 ^ (h2 * jnp.uint32(0x27D4EB2F)))
+
+
+def hash_kmer_np(khi, klo):
+    """Host (numpy) twin of hash_kmer — must match bit-for-bit."""
+    def fmix(h):
+        h = h ^ (h >> np.uint32(16))
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> np.uint32(13))
+        h = h * np.uint32(0xC2B2AE35)
+        h = h ^ (h >> np.uint32(16))
+        return h
+
+    with np.errstate(over="ignore"):
+        h1 = fmix(np.asarray(klo, dtype=np.uint32))
+        h2 = fmix(np.asarray(khi, dtype=np.uint32) ^ np.uint32(0x5BD1E995))
+        return fmix(h1 ^ (h2 * np.uint32(0x27D4EB2F)))
+
+
+# ---------------------------------------------------------------------------
+# Value-word merge rule
+# ---------------------------------------------------------------------------
+
+def merge_val(cur_val, hq, lq, max_val: int):
+    """Merge a batch-aggregate (hq high-quality obs, lq low-quality obs)
+    into a value word. Order-independent closed form of the reference's
+    per-insert rule (src/mer_database.hpp:104-111): first HQ observation
+    resets the count; LQ observations are ignored once HQ; counts
+    saturate at max_val. cur_val == 0 (empty) falls out naturally."""
+    cur_cnt = cur_val >> 1
+    cur_q = cur_val & jnp.uint32(1)
+    has_hq = hq > 0
+    q = cur_q | has_hq.astype(jnp.uint32)
+    base = jnp.where((cur_q == 0) & has_hq, jnp.uint32(0), cur_cnt)
+    add = jnp.where(q > 0, hq, lq).astype(jnp.uint32)
+    cnt = jnp.minimum(base + add, jnp.uint32(max_val))
+    return (cnt << 1) | q
+
+
+# ---------------------------------------------------------------------------
+# Batch aggregation: (kmer, qual) stream -> unique kmers + hq/lq counts
+# ---------------------------------------------------------------------------
+
+def aggregate_kmers(khi, klo, qual, valid):
+    """Sort + segment-sum a flat batch of canonical k-mer observations.
+
+    Args:
+      khi, klo: uint32[N] canonical k-mer lanes.
+      qual: int32[N] 1 if the k-mer was observed all-high-quality.
+      valid: bool[N].
+
+    Returns:
+      (ukhi, uklo, hq, lq, uvalid): unique keys (padded with sentinel),
+      per-key counts of high/low-quality observations. Sentinel key
+      (0xFFFFFFFF, 0xFFFFFFFF) is unreachable for k <= 31 (hi < 2^30).
+    """
+    n = khi.shape[0]
+    skhi = jnp.where(valid, khi, _CLAIM_NONE)
+    sklo = jnp.where(valid, klo, _CLAIM_NONE)
+    qual = jnp.where(valid, qual, 0).astype(jnp.int32)
+    # lax.sort lexicographically by (hi, lo); qual rides along.
+    skhi, sklo, squal = jax.lax.sort((skhi, sklo, qual), num_keys=2)
+    prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFE, jnp.uint32), skhi[:-1]])
+    prev_lo = jnp.concatenate([jnp.full((1,), 0xFFFFFFFE, jnp.uint32), sklo[:-1]])
+    boundary = (skhi != prev_hi) | (sklo != prev_lo)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    hq = jax.ops.segment_sum(squal, seg, num_segments=n)
+    lq = jax.ops.segment_sum(1 - squal, seg, num_segments=n)
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg, num_segments=n
+    )
+    first_idx_c = jnp.clip(first_idx, 0, n - 1)
+    ukhi = skhi[first_idx_c]
+    uklo = sklo[first_idx_c]
+    uvalid = (first_idx < n) & ~((ukhi == _CLAIM_NONE) & (uklo == _CLAIM_NONE))
+    return ukhi, uklo, hq.astype(jnp.uint32), lq.astype(jnp.uint32), uvalid
+
+
+# ---------------------------------------------------------------------------
+# Probing insert (merge or raw) and lookup
+# ---------------------------------------------------------------------------
+
+def _probe_insert(state: TableState, meta: TableMeta, ukhi, uklo, a, b, valid,
+                  raw: bool):
+    """Place/merge a batch of *unique* keys. If raw, `a` is the full value
+    word to store; else (a, b) = (hq, lq) aggregates for merge_val."""
+    size = meta.size
+    mask = jnp.uint32(size - 1)
+    n = ukhi.shape[0]
+    lane = jnp.arange(n, dtype=jnp.uint32)
+    home = hash_kmer(ukhi, uklo) & mask
+
+    def cond(carry):
+        _, done, probe, _ = carry
+        return jnp.any(~done) & (probe <= meta.max_reprobe)
+
+    def body(carry):
+        st, done, probe, off = carry
+        keys_hi, keys_lo, vals = st
+        active = ~done
+        slot = (home + off) & mask
+        gslot = jnp.where(active, slot, 0)
+        cur_val = vals[gslot]
+        cur_hi = keys_hi[gslot]
+        cur_lo = keys_lo[gslot]
+        is_empty = cur_val == EMPTY_VAL
+        is_match = active & ~is_empty & (cur_hi == ukhi) & (cur_lo == uklo)
+        want_claim = active & is_empty
+        # scatter-min claim: at most one lane wins each empty slot
+        claim = jnp.full((size,), _CLAIM_NONE, dtype=jnp.uint32)
+        claim = claim.at[jnp.where(want_claim, slot, size)].min(
+            lane, mode="drop"
+        )
+        won = want_claim & (claim[gslot] == lane)
+        if raw:
+            new_val = a
+        else:
+            new_val = merge_val(jnp.where(is_match, cur_val, 0), a, b,
+                                meta.max_val)
+        writer = won | is_match
+        wslot = jnp.where(writer, slot, size)
+        vals = vals.at[wslot].set(new_val, mode="drop")
+        keys_hi = keys_hi.at[jnp.where(won, slot, size)].set(ukhi, mode="drop")
+        keys_lo = keys_lo.at[jnp.where(won, slot, size)].set(uklo, mode="drop")
+        ndone = done | writer
+        noff = jnp.where(active & ~writer, off + 1, off)
+        return (TableState(keys_hi, keys_lo, vals), ndone, probe + 1, noff)
+
+    done0 = ~valid
+    off0 = jnp.zeros((n,), dtype=jnp.uint32)
+    st, done, _, _ = jax.lax.while_loop(
+        cond, body, (state, done0, jnp.int32(0), off0)
+    )
+    full = jnp.any(~done)
+    return st, full
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def merge_batch(state: TableState, meta: TableMeta, ukhi, uklo, hq, lq, valid):
+    """Merge aggregated unique (key, hq, lq) into the table.
+    Returns (new_state, full_flag)."""
+    return _probe_insert(state, meta, ukhi, uklo, hq, lq, valid, raw=False)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def raw_insert(state: TableState, meta: TableMeta, ukhi, uklo, vals, valid):
+    """Insert unique keys with explicit value words (rehash path)."""
+    return _probe_insert(state, meta, ukhi, uklo, vals, vals, valid, raw=True)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def add_kmer_batch(state: TableState, meta: TableMeta, khi, klo, qual, valid):
+    """Full insert path for a flat (non-unique) observation batch:
+    aggregate then merge. The TPU analogue of N threads hammering
+    hash_with_quality::add (src/create_database.cc:86)."""
+    ukhi, uklo, hq, lq, uvalid = aggregate_kmers(khi, klo, qual, valid)
+    # donate_argnums on merge_batch doesn't apply through this outer jit;
+    # call the inner implementation directly.
+    return _probe_insert(state, meta, ukhi, uklo, hq, lq, uvalid, raw=False)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def lookup(state: TableState, meta: TableMeta, khi, klo):
+    """Batched query: value word (0 if absent) per canonical k-mer.
+    The device boundary named in SURVEY §2.1 (database_query::operator[],
+    src/mer_database.hpp:284-293) — gather + probe walk over the batch."""
+    size = meta.size
+    mask = jnp.uint32(size - 1)
+    n = khi.shape[0]
+    home = hash_kmer(khi, klo) & mask
+
+    def cond(carry):
+        done, probe, _, _ = carry
+        return jnp.any(~done) & (probe <= meta.max_reprobe)
+
+    def body(carry):
+        done, probe, off, res = carry
+        active = ~done
+        slot = (home + off) & mask
+        gslot = jnp.where(active, slot, 0)
+        cur_val = state.vals[gslot]
+        cur_hi = state.keys_hi[gslot]
+        cur_lo = state.keys_lo[gslot]
+        is_empty = cur_val == EMPTY_VAL
+        is_match = ~is_empty & (cur_hi == khi) & (cur_lo == klo)
+        res = jnp.where(active & is_match, cur_val, res)
+        ndone = done | is_empty | is_match
+        noff = jnp.where(active & ~ndone, off + 1, off)
+        return (ndone, probe + 1, noff, res)
+
+    done0 = jnp.zeros((n,), dtype=bool)
+    off0 = jnp.zeros((n,), dtype=jnp.uint32)
+    res0 = jnp.zeros((n,), dtype=jnp.uint32)
+    _, _, _, res = jax.lax.while_loop(
+        cond, body, (done0, jnp.int32(0), off0, res0)
+    )
+    return res
+
+
+def decode_val(v):
+    """value word -> (count, qual) like database_query::operator[]."""
+    return v >> 1, v & jnp.uint32(1)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def table_stats(state: TableState, meta: TableMeta):
+    """(n_occupied, distinct_hq_ge1, total_hq) — the reductions behind
+    compute_poisson_cutoff__ (error_correct_reads.cc:650-659)."""
+    v = state.vals
+    occ = v != EMPTY_VAL
+    hq_sel = ((v & 1) == 1) & (v >= 2)
+    distinct = jnp.sum(hq_sel.astype(jnp.int32))
+    # float32 sum: exact below 2^24 and within float32 relative error
+    # beyond; feeds only the coverage estimate for the Poisson cutoff.
+    total = jnp.sum(jnp.where(hq_sel, v >> 1, 0).astype(jnp.float32))
+    return jnp.sum(occ.astype(jnp.int32)), distinct, total
+
+
+def grow(state: TableState, meta: TableMeta, chunk: int = 1 << 20):
+    """Double the table: allocate 2x and re-scatter all occupied entries.
+    Host-orchestrated replacement for handle_full_ary
+    (src/mer_database.hpp:137-187). Raises MemoryError upward naturally
+    if allocation fails (caller surfaces the reference's FULL contract)."""
+    new_meta = dataclasses.replace(meta, size_log2=meta.size_log2 + 1)
+    new_state = make_table(new_meta)
+    size = meta.size
+    for start in range(0, size, chunk):
+        end = min(start + chunk, size)
+        khi = state.keys_hi[start:end]
+        klo = state.keys_lo[start:end]
+        vals = state.vals[start:end]
+        valid = vals != EMPTY_VAL
+        new_state, full = raw_insert(new_state, new_meta, khi, klo, vals, valid)
+        if bool(full):  # pragma: no cover - doubling can't fill up
+            raise RuntimeError("Hash is full")
+    return new_state, new_meta
+
+
+# ---------------------------------------------------------------------------
+# Host-side mirrors (tiny, for tests and the query CLI on host arrays)
+# ---------------------------------------------------------------------------
+
+def lookup_np(keys_hi, keys_lo, vals, khi, klo, max_reprobe=126):
+    """Pure-numpy scalar lookup over host arrays (oracle/CLI use)."""
+    size = len(vals)
+    mask = np.uint32(size - 1)
+    h = int(hash_kmer_np(np.uint32(khi), np.uint32(klo)) & mask)
+    for off in range(max_reprobe + 1):
+        slot = (h + off) & int(mask)
+        v = int(vals[slot])
+        if v == EMPTY_VAL:
+            return 0
+        if int(keys_hi[slot]) == int(khi) and int(keys_lo[slot]) == int(klo):
+            return v
+    return 0

@@ -1,0 +1,25 @@
+"""Test harness: pin tests to an 8-virtual-device CPU platform.
+
+The container force-registers the experimental `axon` TPU backend via
+sitecustomize (ignoring JAX_PLATFORMS), so we can't exclude it by env
+var alone; instead we request 8 host CPU devices and set the default
+device to CPU. Multi-chip sharding tests build their mesh from
+jax.devices('cpu') explicitly. Benchmarks (bench.py) run on the real
+chip outside pytest."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+def cpu_devices(n=None):
+    devs = jax.devices("cpu")
+    return devs if n is None else devs[:n]

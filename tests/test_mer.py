@@ -1,0 +1,78 @@
+"""Tests for 2-bit k-mer arithmetic (quorum_tpu.ops.mer)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.ops import mer
+
+
+def ref_revcomp(s):
+    comp = {"A": "T", "C": "G", "G": "C", "T": "A"}
+    return "".join(comp[c] for c in reversed(s))
+
+
+@pytest.mark.parametrize("k", [5, 16, 17, 24, 31])
+def test_pack_unpack_roundtrip(k):
+    rng = np.random.default_rng(42 + k)
+    for _ in range(20):
+        s = "".join(rng.choice(list("ACGT"), size=k))
+        hi, lo = mer.pack_kmer(s)
+        assert mer.unpack_kmer(hi, lo, k) == s
+
+
+@pytest.mark.parametrize("k", [5, 16, 24, 31])
+def test_revcomp_and_canonical(k):
+    rng = np.random.default_rng(7 + k)
+    for _ in range(20):
+        s = "".join(rng.choice(list("ACGT"), size=k))
+        hi, lo = mer.pack_kmer(s)
+        rhi, rlo = mer.revcomp_py(hi, lo, k)
+        assert mer.unpack_kmer(rhi, rlo, k) == ref_revcomp(s)
+        chi, clo = mer.canonical_py(hi, lo, k)
+        expect = min(s, ref_revcomp(s))
+        assert mer.unpack_kmer(chi, clo, k) == expect
+
+
+@pytest.mark.parametrize("k", [5, 16, 17, 24, 31])
+def test_rolling_kmers_match_host(k):
+    rng = np.random.default_rng(3 + k)
+    L = 60
+    B = 4
+    seqs = []
+    for _ in range(B):
+        s = "".join(rng.choice(list("ACGTN"), size=L, p=[0.24, 0.24, 0.24, 0.24, 0.04]))
+        seqs.append(s)
+    codes = np.stack([mer.seq_to_codes(s) for s in seqs]).astype(np.int32)
+    fhi, flo, rhi, rlo, valid = mer.rolling_kmers(jnp.asarray(codes), k)
+    fhi, flo, rhi, rlo, valid = map(np.asarray, (fhi, flo, rhi, rlo, valid))
+    for b, s in enumerate(seqs):
+        for p in range(L):
+            window = s[p - k + 1 : p + 1] if p >= k - 1 else ""
+            ok = len(window) == k and all(c in "ACGT" for c in window)
+            assert bool(valid[b, p]) == ok, (b, p, window)
+            if ok:
+                assert mer.unpack_kmer(fhi[b, p], flo[b, p], k) == window
+                assert (
+                    mer.unpack_kmer(rhi[b, p], rlo[b, p], k) == ref_revcomp(window)
+                )
+
+
+def test_shift_and_base_ops():
+    k = 24
+    s = "ACGTACGTACGTACGTACGTACGT"
+    hi, lo = mer.pack_kmer(s)
+    hi_j, lo_j = jnp.uint32(hi), jnp.uint32(lo)
+    # shift_left appends at base 0
+    nhi, nlo = mer.shift_left(hi_j, lo_j, jnp.uint32(2), k)
+    assert mer.unpack_kmer(int(nhi), int(nlo), k) == s[1:] + "G"
+    # shift_right inserts at base k-1
+    nhi, nlo = mer.shift_right(hi_j, lo_j, jnp.uint32(1), k)
+    assert mer.unpack_kmer(int(nhi), int(nlo), k) == "C" + s[:-1]
+    # get/set base 0 and k-1
+    assert int(mer.get_base(hi_j, lo_j, 0, k)) == 3  # T
+    assert int(mer.get_base(hi_j, lo_j, k - 1, k)) == 0  # A
+    shi, slo = mer.set_base(hi_j, lo_j, 0, jnp.uint32(1), k)
+    assert mer.unpack_kmer(int(shi), int(slo), k) == s[:-1] + "C"
+    shi, slo = mer.set_base(hi_j, lo_j, k - 1, jnp.uint32(3), k)
+    assert mer.unpack_kmer(int(shi), int(slo), k) == "T" + s[1:]

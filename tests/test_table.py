@@ -1,0 +1,163 @@
+"""Tests for the HBM hash table (quorum_tpu.ops.table).
+
+Mirrors the reference's only real unit test
+(unit_tests/test_mer_database.cc TEST_P(MerDatabase, WriteRead)): random
+sequences inserted under different quality patterns, then exact (count,
+quality) asserted per k-mer, parameterized over undersized tables to
+force the growth path."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.ops import mer, table
+
+
+def brute_force_counts(obs, bits):
+    """obs: list of (key_int, qual). Returns {key: (count, qual)} by
+    replaying the reference add() rule sequentially."""
+    max_val = (1 << bits) - 1
+    d = {}
+    for key, q in obs:
+        cnt, cq = d.get(key, (0, 0))
+        if cq < q:
+            d[key] = (1, 1)
+        elif cnt == max_val or cq > q:
+            pass
+        else:
+            d[key] = (cnt + 1, cq)
+    return d
+
+
+def make_obs(rng, n_keys, n_obs, k):
+    keys = rng.integers(0, 1 << (2 * k), size=n_keys, dtype=np.uint64)
+    idx = rng.integers(0, n_keys, size=n_obs)
+    quals = rng.integers(0, 2, size=n_obs)
+    return keys[idx], quals
+
+
+@pytest.mark.parametrize("bits", [3, 7])
+@pytest.mark.parametrize("size_log2", [6, 10])
+def test_merge_matches_sequential_reference_rule(bits, size_log2):
+    k = 24
+    rng = np.random.default_rng(size_log2 * 100 + bits)
+    keys, quals = make_obs(rng, n_keys=40, n_obs=600, k=k)
+    meta = table.TableMeta(k=k, bits=bits, size_log2=size_log2)
+    state = table.make_table(meta)
+
+    # Insert in several batches with interleaved quality order — the rule
+    # is order independent (pinned by the reference unit test).
+    for start in range(0, len(keys), 97):
+        kk = keys[start : start + 97]
+        qq = quals[start : start + 97]
+        khi = jnp.asarray((kk >> np.uint64(32)).astype(np.uint32))
+        klo = jnp.asarray((kk & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        state, full = table.add_kmer_batch(
+            state, meta, khi, klo, jnp.asarray(qq.astype(np.int32)),
+            jnp.ones(len(kk), dtype=bool),
+        )
+        assert not bool(full)
+
+    expect = brute_force_counts(
+        [(int(kx), int(q)) for kx, q in zip(keys, quals)], bits
+    )
+    ukeys = sorted(set(int(kx) for kx in keys))
+    khi = jnp.asarray(np.array([kx >> 32 for kx in ukeys], dtype=np.uint32))
+    klo = jnp.asarray(np.array([kx & 0xFFFFFFFF for kx in ukeys], dtype=np.uint32))
+    vals = np.asarray(table.lookup(state, meta, khi, klo))
+    for kx, v in zip(ukeys, vals):
+        cnt, q = int(v) >> 1, int(v) & 1
+        assert (cnt, q) == expect[kx], hex(kx)
+
+    # absent keys return 0
+    absent = jnp.asarray(np.array([1, 2, 3], dtype=np.uint32))
+    absent_hi = jnp.asarray(np.array([0x3FFF0000, 0x3FFF0001, 0x3FFF0002], dtype=np.uint32))
+    v = np.asarray(table.lookup(state, meta, absent_hi, absent))
+    assert (v == 0).all()
+
+
+def test_growth_path():
+    """Undersized table (the reference's sizes 1-20x trick) must report
+    full; grow() then preserves every entry exactly."""
+    k = 20
+    rng = np.random.default_rng(0)
+    meta = table.TableMeta(k=k, bits=7, size_log2=4)  # 16 slots
+    state = table.make_table(meta)
+    keys = rng.integers(0, 1 << (2 * k), size=500, dtype=np.uint64)
+    quals = rng.integers(0, 2, size=500)
+
+    khi = jnp.asarray((keys >> np.uint64(32)).astype(np.uint32))
+    klo = jnp.asarray((keys & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    qq = jnp.asarray(quals.astype(np.int32))
+    valid = jnp.ones(len(keys), dtype=bool)
+
+    state, full = table.add_kmer_batch(state, meta, khi, klo, qq, valid)
+    # 500 obs of ~500 distinct keys into 16 slots must overflow
+    assert bool(full)
+
+    # host driver loop: grow until the batch fits (replays the whole batch;
+    # idempotence is guaranteed because a failed merge_batch leaves some
+    # keys unplaced — so the driver must re-merge from a clean snapshot.
+    # Here we simply restart from scratch at each size like the CDB
+    # pipeline does per batch-with-retry.)
+    while True:
+        meta = table.TableMeta(k=k, bits=7, size_log2=meta.size_log2 + 1)
+        state = table.make_table(meta)
+        state, full = table.add_kmer_batch(state, meta, khi, klo, qq, valid)
+        if not bool(full):
+            break
+    assert meta.size >= 500
+
+    expect = brute_force_counts(
+        [(int(kx), int(q)) for kx, q in zip(keys, quals)], 7
+    )
+    # grow twice more and re-check values survive re-scatter
+    for _ in range(2):
+        state, meta = table.grow(state, meta, chunk=64)
+    ukeys = sorted(set(int(kx) for kx in keys))
+    uhi = jnp.asarray(np.array([kx >> 32 for kx in ukeys], dtype=np.uint32))
+    ulo = jnp.asarray(np.array([kx & 0xFFFFFFFF for kx in ukeys], dtype=np.uint32))
+    vals = np.asarray(table.lookup(state, meta, uhi, ulo))
+    for kx, v in zip(ukeys, vals):
+        assert (int(v) >> 1, int(v) & 1) == expect[kx]
+
+    # full-table stats agree with brute force
+    occ, distinct, total = table.table_stats(state, meta)
+    assert int(occ) == len(ukeys)
+    exp_distinct = sum(1 for c, q in expect.values() if q == 1 and c >= 1)
+    exp_total = sum(c for c, q in expect.values() if q == 1 and c >= 1)
+    assert int(distinct) == exp_distinct
+    assert int(total) == exp_total
+
+
+def test_saturation():
+    k = 24
+    meta = table.TableMeta(k=k, bits=3, size_log2=6)  # max_val = 7
+    state = table.make_table(meta)
+    khi = jnp.zeros(20, dtype=jnp.uint32)
+    klo = jnp.full(20, 5, dtype=jnp.uint32)
+    state, full = table.add_kmer_batch(
+        state, meta, khi, klo,
+        jnp.ones(20, dtype=jnp.int32), jnp.ones(20, dtype=bool),
+    )
+    assert not bool(full)
+    v = int(np.asarray(table.lookup(state, meta, khi[:1], klo[:1]))[0])
+    assert v >> 1 == 7 and v & 1 == 1
+
+
+def test_quality_reset_across_batches():
+    """LQ batch then HQ batch == HQ alone (reference :117-118); HQ then
+    LQ ignores LQ."""
+    k = 24
+    meta = table.TableMeta(k=k, bits=7, size_log2=6)
+    st = table.make_table(meta)
+    khi = jnp.zeros(3, dtype=jnp.uint32)
+    klo = jnp.asarray(np.array([1, 1, 1], dtype=np.uint32))
+    ones = jnp.ones(3, dtype=bool)
+    lq = jnp.zeros(3, dtype=jnp.int32)
+    hq = jnp.ones(3, dtype=jnp.int32)
+    st, _ = table.add_kmer_batch(st, meta, khi, klo, lq, ones)  # 3 LQ
+    st, _ = table.add_kmer_batch(st, meta, khi[:2], klo[:2], hq[:2], ones[:2])  # 2 HQ
+    st, _ = table.add_kmer_batch(st, meta, khi, klo, lq, ones)  # 3 LQ again
+    v = int(np.asarray(table.lookup(st, meta, khi[:1], klo[:1]))[0])
+    assert (v >> 1, v & 1) == (2, 1)
