@@ -1,0 +1,83 @@
+"""quorum_create_database — flag-compatible with the reference CLI
+(src/create_database_cmdline.yaggo): required -s/-m/-b, one of -q/-Q,
+plus -t/-o/-p and read files."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..models.create_database import BuildConfig, create_database_main
+from ..utils import vlog as vlog_mod
+from ..utils.sizes import parse_size
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="quorum_create_database",
+        description="Create database of k-mers for quorum error corrector",
+    )
+    p.add_argument("-s", "--size", required=True,
+                   help="Initial hash size (suffix k/M/G/T ok)")
+    p.add_argument("-m", "--mer", required=True, type=int, help="Mer length")
+    p.add_argument("-b", "--bits", required=True, type=int,
+                   help="Bits for value field")
+    p.add_argument("-q", "--min-qual-value", type=int,
+                   help="Min quality as an int")
+    p.add_argument("-Q", "--min-qual-char",
+                   help="Min quality as a ASCII character")
+    p.add_argument("-t", "--threads", type=int, default=1,
+                   help="Number of threads (host I/O; device is parallel)")
+    p.add_argument("-o", "--output", default="combined_database",
+                   help="Output file")
+    p.add_argument("-p", "--reprobe", type=int, default=126,
+                   help="Maximum number of reprobes")
+    p.add_argument("--batch-size", type=int, default=8192,
+                   help="Reads per device batch")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("reads", nargs="+", help="Read files")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    vlog_mod.verbose = args.verbose
+    if args.min_qual_value is None and args.min_qual_char is None:
+        print("Either a min-qual-value or min-qual-char must be provided.",
+              file=sys.stderr)
+        return 1
+    if args.min_qual_char is not None and len(args.min_qual_char) != 1:
+        print("The min-qual-char should be one ASCII character.",
+              file=sys.stderr)
+        return 1
+    # our value word is uint32: bit0 quality + up to 30 count bits
+    if not (1 <= args.bits <= 30):
+        print("The number of bits should be between 1 and 30",
+              file=sys.stderr)
+        return 1
+    qual_thresh = (
+        ord(args.min_qual_char) if args.min_qual_char is not None
+        else args.min_qual_value
+    )
+    if args.mer < 1 or args.mer > 31:
+        print("Mer length must be between 1 and 31", file=sys.stderr)
+        return 1
+    cfg = BuildConfig(
+        k=args.mer,
+        bits=args.bits,
+        qual_thresh=qual_thresh,
+        initial_size=parse_size(args.size),
+        max_reprobe=args.reprobe,
+        batch_size=args.batch_size,
+    )
+    try:
+        create_database_main(args.reads, args.output, cfg,
+                             cmdline=list(sys.argv))
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""histo_mer_database — count histogram split by quality bit, capped at
+1000 (reference: src/histo_mer_database.cc:8-28; identical output:
+"<count> <n_lowqual> <n_highqual>" for each non-empty bin). The primary
+DB-equivalence check — one bincount reduce over the value array."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..io import db_format
+
+HLEN = 1001
+
+
+def histo(vals: np.ndarray) -> np.ndarray:
+    v = np.asarray(vals)
+    v = v[v != 0]
+    counts = np.minimum(v >> 1, HLEN - 1).astype(np.int64)
+    quals = (v & 1).astype(np.int64)
+    out = np.zeros((HLEN, 2), dtype=np.int64)
+    np.add.at(out, (counts, quals), 1)
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(f"Usage: histo_mer_database db", file=sys.stderr)
+        return 1
+    state, _, _ = db_format.read_db(argv[0], to_device=False)
+    out = histo(state.vals)
+    for i in range(HLEN):
+        if out[i, 0] or out[i, 1]:
+            print(f"{i} {out[i, 0]} {out[i, 1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""query_mer_database — print count+quality for given mers
+(reference: src/query_mer_database.cc:7-24; same output format)."""
+
+from __future__ import annotations
+
+import sys
+
+from ..io import db_format
+from ..ops import mer, table
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(f"Usage: query_mer_database db mer ...", file=sys.stderr)
+        return 1
+    state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    k = meta.k
+    print(k)
+    for s in argv[1:]:
+        if len(s) != k:
+            print(f"{s}: wrong length (k={k})", file=sys.stderr)
+            continue
+        hi, lo = mer.pack_kmer(s)
+        chi, clo = mer.canonical_py(hi, lo, k)
+        v = table.lookup_np(state.keys_hi, state.keys_lo, state.vals,
+                            chi, clo, meta.max_reprobe)
+        canon = mer.unpack_kmer(chi, clo, k)
+        print(f"{s}:{canon} val:{v >> 1} qual:{v & 1}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from . import fastq, db_format  # noqa: F401

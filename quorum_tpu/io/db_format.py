@@ -1,0 +1,104 @@
+"""On-disk mer-database format: the pipeline checkpoint.
+
+Like the reference, the database file IS the checkpoint between stage 1
+(create_database) and stage 2 (error correction): a self-describing
+JSON header followed by the raw table arrays
+(reference: database_header src/mer_database.hpp:43-63,
+hash_with_quality::write :115-126, reload via database_query :270-278).
+
+We keep the reference's header spirit (format tag, geometry, provenance
+fields from file_header::fill_standard) but the payload is our TPU
+layout: three little-endian uint32 arrays (keys_hi, keys_lo, vals) of
+equal length `size`, written contiguously after the header line. Keys
+are stored in full (the reference stores partial keys recoverable via
+its invertible matrix hash — unnecessary here).
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops.table import TableMeta, TableState
+
+FORMAT = "binary/quorum_tpu_db"
+
+
+def write_db(path: str, state: TableState, meta: TableMeta,
+             cmdline: list[str] | None = None) -> None:
+    keys_hi = np.asarray(state.keys_hi, dtype=np.uint32)
+    keys_lo = np.asarray(state.keys_lo, dtype=np.uint32)
+    vals = np.asarray(state.vals, dtype=np.uint32)
+    size = meta.size
+    header = {
+        "format": FORMAT,
+        "version": 1,
+        "key_len": 2 * meta.k,
+        "bits": meta.bits,
+        "size": size,
+        "size_log2": meta.size_log2,
+        "max_reprobe": meta.max_reprobe,
+        "key_bytes": int(keys_hi.nbytes + keys_lo.nbytes),
+        "value_bytes": int(vals.nbytes),
+        # provenance, like file_header::fill_standard / set_cmdline
+        "cmdline": cmdline or [],
+        "hostname": socket.gethostname(),
+        "pwd": os.getcwd(),
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "user": getpass.getuser(),
+    }
+    with open(path, "wb") as f:
+        f.write(json.dumps(header).encode() + b"\n")
+        f.write(keys_hi.tobytes())
+        f.write(keys_lo.tobytes())
+        f.write(vals.tobytes())
+
+
+def read_header(path: str) -> dict:
+    with open(path, "rb") as f:
+        line = f.readline()
+    header = json.loads(line)
+    if header.get("format") != FORMAT:
+        raise ValueError(
+            f"Wrong type '{header.get('format')}' for file '{path}'"
+        )
+    return header
+
+
+def read_db(path: str, to_device: bool = True):
+    """Load a database file. Returns (state, meta, header). With
+    to_device the arrays are jnp (HBM); else host numpy views.
+
+    The reference mmaps by default with a --no-mmap escape hatch
+    (map_or_read_file, src/mer_database.hpp:228-248); we always memmap
+    on host and the `to_device` flag controls the HBM copy."""
+    header = read_header(path)
+    size = header["size"]
+    with open(path, "rb") as f:
+        offset = len(f.readline())
+    nbytes = size * 4
+    mm = np.memmap(path, dtype=np.uint32, mode="r", offset=offset,
+                   shape=(3 * size,))
+    keys_hi = mm[:size]
+    keys_lo = mm[size : 2 * size]
+    vals = mm[2 * size :]
+    assert offset + 3 * nbytes <= os.path.getsize(path), "truncated database"
+    meta = TableMeta(
+        k=header["key_len"] // 2,
+        bits=header["bits"],
+        size_log2=header["size_log2"],
+        max_reprobe=header["max_reprobe"],
+    )
+    if to_device:
+        state = TableState(
+            jnp.asarray(keys_hi), jnp.asarray(keys_lo), jnp.asarray(vals)
+        )
+    else:
+        state = TableState(keys_hi, keys_lo, vals)
+    return state, meta, header

@@ -1,0 +1,172 @@
+"""FASTQ/FASTA ingestion: streaming, multi-file, fixed-shape batches.
+
+Host-side replacement for Jellyfish's `stream_manager` +
+`whole_sequence_parser` (used at src/create_database.cc:27-28,52 and
+src/error_correct_reads.cc:127): a chunked reader that yields
+fixed-shape numpy batches ready for `jax.device_put`. A C++ fast path
+(quorum_tpu.native) parses and 2-bit-encodes large inputs; this module
+is the always-available pure-Python implementation and the common
+batching logic.
+
+Handles 4-line and multi-line FASTQ, FASTA (quality treated as absent),
+gzip-compressed inputs (by extension or magic), and '-' for stdin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import io as _io
+import sys
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from ..ops import mer
+
+# Read-length buckets: batches are padded to the smallest bucket that
+# fits the longest read in the batch, so jit specializations stay few.
+LENGTH_BUCKETS = (64, 128, 160, 192, 256, 384, 512, 1024, 2048, 4096)
+
+
+@dataclasses.dataclass
+class ReadBatch:
+    """A fixed-shape batch of reads.
+
+    codes: int8[B, L] 2-bit base codes, -1 for non-ACGT, -2 beyond length.
+    quals: uint8[B, L] ASCII quality codes (0 beyond length / FASTA).
+    lengths: int32[B]
+    headers: list[str] (without the @/> marker)
+    n: number of real reads (rows beyond n are padding)
+    """
+
+    codes: np.ndarray
+    quals: np.ndarray
+    lengths: np.ndarray
+    headers: list
+    n: int
+
+
+def _open(path: str):
+    if path == "-" or path == "/dev/fd/0" or path == "/dev/stdin":
+        return sys.stdin.buffer
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    f = open(path, "rb")
+    magic = f.read(2)
+    f.seek(0)
+    if magic == b"\x1f\x8b":
+        f.close()
+        return gzip.open(path, "rb")
+    return f
+
+
+def iter_records(paths: Sequence[str]) -> Iterator[tuple[str, bytes, bytes]]:
+    """Yield (header, seq, qual) byte records across files. qual is b''
+    for FASTA records (Jellyfish's parser does the same; merge_mate_pairs
+    then fabricates '*' quals, src/merge_mate_pairs.cc:51-59)."""
+    for path in paths:
+        f = _open(path)
+        try:
+            yield from _iter_one(f, path)
+        finally:
+            if f is not sys.stdin.buffer:
+                f.close()
+
+
+def _iter_one(f, path: str) -> Iterator[tuple[str, bytes, bytes]]:
+    line = f.readline()
+    while line:
+        line = line.rstrip(b"\r\n")
+        if not line:
+            line = f.readline()
+            continue
+        if line.startswith(b">"):
+            header = line[1:].decode()
+            seq_parts = []
+            line = f.readline()
+            while line and not line.startswith(b">") and not line.startswith(b"@"):
+                seq_parts.append(line.rstrip(b"\r\n"))
+                line = f.readline()
+            yield header, b"".join(seq_parts), b""
+        elif line.startswith(b"@"):
+            header = line[1:].decode()
+            seq_parts = []
+            line = f.readline()
+            while line and not line.startswith(b"+"):
+                seq_parts.append(line.rstrip(b"\r\n"))
+                line = f.readline()
+            seq = b"".join(seq_parts)
+            # line is the '+' separator; read quals until length matches
+            qual_parts = []
+            qlen = 0
+            line = f.readline()
+            while line and qlen < len(seq):
+                q = line.rstrip(b"\r\n")
+                qual_parts.append(q)
+                qlen += len(q)
+                line = f.readline()
+            qual = b"".join(qual_parts)
+            if len(qual) != len(seq):
+                raise ValueError(
+                    f"{path}: quality length {len(qual)} != sequence length "
+                    f"{len(seq)} for read '{header}'"
+                )
+            yield header, seq, qual
+        else:
+            raise ValueError(f"{path}: unrecognized record start: {line[:40]!r}")
+
+
+def bucket_for(length: int) -> int:
+    for b in LENGTH_BUCKETS:
+        if length <= b:
+            return b
+    return length  # oversized: one-off shape
+
+
+def batch_records(
+    records: Iterable[tuple[str, bytes, bytes]],
+    batch_size: int = 8192,
+) -> Iterator[ReadBatch]:
+    """Group records into fixed-shape ReadBatches of `batch_size` rows."""
+    buf: list[tuple[str, bytes, bytes]] = []
+    for rec in records:
+        buf.append(rec)
+        if len(buf) == batch_size:
+            yield _make_batch(buf, batch_size)
+            buf = []
+    if buf:
+        yield _make_batch(buf, batch_size)
+
+
+def _make_batch(buf, batch_size) -> ReadBatch:
+    n = len(buf)
+    maxlen = max((len(seq) for _, seq, _ in buf), default=1)
+    L = bucket_for(max(maxlen, 1))
+    codes = np.full((batch_size, L), -2, dtype=np.int8)
+    quals = np.zeros((batch_size, L), dtype=np.uint8)
+    lengths = np.zeros((batch_size,), dtype=np.int32)
+    headers = []
+    for i, (hdr, seq, qual) in enumerate(buf):
+        headers.append(hdr)
+        m = len(seq)
+        lengths[i] = m
+        codes[i, :m] = mer.seq_to_codes(seq)
+        if qual:
+            quals[i, :m] = np.frombuffer(qual, dtype=np.uint8)
+    return ReadBatch(codes=codes, quals=quals, lengths=lengths,
+                     headers=headers, n=n)
+
+
+def read_batches(paths: Sequence[str], batch_size: int = 8192) -> Iterator[ReadBatch]:
+    use_native = False
+    try:  # C++ fast path, if the shared library is built
+        from ..native import binding as _nb
+        use_native = _nb.available()
+    except Exception:
+        use_native = False
+    if use_native:
+        from ..native import binding as _nb
+        yield from _nb.read_batches(paths, batch_size)
+    else:
+        yield from batch_records(iter_records(paths), batch_size)

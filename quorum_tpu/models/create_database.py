@@ -1,0 +1,132 @@
+"""Stage 1: build the quality-aware k-mer database from FASTQ reads.
+
+TPU-native rebuild of `quorum_create_database`
+(reference: src/create_database.cc). The reference streams reads into N
+pthreads that CAS into a shared hash; here each fixed-shape read batch
+becomes one device program: rolling canonical k-mers + quality-run
+tracking (the low_len/high_len logic of create_database.cc:64-91) are
+computed for every position of every read in parallel, aggregated by
+sort/segment-sum, and merged into the HBM table. The table auto-grows
+on overflow exactly once per key (placed-mask retry), mirroring the
+reference's cooperative resize (src/mer_database.hpp:137-187) with a
+host-orchestrated re-scatter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Iterable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..io import fastq, db_format
+from ..ops import mer, table
+from ..utils.vlog import vlog
+
+
+@dataclasses.dataclass(frozen=True)
+class BuildConfig:
+    k: int = 24
+    bits: int = 7
+    qual_thresh: int = 38  # ASCII code: base qual char >= this is "high"
+    initial_size: int = 200_000_000
+    max_reprobe: int = 126
+    batch_size: int = 8192
+    max_grows: int = 16
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def extract_observations(codes_i8, quals_u8, k: int, qual_thresh: int):
+    """codes/quals [B, L] -> flat canonical k-mer observations.
+
+    Returns (chi, clo, qualbit, valid), each [B*L]. qualbit is 1 iff all
+    k bases of the window have quality >= qual_thresh (high_len >= k,
+    create_database.cc:80-86); valid iff the window holds k consecutive
+    ACGT bases.
+    """
+    codes = codes_i8.astype(jnp.int32)
+    B, L = codes.shape
+    fhi, flo, rhi, rlo, valid = mer.rolling_kmers(codes, k)
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    reset = (codes < 0) | (quals_u8.astype(jnp.int32) < qual_thresh)
+    last_reset = jax.lax.cummax(jnp.where(reset, pos, -1), axis=1)
+    qualbit = ((pos - last_reset) >= k).astype(jnp.int32)
+    return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
+
+
+_aggregate = jax.jit(table.aggregate_kmers)
+
+
+@dataclasses.dataclass
+class BuildStats:
+    reads: int = 0
+    bases: int = 0
+    batches: int = 0
+    grows: int = 0
+    distinct: int = 0
+
+
+def build_database(
+    paths: Sequence[str],
+    cfg: BuildConfig,
+    batches: Iterable[fastq.ReadBatch] | None = None,
+):
+    """Run the full stage-1 pipeline. Returns (state, meta, stats).
+
+    Raises RuntimeError("Hash is full") only if growth itself fails
+    (allocation), preserving the reference's failure contract
+    (create_database.cc:87, README.md:46-47).
+    """
+    meta = table.TableMeta(
+        k=cfg.k,
+        bits=cfg.bits,
+        size_log2=table.required_size_log2(cfg.initial_size),
+        max_reprobe=cfg.max_reprobe,
+    )
+    state = table.make_table(meta)
+    stats = BuildStats()
+
+    if batches is None:
+        batches = fastq.read_batches(paths, cfg.batch_size)
+    for batch in batches:
+        stats.batches += 1
+        stats.reads += batch.n
+        stats.bases += int(batch.lengths.sum())
+        chi, clo, q, valid = extract_observations(
+            jnp.asarray(batch.codes), jnp.asarray(batch.quals),
+            cfg.k, cfg.qual_thresh,
+        )
+        ukhi, uklo, hq, lq, uvalid = _aggregate(chi, clo, q, valid)
+        pending = uvalid
+        for _ in range(cfg.max_grows + 1):
+            state, full, placed = table.merge_batch(
+                state, meta, ukhi, uklo, hq, lq, pending
+            )
+            if not bool(full):
+                break
+            pending = jnp.logical_and(pending, jnp.logical_not(placed))
+            vlog("Hash table full at size ", meta.size, "; doubling")
+            state, meta = table.grow(state, meta)
+            stats.grows += 1
+        else:
+            raise RuntimeError("Hash is full")
+    occ, _, _ = table.table_stats(state, meta)
+    stats.distinct = int(occ)
+    vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
+         stats.distinct, " distinct mers")
+    return state, meta, stats
+
+
+def create_database_main(
+    paths: Sequence[str],
+    output: str,
+    cfg: BuildConfig,
+    cmdline: list[str] | None = None,
+) -> BuildStats:
+    state, meta, stats = build_database(paths, cfg)
+    db_format.write_db(output, state, meta, cmdline)
+    return stats

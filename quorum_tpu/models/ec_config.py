@@ -1,0 +1,48 @@
+"""Error-correction configuration, shared by the oracle and the batched
+device corrector. Field names/defaults mirror the reference CLI
+(src/error_correct_reads_cmdline.yaggo) and the accessor semantics of
+error_correct_t (error_correct_reads.cc:197-216: window/error of 0 fall
+back to k and k/2)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ECConfig:
+    k: int
+    skip: int = 1
+    good: int = 2
+    anchor_count: int = 3
+    min_count: int = 1
+    cutoff: int = 4
+    qual_cutoff: int = 127  # ASCII code; numeric_limits<char>::max() default
+    window: int = 10
+    error: int = 3
+    homo_trim: int | None = None
+    trim_contaminant: bool = False
+    no_discard: bool = False
+    collision_prob: float = 0.01 / 3.0
+    poisson_threshold: float = 1e-6
+    # float dtype for the Poisson ambiguity test: the reference computes
+    # in double; the device computes in float32. Tests set "float32" on
+    # the oracle so both sides round identically at the threshold.
+    poisson_dtype: str = "float64"
+
+    @property
+    def effective_window(self) -> int:
+        return self.window if self.window else self.k
+
+    @property
+    def effective_error(self) -> int:
+        return self.error if self.error else self.k // 2
+
+    @property
+    def do_homo_trim(self) -> bool:
+        return self.homo_trim is not None
+
+
+ERROR_CONTAMINANT = "Contaminated read"
+ERROR_NO_STARTING_MER = "No high quality mer"
+ERROR_HOMOPOLYMER = "Entire read is an homopolymer"

@@ -1,0 +1,501 @@
+"""Pure-Python per-read oracle of the Quorum correction semantics.
+
+A direct, slow, readable transcription of the reference algorithm
+(src/error_correct_reads.cc: find_starting_mer :609-643, extend
+:384-565, err_log src/err_log.hpp, homo_trim :567-597), written from
+the spec to serve as the behavioral test oracle for the batched device
+corrector and as a host fallback path. All positions are raw 0-based
+read indices; direction-generic arithmetic replaces the reference's
+forward_/backward_ pointer-and-counter template machinery (d = +1 for
+5'->3', -1 for 3'->5').
+
+Known intentional deviation (documented): err_log::force_truncate's
+position filter uses the *raw* position comparison for both directions
+(the code comment in err_log.hpp:44 states raw comparison is intended;
+the reference's backward instantiation inherits an inverted operator>=
+and so drops the complement set for backward logs — we follow the
+stated intent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+from ..ops import mer as merops
+from ..ops import table as tableops
+from ..ops.poisson import poisson_term_f32, poisson_term_np
+from .ec_config import (
+    ECConfig,
+    ERROR_CONTAMINANT,
+    ERROR_HOMOPOLYMER,
+    ERROR_NO_STARTING_MER,
+)
+
+_INT_MIN = -(2**31)
+_UINT32_MAX = 2**32 - 1
+
+
+def _wrap_int32(x: int) -> int:
+    """C-style (int) cast: wrap modulo 2^32 into [-2^31, 2^31)."""
+    return ((x + 2**31) % 2**32) - 2**31
+
+
+class DictDB:
+    """Host-side (count, qual) store keyed by canonical k-mer int."""
+
+    def __init__(self, d: dict[int, tuple[int, int]], k: int):
+        self.d = d
+        self.k = k
+
+    @classmethod
+    def from_table(cls, state, meta) -> "DictDB":
+        keys_hi = np.asarray(state.keys_hi)
+        keys_lo = np.asarray(state.keys_lo)
+        vals = np.asarray(state.vals)
+        occ = vals != 0
+        keys = (keys_hi[occ].astype(np.uint64) << np.uint64(32)) | keys_lo[
+            occ
+        ].astype(np.uint64)
+        v = vals[occ]
+        return cls(
+            {int(kk): (int(vv) >> 1, int(vv) & 1) for kk, vv in zip(keys, v)},
+            meta.k,
+        )
+
+    def get(self, key: int) -> tuple[int, int]:
+        return self.d.get(key, (0, 0))
+
+
+class Kmer:
+    """fwd + revcomp 2k-bit ints, mirroring kmer_t (src/kmer.hpp:11-61)."""
+
+    __slots__ = ("f", "r", "k")
+
+    def __init__(self, k: int, f: int = 0, r: int = 0):
+        self.k = k
+        self.f = f
+        self.r = r
+
+    def copy(self) -> "Kmer":
+        return Kmer(self.k, self.f, self.r)
+
+    def shift_left(self, code: int) -> None:
+        mask = (1 << (2 * self.k)) - 1
+        self.f = ((self.f << 2) | code) & mask
+        self.r = (self.r >> 2) | ((3 - code) << (2 * self.k - 2))
+
+    def shift_right(self, code: int) -> None:
+        mask = (1 << (2 * self.k)) - 1
+        self.f = (self.f >> 2) | (code << (2 * self.k - 2))
+        self.r = ((self.r << 2) | (3 - code)) & mask
+
+    def canonical(self) -> int:
+        return self.f if self.f <= self.r else self.r
+
+    # direction-generic ops; d=+1 forward, d=-1 backward. "Base 0" is
+    # the most recently shifted-in base in the direction of travel
+    # (src/kmer.hpp:75-103: backward adapters mirror the index).
+    def shift(self, d: int, code: int) -> None:
+        if d == 1:
+            self.shift_left(code)
+        else:
+            self.shift_right(code)
+
+    def base0(self, d: int) -> int:
+        i = 0 if d == 1 else self.k - 1
+        return (self.f >> (2 * i)) & 3
+
+    def replace0(self, d: int, code: int) -> None:
+        i = 0 if d == 1 else self.k - 1
+        ri = self.k - 1 - i
+        self.f = (self.f & ~(3 << (2 * i))) | (code << (2 * i))
+        self.r = (self.r & ~(3 << (2 * ri))) | ((3 - code) << (2 * ri))
+
+
+class DirLog:
+    """err_log<T> with direction-generic raw positions
+    (src/err_log.hpp:22-135; see module docstring for the
+    force_truncate deviation)."""
+
+    def __init__(self, d: int, window: int, error: int, trunc_string: str):
+        self.d = d
+        self.window = window
+        self.error = error
+        self.trunc = trunc_string
+        self.entries: list[tuple[str, int, str, str]] = []
+        self.lwin = 0
+
+    def _dist(self, a_raw: int, b_raw: int) -> int:
+        return self.d * (a_raw - b_raw)
+
+    def check_nb_error(self) -> bool:
+        if self.entries:
+            back = self.entries[-1][1]
+            guard = back > self.window if self.d == 1 else back < self.window
+            if guard:
+                while self._dist(back, self.entries[self.lwin][1]) > self.window:
+                    self.lwin += 1
+        return len(self.entries) - self.lwin - 1 >= self.error
+
+    def substitution(self, raw: int, frm: str, to: str) -> bool:
+        self.entries.append(("sub", raw, frm, to))
+        return self.check_nb_error()
+
+    def truncation(self, raw: int) -> bool:
+        # backward_log::truncation records pos - 1 (direction units),
+        # i.e. raw + 1: the first *kept* base index
+        # (src/error_correct_reads.hpp:170-172)
+        if self.d == -1:
+            raw += 1
+        self.entries.append(("trunc", raw, "", ""))
+        return self.check_nb_error()
+
+    def force_truncate(self, raw: int) -> bool:
+        self.entries = [e for e in self.entries if not e[1] >= raw]
+        self.lwin = 0
+        return self.check_nb_error()
+
+    def remove_last_window(self) -> int:
+        if not self.entries:
+            return 0
+        diff = self._dist(self.entries[-1][1], self.entries[self.lwin][1])
+        del self.entries[self.lwin :]
+        self.lwin = 0
+        self.check_nb_error()
+        return diff
+
+    def render(self) -> str:
+        parts = []
+        for typ, raw, frm, to in self.entries:
+            if typ == "sub":
+                parts.append(f"{raw}:sub:{frm}-{to}")
+            else:
+                parts.append(f"{raw}:{self.trunc}")
+        return " ".join(parts)
+
+
+@dataclasses.dataclass
+class ReadResult:
+    ok: bool
+    error: str = ""
+    seq: str = ""
+    fwd_log: str = ""
+    bwd_log: str = ""
+    start: int = 0
+    end: int = 0
+
+
+_REV = "ACGT"
+
+
+class OracleCorrector:
+    def __init__(self, db: DictDB, cfg: ECConfig,
+                 contaminant: set[int] | None = None):
+        self.db = db
+        self.cfg = cfg
+        self.k = db.k
+        self.contaminant = contaminant if contaminant is not None else set()
+
+    # -- db primitives ----------------------------------------------------
+    def get_val(self, canon: int) -> int:
+        cnt, q = self.db.get(canon)
+        return cnt if q else 0
+
+    def get_best_alternatives(self, m: Kmer, d: int):
+        """database_query::get_best_alternatives
+        (src/mer_database.hpp:302-329): counts for the 4 variants of
+        base 0, kept only at the best quality level seen (in loop
+        order)."""
+        counts = [0, 0, 0, 0]
+        level = 0
+        count = 0
+        ucode = 0
+        ori = m.base0(d)
+        for i in range(4):
+            m.replace0(d, i)
+            cnt, q = self.db.get(m.canonical())
+            if cnt > 0 and q >= level:
+                if q > level and count > 0:
+                    for j in range(i):
+                        counts[j] = 0
+                    count = 0
+                counts[i] = cnt
+                ucode = i
+                level = q
+                count += 1
+        m.replace0(d, ori)
+        return counts, ucode, level, count
+
+    def is_contaminant(self, canon: int) -> bool:
+        return canon in self.contaminant
+
+    def _poisson(self, lam: float, i: int) -> float:
+        if self.cfg.poisson_dtype == "float32":
+            return poisson_term_f32(lam, i)
+        return poisson_term_np(lam, i)
+
+    # -- the algorithm ----------------------------------------------------
+    def correct(self, seq: str, qual: str) -> ReadResult:
+        cfg = self.cfg
+        k = self.k
+        codes = [
+            {"A": 0, "C": 1, "G": 2, "T": 3}.get(c.upper(), -1) for c in seq
+        ]
+        quals = [ord(c) for c in qual] if qual else [0] * len(seq)
+        n = len(seq)
+        out = list(codes)  # out buffer; positions written as we extend
+
+        # ---- find_starting_mer (error_correct_reads.cc:609-643) ----
+        m = Kmer(k)
+        inp = cfg.skip
+        anchor_found = False
+        while inp < n and not anchor_found:
+            i = 0
+            while inp < n and i < k:
+                c = codes[inp]
+                inp += 1
+                if c >= 0:
+                    m.shift_left(c)
+                    i += 1
+                else:
+                    i = 0
+            if i < k:
+                break
+            found = 0
+            while inp < n:
+                canon = m.canonical()
+                contaminated = self.is_contaminant(canon)
+                if contaminated and not cfg.trim_contaminant:
+                    return ReadResult(False, ERROR_CONTAMINANT)
+                if not contaminated:
+                    val = self.get_val(canon)
+                    found = found + 1 if val >= cfg.anchor_count else 0
+                    if found >= cfg.good:
+                        anchor_found = True
+                        break
+                c = codes[inp]
+                inp += 1
+                if c >= 0:
+                    m.shift_left(c)
+                else:
+                    break
+        if not anchor_found:
+            return ReadResult(False, ERROR_NO_STARTING_MER)
+
+        start_off = inp
+        fwd_log = DirLog(+1, cfg.effective_window, cfg.effective_error,
+                         "3_trunc")
+        bwd_log = DirLog(-1, cfg.effective_window, cfg.effective_error,
+                         "5_trunc")
+
+        end_out = self._extend(m.copy(), codes, quals, out, start_off, n, +1,
+                               fwd_log)
+        if end_out is None:
+            return ReadResult(False, self._ext_error)
+        start_out = self._extend(m.copy(), codes, quals, out,
+                                 start_off - k - 1, -1, -1, bwd_log)
+        if start_out is None:
+            return ReadResult(False, self._ext_error)
+        start_out += 1
+
+        if cfg.do_homo_trim:
+            end_out = self._homo_trim(out, start_out, end_out, fwd_log,
+                                      bwd_log)
+            if end_out is None:
+                return ReadResult(False, ERROR_HOMOPOLYMER)
+
+        corrected = "".join(_REV[c] for c in out[start_out:end_out])
+        return ReadResult(True, "", corrected, fwd_log.render(),
+                          bwd_log.render(), start_out, end_out)
+
+    _ext_error = ""
+
+    def _log_substitution(self, m: Kmer, d: int, log: DirLog, cpos: int,
+                          frm: int, to: int):
+        """log_substitution (error_correct_reads.cc:360-379).
+        Returns ('ok'|'truncate'|'error', out_rewind)."""
+        if frm == to:
+            return "ok", 0
+        m.replace0(d, to)
+        if self.is_contaminant(m.canonical()):
+            if self.cfg.trim_contaminant:
+                log.truncation(cpos)
+                return "truncate", 0
+            self._ext_error = ERROR_CONTAMINANT
+            return "error", 0
+        frm_c = _REV[frm] if frm >= 0 else "N"
+        to_c = _REV[to] if to >= 0 else "N"
+        if log.substitution(cpos, frm_c, to_c):
+            diff = log.remove_last_window()
+            log.truncation(cpos - d * diff)
+            return "truncate", diff
+        return "ok", 0
+
+    def _extend(self, m: Kmer, codes, quals, out, pos, end, d, log):
+        """extend (error_correct_reads.cc:384-565). Returns the raw out
+        position (one-past-last-written in direction d), or None with
+        self._ext_error set."""
+        cfg = self.cfg
+        self._ext_error = ""
+        prev_count = self.get_val(m.canonical())
+        opos = pos  # out position; moves in lockstep with pos
+
+        def in_range(p):
+            return p < end if d == 1 else p > end
+
+        while in_range(pos):
+            base_code = codes[pos]
+            cpos = pos
+            pos += d
+
+            ori = base_code
+            m.shift(d, ori if ori >= 0 else 0)
+            if ori >= 0 and self.is_contaminant(m.canonical()):
+                if cfg.trim_contaminant:
+                    log.truncation(cpos)
+                    return opos
+                self._ext_error = ERROR_CONTAMINANT
+                return None
+
+            counts, ucode, level, count = self.get_best_alternatives(m, d)
+
+            if count == 0:
+                log.truncation(cpos)
+                return opos
+
+            if count == 1:
+                prev_count = counts[ucode]
+                res, diff = self._log_substitution(m, d, log, cpos, ori, ucode)
+                if res == "truncate":
+                    return opos - d * diff
+                if res == "error":
+                    return None
+                out[opos] = m.base0(d)
+                opos += d
+                continue
+
+            if ori >= 0:
+                if counts[ori] > cfg.min_count:
+                    if counts[ori] >= cfg.cutoff or quals[cpos] >= cfg.qual_cutoff:
+                        out[opos] = m.base0(d)
+                        opos += d
+                        continue
+                    p = float(sum(counts)) * cfg.collision_prob
+                    prob = self._poisson(p, counts[ori])
+                    if prob < cfg.poisson_threshold:
+                        out[opos] = m.base0(d)
+                        opos += d
+                        continue
+                elif level == 0 and counts[ori] == 0:
+                    log.truncation(cpos)
+                    return opos
+            elif level == 0:
+                log.truncation(cpos)
+                return opos
+
+            # multiple alternatives: find those with a continuation at
+            # the same-or-better level (error_correct_reads.cc:473-507)
+            check_code = ori
+            success = False
+            cont_counts = [0, 0, 0, 0]
+            cont_with_next = [False, False, False, False]
+            read_nbase = codes[pos] if in_range(pos) else -1
+
+            for i in range(4):
+                if counts[i] <= cfg.min_count:
+                    continue
+                check_code = i
+                nmer = m.copy()
+                nmer.replace0(d, i)
+                nmer.shift(d, 0)
+                ncounts, _, nlevel, ncount = self.get_best_alternatives(nmer, d)
+                if ncount > 0 and nlevel >= level:
+                    cont_with_next[i] = read_nbase >= 0 and ncounts[read_nbase] > 0
+                    success = True
+                    cont_counts[i] = counts[i]
+
+            if success:
+                check_code = -1
+                _prev = (
+                    _UINT32_MAX
+                    if prev_count <= cfg.min_count
+                    else prev_count
+                )
+                # Replicates the compiled reference exactly, including the
+                # int overflow at error_correct_reads.cc:520: min_diff is
+                # (int)std::abs((long)cont - (long)_prev_count), which for
+                # _prev_count == UINT32_MAX wraps negative, so the
+                # (un-cast long) comparison below never matches and no
+                # substitution happens when prev_count <= min_count —
+                # the source comment's "pick the largest count" intent is
+                # dead code in the real binary.
+                min_diff = 2**31 - 1
+                candidates = [False] * 4
+                ncand = 0
+                for i in range(4):
+                    if cont_counts[i] > 0:
+                        min_diff = min(
+                            min_diff, _wrap_int32(abs(cont_counts[i] - _prev))
+                        )
+                for i in range(4):
+                    if abs(cont_counts[i] - _prev) == min_diff:
+                        candidates[i] = True
+                        ncand += 1
+                        check_code = i
+                if ncand > 1 and read_nbase >= 0:
+                    for i in range(4):
+                        if candidates[i]:
+                            if not cont_with_next[i]:
+                                ncand -= 1
+                            else:
+                                check_code = i
+                if ncand != 1:
+                    check_code = -1
+                if check_code >= 0:
+                    res, diff = self._log_substitution(
+                        m, d, log, cpos, ori, check_code
+                    )
+                    if res == "truncate":
+                        return opos - d * diff
+                    if res == "error":
+                        return None
+
+            if ori < 0 and check_code < 0:
+                log.truncation(cpos)
+                return opos
+
+            out[opos] = m.base0(d)
+            opos += d
+
+        return opos
+
+    def _homo_trim(self, out, start_out, end_out, fwd_log, bwd_log):
+        """homo_trim (error_correct_reads.cc:567-597). Returns new
+        end_out or None (whole read is homopolymer)."""
+        cfg = self.cfg
+        max_score = _INT_MIN
+        max_pos = None
+        score = 0
+        ptr = end_out - 1
+        pbase = out[ptr]
+        ptr -= 1
+        while ptr >= start_out:
+            cbase = out[ptr]
+            # +1 if same as last, -1 if not (reference :577)
+            score += (2 if pbase == cbase else 0) - 1
+            pbase = cbase
+            if score > max_score:
+                max_score = score
+                max_pos = ptr
+            ptr -= 1
+        if max_score < cfg.homo_trim:
+            return end_out
+        if max_pos is None or max_pos < start_out:
+            return None
+        fwd_log.force_truncate(max_pos)
+        bwd_log.force_truncate(max_pos)
+        fwd_log.truncation(max_pos)
+        return max_pos

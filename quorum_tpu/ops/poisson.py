@@ -26,6 +26,22 @@ def poisson_term_np(lam: float, i: int) -> float:
     return float(np.exp(-lam + i) * (lam / i) ** i / np.sqrt(_TAU * i))
 
 
+def poisson_term_f32(lam: float, i: int) -> float:
+    """Host scalar float32 twin of the device formula — used by the
+    oracle when mirroring device rounding at the threshold boundary."""
+    lam32 = np.float32(lam)
+    if i < 11:
+        return float(
+            np.exp(-lam32) * lam32 ** np.float32(i) / np.float32(_FACTS[int(i)])
+        )
+    fi = np.float32(max(i, 1))
+    return float(
+        np.exp(-lam32 + fi)
+        * (lam32 / fi) ** fi
+        / np.sqrt(np.float32(_TAU) * fi)
+    )
+
+
 def poisson_term(lam, i):
     """Device version: elementwise over arrays. `lam` float, `i` int array."""
     i = jnp.asarray(i, dtype=jnp.int32)

@@ -234,21 +234,26 @@ def _probe_insert(state: TableState, meta: TableMeta, ukhi, uklo, a, b, valid,
     st, done, _, _ = jax.lax.while_loop(
         cond, body, (state, done0, jnp.int32(0), off0)
     )
+    placed = done & valid
     full = jnp.any(~done)
-    return st, full
+    return st, full, placed
 
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
 def merge_batch(state: TableState, meta: TableMeta, ukhi, uklo, hq, lq, valid):
     """Merge aggregated unique (key, hq, lq) into the table.
-    Returns (new_state, full_flag)."""
+    Returns (new_state, full_flag, placed_mask). On full, the caller
+    grows the table and retries with `valid & ~placed` — exact-once
+    merging survives the resize."""
     return _probe_insert(state, meta, ukhi, uklo, hq, lq, valid, raw=False)
 
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
 def raw_insert(state: TableState, meta: TableMeta, ukhi, uklo, vals, valid):
     """Insert unique keys with explicit value words (rehash path)."""
-    return _probe_insert(state, meta, ukhi, uklo, vals, vals, valid, raw=True)
+    st, full, _ = _probe_insert(state, meta, ukhi, uklo, vals, vals, valid,
+                                raw=True)
+    return st, full
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -259,7 +264,9 @@ def add_kmer_batch(state: TableState, meta: TableMeta, khi, klo, qual, valid):
     ukhi, uklo, hq, lq, uvalid = aggregate_kmers(khi, klo, qual, valid)
     # donate_argnums on merge_batch doesn't apply through this outer jit;
     # call the inner implementation directly.
-    return _probe_insert(state, meta, ukhi, uklo, hq, lq, uvalid, raw=False)
+    st, full, _ = _probe_insert(state, meta, ukhi, uklo, hq, lq, uvalid,
+                                raw=False)
+    return st, full
 
 
 @functools.partial(jax.jit, static_argnums=(1,))

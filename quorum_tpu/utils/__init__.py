@@ -1,0 +1,1 @@
+from . import vlog, sizes  # noqa: F401

@@ -1,0 +1,14 @@
+"""Size-argument parsing with k/M/G/T suffixes (decimal, matching the
+yaggo `suffix` option used for -s, src/create_database_cmdline.yaggo and
+the driver's validation regex \\d+[kMGT] at src/quorum.in:92)."""
+
+from __future__ import annotations
+
+_SUFFIX = {"k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
+
+
+def parse_size(s: str) -> int:
+    s = s.strip()
+    if s and s[-1] in _SUFFIX:
+        return int(s[:-1]) * _SUFFIX[s[-1]]
+    return int(s)

@@ -1,0 +1,19 @@
+"""Timestamped verbose logging, gated by --verbose.
+
+Same surface as the reference's vlog (src/verbose_log.hpp:26-63):
+"[YYYY/MM/DD HH:MM:SS] message" on stderr when enabled.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+verbose = False
+
+
+def vlog(*parts) -> None:
+    if not verbose:
+        return
+    stamp = time.strftime("[%Y/%m/%d %H:%M:%S]")
+    print(stamp, "".join(str(p) for p in parts), file=sys.stderr, flush=True)

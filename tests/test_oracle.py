@@ -1,0 +1,165 @@
+"""Sanity tests for the oracle corrector: known scenarios with
+hand-derivable outcomes (clean read untouched, single error corrected
+and logged, unsupported tail truncated, anchor failure, homopolymer
+trim, window budget)."""
+
+import numpy as np
+import pytest
+
+from quorum_tpu.models.ec_config import ECConfig, ERROR_NO_STARTING_MER
+from quorum_tpu.models.oracle import DictDB, Kmer, OracleCorrector
+
+K = 15
+
+
+def make_db(genome, k=K, cov=30):
+    """Perfect high-quality coverage of every k-mer in the genome."""
+    d = {}
+    for i in range(len(genome) - k + 1):
+        m = Kmer(k)
+        for c in genome[i : i + k]:
+            m.shift_left("ACGT".index(c))
+        d[m.canonical()] = (cov, 1)
+    return DictDB(d, k)
+
+
+@pytest.fixture
+def genome():
+    rng = np.random.default_rng(5)
+    return "".join(rng.choice(list("ACGT"), size=600))
+
+
+def cfg(**kw):
+    return ECConfig(k=K, **kw)
+
+
+def test_clean_read_untouched(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    read = genome[50:150]
+    res = oc.correct(read, "I" * len(read))
+    assert res.ok
+    assert res.seq == read
+    assert res.fwd_log == "" and res.bwd_log == ""
+    assert res.start == 0 and res.end == 100
+
+
+def test_single_error_corrected(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    read = list(genome[50:150])
+    orig = read[60]
+    sub = {"A": "C", "C": "G", "G": "T", "T": "A"}[orig]
+    read[60] = sub
+    res = oc.correct("".join(read), "I" * len(read))
+    assert res.ok
+    assert res.seq == genome[50:150]
+    assert f"60:sub:{sub}-{orig}" in res.fwd_log
+    assert res.bwd_log == ""
+
+
+def test_error_before_anchor_corrected_backward(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    read = list(genome[50:150])
+    orig = read[5]
+    sub = {"A": "C", "C": "G", "G": "T", "T": "A"}[orig]
+    read[5] = sub
+    res = oc.correct("".join(read), "I" * len(read))
+    assert res.ok
+    assert res.seq == genome[50:150]
+    assert f"5:sub:{sub}-{orig}" in res.bwd_log
+    assert res.fwd_log == ""
+
+
+def test_garbage_tail_truncated(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    # genome prefix + random tail that matches nothing
+    rng = np.random.default_rng(9)
+    comp = {"A": "T", "C": "G", "G": "C", "T": "A"}
+    tail = "".join(comp[c] for c in genome[300:340][::-1])  # revcomp of a
+    # distant region reversed = unrelated sequence
+    read = genome[50:120] + tail[:30]
+    res = oc.correct(read, "I" * len(read))
+    assert res.ok
+    assert res.start == 0
+    # forward log must contain a 3' truncation event
+    assert "3_trunc" in res.fwd_log
+    # kept prefix must be a prefix of the genome region
+    assert genome[50:120].startswith(res.seq[:70][:5])
+    assert res.seq == genome[50 : 50 + len(res.seq)]
+
+
+def test_no_anchor(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    rng = np.random.default_rng(13)
+    junk = "".join(rng.choice(list("ACGT"), size=60))
+    res = oc.correct(junk, "I" * 60)
+    assert not res.ok
+    assert res.error == ERROR_NO_STARTING_MER
+
+
+def test_short_read_no_anchor(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    res = oc.correct(genome[50 : 50 + K], "I" * K)  # too short: skip=1
+    assert not res.ok
+
+
+def test_n_base_corrected(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg())
+    read = list(genome[50:150])
+    orig = read[60]
+    read[60] = "N"
+    res = oc.correct("".join(read), "I" * len(read))
+    assert res.ok
+    assert res.seq == genome[50:150]
+    assert f"60:sub:N-{orig}" in res.fwd_log
+
+
+def test_homo_trim(genome):
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg(homo_trim=10))
+    read = genome[50:120] + "A" * 30
+    res = oc.correct(read, "I" * len(read))
+    assert res.ok
+    # polyA tail trimmed; kept part is genome prefix
+    assert len(res.seq) <= 75
+    assert res.seq == genome[50 : 50 + len(res.seq)]
+
+
+def test_window_budget_truncates(genome):
+    """More than `error` corrections within `window` bases must rewind
+    and truncate (err_log.hpp:87-106)."""
+    db = make_db(genome)
+    oc = OracleCorrector(db, cfg(window=10, error=2))
+    read = list(genome[50:150])
+    # three errors clustered within a 6-base window
+    positions = [70, 72, 74]
+    origs = {}
+    for p in positions:
+        origs[p] = read[p]
+        read[p] = {"A": "C", "C": "G", "G": "T", "T": "A"}[read[p]]
+    res = oc.correct("".join(read), "I" * len(read))
+    assert res.ok
+    # the read must be truncated before position 74
+    assert res.end <= 74
+    assert "3_trunc" in res.fwd_log
+
+
+def test_paired_quality_semantics(genome):
+    """Low-quality-only k-mers don't anchor (get_val returns 0)."""
+    d = {}
+    for i in range(len(genome) - K + 1):
+        m = Kmer(K)
+        for c in genome[i : i + K]:
+            m.shift_left("ACGT".index(c))
+        d[m.canonical()] = (30, 0)  # high count but low quality
+    db = DictDB(d, K)
+    oc = OracleCorrector(db, cfg())
+    res = oc.correct(genome[50:150], "I" * 100)
+    assert not res.ok
+    assert res.error == ERROR_NO_STARTING_MER
