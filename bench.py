@@ -1,0 +1,82 @@
+"""Benchmark: end-to-end device throughput vs the reference baseline.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's headline claim of 48 Gbases/hour for
+correction on 48 threads (paper/bmc_article.tex:199; BASELINE.md).
+
+Until the batched corrector lands, measures the stage-1 database-build
+throughput; afterwards it measures the full correct path.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_GBASES_PER_HOUR = 48.0
+
+
+def synth_reads(rng, n_reads, read_len, genome_len=200_000, err_rate=0.01):
+    """Reads sampled from a random genome with substitution errors —
+    shaped like real Illumina input so hash-table load is realistic."""
+    genome = rng.integers(0, 4, size=genome_len, dtype=np.int8)
+    starts = rng.integers(0, genome_len - read_len, size=n_reads)
+    idx = starts[:, None] + np.arange(read_len)[None, :]
+    codes = genome[idx]
+    errs = rng.random(codes.shape) < err_rate
+    codes = np.where(errs, (codes + rng.integers(1, 4, size=codes.shape)) % 4,
+                     codes).astype(np.int8)
+    quals = rng.integers(35, 74, size=codes.shape).astype(np.uint8)
+    quals[errs] = 33
+    return codes, quals
+
+
+def bench_stage1(batch=16384, read_len=150, n_batches=8, k=24):
+    import jax
+    import jax.numpy as jnp
+    from quorum_tpu.ops import table
+    from quorum_tpu.models.create_database import extract_observations
+
+    rng = np.random.default_rng(0)
+    meta = table.TableMeta(k=k, bits=7,
+                           size_log2=table.required_size_log2(
+                               4 * batch * read_len))
+    state = table.make_table(meta)
+
+    batches = [synth_reads(rng, batch, read_len) for _ in range(2)]
+    dev_batches = [(jnp.asarray(c), jnp.asarray(q)) for c, q in batches]
+
+    def step(state, codes, quals):
+        chi, clo, qb, valid = extract_observations(codes, quals, k, 53)
+        u = table.aggregate_kmers(chi, clo, qb, valid)
+        state, full, _ = table._probe_insert(state, meta, *u, raw=False)
+        return state, full
+
+    step = jax.jit(step, donate_argnums=(0,))
+    state, _ = step(state, *dev_batches[0])  # compile + warm
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        state, full = step(state, *dev_batches[i % 2])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    bases = n_batches * batch * read_len
+    return bases / dt
+
+
+def main():
+    bases_per_s = bench_stage1()
+    gb_per_h = bases_per_s * 3600 / 1e9
+    print(json.dumps({
+        "metric": "stage1_db_build_throughput",
+        "value": round(gb_per_h, 3),
+        "unit": "Gbases/hour",
+        "vs_baseline": round(gb_per_h / BASELINE_GBASES_PER_HOUR, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,14 +38,14 @@ class BuildConfig:
     max_grows: int = 16
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def extract_observations(codes_i8, quals_u8, k: int, qual_thresh: int):
+def extract_observations_impl(codes_i8, quals_u8, k: int, qual_thresh: int):
     """codes/quals [B, L] -> flat canonical k-mer observations.
 
     Returns (chi, clo, qualbit, valid), each [B*L]. qualbit is 1 iff all
     k bases of the window have quality >= qual_thresh (high_len >= k,
     create_database.cc:80-86); valid iff the window holds k consecutive
-    ACGT bases.
+    ACGT bases. Unjitted so the sharded build can call it under
+    shard_map; use `extract_observations` elsewhere.
     """
     codes = codes_i8.astype(jnp.int32)
     B, L = codes.shape
@@ -56,6 +56,10 @@ def extract_observations(codes_i8, quals_u8, k: int, qual_thresh: int):
     last_reset = jax.lax.cummax(jnp.where(reset, pos, -1), axis=1)
     qualbit = ((pos - last_reset) >= k).astype(jnp.int32)
     return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
+
+
+extract_observations = jax.jit(extract_observations_impl,
+                               static_argnums=(2, 3))
 
 
 _aggregate = jax.jit(table.aggregate_kmers)

@@ -269,11 +269,12 @@ def add_kmer_batch(state: TableState, meta: TableMeta, khi, klo, qual, valid):
     return st, full
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def lookup(state: TableState, meta: TableMeta, khi, klo):
+def _lookup_impl(state: TableState, meta: TableMeta, khi, klo, active=None):
     """Batched query: value word (0 if absent) per canonical k-mer.
     The device boundary named in SURVEY §2.1 (database_query::operator[],
-    src/mer_database.hpp:284-293) — gather + probe walk over the batch."""
+    src/mer_database.hpp:284-293) — gather + probe walk over the batch.
+    Lanes with ``active=False`` probe zero times and return 0 (used by
+    the sharded ring query and the masked corrector steps)."""
     size = meta.size
     mask = jnp.uint32(size - 1)
     n = khi.shape[0]
@@ -298,13 +299,19 @@ def lookup(state: TableState, meta: TableMeta, khi, klo):
         noff = jnp.where(active & ~ndone, off + 1, off)
         return (ndone, probe + 1, noff, res)
 
-    done0 = jnp.zeros((n,), dtype=bool)
+    done0 = (jnp.zeros((n,), dtype=bool) if active is None
+             else jnp.logical_not(active))
     off0 = jnp.zeros((n,), dtype=jnp.uint32)
     res0 = jnp.zeros((n,), dtype=jnp.uint32)
     _, _, _, res = jax.lax.while_loop(
         cond, body, (done0, jnp.int32(0), off0, res0)
     )
     return res
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def lookup(state: TableState, meta: TableMeta, khi, klo):
+    return _lookup_impl(state, meta, khi, klo)
 
 
 def decode_val(v):

@@ -1,0 +1,1 @@
+from . import sharded  # noqa: F401

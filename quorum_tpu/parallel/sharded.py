@@ -1,0 +1,321 @@
+"""Multi-chip sharded k-mer table: build and query over a device mesh.
+
+The reference is single-node shared-memory (SURVEY §2.4): N pthreads
+hammer one hash with CAS. The TPU-native scale-out replaces that with a
+**hash-prefix sharded table** over a 1-D `jax.sharding.Mesh` axis
+("shards"): shard `s` owns every k-mer whose 32-bit hash has top
+``log2(n_shards)`` bits equal to ``s``; the low bits index the local
+open-addressing table. Reads are data-parallel over the same axis.
+
+Communication pattern (rides ICI, no host involvement):
+
+* **Build**: each shard 2-bit-encodes and aggregates its own read
+  sub-batch locally (sort + segment-sum), then the aggregates circulate
+  the ring via `lax.ppermute`; at each of the ``n`` steps a shard merges
+  the keys it owns from the visiting buffer. After ``n`` steps every
+  observation has reached its owner exactly once. This is the TPU
+  analogue of the reference's "all threads insert into one shared hash"
+  (src/create_database.cc:86) with the CAS replaced by ring-scheduled
+  exclusive ownership.
+
+* **Query**: the query batch circulates the same ring; each shard
+  answers the lanes it owns (value word, 0 elsewhere) and the partial
+  results travel with the queries; after ``n`` steps each lane holds
+  its answer (OR-combine: exactly one shard can supply a nonzero word).
+
+Both are `shard_map`-ped single XLA programs; the per-shard table code
+is the same `_probe_insert`/`lookup` machinery as the single-chip path
+(quorum_tpu.ops.table), so single- and multi-chip semantics are pinned
+by the same unit tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import mer, table
+
+AXIS = "shards"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedMeta:
+    """Static geometry of a sharded table (hashable, jit-static)."""
+
+    k: int
+    bits: int
+    local_size_log2: int  # per-shard slots = 2**local_size_log2
+    n_shards: int
+    max_reprobe: int = 126
+
+    def __post_init__(self):
+        assert self.n_shards & (self.n_shards - 1) == 0, (
+            "n_shards must be a power of two"
+        )
+        assert self.local_size_log2 + self.owner_bits <= 32
+
+    @property
+    def owner_bits(self) -> int:
+        return (self.n_shards - 1).bit_length()
+
+    @property
+    def local(self) -> table.TableMeta:
+        return table.TableMeta(
+            k=self.k,
+            bits=self.bits,
+            size_log2=self.local_size_log2,
+            max_reprobe=self.max_reprobe,
+        )
+
+    @property
+    def global_size(self) -> int:
+        return self.n_shards << self.local_size_log2
+
+
+def owner_of(khi, klo, meta: ShardedMeta):
+    """Owning shard index of each key: top owner_bits of the hash.
+    Independent of the low bits used for the local slot (ops.table uses
+    hash & (local_size-1)), so no correlation between shard and slot."""
+    if meta.n_shards == 1:
+        return jnp.zeros_like(khi, dtype=jnp.uint32)
+    return table.hash_kmer(khi, klo) >> jnp.uint32(32 - meta.owner_bits)
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        # single real TPU chip + virtual CPU mesh for sharding tests
+        # (the driver's dryrun sets xla_force_host_platform_device_count)
+        devs = jax.devices("cpu")
+    assert len(devs) >= n_devices, (
+        f"need {n_devices} devices, have {len(devs)}"
+    )
+    return Mesh(np.array(devs[:n_devices]), (AXIS,))
+
+
+def make_sharded_table(meta: ShardedMeta, mesh: Mesh) -> table.TableState:
+    """Allocate the table sharded over the mesh: global arrays of length
+    n_shards * local_size, dimension 0 split across shards."""
+    sharding = NamedSharding(mesh, P(AXIS))
+    z = functools.partial(jnp.zeros, (meta.global_size,), dtype=jnp.uint32)
+    make = jax.jit(lambda: table.TableState(z(), z(), z()),
+                   out_shardings=sharding)
+    return make()
+
+
+# ---------------------------------------------------------------------------
+# Build: DP extract + ring merge
+# ---------------------------------------------------------------------------
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _build_shard_fn(meta: ShardedMeta, qual_thresh: int):
+    """Per-shard body (runs under shard_map). Arguments are the local
+    blocks plus a per-lane `pending` mask (aligned with the shard's
+    deterministic aggregate order); returns (new local table, full flag,
+    placed mask in the same order). The placed mask travels the ring
+    with its buffer and arrives back home after n rounds, so the host
+    can grow the table and retry exactly the unplaced keys —
+    preserving the single-chip path's exact-once contract
+    (models/create_database.build_database)."""
+    n = meta.n_shards
+    local = meta.local
+
+    def fn(keys_hi, keys_lo, vals, codes_i8, quals_u8, pending):
+        from ..models.create_database import extract_observations_impl
+
+        me = lax.axis_index(AXIS).astype(jnp.uint32)
+        chi, clo, qualbit, valid = extract_observations_impl(
+            codes_i8, quals_u8, meta.k, qual_thresh
+        )
+        ukhi, uklo, hq, lq, uvalid = table.aggregate_kmers(
+            chi, clo, qualbit, valid
+        )
+        uvalid = uvalid & pending
+
+        st = table.TableState(keys_hi, keys_lo, vals)
+        full = jnp.zeros((), dtype=bool)
+        placed0 = jnp.zeros_like(uvalid)
+
+        def ring_round(r, carry):
+            st, khi, klo, hq, lq, vld, placed, full = carry
+            mine = vld & (owner_of(khi, klo, meta) == me)
+            st, f, pl = table._probe_insert(st, local, khi, klo, hq, lq,
+                                            mine, raw=False)
+            placed = placed | pl
+            perm = _ring_perm(n)
+            khi, klo, vld, placed = (lax.ppermute(x, AXIS, perm)
+                                     for x in (khi, klo, vld, placed))
+            hq, lq = (lax.ppermute(x, AXIS, perm) for x in (hq, lq))
+            return (st, khi, klo, hq, lq, vld, placed, full | f)
+
+        carry = (st, ukhi, uklo, hq, lq, uvalid, placed0, full)
+        if n == 1:
+            carry = ring_round(0, carry)
+        else:
+            # after n ppermutes the buffer (and its placed mask) is home
+            carry = lax.fori_loop(0, n, ring_round, carry)
+        st, placed, full = carry[0], carry[-2], carry[-1]
+        # every shard must agree on fullness so the host can react
+        full = lax.pmax(full.astype(jnp.int32), AXIS) > 0
+        return st.keys_hi, st.keys_lo, st.vals, full, placed
+
+    return fn
+
+
+def build_step(mesh: Mesh, meta: ShardedMeta, qual_thresh: int):
+    """Compile the sharded build step.
+
+    Returns f(state, codes_i8[B,L], quals_u8[B,L], pending[B*L])
+    -> (state, full, placed[B*L]) with state arrays sharded P('shards')
+    and the read batch sharded on dim 0 (B divisible by n_shards).
+    `pending` masks the per-shard aggregate lanes (deterministic given
+    the batch): pass ones for a fresh batch, `~placed` for a retry
+    after grow().
+    """
+    fn = _build_shard_fn(meta, qual_thresh)
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS, None),
+                  P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS)),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: table.TableState, codes_i8, quals_u8, pending):
+        kh, kl, v, full, placed = mapped(
+            state.keys_hi, state.keys_lo, state.vals, codes_i8, quals_u8,
+            pending,
+        )
+        return table.TableState(kh, kl, v), full, placed
+
+    return step
+
+
+def grow_step(mesh: Mesh, meta: ShardedMeta):
+    """Compile the sharded grow: every shard doubles its local table and
+    re-scatters its own entries (owner bits are hash-prefix bits, so
+    keys never migrate between shards — no communication). Returns
+    f(state) -> new state for meta.local_size_log2 + 1."""
+    new_meta = dataclasses.replace(meta,
+                                   local_size_log2=meta.local_size_log2 + 1)
+    local_new = new_meta.local
+
+    def fn(keys_hi, keys_lo, vals):
+        st = table.TableState(
+            jnp.zeros((local_new.size,), dtype=jnp.uint32),
+            jnp.zeros((local_new.size,), dtype=jnp.uint32),
+            jnp.zeros((local_new.size,), dtype=jnp.uint32),
+        )
+        valid = vals != table.EMPTY_VAL
+        st, full, _ = table._probe_insert(st, local_new, keys_hi, keys_lo,
+                                          vals, vals, valid, raw=True)
+        del full  # doubling cannot fill up
+        return st.keys_hi, st.keys_lo, st.vals
+
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state: table.TableState):
+        return table.TableState(*mapped(state.keys_hi, state.keys_lo,
+                                        state.vals))
+
+    return step, new_meta
+
+
+def build_database_sharded(batches, mesh: Mesh, meta: ShardedMeta,
+                           qual_thresh: int, max_grows: int = 16):
+    """Host loop over read batches with grow-and-retry on full shards
+    (the multi-chip twin of models.create_database.build_database).
+    `batches` yields (codes_i8[B, L], quals_u8[B, L]) device-ready
+    arrays. Returns (state, meta)."""
+    state = make_sharded_table(meta, mesh)
+    steps: dict[tuple, object] = {}
+    for codes, quals in batches:
+        key = (meta.local_size_log2, codes.shape[1])
+        if key not in steps:
+            steps[key] = build_step(mesh, meta, qual_thresh)
+        pending = jnp.ones((codes.size,), dtype=bool)
+        for _ in range(max_grows + 1):
+            state, full, placed = steps[key](state, codes, quals, pending)
+            if not bool(full):
+                break
+            pending = pending & jnp.logical_not(placed)
+            gstep, meta = grow_step(mesh, meta)
+            state = gstep(state)
+            key = (meta.local_size_log2, codes.shape[1])
+            if key not in steps:
+                steps[key] = build_step(mesh, meta, qual_thresh)
+        else:
+            raise RuntimeError("Hash is full")
+    return state, meta
+
+
+# ---------------------------------------------------------------------------
+# Query: ring-rotated lookup
+# ---------------------------------------------------------------------------
+
+def _query_shard_fn(meta: ShardedMeta):
+    n = meta.n_shards
+    local = meta.local
+
+    def fn(keys_hi, keys_lo, vals, khi, klo):
+        me = lax.axis_index(AXIS).astype(jnp.uint32)
+        st = table.TableState(keys_hi, keys_lo, vals)
+
+        def ring_round(r, carry):
+            khi, klo, res = carry
+            mine = owner_of(khi, klo, meta) == me
+            ans = table._lookup_impl(st, local, khi, klo, mine)
+            res = res | ans
+            perm = _ring_perm(n)
+            khi, klo, res = (lax.ppermute(x, AXIS, perm)
+                             for x in (khi, klo, res))
+            return (khi, klo, res)
+
+        res0 = jnp.zeros_like(khi)
+        carry = (khi, klo, res0)
+        if n == 1:
+            carry = ring_round(0, carry)
+        else:
+            # n rounds brings each lane's partial result back home
+            carry = lax.fori_loop(0, n, ring_round, carry)
+        return carry[2]
+
+    return fn
+
+
+def query_step(mesh: Mesh, meta: ShardedMeta):
+    """Compile the sharded lookup: f(state, khi[N], klo[N]) -> vals[N],
+    with queries sharded on dim 0 (their issuing shard) and results
+    returned to the same layout."""
+    fn = _query_shard_fn(meta)
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=P(AXIS),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state: table.TableState, khi, klo):
+        return mapped(state.keys_hi, state.keys_lo, state.vals, khi, klo)
+
+    return step

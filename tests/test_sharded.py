@@ -1,0 +1,119 @@
+"""Multi-chip sharded table: build + query on a virtual CPU mesh must
+agree with the single-chip path (which is itself pinned against the
+reference semantics in test_table/test_create_database).
+
+The reference's "undersize to force resize" stress trick (SURVEY §4)
+translates here to "tiny local tables + several mesh shapes to force
+multi-shard routing"."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.ops import mer, table
+from quorum_tpu.parallel import sharded
+from quorum_tpu.models.create_database import extract_observations
+
+
+def _random_reads(rng, n, length):
+    codes = rng.integers(0, 4, size=(n, length)).astype(np.int8)
+    quals = rng.integers(33, 74, size=(n, length)).astype(np.uint8)
+    return codes, quals
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_sharded_build_matches_single_chip(n_shards):
+    k, bits, qt = 9, 7, 53
+    rng = np.random.default_rng(n_shards)
+    codes, quals = _random_reads(rng, 16, 80)
+
+    # single-chip truth
+    meta1 = table.TableMeta(k=k, bits=bits, size_log2=12)
+    st1 = table.make_table(meta1)
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), k, qt
+    )
+    st1, full = table.add_kmer_batch(st1, meta1, chi, clo, q, valid)
+    assert not bool(full)
+    occ = np.asarray(st1.vals) != 0
+    want = {}
+    kh, kl, vv = (np.asarray(a) for a in st1)
+    for h, l, v in zip(kh[occ], kl[occ], vv[occ]):
+        want[(int(h), int(l))] = int(v)
+
+    # sharded build
+    mesh = sharded.make_mesh(n_shards)
+    smeta = sharded.ShardedMeta(k=k, bits=bits, local_size_log2=12,
+                                n_shards=n_shards)
+    sstate = sharded.make_sharded_table(smeta, mesh)
+    step = sharded.build_step(mesh, smeta, qual_thresh=qt)
+    pending = jnp.ones((codes.size,), dtype=bool)
+    sstate, full, placed = step(sstate, jnp.asarray(codes),
+                                jnp.asarray(quals), pending)
+    assert not bool(full)
+
+    got = {}
+    kh, kl, vv = (np.asarray(a) for a in sstate)
+    for h, l, v in zip(kh[vv != 0], kl[vv != 0], vv[vv != 0]):
+        got[(int(h), int(l))] = int(v)
+    assert got == want
+
+    # keys landed on their owning shards
+    local = 1 << smeta.local_size_log2
+    occ_idx = np.nonzero(vv != 0)[0]
+    owners = np.asarray(
+        sharded.owner_of(jnp.asarray(kh[occ_idx]), jnp.asarray(kl[occ_idx]),
+                         smeta)
+    )
+    assert np.array_equal(owners, occ_idx // local)
+
+    # sharded query answers every inserted key and misses absent ones
+    keys = sorted(want)
+    pad = (-len(keys)) % n_shards
+    qhi = np.array([h for h, _ in keys] + [0] * pad, dtype=np.uint32)
+    qlo = np.array([l for _, l in keys] + [0] * pad, dtype=np.uint32)
+    qstep = sharded.query_step(mesh, smeta)
+    res = np.asarray(qstep(sstate, jnp.asarray(qhi), jnp.asarray(qlo)))
+    for (key, r) in zip(keys, res):
+        assert want[key] == int(r)
+
+    absent_hi = jnp.full((n_shards,), 0x3FFFFFFF, jnp.uint32)
+    absent_lo = jnp.full((n_shards,), 0xFFFFFFFF, jnp.uint32)
+    assert np.all(np.asarray(qstep(sstate, absent_hi, absent_lo)) == 0)
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_sharded_grow_and_retry_exact_once(n_shards):
+    """Undersized local tables force the full->grow->retry path; final
+    contents must still match the single-chip truth exactly (the
+    reference's undersize-to-force-resize stress test, SURVEY §4,
+    translated to multi-chip)."""
+    k, bits, qt = 9, 7, 53
+    rng = np.random.default_rng(99)
+    codes, quals = _random_reads(rng, 16, 80)
+
+    meta1 = table.TableMeta(k=k, bits=bits, size_log2=12)
+    st1 = table.make_table(meta1)
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), k, qt
+    )
+    st1, full = table.add_kmer_batch(st1, meta1, chi, clo, q, valid)
+    assert not bool(full)
+    kh, kl, vv = (np.asarray(a) for a in st1)
+    occ = vv != 0
+    want = {(int(h), int(l)): int(v)
+            for h, l, v in zip(kh[occ], kl[occ], vv[occ])}
+
+    mesh = sharded.make_mesh(n_shards)
+    smeta = sharded.ShardedMeta(k=k, bits=bits, local_size_log2=4,
+                                n_shards=n_shards)
+    sstate, smeta = sharded.build_database_sharded(
+        [(jnp.asarray(codes), jnp.asarray(quals))], mesh, smeta, qt
+    )
+    assert smeta.local_size_log2 > 4  # growth actually happened
+    kh, kl, vv = (np.asarray(a) for a in sstate)
+    occ = vv != 0
+    got = {(int(h), int(l)): int(v)
+           for h, l, v in zip(kh[occ], kl[occ], vv[occ])}
+    assert got == want
