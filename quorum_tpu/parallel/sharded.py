@@ -27,12 +27,21 @@ Both are `shard_map`-ped single XLA programs; the per-shard table code
 is the same `_probe_insert`/`lookup` machinery as the single-chip path
 (quorum_tpu.ops.table), so single- and multi-chip semantics are pinned
 by the same unit tests.
+
+Scaling note: the ring circulates the *full* per-shard aggregate
+buffers for n rounds, so per-batch ICI traffic grows linearly with the
+shard count even though each shard consumes ~1/n of each visiting
+buffer. Fine for small meshes; for pod-scale meshes the planned
+optimization is an owner-bucketed `all_to_all` (each shard sends each
+other shard only the keys it owns) which makes total traffic
+shard-count-independent.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 
 import numpy as np
 import jax
@@ -40,7 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import mer, table
+from ..ops import table
 
 AXIS = "shards"
 
@@ -88,16 +97,27 @@ def owner_of(khi, klo, meta: ShardedMeta):
     return table.hash_kmer(khi, klo) >> jnp.uint32(32 - meta.owner_bits)
 
 
-def make_mesh(n_devices: int) -> Mesh:
-    devs = jax.devices()
-    if len(devs) < n_devices:
-        # single real TPU chip + virtual CPU mesh for sharding tests
-        # (the driver's dryrun sets xla_force_host_platform_device_count)
-        devs = jax.devices("cpu")
-    assert len(devs) >= n_devices, (
-        f"need {n_devices} devices, have {len(devs)}"
+def make_mesh(n_devices: int, devices=None) -> Mesh:
+    """1-D mesh over the first n accelerator devices. Pass `devices`
+    explicitly (tests/dryrun use jax.devices('cpu')) to control
+    placement. Without it, falls back to virtual CPU devices when the
+    accelerator count is short — with a loud warning, since a
+    production run landing on CPU silently would lose the speedup."""
+    if devices is None:
+        devices = jax.devices()
+        if len(devices) < n_devices:
+            warnings.warn(
+                f"only {len(devices)} accelerator device(s) available; "
+                f"building the {n_devices}-way mesh from host CPU devices "
+                "— expect no speedup",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            devices = jax.devices("cpu")
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)}"
     )
-    return Mesh(np.array(devs[:n_devices]), (AXIS,))
+    return Mesh(np.array(devices[:n_devices]), (AXIS,))
 
 
 def make_sharded_table(meta: ShardedMeta, mesh: Mesh) -> table.TableState:
@@ -221,20 +241,29 @@ def grow_step(mesh: Mesh, meta: ShardedMeta):
         valid = vals != table.EMPTY_VAL
         st, full, _ = table._probe_insert(st, local_new, keys_hi, keys_lo,
                                           vals, vals, valid, raw=True)
-        del full  # doubling cannot fill up
-        return st.keys_hi, st.keys_lo, st.vals
+        # Doubling shouldn't fill up, but if a probe chain ever exceeded
+        # max_reprobe during re-scatter, silently dropping entries would
+        # be data loss: surface it like the single-chip grow() does.
+        full = lax.pmax(full.astype(jnp.int32), AXIS) > 0
+        return st.keys_hi, st.keys_lo, st.vals, full
 
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
         check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step(state: table.TableState):
+        kh, kl, v, full = mapped(state.keys_hi, state.keys_lo, state.vals)
+        return table.TableState(kh, kl, v), full
+
     def step(state: table.TableState):
-        return table.TableState(*mapped(state.keys_hi, state.keys_lo,
-                                        state.vals))
+        st, full = _step(state)
+        if bool(full):  # pragma: no cover - doubling can't fill up
+            raise RuntimeError("Hash is full")
+        return st
 
     return step, new_meta
 

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from quorum_tpu.ops import mer, table
+from quorum_tpu.ops import table
 from quorum_tpu.parallel import sharded
 from quorum_tpu.models.create_database import extract_observations
 
