@@ -1,0 +1,664 @@
+"""Batched device corrector: stage 2 (`quorum_error_correct_reads`) as
+lockstep masked tensor programs.
+
+The reference corrects one read per thread with data-dependent control
+flow (src/error_correct_reads.cc: find_starting_mer :609-643, extend
+:384-565, err_log src/err_log.hpp). The TPU-native design runs a whole
+batch of reads in lockstep:
+
+* **Anchor phase** (`find_anchors`): rolling k-mers for every position
+  of every read are computed by one scan, their DB values fetched by one
+  batched lookup, and the reference's sequential anchor scan (k "good"
+  mers in a row, contaminant discard, N-resets) becomes a `lax.scan`
+  over positions with per-lane counters.
+
+* **Extension phase** (`extend`, one jit per direction): a
+  `lax.while_loop` advances every read one base per iteration. Each
+  iteration does the shifted-mer contaminant check, one batched
+  `get_best_alternatives` (4 lookups/lane), and — for lanes on the
+  ambiguous path — the 16-lookup continuation probe, all masked so
+  retired/finished lanes cost no probes. Per-lane edit logs (the
+  reference's err_log window machinery, including remove_last_window
+  rewind) live in fixed-size device buffers.
+
+Semantics are pinned to the pure-Python oracle (models/oracle.py),
+which is itself pinned to the reference binary (bug-compatibility
+standard: byte parity, including the int-overflow dead code at
+error_correct_reads.cc:520 and the inverted backward force_truncate of
+err_log.hpp:42-46). The device Poisson test computes in float32; the
+oracle mirrors it with poisson_dtype="float32".
+
+Direction convention follows the oracle: d=+1 extends 5'->3', d=-1
+extends 3'->5'; positions are raw 0-based read indices throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops import mer, table
+from ..ops.poisson import poisson_term
+from .ec_config import (
+    ECConfig,
+    ERROR_CONTAMINANT,
+    ERROR_HOMOPOLYMER,
+    ERROR_NO_STARTING_MER,
+)
+from .oracle import ReadResult
+
+# status codes per lane
+OK = 0
+ST_CONTAMINANT = 1
+ST_NO_ANCHOR = 2
+ST_HOMOPOLYMER = 3
+
+STATUS_ERRORS = {
+    ST_CONTAMINANT: ERROR_CONTAMINANT,
+    ST_NO_ANCHOR: ERROR_NO_STARTING_MER,
+    ST_HOMOPOLYMER: ERROR_HOMOPOLYMER,
+}
+
+# entry meta packing: bit0 type (0=sub, 1=trunc), bits1-3 from, bits4-6
+# to; from/to are base codes with 4 = 'N'
+_T_SUB = 0
+_T_TRUNC = 1
+_BASES = "ACGTN"
+
+
+class LogState(NamedTuple):
+    """Per-lane err_log state (err_log.hpp:22-106): entry count, window
+    start index, and the entry buffers (raw positions + packed meta)."""
+
+    n: jax.Array  # int32[B]
+    lwin: jax.Array  # int32[B]
+    pos: jax.Array  # int32[B, E]
+    meta: jax.Array  # int32[B, E]
+
+
+def make_log(b: int, maxe: int) -> LogState:
+    z = jnp.zeros((b,), jnp.int32)
+    return LogState(z, z, jnp.zeros((b, maxe), jnp.int32),
+                    jnp.zeros((b, maxe), jnp.int32))
+
+
+def _advance_lwin(pos_buf, n, lwin, back, guard, window: int, d: int):
+    """The while-advance of err_log::check_nb_error (err_log.hpp:89-92):
+    entry positions are monotone in direction order, so the first index
+    whose distance from `back` is within the window equals the count of
+    over-window entries (a prefix)."""
+    maxe = pos_buf.shape[1]
+    j = jnp.arange(maxe, dtype=jnp.int32)[None, :]
+    dist = d * (back[:, None] - pos_buf)
+    over = (j < n[:, None]) & (dist > window)
+    cnt = jnp.sum(over.astype(jnp.int32), axis=1)
+    return jnp.where(guard, jnp.maximum(lwin, cnt), lwin)
+
+
+def _log_append(log: LogState, mask, raw_pos, meta_val, window: int,
+                error: int, d: int):
+    """Append an entry for `mask` lanes and run check_nb_error.
+    Returns (log, trip) where trip = error budget exceeded."""
+    b = log.n.shape[0]
+    maxe = log.pos.shape[1]
+    lane = jnp.arange(b, dtype=jnp.int32)
+    # masked lanes scatter to index maxe, dropped as out-of-bounds
+    # (negative sentinels would *wrap*, silently hitting the last slot)
+    idx = jnp.where(mask, log.n, maxe)
+    pos_buf = log.pos.at[lane, idx].set(raw_pos, mode="drop")
+    meta_buf = log.meta.at[lane, idx].set(meta_val, mode="drop")
+    n = log.n + mask.astype(jnp.int32)
+    guard = mask & ((raw_pos > window) if d == 1 else (raw_pos < window))
+    lwin = _advance_lwin(pos_buf, n, log.lwin, raw_pos, guard, window, d)
+    trip = mask & ((n - lwin - 1) >= error)
+    return LogState(n, lwin, pos_buf, meta_buf), trip
+
+
+def _log_remove_last_window(log: LogState, mask, window: int, d: int):
+    """err_log::remove_last_window (err_log.hpp:97-106): erase entries
+    [lwin:], reset lwin, re-run check_nb_error. Returns (log, diff)
+    with diff in direction units (0 for unmasked lanes)."""
+    b = log.n.shape[0]
+    lane = jnp.arange(b, dtype=jnp.int32)
+    back = log.pos[lane, jnp.clip(log.n - 1, 0)]
+    at_lwin = log.pos[lane, jnp.clip(log.lwin, 0)]
+    diff = jnp.where(mask & (log.n > 0), d * (back - at_lwin), 0)
+    n = jnp.where(mask, jnp.where(log.n > 0, log.lwin, 0), log.n)
+    lwin = jnp.where(mask, 0, log.lwin)
+    nb = log.pos[lane, jnp.clip(n - 1, 0)]
+    guard = mask & (n > 0) & ((nb > window) if d == 1 else (nb < window))
+    lwin = _advance_lwin(log.pos, n, lwin, nb, guard, window, d)
+    return LogState(n, lwin, log.pos, log.meta), diff
+
+
+def _append_trunc(log: LogState, mask, cpos, window: int, error: int, d: int):
+    """log.truncation(cpos): the backward log records pos-1 in direction
+    units = raw+1 (error_correct_reads.hpp:170-172)."""
+    raw = cpos + (1 if d == -1 else 0)
+    meta_val = jnp.full_like(cpos, _T_TRUNC)
+    log, _ = _log_append(log, mask, raw, meta_val, window, error, d)
+    return log
+
+
+def _pack_sub(frm, to):
+    f = jnp.where(frm >= 0, frm, 4)
+    t = jnp.where(to >= 0, to, 4)
+    return _T_SUB | (f << 1) | (t << 4)
+
+
+# ---------------------------------------------------------------------------
+# Batched get_best_alternatives
+# ---------------------------------------------------------------------------
+
+def _gba(state, tmeta, fhi, flo, rhi, rlo, d: int, active):
+    """database_query::get_best_alternatives (src/mer_database.hpp:
+    302-329) for a [B] batch: counts of the 4 base-0 variants kept only
+    at the best quality level present; 4 table probes per lane, masked
+    by `active`. Returns (counts[B,4] int32, ucode, level, count)."""
+    k = tmeta.k
+    vhis, vlos = [], []
+    for i in range(4):
+        nfhi, nflo, nrhi, nrlo = mer.dir_replace0(
+            fhi, flo, rhi, rlo, mer.u32(i), d, k)
+        chi, clo = mer.canonical(nfhi, nflo, nrhi, nrlo)
+        vhis.append(chi)
+        vlos.append(clo)
+    chi = jnp.stack(vhis).ravel()  # [4B], variant-major
+    clo = jnp.stack(vlos).ravel()
+    act4 = jnp.tile(active, 4)
+    vals = table._lookup_impl(state, tmeta, chi, clo, act4)
+    vals = vals.reshape(4, -1).T  # [B, 4]
+    cnt = (vals >> 1).astype(jnp.int32)
+    q = (vals & 1).astype(jnp.int32)
+    present = cnt > 0
+    level = jnp.max(jnp.where(present, q, 0), axis=1)
+    counts = jnp.where(present & (q == level[:, None]), cnt, 0)
+    has = counts > 0
+    count = jnp.sum(has.astype(jnp.int32), axis=1)
+    ucode = jnp.zeros_like(count)
+    for i in range(4):
+        ucode = jnp.where(has[:, i], i, ucode)
+    return counts, ucode, level, count
+
+
+def _contam_hit(contam_state, contam_meta, fhi, flo, rhi, rlo, active):
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    v = table._lookup_impl(contam_state, contam_meta, chi, clo, active)
+    return active & (v != 0)
+
+
+# ---------------------------------------------------------------------------
+# Anchor phase
+# ---------------------------------------------------------------------------
+
+class AnchorResult(NamedTuple):
+    found: jax.Array  # bool[B]
+    status: jax.Array  # int32[B] (OK / ST_CONTAMINANT / ST_NO_ANCHOR)
+    start_off: jax.Array  # int32[B] first raw index after the anchor mer
+    fhi: jax.Array
+    flo: jax.Array
+    rhi: jax.Array
+    rlo: jax.Array
+    prev_count: jax.Array  # int32[B] get_val(anchor mer)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 6, 7))
+def find_anchors(state: table.TableState, tmeta: table.TableMeta,
+                 codes, lengths, cfg: ECConfig,
+                 contam_state, contam_meta, has_contam: bool
+                 ) -> AnchorResult:
+    """find_starting_mer (error_correct_reads.cc:609-643) over a batch.
+
+    The sequential build/check loop is equivalent to scanning all
+    positions p (last base of a window) in order: windows with k
+    consecutive ACGT bases starting at >= skip are "checked" while
+    p <= len-2; an N resets the good-run counter; contaminated windows
+    are skipped (counter unchanged) or kill the read. Anchor at the
+    first p where `good` consecutive checked mers had HQ count >=
+    anchor_count; start_off = p + 1."""
+    k = cfg.k
+    b, l = codes.shape
+    codes32 = codes.astype(jnp.int32)
+    fhi, flo, rhi, rlo, validk = mer.rolling_kmers(codes32, k)
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    p_idx = jnp.arange(l, dtype=jnp.int32)[None, :]
+    vw = validk & (p_idx >= cfg.skip + k - 1)
+    vals = table._lookup_impl(
+        state, tmeta, chi.ravel(), clo.ravel(), vw.ravel()
+    ).reshape(b, l)
+    val_hq = jnp.where((vals & 1) == 1, vals >> 1, 0).astype(jnp.int32)
+    if has_contam:
+        con = table._lookup_impl(
+            contam_state, contam_meta, chi.ravel(), clo.ravel(), vw.ravel()
+        ).reshape(b, l) != 0
+    else:
+        con = jnp.zeros((b, l), bool)
+    checked = vw & (p_idx <= (lengths[:, None] - 2))
+
+    # lax.scan over positions with per-lane counters
+    def scan_step(carry, x):
+        found, done, anchor_p, contam_flag = carry
+        vwp, chkp, valp, conp, p = x
+        is_checked = chkp & ~done
+        con_event = is_checked & conp & (not cfg.trim_contaminant)
+        contam_flag = contam_flag | con_event
+        done = done | con_event
+        upd = is_checked & ~conp & ~con_event
+        found = jnp.where(
+            upd, jnp.where(valp >= cfg.anchor_count, found + 1, 0), found)
+        hit = upd & (found >= cfg.good) & ~done
+        anchor_p = jnp.where(hit, p, anchor_p)
+        done = done | hit
+        found = jnp.where(~vwp & ~done, 0, found)
+        return (found, done, anchor_p, contam_flag), None
+
+    z = jnp.zeros((b,), jnp.int32)
+    fz = jnp.zeros((b,), bool)
+    xs = (vw.T, checked.T, val_hq.T, con.T,
+          jnp.arange(l, dtype=jnp.int32)[:, None] + jnp.zeros((l, b), jnp.int32))
+    (found, done, anchor_p, contam_flag), _ = jax.lax.scan(
+        scan_step, (z, fz, z, fz), xs)
+
+    anchor_found = done & ~contam_flag
+    status = jnp.where(anchor_found, OK,
+                       jnp.where(contam_flag, ST_CONTAMINANT, ST_NO_ANCHOR))
+    lane = jnp.arange(b, dtype=jnp.int32)
+    ap = jnp.clip(anchor_p, 0)
+    return AnchorResult(
+        anchor_found, status, anchor_p + 1,
+        fhi[lane, ap], flo[lane, ap], rhi[lane, ap], rlo[lane, ap],
+        val_hq[lane, ap],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Extension phase
+# ---------------------------------------------------------------------------
+
+class ExtendResult(NamedTuple):
+    out: jax.Array  # int32[B, L]
+    opos: jax.Array  # int32[B] one-past-last-written in direction d
+    status: jax.Array  # int32[B]
+    log: LogState
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 16, 17, 18))
+def extend(state: table.TableState, tmeta: table.TableMeta,
+           codes, quals, cfg: ECConfig,
+           out, fhi, flo, rhi, rlo, prev0, alive0,
+           pos0, end, status0,
+           contam_state, contam_meta, d: int, has_contam: bool):
+    """extend (error_correct_reads.cc:384-565) in lockstep over a batch.
+
+    Carries per-lane (mer, pos, opos, prev_count, alive, status, log)
+    through a while_loop; every iteration advances each live lane one
+    base. See module docstring for the branch structure."""
+    k = cfg.k
+    window = cfg.effective_window
+    error = cfg.effective_error
+    b, l = codes.shape
+    lane = jnp.arange(b, dtype=jnp.int32)
+    codes32 = codes.astype(jnp.int32)
+    quals32 = quals.astype(jnp.int32)
+    maxe = out.shape[1] + 2
+
+    def in_range(pos):
+        return (pos < end) if d == 1 else (pos > end)
+
+    def gather_code(arr, idx, mask):
+        safe = jnp.clip(idx, 0, l - 1)
+        v = jnp.take_along_axis(arr, safe[:, None], axis=1)[:, 0]
+        return jnp.where(mask, v, -1)
+
+    def take4(counts, idx):
+        safe = jnp.clip(idx, 0, 3)
+        return jnp.take_along_axis(counts, safe[:, None], axis=1)[:, 0]
+
+    def contam(fh, fl, rh, rl, mask):
+        if not has_contam:
+            return jnp.zeros_like(mask)
+        return _contam_hit(contam_state, contam_meta, fh, fl, rh, rl, mask)
+
+    def body(carry):
+        (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log) = carry
+        active = alive & in_range(pos)
+        cpos = pos
+        pos = jnp.where(active, pos + d, pos)
+
+        ori = gather_code(codes32, cpos, active)
+        qualc = jnp.where(active,
+                          gather_code(quals32, cpos, active), 0)
+
+        shift_code = mer.u32(jnp.maximum(ori, 0))
+        sfh, sfl, srh, srl = mer.dir_shift(fh, fl, rh, rl, shift_code, d, k)
+        fh = jnp.where(active, sfh, fh)
+        fl = jnp.where(active, sfl, fl)
+        rh = jnp.where(active, srh, rh)
+        rl = jnp.where(active, srl, rl)
+
+        # contaminant on the shifted mer (error_correct_reads.cc:401-407)
+        con1 = contam(fh, fl, rh, rl, active & (ori >= 0))
+        con1_trim = con1 if cfg.trim_contaminant else jnp.zeros_like(con1)
+        con1_err = con1 & ~con1_trim
+        log = _append_trunc(log, con1_trim, cpos, window, error, d)
+        status = jnp.where(con1_err, ST_CONTAMINANT, status)
+        alive = alive & ~con1
+        live = active & ~con1
+
+        counts, ucode, level, count = _gba(
+            state, tmeta, fh, fl, rh, rl, d, live)
+
+        # count == 0: truncate (cc:416-419)
+        t0 = live & (count == 0)
+        log = _append_trunc(log, t0, cpos, window, error, d)
+        alive = alive & ~t0
+        live = live & ~t0
+
+        # count == 1 (cc:421-430)
+        c1 = live & (count == 1)
+        prev = jnp.where(c1, take4(counts, ucode), prev)
+        sub1 = c1 & (ori != ucode)
+        nfh, nfl, nrh, nrl = mer.dir_replace0(
+            fh, fl, rh, rl, mer.u32(jnp.clip(ucode, 0)), d, k)
+        fh = jnp.where(c1, nfh, fh)
+        fl = jnp.where(c1, nfl, fl)
+        rh = jnp.where(c1, nrh, rh)
+        rl = jnp.where(c1, nrl, rl)
+        # log_substitution (cc:360-379): contaminant check on the
+        # substituted mer, then window-budget bookkeeping
+        con2 = contam(fh, fl, rh, rl, sub1)
+        con2_trim = con2 if cfg.trim_contaminant else jnp.zeros_like(con2)
+        con2_err = con2 & ~con2_trim
+        log = _append_trunc(log, con2_trim, cpos, window, error, d)
+        status = jnp.where(con2_err, ST_CONTAMINANT, status)
+        alive = alive & ~con2
+        sub1 = sub1 & ~con2
+        log, trip1 = _log_append(
+            log, sub1, cpos, _pack_sub(ori, ucode), window, error, d)
+        log, diff1 = _log_remove_last_window(log, trip1, window, d)
+        log = _append_trunc(log, trip1, cpos - d * diff1, window, error, d)
+        opos = jnp.where(trip1, opos - d * diff1, opos)
+        alive = alive & ~trip1
+        write1 = c1 & ~con2 & ~trip1
+
+        # count > 1 (cc:432-561)
+        cm = live & (count > 1)
+        c_ori = jnp.where(cm & (ori >= 0), take4(counts, ori), 0)
+        ori_hi = cm & (ori >= 0) & (c_ori > cfg.min_count)
+        keep_cut = ori_hi & ((c_ori >= cfg.cutoff)
+                             | (qualc >= cfg.qual_cutoff))
+        p_lam = (jnp.sum(counts, axis=1).astype(jnp.float32)
+                 * jnp.float32(cfg.collision_prob))
+        prob = poisson_term(p_lam, c_ori)
+        keep_poi = ori_hi & ~keep_cut & (prob < cfg.poisson_threshold)
+        keep_simple = keep_cut | keep_poi
+        t_a = cm & (ori >= 0) & ~ori_hi & (level == 0) & (c_ori == 0)
+        t_b = cm & (ori < 0) & (level == 0)
+        log = _append_trunc(log, t_a | t_b, cpos, window, error, d)
+        alive = alive & ~(t_a | t_b)
+        ambig = cm & ~keep_simple & ~t_a & ~t_b
+
+        # continuation probe (cc:473-507): for each eligible variant,
+        # does any base extend it at the same-or-better level?
+        read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
+        chis, clos = [], []
+        for i in range(4):
+            ifh, ifl, irh, irl = mer.dir_replace0(
+                fh, fl, rh, rl, mer.u32(i), d, k)
+            ifh, ifl, irh, irl = mer.dir_shift(
+                ifh, ifl, irh, irl, mer.u32(0), d, k)
+            for j in range(4):
+                jfh, jfl, jrh, jrl = mer.dir_replace0(
+                    ifh, ifl, irh, irl, mer.u32(j), d, k)
+                chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
+                chis.append(chi)
+                clos.append(clo)
+        elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
+                          for i in range(4)], axis=1)  # [B, 4]
+        act16 = jnp.repeat(elig.T, 4, axis=0).reshape(-1)  # [16B] i-major
+        nvals = table._lookup_impl(
+            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+            act16,
+        ).reshape(4, 4, b)  # [i, j, B]
+        ncnt = (nvals >> 1).astype(jnp.int32)
+        nq = (nvals & 1).astype(jnp.int32)
+        npresent = ncnt > 0
+        nlevel = jnp.max(jnp.where(npresent, nq, 0), axis=1)  # [i, B]
+        ncounts = jnp.where(npresent & (nq == nlevel[:, None, :]), ncnt, 0)
+        ncount = jnp.sum((ncounts > 0).astype(jnp.int32), axis=1)  # [i, B]
+
+        succ = jnp.stack([
+            elig[:, i] & (ncount[i] > 0) & (nlevel[i] >= level)
+            for i in range(4)], axis=1)  # [B, 4]
+        cont_counts = jnp.where(succ, counts, 0)
+        safe_nb = jnp.clip(read_nbase, 0, 3)
+        cwn = jnp.stack([
+            succ[:, i] & (read_nbase >= 0)
+            & (ncounts[i][safe_nb, lane] > 0)
+            for i in range(4)], axis=1)  # [B, 4]
+
+        check_code = jnp.where(ambig, ori, 0)
+        for i in range(4):
+            check_code = jnp.where(elig[:, i], i, check_code)
+        success = ambig & jnp.any(succ, axis=1)
+
+        # tie-break chain (cc:509-545). prev_count <= min_count takes
+        # the int-overflow dead-code path: no candidate ever matches.
+        prev_ok = prev > cfg.min_count
+        diffs = jnp.abs(cont_counts - prev[:, None])
+        min_diff = jnp.min(
+            jnp.where(cont_counts > 0, diffs, jnp.int32(2**31 - 1)), axis=1)
+        cand = success[:, None] & prev_ok[:, None] & (diffs == min_diff[:, None])
+        ncand = jnp.sum(cand.astype(jnp.int32), axis=1)
+        cc2 = jnp.full((b,), -1, jnp.int32)
+        for i in range(4):
+            cc2 = jnp.where(cand[:, i], i, cc2)
+        tie = (ncand > 1) & (read_nbase >= 0)
+        ncand = jnp.where(tie, jnp.sum((cand & cwn).astype(jnp.int32), axis=1),
+                          ncand)
+        for i in range(4):
+            cc2 = jnp.where(tie & cand[:, i] & cwn[:, i], i, cc2)
+        cc2 = jnp.where(ncand != 1, -1, cc2)
+        check_code = jnp.where(success, cc2, check_code)
+
+        sub2 = success & (check_code >= 0) & (check_code != ori)
+        nfh, nfl, nrh, nrl = mer.dir_replace0(
+            fh, fl, rh, rl, mer.u32(jnp.clip(check_code, 0)), d, k)
+        do_rep = success & (check_code >= 0)
+        fh = jnp.where(do_rep, nfh, fh)
+        fl = jnp.where(do_rep, nfl, fl)
+        rh = jnp.where(do_rep, nrh, rh)
+        rl = jnp.where(do_rep, nrl, rl)
+        con3 = contam(fh, fl, rh, rl, sub2)
+        con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
+        con3_err = con3 & ~con3_trim
+        log = _append_trunc(log, con3_trim, cpos, window, error, d)
+        status = jnp.where(con3_err, ST_CONTAMINANT, status)
+        alive = alive & ~con3
+        sub2 = sub2 & ~con3
+        log, trip2 = _log_append(
+            log, sub2, cpos, _pack_sub(ori, check_code), window, error, d)
+        log, diff2 = _log_remove_last_window(log, trip2, window, d)
+        log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d)
+        opos = jnp.where(trip2, opos - d * diff2, opos)
+        alive = alive & ~trip2
+
+        # N base with no good substitution: truncate (cc:553-556)
+        t_c = ambig & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
+        log = _append_trunc(log, t_c, cpos, window, error, d)
+        alive = alive & ~t_c
+
+        write_m = (ambig | keep_simple) & alive & active
+        write = write1 | write_m
+        base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
+        # out-of-range positive sentinel: dropped (negative would wrap)
+        widx = jnp.where(write, opos, l)
+        outb = outb.at[lane, widx].set(base0, mode="drop")
+        opos = jnp.where(write, opos + d, opos)
+
+        return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log)
+
+    def cond(carry):
+        (_, _, _, _, pos, _, _, alive, _, _, _) = carry
+        return jnp.any(alive & in_range(pos))
+
+    log0 = make_log(b, maxe)
+    carry = (fhi, flo, rhi, rlo, pos0, pos0, prev0, alive0, status0, out,
+             log0)
+    carry = jax.lax.while_loop(cond, body, carry)
+    (_, _, _, _, _, opos, _, _, status, outb, log) = carry
+    return ExtendResult(outb, opos, status, log)
+
+
+# ---------------------------------------------------------------------------
+# Batch glue + host finishing
+# ---------------------------------------------------------------------------
+
+class BatchResult(NamedTuple):
+    """Device-side result of correcting one batch."""
+
+    out: jax.Array  # int32[B, L] corrected base codes
+    start: jax.Array  # int32[B] first kept index (5_trunc)
+    end: jax.Array  # int32[B] one past last kept index (3_trunc)
+    status: jax.Array  # int32[B]
+    fwd_log: LogState
+    bwd_log: LogState
+
+
+def _dummy_contam(k: int):
+    meta = table.TableMeta(k=k, bits=1, size_log2=4)
+    return table.make_table(meta), meta
+
+
+def correct_batch(state: table.TableState, tmeta: table.TableMeta,
+                  codes, quals, lengths, cfg: ECConfig,
+                  contam=None) -> BatchResult:
+    """Correct a batch of reads on device. `contam` is an optional
+    (TableState, TableMeta) k-mer membership set (value word != 0).
+    Mirrors error_correct_instance::start (error_correct_reads.cc:
+    246-341): anchor, forward extend, backward extend."""
+    codes = jnp.asarray(codes, jnp.int32)
+    quals = jnp.asarray(quals, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    has_contam = contam is not None
+    cstate, cmeta = contam if has_contam else _dummy_contam(cfg.k)
+    if has_contam and cmeta.k != cfg.k:
+        raise ValueError(
+            f"Contaminant mer length ({cmeta.k}) different than correction "
+            f"mer length ({cfg.k})")
+
+    anc = find_anchors(state, tmeta, codes, lengths, cfg,
+                       cstate, cmeta, has_contam)
+    b = codes.shape[0]
+    out0 = codes
+    fwd = extend(state, tmeta, codes, quals, cfg, out0,
+                 anc.fhi, anc.flo, anc.rhi, anc.rlo,
+                 anc.prev_count, anc.found,
+                 anc.start_off, lengths, anc.status,
+                 cstate, cmeta, 1, has_contam)
+    bwd_alive = anc.found & (fwd.status == OK)
+    bpos0 = anc.start_off - cfg.k - 1
+    bend = jnp.full((b,), -1, jnp.int32)
+    bwd = extend(state, tmeta, codes, quals, cfg, fwd.out,
+                 anc.fhi, anc.flo, anc.rhi, anc.rlo,
+                 anc.prev_count, bwd_alive,
+                 bpos0, bend, fwd.status,
+                 cstate, cmeta, -1, has_contam)
+    return BatchResult(bwd.out, bwd.opos + 1, fwd.opos, bwd.status,
+                       fwd.log, bwd.log)
+
+
+def _render_entries(pos, meta, n, trunc_string: str) -> str:
+    parts = []
+    for j in range(n):
+        m = int(meta[j])
+        if m & 1:
+            parts.append(f"{int(pos[j])}:{trunc_string}")
+        else:
+            frm = (m >> 1) & 7
+            to = (m >> 4) & 7
+            parts.append(f"{int(pos[j])}:sub:{_BASES[frm]}-{_BASES[to]}")
+    return " ".join(parts)
+
+
+def _homo_trim_np(out, start, end, ok, homo_trim_val: int):
+    """Vectorized homo_trim (error_correct_reads.cc:567-597): walking
+    from the 3' end, score +1 per repeated base, -1 per change; trim at
+    the highest-scoring position (largest position wins ties) if the
+    max score reaches the threshold. Returns (trim_mask, max_pos)."""
+    b, l = out.shape
+    q = np.arange(l - 1)[None, :]
+    t = np.where((q >= start[:, None]) & (q <= end[:, None] - 2),
+                 2 * (out[:, :-1] == out[:, 1:]).astype(np.int64) - 1, 0)
+    scores = np.flip(np.cumsum(np.flip(t, 1), 1), 1)  # S[p] = sum t[p:]
+    valid = (q >= start[:, None]) & (q <= end[:, None] - 2) & ok[:, None]
+    neg = np.int64(-(2**62))
+    masked = np.where(valid, scores, neg)
+    max_score = masked.max(axis=1)
+    has = valid.any(axis=1)
+    is_max = valid & (masked == max_score[:, None])
+    max_pos = np.where(has,
+                       np.where(is_max, q, -1).max(axis=1), -1)
+    trim = has & (max_score >= homo_trim_val)
+    return trim, max_pos
+
+
+def finish_batch(res: BatchResult, n: int, cfg: ECConfig
+                 ) -> list[ReadResult]:
+    """Host post-processing: optional homo-trim, log rendering, and
+    ReadResult assembly (same shape as the oracle's results)."""
+    out = np.asarray(res.out)
+    start = np.asarray(res.start).copy()
+    end = np.asarray(res.end).copy()
+    status = np.asarray(res.status).copy()
+    f_n = np.asarray(res.fwd_log.n).copy()
+    f_pos = np.asarray(res.fwd_log.pos).copy()
+    f_meta = np.asarray(res.fwd_log.meta).copy()
+    b_n = np.asarray(res.bwd_log.n).copy()
+    b_pos = np.asarray(res.bwd_log.pos).copy()
+    b_meta = np.asarray(res.bwd_log.meta).copy()
+
+    extra_fwd: dict[int, list[tuple[int, int]]] = {}
+    if cfg.do_homo_trim:
+        ok = status[:n] == OK
+        trim, max_pos = _homo_trim_np(out[:n], start[:n], end[:n], ok,
+                                      cfg.homo_trim)
+        for i in np.nonzero(trim)[0]:
+            mp = int(max_pos[i])
+            if mp < start[i]:  # pragma: no cover - dead in the binary too
+                status[i] = ST_HOMOPOLYMER
+                continue
+            # force_truncate, binary parity (see oracle module
+            # docstring): forward drops raw >= pos, backward raw <= pos
+            keep = f_pos[i, : f_n[i]] < mp
+            f_pos[i, : keep.sum()] = f_pos[i, : f_n[i]][keep]
+            f_meta[i, : keep.sum()] = f_meta[i, : f_n[i]][keep]
+            f_n[i] = keep.sum()
+            bkeep = b_pos[i, : b_n[i]] > mp
+            b_pos[i, : bkeep.sum()] = b_pos[i, : b_n[i]][bkeep]
+            b_meta[i, : bkeep.sum()] = b_meta[i, : b_n[i]][bkeep]
+            b_n[i] = bkeep.sum()
+            extra_fwd[int(i)] = [(mp, _T_TRUNC)]
+            end[i] = mp
+
+    results: list[ReadResult] = []
+    for i in range(n):
+        st = int(status[i])
+        if st != OK:
+            results.append(ReadResult(False, STATUS_ERRORS[st]))
+            continue
+        s, e = int(start[i]), int(end[i])
+        seq_codes = out[i, s:e]
+        seq = mer.codes_to_seq(seq_codes) if e > s else ""
+        fwd_s = _render_entries(f_pos[i], f_meta[i], int(f_n[i]), "3_trunc")
+        if int(i) in extra_fwd:
+            extra = " ".join(f"{p}:3_trunc" for p, _ in extra_fwd[int(i)])
+            fwd_s = f"{fwd_s} {extra}" if fwd_s else extra
+        bwd_s = _render_entries(b_pos[i], b_meta[i], int(b_n[i]), "5_trunc")
+        results.append(ReadResult(True, "", seq, fwd_s, bwd_s, s, e))
+    return results

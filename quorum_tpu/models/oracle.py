@@ -9,16 +9,25 @@ read indices; direction-generic arithmetic replaces the reference's
 forward_/backward_ pointer-and-counter template machinery (d = +1 for
 5'->3', -1 for 3'->5').
 
-Known intentional deviation (documented): err_log::force_truncate's
-position filter uses the *raw* position comparison for both directions
-(the code comment in err_log.hpp:44 states raw comparison is intended;
-the reference's backward instantiation inherits an inverted operator>=
-and so drops the complement set for backward logs — we follow the
-stated intent).
+Bug-compatibility standard: byte-parity with the compiled reference
+binary, including behaviors its own comments call unintended. Two such
+behaviors are replicated deliberately:
+
+* err_log::force_truncate's position filter (err_log.hpp:42-46) uses
+  the counter's overloaded operator>=, which is inverted for
+  backward_counter (error_correct_reads.hpp:135-137). So for the
+  backward log, force_truncate(pos) drops entries with raw position
+  <= pos (entries *inside* the kept region) and keeps those beyond it
+  — the opposite of the comment's stated intent. We match the binary.
+
+* The int-overflow dead code in the ambiguous-substitution tie-break
+  (error_correct_reads.cc:520): when prev_count <= min_count the
+  "pick the largest count" intent never fires; see _extend below.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Callable
 
@@ -118,7 +127,7 @@ class Kmer:
 class DirLog:
     """err_log<T> with direction-generic raw positions
     (src/err_log.hpp:22-135; see module docstring for the
-    force_truncate deviation)."""
+    force_truncate binary-parity semantics)."""
 
     def __init__(self, d: int, window: int, error: int, trunc_string: str):
         self.d = d
@@ -154,7 +163,14 @@ class DirLog:
         return self.check_nb_error()
 
     def force_truncate(self, raw: int) -> bool:
-        self.entries = [e for e in self.entries if not e[1] >= raw]
+        # Binary parity: the remove_if predicate calls the counter's
+        # operator>=, inverted for backward (err_log.hpp:42-46 +
+        # error_correct_reads.hpp:135-137): forward drops raw >= pos,
+        # backward drops raw <= pos. See module docstring.
+        if self.d == 1:
+            self.entries = [e for e in self.entries if not e[1] >= raw]
+        else:
+            self.entries = [e for e in self.entries if not e[1] <= raw]
         self.lwin = 0
         return self.check_nb_error()
 
@@ -198,6 +214,9 @@ class OracleCorrector:
         self.cfg = cfg
         self.k = db.k
         self.contaminant = contaminant if contaminant is not None else set()
+        # branch-coverage counters: tests assert the adversarial inputs
+        # actually reach the paths they target (VERDICT r1 weak #3)
+        self.counters: dict[str, int] = collections.Counter()
 
     # -- db primitives ----------------------------------------------------
     def get_val(self, canon: int) -> int:
@@ -363,13 +382,18 @@ class OracleCorrector:
             counts, ucode, level, count = self.get_best_alternatives(m, d)
 
             if count == 0:
+                self.counters["trunc_count0"] += 1
                 log.truncation(cpos)
                 return opos
 
             if count == 1:
+                if ori != ucode:
+                    self.counters["count1_sub"] += 1
                 prev_count = counts[ucode]
                 res, diff = self._log_substitution(m, d, log, cpos, ori, ucode)
                 if res == "truncate":
+                    if diff > 0:
+                        self.counters["window_trip"] += 1
                     return opos - d * diff
                 if res == "error":
                     return None
@@ -380,24 +404,30 @@ class OracleCorrector:
             if ori >= 0:
                 if counts[ori] > cfg.min_count:
                     if counts[ori] >= cfg.cutoff or quals[cpos] >= cfg.qual_cutoff:
+                        self.counters["keep_cutoff_or_qual"] += 1
                         out[opos] = m.base0(d)
                         opos += d
                         continue
                     p = float(sum(counts)) * cfg.collision_prob
                     prob = self._poisson(p, counts[ori])
                     if prob < cfg.poisson_threshold:
+                        self.counters["keep_poisson"] += 1
                         out[opos] = m.base0(d)
                         opos += d
                         continue
+                    self.counters["poisson_rejected"] += 1
                 elif level == 0 and counts[ori] == 0:
+                    self.counters["trunc_lq_alts"] += 1
                     log.truncation(cpos)
                     return opos
             elif level == 0:
+                self.counters["trunc_n_lq"] += 1
                 log.truncation(cpos)
                 return opos
 
             # multiple alternatives: find those with a continuation at
             # the same-or-better level (error_correct_reads.cc:473-507)
+            self.counters["ambiguous"] += 1
             check_code = ori
             success = False
             cont_counts = [0, 0, 0, 0]
@@ -418,12 +448,15 @@ class OracleCorrector:
                     cont_counts[i] = counts[i]
 
             if success:
+                self.counters["ambig_success"] += 1
                 check_code = -1
                 _prev = (
                     _UINT32_MAX
                     if prev_count <= cfg.min_count
                     else prev_count
                 )
+                if prev_count <= cfg.min_count:
+                    self.counters["tiebreak_overflow_deadcode"] += 1
                 # Replicates the compiled reference exactly, including the
                 # int overflow at error_correct_reads.cc:520: min_diff is
                 # (int)std::abs((long)cont - (long)_prev_count), which for
@@ -446,6 +479,7 @@ class OracleCorrector:
                         ncand += 1
                         check_code = i
                 if ncand > 1 and read_nbase >= 0:
+                    self.counters["tiebreak_next_base"] += 1
                     for i in range(4):
                         if candidates[i]:
                             if not cont_with_next[i]:
@@ -455,6 +489,8 @@ class OracleCorrector:
                 if ncand != 1:
                     check_code = -1
                 if check_code >= 0:
+                    if check_code != ori:
+                        self.counters["ambig_sub"] += 1
                     res, diff = self._log_substitution(
                         m, d, log, cpos, ori, check_code
                     )
@@ -464,6 +500,7 @@ class OracleCorrector:
                         return None
 
             if ori < 0 and check_code < 0:
+                self.counters["trunc_n_no_sub"] += 1
                 log.truncation(cpos)
                 return opos
 

@@ -155,6 +155,40 @@ def canonical(fhi, flo, rhi, rlo):
     return jnp.where(take_f, fhi, rhi), jnp.where(take_f, flo, rlo)
 
 
+# ---------------------------------------------------------------------------
+# Direction-generic paired-lane ops (fwd + revcomp held together), the
+# device twin of kmer_t / forward_mer / backward_mer (src/kmer.hpp:11-116):
+# d=+1 walks 5'->3' (shift_left on fwd), d=-1 walks 3'->5'. "Base 0" is
+# the most recently shifted-in base in the direction of travel.
+# ---------------------------------------------------------------------------
+
+def dir_shift(fhi, flo, rhi, rlo, code_u32, d: int, k: int):
+    """Shift a new base into the direction of travel; the revcomp lanes
+    get the complement shifted the opposite way."""
+    if d == 1:
+        nfhi, nflo = shift_left(fhi, flo, code_u32, k)
+        nrhi, nrlo = shift_right(rhi, rlo, u32(3) - code_u32, k)
+    else:
+        nfhi, nflo = shift_right(fhi, flo, code_u32, k)
+        nrhi, nrlo = shift_left(rhi, rlo, u32(3) - code_u32, k)
+    return nfhi, nflo, nrhi, nrlo
+
+
+def dir_base0(fhi, flo, d: int, k: int):
+    """Code of the most recently shifted-in base (index 0 forward,
+    k-1 backward — src/kmer.hpp:75-103)."""
+    return get_base(fhi, flo, 0 if d == 1 else k - 1, k)
+
+
+def dir_replace0(fhi, flo, rhi, rlo, code_u32, d: int, k: int):
+    """Replace base 0 (direction d) in both lanes pairs."""
+    i = 0 if d == 1 else k - 1
+    ri = k - 1 - i
+    nfhi, nflo = set_base(fhi, flo, i, code_u32, k)
+    nrhi, nrlo = set_base(rhi, rlo, ri, u32(3) - code_u32, k)
+    return nfhi, nflo, nrhi, nrlo
+
+
 def rolling_kmers(codes, k: int):
     """All k-mer windows of a batch of code sequences, via one scan.
 

@@ -6,6 +6,7 @@ The reference's "undersize to force resize" stress trick (SURVEY §4)
 translates here to "tiny local tables + several mesh shapes to force
 multi-shard routing"."""
 
+import conftest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -43,7 +44,7 @@ def test_sharded_build_matches_single_chip(n_shards):
         want[(int(h), int(l))] = int(v)
 
     # sharded build
-    mesh = sharded.make_mesh(n_shards)
+    mesh = sharded.make_mesh(n_shards, devices=conftest.cpu_devices(n_shards))
     smeta = sharded.ShardedMeta(k=k, bits=bits, local_size_log2=12,
                                 n_shards=n_shards)
     sstate = sharded.make_sharded_table(smeta, mesh)
@@ -105,7 +106,7 @@ def test_sharded_grow_and_retry_exact_once(n_shards):
     want = {(int(h), int(l)): int(v)
             for h, l, v in zip(kh[occ], kl[occ], vv[occ])}
 
-    mesh = sharded.make_mesh(n_shards)
+    mesh = sharded.make_mesh(n_shards, devices=conftest.cpu_devices(n_shards))
     smeta = sharded.ShardedMeta(k=k, bits=bits, local_size_log2=4,
                                 n_shards=n_shards)
     sstate, smeta = sharded.build_database_sharded(
