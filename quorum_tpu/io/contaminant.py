@@ -1,0 +1,80 @@
+"""Contaminant k-mer set loading.
+
+The reference loads a Jellyfish `binary/binary_dumper` database into an
+in-memory mer set (contaminant_database, error_correct_reads.cc:66-99,
+:693-708) that the driver builds from a FASTA at compile time via
+`jellyfish count` (Makefile.am:50-56). The TPU build accepts:
+
+* a FASTA/FASTQ file of contaminant sequences — counted directly into a
+  small device table (membership only), covering both the driver's
+  documented `--contaminant FILE` surface (README.md "fasta or fastq
+  file of contaminant sequences") and removing the build-time jellyfish
+  dependency;
+* one of our own `binary/quorum_tpu_db` database files.
+
+Either way the result is a (TableState, TableMeta) whose value words
+are nonzero exactly for member k-mers; the device corrector fuses the
+membership probe into its lookup rounds. The k-match validation of
+error_correct_reads.cc:703-705 is enforced by the caller (correct_batch
+raises on mismatch) and double-checked here for DB files.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+
+from ..ops import table
+from . import db_format, fastq
+
+
+def _is_quorum_db(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            line = f.readline(1 << 16)
+        header = json.loads(line)
+        return header.get("format") == db_format.FORMAT
+    except (OSError, ValueError, UnicodeDecodeError):
+        return False
+
+
+def build_kmer_set(paths, k: int, size_log2: int = 16):
+    """Count every canonical k-mer of the given sequence files into a
+    membership table (value word nonzero for members), via the same
+    batched rolling-kmer device path as stage 1."""
+    from ..models.create_database import extract_observations
+
+    meta = table.TableMeta(k=k, bits=1, size_log2=size_log2)
+    state = table.make_table(meta)
+    for batch in fastq.batch_records(fastq.iter_records(list(paths)), 512):
+        # qual_thresh=0: every base counts as high quality; only window
+        # validity (k consecutive ACGT) matters for membership.
+        chi, clo, q, valid = extract_observations(
+            jnp.asarray(batch.codes), jnp.asarray(batch.quals), k, 0)
+        ukhi, uklo, hq, lq, uvalid = table.aggregate_kmers(chi, clo, q, valid)
+        pending = uvalid
+        for _ in range(16):
+            state, full, placed = table.merge_batch(
+                state, meta, ukhi, uklo, hq, lq, pending)
+            if not bool(full):
+                break
+            pending = jnp.logical_and(pending, jnp.logical_not(placed))
+            state, meta = table.grow(state, meta)
+        else:
+            raise RuntimeError("Hash is full")
+    return state, meta
+
+
+def load_contaminant(path: str, k: int):
+    """Load a contaminant k-mer set for correction at mer length k.
+    Returns (TableState, TableMeta). Raises ValueError on k mismatch
+    (reference message, error_correct_reads.cc:703-705)."""
+    if _is_quorum_db(path):
+        state, meta, _hdr = db_format.read_db(path, to_device=True)
+        if meta.k != k:
+            raise ValueError(
+                f"Contaminant mer length ({meta.k}) different than "
+                f"correction mer length ({k})")
+        return state, meta
+    return build_kmer_set([path], k)

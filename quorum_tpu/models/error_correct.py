@@ -1,0 +1,170 @@
+"""Stage 2 as a program: FASTQ in, corrected FASTA + skip log out.
+
+The orchestration that turns the batched device corrector
+(models/corrector.py) into `quorum_error_correct_reads`: database
+loading, auto Poisson cutoff, contaminant loading, the streaming
+read -> device -> writer pipeline, and the reference's exact output
+surfaces (error_correct_reads.cc: do_it :158-171, per-read output
+:246-341; formats documented in the reference README.md "Output
+format" section).
+
+Output contract (byte-compatible with the reference):
+  * `.fa` record: ``>header fwd_log bwd_log\\nseq\\n`` — the two edit
+    logs are space-separated ``pos:sub:X-Y`` / ``pos:3_trunc`` /
+    ``pos:5_trunc`` entries (err_log.hpp operator<< :111-135); both
+    spaces print even when a log is empty.
+  * `.log` record per skipped read: ``Skipped <header>: <reason>\\n``.
+  * `--no-discard`: skipped reads additionally emit ``>header\\nN\\n``
+    so mate pairing survives (error_correct_reads.cc:274-327).
+  * `-o PREFIX` writes ``PREFIX.fa``/``PREFIX.log`` (plus ``.gz`` when
+    gzipped); without it output goes to stdout and the log to stderr
+    (error_correct_reads.cc:133-155 open_file defaults).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip as gzip_mod
+import sys
+from typing import Sequence
+
+from ..io import contaminant as contaminant_mod
+from ..io import db_format, fastq
+from ..ops import table
+from ..ops.poisson import compute_poisson_cutoff
+from ..utils.pipeline import AsyncWriter, prefetch
+from ..utils.vlog import vlog
+from .corrector import correct_batch, finish_batch
+from .ec_config import ECConfig
+
+
+@dataclasses.dataclass
+class ECStats:
+    reads: int = 0
+    corrected: int = 0
+    skipped: int = 0
+    bases_in: int = 0
+    bases_out: int = 0
+    cutoff: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ECOptions:
+    """CLI-level options beyond ECConfig (yaggo surface,
+    src/error_correct_reads_cmdline.yaggo)."""
+
+    output: str | None = None  # -o prefix; None = stdout/stderr
+    gzip: bool = False
+    contaminant: str | None = None
+    cutoff: int | None = None  # -p; None = compute from DB
+    apriori_error_rate: float = 0.01
+    poisson_threshold: float = 1e-6
+    batch_size: int = 8192
+
+
+def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
+    """open_file (error_correct_reads.cc:133-155): default stream when
+    no prefix; gzip appends .gz to named files only."""
+    if prefix is None:
+        if gzip:
+            return gzip_mod.open(default_stream.buffer, "wt", compresslevel=1)
+        return default_stream
+    path = prefix + suffix + (".gz" if gzip else "")
+    if gzip:
+        return gzip_mod.open(path, "wt", compresslevel=1)
+    return open(path, "w")
+
+
+def resolve_cutoff(state, meta, opts: ECOptions) -> int:
+    """args.cutoff_given ? arg : compute_poisson_cutoff(...) with the
+    reference's exact parameterization (error_correct_reads.cc:710-717):
+    collision_prob = apriori/3, threshold = poisson_threshold/apriori.
+    Returns 0 when the computation fails and no -p was given (caller
+    dies with the reference message)."""
+    if opts.cutoff is not None:
+        return opts.cutoff
+    vlog("Computing Poisson cutoff")
+    _occ, distinct, total = table.table_stats(state, meta)
+    return compute_poisson_cutoff(
+        int(distinct), int(total),
+        opts.apriori_error_rate / 3.0,
+        opts.poisson_threshold / opts.apriori_error_rate,
+    )
+
+
+def run_error_correct(db_path: str, sequences: Sequence[str],
+                      cfg_in: ECConfig | None, opts: ECOptions,
+                      qual_cutoff: int = 127, skip: int = 1, good: int = 2,
+                      anchor_count: int = 3, min_count: int = 1,
+                      window: int = 10, error: int = 3,
+                      homo_trim: int | None = None,
+                      trim_contaminant: bool = False,
+                      no_discard: bool = False) -> ECStats:
+    """Run the full stage-2 pipeline. If `cfg_in` is given it overrides
+    the individual knobs (library use); otherwise an ECConfig is built
+    from the flags plus the DB geometry, with the cutoff resolved per
+    `resolve_cutoff`."""
+    vlog("Loading mer database")
+    state, meta, _header = db_format.read_db(db_path, to_device=True)
+
+    cutoff = resolve_cutoff(state, meta, opts)
+    vlog("Using cutoff of ", cutoff)
+    if cutoff == 0 and opts.cutoff is None:
+        raise RuntimeError(
+            "Cutoff computation failed. Pass it explicitly with -p switch.")
+
+    if cfg_in is not None:
+        cfg = cfg_in
+    else:
+        cfg = ECConfig(
+            k=meta.k, skip=skip, good=good, anchor_count=anchor_count,
+            min_count=min_count, cutoff=cutoff, qual_cutoff=qual_cutoff,
+            window=window, error=error, homo_trim=homo_trim,
+            trim_contaminant=trim_contaminant, no_discard=no_discard,
+            collision_prob=opts.apriori_error_rate / 3.0,
+            poisson_threshold=opts.poisson_threshold,
+        )
+
+    contam = None
+    if opts.contaminant is not None:
+        vlog("Loading contaminant sequences")
+        contam = contaminant_mod.load_contaminant(opts.contaminant, cfg.k)
+
+    out = _open_out(opts.output, ".fa", sys.stdout, opts.gzip)
+    log = _open_out(opts.output, ".log", sys.stderr, opts.gzip)
+    stats = ECStats(cutoff=cutoff)
+    writer = AsyncWriter([out, log])
+    vlog("Correcting reads")
+    try:
+        batches = prefetch(fastq.read_batches(sequences, opts.batch_size))
+        for batch in batches:
+            res = correct_batch(state, meta, batch.codes, batch.quals,
+                                batch.lengths, cfg, contam=contam)
+            results = finish_batch(res, batch.n, cfg)
+            fa_parts: list[str] = []
+            log_parts: list[str] = []
+            for hdr, r in zip(batch.headers, results):
+                if r.ok:
+                    fa_parts.append(f">{hdr} {r.fwd_log} {r.bwd_log}\n"
+                                    f"{r.seq}\n")
+                    stats.corrected += 1
+                    stats.bases_out += r.end - r.start
+                else:
+                    log_parts.append(f"Skipped {hdr}: {r.error}\n")
+                    stats.skipped += 1
+                    if cfg.no_discard:
+                        fa_parts.append(f">{hdr}\nN\n")
+            stats.reads += batch.n
+            stats.bases_in += int(batch.lengths[:batch.n].sum())
+            writer.write(0, "".join(fa_parts))
+            writer.write(1, "".join(log_parts))
+    finally:
+        writer.close()
+        for f in (out, log):
+            if f is not sys.stdout and f is not sys.stderr:
+                f.close()
+            else:
+                f.flush()
+    vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
+         " skipped of ", stats.reads, " reads")
+    return stats

@@ -1,0 +1,88 @@
+"""Host pipeline stages: prefetching reader and asynchronous writer.
+
+The TPU-native equivalent of the reference's producer/consumer I/O
+machinery — the buffer pool + many-writers-to-one-ostream multiplexer
+(jflib::pool include/jflib/pool.hpp:28-134, jflib::o_multiplexer /
+writer_loop include/jflib/multiplexed_io.hpp:58-331) and the coarse
+merge|correct|split process pipeline (src/quorum.in:172-231). Here one
+host thread decodes+batches FASTQ ahead of the device (double
+buffering), the main thread runs device steps and host finishing, and
+one writer thread drains rendered records to the output streams.
+Record atomicity falls out of whole-string enqueueing, like the
+reference's endr-delimited records."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_STOP = object()
+
+
+def prefetch(it: Iterable[T], depth: int = 4) -> Iterator[T]:
+    """Run `it` in a background thread, buffering up to `depth` items.
+    Exceptions in the producer re-raise at the consumption point."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+
+    def loop():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            q.put(("__prefetch_error__", e))
+        finally:
+            q.put(_STOP)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _STOP:
+            break
+        if (isinstance(item, tuple) and len(item) == 2
+                and item[0] == "__prefetch_error__"):
+            raise item[1]
+        yield item
+    t.join()
+
+
+class AsyncWriter:
+    """One writer thread draining (stream, text) records to N streams.
+
+    Streams are indexed by position; `write(i, text)` never blocks the
+    caller unless `maxsize` records are already queued (backpressure,
+    like the bounded jflib::pool). `close()` flushes and joins; a
+    writer-side exception re-raises there."""
+
+    def __init__(self, streams, maxsize: int = 64):
+        self.streams = list(streams)
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.err: BaseException | None = None
+        self.t = threading.Thread(target=self._loop, daemon=True)
+        self.t.start()
+
+    def _loop(self):
+        while True:
+            item = self.q.get()
+            if item is _STOP:
+                return
+            if self.err is not None:
+                continue  # drain without writing after a failure
+            i, text = item
+            try:
+                self.streams[i].write(text)
+            except BaseException as e:  # noqa: BLE001 - surfaced in close
+                self.err = e
+
+    def write(self, i: int, text: str) -> None:
+        if text:
+            self.q.put((i, text))
+
+    def close(self) -> None:
+        self.q.put(_STOP)
+        self.t.join()
+        if self.err is not None:
+            raise self.err

@@ -1,0 +1,203 @@
+"""End-to-end tests of the stage-2 program surface: the
+quorum_error_correct_reads CLI corrects FASTQ files against a stage-1
+database and writes the reference's exact output formats
+(error_correct_reads.cc:246-341; README.md "Output format").
+
+The expected output is computed by the pure-Python oracle over the same
+database — so these tests pin the whole program path (DB file round
+trip, auto Poisson cutoff, batching, device correction, log rendering,
+file writing) against the independently tested per-read semantics."""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.io import db_format
+from quorum_tpu.models.ec_config import ECConfig
+from quorum_tpu.models.error_correct import ECOptions, resolve_cutoff
+from quorum_tpu.models.oracle import DictDB, OracleCorrector
+
+K = 13
+BASES = "ACGT"
+QUAL_THRESH = 38  # CDB -q: base+5 for base 33
+
+
+def _rng():
+    return np.random.default_rng(42)
+
+
+def make_dataset(tmp_path, n_reads=240, read_len=60, genome_len=1500,
+                 err_rate=0.02, seed=42):
+    """A synthetic genome + error-bearing reads, written as FASTQ."""
+    rng = np.random.default_rng(seed)
+    genome = "".join(BASES[c] for c in rng.integers(0, 4, genome_len))
+    reads, quals = [], []
+    for i in range(n_reads):
+        start = int(rng.integers(0, genome_len - read_len))
+        r = list(genome[start:start + read_len])
+        q = [chr(int(c)) for c in rng.integers(40, 70, read_len)]
+        for j in range(read_len):
+            if rng.random() < err_rate:
+                r[j] = BASES[int(rng.integers(0, 4))]
+                q[j] = chr(33 + int(rng.integers(0, 4)))
+        reads.append("".join(r))
+        quals.append("".join(q))
+    path = tmp_path / "reads.fastq"
+    with open(path, "w") as f:
+        for i, (r, q) in enumerate(zip(reads, quals)):
+            f.write(f"@read{i}\n{r}\n+\n{q}\n")
+    return str(path), reads, quals
+
+
+def build_db(tmp_path, reads_path, k=K):
+    db_path = str(tmp_path / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", str(k), "-b", "7",
+                       "-q", str(QUAL_THRESH), "-o", db_path, reads_path])
+    assert rc == 0
+    return db_path
+
+
+def oracle_expected(db_path, reads, quals, cfg):
+    """Render the oracle's .fa/.log text for the given reads."""
+    state, meta, _ = db_format.read_db(db_path, to_device=False)
+    db = DictDB.from_table(state, meta)
+    oc = OracleCorrector(db, cfg)
+    fa, log = [], []
+    for i, (r, q) in enumerate(zip(reads, quals)):
+        res = oc.correct(r, q)
+        hdr = f"read{i}"
+        if res.ok:
+            fa.append(f">{hdr} {res.fwd_log} {res.bwd_log}\n{res.seq}\n")
+        else:
+            log.append(f"Skipped {hdr}: {res.error}\n")
+            if cfg.no_discard:
+                fa.append(f">{hdr}\nN\n")
+    return "".join(fa), "".join(log)
+
+
+def auto_cutoff(db_path):
+    state, meta, _ = db_format.read_db(db_path, to_device=True)
+    return resolve_cutoff(state, meta, ECOptions())
+
+
+def test_ec_cli_end_to_end(tmp_path):
+    reads_path, reads, quals = make_dataset(tmp_path)
+    db_path = build_db(tmp_path, reads_path)
+    prefix = str(tmp_path / "out")
+    rc = ec_cli.main(["-o", prefix, "--batch-size", "64", db_path,
+                      reads_path])
+    assert rc == 0
+
+    cutoff = auto_cutoff(db_path)
+    assert cutoff > 0
+    cfg = ECConfig(k=K, cutoff=cutoff, poisson_dtype="float32")
+    want_fa, want_log = oracle_expected(db_path, reads, quals, cfg)
+    with open(prefix + ".fa") as f:
+        got_fa = f.read()
+    with open(prefix + ".log") as f:
+        got_log = f.read()
+    assert got_fa == want_fa
+    assert got_log == want_log
+    # the dataset must exercise both surfaces
+    assert got_fa.count(">") > 100
+    assert ":sub:" in got_fa
+
+
+def test_ec_cli_no_discard_and_flags(tmp_path):
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=80)
+    db_path = build_db(tmp_path, reads_path)
+    prefix = str(tmp_path / "out")
+    rc = ec_cli.main(["-o", prefix, "-d", "-p", "4", "-w", "8", "-e", "2",
+                      "--homo-trim", "6", "--batch-size", "32",
+                      db_path, reads_path])
+    assert rc == 0
+    cfg = ECConfig(k=K, cutoff=4, window=8, error=2, homo_trim=6,
+                   no_discard=True, poisson_dtype="float32")
+    want_fa, want_log = oracle_expected(db_path, reads, quals, cfg)
+    with open(prefix + ".fa") as f:
+        assert f.read() == want_fa
+    with open(prefix + ".log") as f:
+        assert f.read() == want_log
+
+
+def test_ec_cli_gzip_output(tmp_path):
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=40)
+    db_path = build_db(tmp_path, reads_path)
+    prefix = str(tmp_path / "out")
+    rc = ec_cli.main(["-o", prefix, "--gzip", "--batch-size", "32",
+                      db_path, reads_path])
+    assert rc == 0
+    assert os.path.exists(prefix + ".fa.gz")
+    cutoff = auto_cutoff(db_path)
+    cfg = ECConfig(k=K, cutoff=cutoff, poisson_dtype="float32")
+    want_fa, _ = oracle_expected(db_path, reads, quals, cfg)
+    with gzip.open(prefix + ".fa.gz", "rt") as f:
+        assert f.read() == want_fa
+
+
+def test_ec_cli_stdout_default(tmp_path, capsys):
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=40)
+    db_path = build_db(tmp_path, reads_path)
+    rc = ec_cli.main(["--batch-size", "32", db_path, reads_path])
+    assert rc == 0
+    cutoff = auto_cutoff(db_path)
+    cfg = ECConfig(k=K, cutoff=cutoff, poisson_dtype="float32")
+    want_fa, want_log = oracle_expected(db_path, reads, quals, cfg)
+    captured = capsys.readouterr()
+    assert captured.out == want_fa
+    assert captured.err == want_log
+
+
+def test_ec_cli_contaminant(tmp_path):
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=60)
+    db_path = build_db(tmp_path, reads_path)
+    # contaminate: take a window from one real read as the adapter
+    adapter = reads[3][10:10 + 2 * K]
+    contam_path = tmp_path / "adapter.fa"
+    contam_path.write_text(f">adapter\n{adapter}\n")
+    prefix = str(tmp_path / "out")
+    rc = ec_cli.main(["-o", prefix, "--contaminant", str(contam_path),
+                      "--batch-size", "32", db_path, reads_path])
+    assert rc == 0
+    with open(prefix + ".log") as f:
+        log_text = f.read()
+    assert "Contaminated read" in log_text
+
+    # oracle comparison with the same contaminant set
+    from quorum_tpu.ops import mer as merops
+    contam_set = set()
+    for i in range(len(adapter) - K + 1):
+        hi, lo = merops.pack_kmer(adapter[i:i + K], K)
+        chi, clo = merops.canonical_py(hi, lo, K)
+        contam_set.add((int(chi) << 32) | int(clo))
+    state, meta, _ = db_format.read_db(db_path, to_device=False)
+    db = DictDB.from_table(state, meta)
+    cutoff = auto_cutoff(db_path)
+    cfg = ECConfig(k=K, cutoff=cutoff, poisson_dtype="float32")
+    oc = OracleCorrector(db, cfg, contaminant=contam_set)
+    fa = []
+    for i, (r, q) in enumerate(zip(reads, quals)):
+        res = oc.correct(r, q)
+        if res.ok:
+            fa.append(f">read{i} {res.fwd_log} {res.bwd_log}\n{res.seq}\n")
+    with open(prefix + ".fa") as f:
+        assert f.read() == "".join(fa)
+
+
+def test_ec_cli_contaminant_k_mismatch(tmp_path):
+    reads_path, _, _ = make_dataset(tmp_path, n_reads=20)
+    db_path = build_db(tmp_path, reads_path)
+    # a quorum DB at the wrong k as contaminant must be rejected
+    other_db = str(tmp_path / "wrong.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", str(K - 2), "-b", "7",
+                       "-q", str(QUAL_THRESH), "-o", other_db, reads_path])
+    assert rc == 0
+    rc = ec_cli.main(["-o", str(tmp_path / "o"), "--contaminant", other_db,
+                      db_path, reads_path])
+    assert rc == 1
