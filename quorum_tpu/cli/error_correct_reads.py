@@ -75,7 +75,9 @@ def main(argv=None) -> int:
     if args.qual_cutoff_char is not None and args.qual_cutoff_value is not None:
         print("Switches -q and -Q are conflicting.", file=sys.stderr)
         return 1
-    if args.qual_cutoff_char is not None and len(args.qual_cutoff_char) != 1:
+    if args.qual_cutoff_char is not None and (
+            len(args.qual_cutoff_char) != 1
+            or ord(args.qual_cutoff_char) > 127):
         print("The qual-cutoff-char must be one ASCII character.",
               file=sys.stderr)
         return 1

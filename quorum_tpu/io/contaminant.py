@@ -41,28 +41,14 @@ def _is_quorum_db(path: str) -> bool:
 
 def build_kmer_set(paths, k: int, size_log2: int = 16):
     """Count every canonical k-mer of the given sequence files into a
-    membership table (value word nonzero for members), via the same
-    batched rolling-kmer device path as stage 1."""
-    from ..models.create_database import extract_observations
+    membership table (value word nonzero for members): stage 1's own
+    build pipeline with bits=1 and qual_thresh=0 (every base "high
+    quality" — only window validity matters for membership)."""
+    from ..models.create_database import BuildConfig, build_database
 
-    meta = table.TableMeta(k=k, bits=1, size_log2=size_log2)
-    state = table.make_table(meta)
-    for batch in fastq.batch_records(fastq.iter_records(list(paths)), 512):
-        # qual_thresh=0: every base counts as high quality; only window
-        # validity (k consecutive ACGT) matters for membership.
-        chi, clo, q, valid = extract_observations(
-            jnp.asarray(batch.codes), jnp.asarray(batch.quals), k, 0)
-        ukhi, uklo, hq, lq, uvalid = table.aggregate_kmers(chi, clo, q, valid)
-        pending = uvalid
-        for _ in range(16):
-            state, full, placed = table.merge_batch(
-                state, meta, ukhi, uklo, hq, lq, pending)
-            if not bool(full):
-                break
-            pending = jnp.logical_and(pending, jnp.logical_not(placed))
-            state, meta = table.grow(state, meta)
-        else:
-            raise RuntimeError("Hash is full")
+    cfg = BuildConfig(k=k, bits=1, qual_thresh=0,
+                      initial_size=1 << size_log2, batch_size=512)
+    state, meta, _stats = build_database(list(paths), cfg)
     return state, meta
 
 

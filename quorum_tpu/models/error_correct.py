@@ -159,12 +159,16 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
             writer.write(0, "".join(fa_parts))
             writer.write(1, "".join(log_parts))
     finally:
-        writer.close()
-        for f in (out, log):
-            if f is not sys.stdout and f is not sys.stderr:
-                f.close()
-            else:
-                f.flush()
+        try:
+            writer.close()
+        finally:
+            # always runs, even if the writer re-raises: gzip streams
+            # need their trailer or the output is unreadable
+            for f in (out, log):
+                if f is not sys.stdout and f is not sys.stderr:
+                    f.close()
+                else:
+                    f.flush()
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
     return stats

@@ -26,27 +26,44 @@ def prefetch(it: Iterable[T], depth: int = 4) -> Iterator[T]:
     """Run `it` in a background thread, buffering up to `depth` items.
     Exceptions in the producer re-raise at the consumption point."""
     q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        # bounded put that gives up if the consumer abandoned us
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def loop():
         try:
             for item in it:
-                q.put(item)
+                if not put(item):
+                    return
         except BaseException as e:  # noqa: BLE001 - forwarded to consumer
-            q.put(("__prefetch_error__", e))
+            put(("__prefetch_error__", e))
         finally:
-            q.put(_STOP)
+            put(_STOP)
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _STOP:
-            break
-        if (isinstance(item, tuple) and len(item) == 2
-                and item[0] == "__prefetch_error__"):
-            raise item[1]
-        yield item
-    t.join()
+    try:
+        while True:
+            item = q.get()
+            if item is _STOP:
+                break
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__prefetch_error__"):
+                raise item[1]
+            yield item
+        t.join()
+    finally:
+        # consumer abandoned (exception / generator close): release the
+        # producer, which may be blocked on a full queue
+        stop.set()
 
 
 class AsyncWriter:
@@ -61,6 +78,7 @@ class AsyncWriter:
         self.streams = list(streams)
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self.err: BaseException | None = None
+        self._raised = False
         self.t = threading.Thread(target=self._loop, daemon=True)
         self.t.start()
 
@@ -78,11 +96,14 @@ class AsyncWriter:
                 self.err = e
 
     def write(self, i: int, text: str) -> None:
+        if self.err is not None:
+            self._raised = True
+            raise self.err  # fail fast, not after gigabases into a dead pipe
         if text:
             self.q.put((i, text))
 
     def close(self) -> None:
         self.q.put(_STOP)
         self.t.join()
-        if self.err is not None:
+        if self.err is not None and not self._raised:
             raise self.err
