@@ -1,0 +1,70 @@
+"""merge_mate_pairs — interleave paired read files into one FASTQ stream.
+
+Reference: src/merge_mate_pairs.cc. Files are taken pairwise (1st with
+2nd, 3rd with 4th, ...); records are emitted alternately so a
+downstream corrector run with --no-discard preserves pairing. FASTA
+inputs get a fabricated quality string of '*' (merge_mate_pairs.cc:51-59).
+Mismatched pair lengths abort with the reference's message
+(merge_mate_pairs.cc:80-85).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+from typing import Iterator, Sequence
+
+from ..io import fastq
+
+
+def merge_records(files: Sequence[str]) -> Iterator[tuple[str, bytes, bytes]]:
+    """Yield records alternating between each pair of files."""
+    if len(files) % 2 != 0:
+        raise ValueError("Must give a even number files")
+    for f_even, f_odd in zip(files[0::2], files[1::2]):
+        it_even = fastq.iter_records([f_even])
+        it_odd = fastq.iter_records([f_odd])
+        for r_even, r_odd in itertools.zip_longest(it_even, it_odd):
+            if r_even is None or r_odd is None:
+                raise RuntimeError("Input files are not paired reads.")
+            yield r_even
+            yield r_odd
+
+
+def write_fastq_record(out, rec: tuple[str, bytes, bytes]) -> None:
+    header, seq, qual = rec
+    qual_s = qual.decode() if qual else "*" * len(seq)
+    out.write(f"@{header}\n{seq.decode()}\n+\n{qual_s}\n")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="merge_mate_pairs",
+        description="Merge paired read files into one interleaved FASTQ "
+                    "stream on stdout.",
+    )
+    p.add_argument("-o", "--output", default=None,
+                   help="Output file (default stdout)")
+    p.add_argument("file", nargs="+", help="Paired input files")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = sys.stdout if args.output is None else open(args.output, "w")
+    try:
+        for rec in merge_records(args.file):
+            write_fastq_record(out, rec)
+    except (ValueError, RuntimeError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    finally:
+        out.flush()
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""quorum — the top-level pipeline driver.
+
+Reference: src/quorum.in (Perl). Orchestrates quality-base autodetect
+(quorum.in:129-152), quorum_create_database (:154-160), and error
+correction — single-file mode (:171-173) or paired mode, where the
+reference forks a merge | correct | split process pipe (:172-231). We
+run the same chain in-process: merge_mate_pairs.merge_records streams
+interleaved pairs through run_error_correct (the prefetch thread gives
+the reader/device overlap), and split_mate_pairs de-interleaves the
+corrected .fa into <prefix>_1.fa / <prefix>_2.fa.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+from ..io import fastq
+from ..models.error_correct import ECOptions, run_error_correct
+from ..utils import vlog as vlog_mod
+from ..utils.vlog import vlog
+from . import create_database as cdb_cli
+from . import error_correct_reads as ec_cli
+from .merge_mate_pairs import merge_records
+from .split_mate_pairs import split_stream
+
+VERSION = "1.0.0"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="quorum",
+        description="Run the quorum error corrector on the given fastq "
+                    "files. With --paired-files, an even number of files "
+                    "is expected and corrected pairs are written to "
+                    "<prefix>_1.fa and <prefix>_2.fa.",
+    )
+    p.add_argument("-s", "--size", default="200M",
+                   help="Mer database size (default 200M)")
+    p.add_argument("-t", "--threads", type=int, default=None,
+                   help="Number of threads (default number of cpus)")
+    p.add_argument("-p", "--prefix", default="quorum_corrected",
+                   help="Output prefix (default quorum_corrected)")
+    p.add_argument("-k", "--kmer-len", type=int, default=24,
+                   help="Kmer length (default 24)")
+    p.add_argument("-q", "--min-q-char", type=int, default=None,
+                   help="Minimum quality char. Usually 33 or 64 "
+                        "(autodetect)")
+    p.add_argument("-m", "--min-quality", type=int, default=5,
+                   help="Minimum above -q for high quality base (5)")
+    p.add_argument("-w", "--window", type=int, default=None,
+                   help="Window size for trimming")
+    p.add_argument("-e", "--error", type=int, default=None,
+                   help="Maximum number of errors in a window")
+    p.add_argument("--min-count", type=int, default=None,
+                   help="Minimum count for a k-mer to be good")
+    p.add_argument("--skip", type=int, default=None,
+                   help="Number of bases to skip to find anchor kmer")
+    p.add_argument("--anchor", type=int, default=None,
+                   help="Number of good kmer in a row for anchor")
+    p.add_argument("--anchor-count", type=int, default=None,
+                   help="Minimum count for an anchor kmer")
+    p.add_argument("--contaminant", default=None,
+                   help="Contaminant sequences")
+    p.add_argument("--trim-contaminant", "--contaminant-trim",
+                   action="store_true",
+                   help="Trim sequences with contaminant mers")
+    p.add_argument("-d", "--no-discard", action="store_true",
+                   help="Do not discard reads, output a single N (false)")
+    p.add_argument("-P", "--paired-files", action="store_true",
+                   help="Preserve mate pairs in two files")
+    p.add_argument("--homo-trim", type=int, default=None,
+                   help="Trim homo-polymer on 3' end")
+    p.add_argument("--batch-size", type=int, default=8192,
+                   help="Reads per device batch")
+    p.add_argument("--debug", action="store_true",
+                   help="Display debugging information")
+    p.add_argument("--version", action="version", version=VERSION)
+    p.add_argument("reads", nargs="*", help="Input fastq files")
+    return p
+
+
+def detect_min_q_char(path: str, max_reads: int = 1000) -> int:
+    """Scan up to `max_reads` records of `path` for the smallest quality
+    character (quorum.in:129-152), with the reference's special Illumina
+    adjustment (min char 35 or 66 -> subtract 2, quality values 0/1
+    unseen) and the 33/59/64 sanity check."""
+    min_q = 256
+    for i, (_hdr, _seq, qual) in enumerate(fastq.iter_records([path])):
+        if i >= max_reads:
+            break
+        if not qual:
+            raise RuntimeError("Invalid fastq format")
+        min_q = min(min_q, min(qual))
+    if min_q in (35, 66):
+        min_q -= 2
+    if min_q not in (33, 59, 64):
+        raise RuntimeError(
+            f"Found an unusual minimum quality char of {min_q} "
+            f"({chr(min_q) if 0 <= min_q < 256 else '?'}). Stopping now. "
+            f"Use option -q to override")
+    return min_q
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    vlog_mod.verbose = args.debug
+
+    if not re.match(r"^\d+[kMGT]?$", args.size):
+        print(f"Invalid size '{args.size}'. It must be a number, maybe "
+              "followed by a suffix (like k, M, G for thousand, million "
+              "and billion).", file=sys.stderr)
+        return 1
+    if not args.reads:
+        print("No sequence files. See quorum --help.", file=sys.stderr)
+        return 1
+    if args.paired_files and len(args.reads) % 2 != 0:
+        print("With --paired-files an even number of input files is "
+              "required.", file=sys.stderr)
+        return 1
+
+    min_q_char = args.min_q_char
+    if min_q_char is None:
+        try:
+            min_q_char = detect_min_q_char(args.reads[0])
+        except (RuntimeError, ValueError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            return 1
+    vlog("Using min quality char ", min_q_char, " (+", args.min_quality, ")")
+
+    # Stage 1: quorum_create_database -s SIZE -m K -q char+qual -b 7
+    # (quorum.in:154-160)
+    db_file = args.prefix + "_mer_database.jf"
+    cdb_argv = ["-s", args.size, "-m", str(args.kmer_len),
+                "-q", str(min_q_char + args.min_quality), "-b", "7",
+                "-o", db_file, "--batch-size", str(args.batch_size)]
+    if args.debug:
+        cdb_argv.append("-v")
+        print("+ quorum_create_database " + " ".join(cdb_argv)
+              + " " + " ".join(args.reads), file=sys.stderr)
+    if cdb_cli.main(cdb_argv + list(args.reads)) != 0:
+        print("Creating the mer database failed. Most likely the size "
+              "passed to the -s switch is too small.", file=sys.stderr)
+        return 1
+
+    # Stage 2: error correction (quorum.in:162-231)
+    ec_common = ["--batch-size", str(args.batch_size)]
+    for flag, val in (("--min-count", args.min_count),
+                      ("--skip", args.skip),
+                      ("--good", args.anchor),
+                      ("--anchor-count", args.anchor_count),
+                      ("--window", args.window),
+                      ("--error", args.error),
+                      ("--homo-trim", args.homo_trim),
+                      ("--contaminant", args.contaminant)):
+        if val is not None:
+            ec_common.extend([flag, str(val)])
+    if args.trim_contaminant:
+        ec_common.append("--trim-contaminant")
+    no_discard = args.no_discard or args.paired_files
+    if no_discard:
+        ec_common.append("--no-discard")
+    if args.debug:
+        ec_common.append("-v")
+
+    if not args.paired_files:
+        ec_argv = ec_common + ["-o", args.prefix, db_file] + list(args.reads)
+        if args.debug:
+            print("+ quorum_error_correct_reads " + " ".join(ec_argv),
+                  file=sys.stderr)
+        if ec_cli.main(ec_argv) != 0:
+            print("Error correction failed", file=sys.stderr)
+            return 1
+        return 0
+
+    # Paired mode: merge | correct | split, in-process
+    # (quorum.in:172-231). --no-discard is forced so every input read
+    # yields exactly one output record and pairing survives the split.
+    if args.debug:
+        print(f"+ merge_mate_pairs {' '.join(args.reads)} | "
+              f"quorum_error_correct_reads {' '.join(ec_common)} "
+              f"{db_file} /dev/fd/0 | split_mate_pairs {args.prefix}",
+              file=sys.stderr)
+    opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
+                     batch_size=args.batch_size)
+    kwargs = dict(no_discard=True,
+                  trim_contaminant=args.trim_contaminant)
+    for key, val in (("min_count", args.min_count), ("skip", args.skip),
+                     ("good", args.anchor),
+                     ("anchor_count", args.anchor_count),
+                     ("window", args.window), ("error", args.error),
+                     ("homo_trim", args.homo_trim)):
+        if val is not None:
+            kwargs[key] = val
+    try:
+        run_error_correct(db_file, [], None, opts,
+                          records=merge_records(args.reads), **kwargs)
+    except (RuntimeError, ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        print("Error correction failed", file=sys.stderr)
+        return 1
+    fa_path = args.prefix + ".fa"
+    try:
+        with open(fa_path, "r") as inp:
+            split_stream(inp, args.prefix)
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    os.remove(fa_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""split_mate_pairs — de-interleave a corrected FASTA stream into
+<prefix>_1.fa / <prefix>_2.fa.
+
+Reference: src/split_mate_pairs.cc — reads two-line records
+(header + sequence) from stdin and writes them alternately to the two
+output files. We additionally accept an input file argument (stdin
+remains the default) so the driver can split an already-written .fa
+without a shell pipe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def split_stream(inp, prefix: str) -> None:
+    file1 = prefix + "_1.fa"
+    file2 = prefix + "_2.fa"
+    with open(file1, "w") as out1, open(file2, "w") as out2:
+        outs = (out1, out2)
+        first = True
+        while True:
+            header = inp.readline()
+            if not header:
+                break
+            seq = inp.readline()
+            outs[0 if first else 1].write(header.rstrip("\r\n") + "\n"
+                                          + seq.rstrip("\r\n") + "\n")
+            first = not first
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="split_mate_pairs",
+        description="Split an interleaved corrected FASTA stream into "
+                    "<prefix>_1.fa and <prefix>_2.fa.",
+    )
+    p.add_argument("-i", "--input", default=None,
+                   help="Input file (default stdin)")
+    p.add_argument("prefix", help="Output prefix")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    inp = sys.stdin if args.input is None else open(args.input, "r")
+    try:
+        split_stream(inp, args.prefix)
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    finally:
+        if inp is not sys.stdin:
+            inp.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,10 +23,7 @@ from __future__ import annotations
 
 import json
 
-import jax.numpy as jnp
-
-from ..ops import table
-from . import db_format, fastq
+from . import db_format
 
 
 def _is_quorum_db(path: str) -> bool:

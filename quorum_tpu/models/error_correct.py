@@ -99,11 +99,17 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                       window: int = 10, error: int = 3,
                       homo_trim: int | None = None,
                       trim_contaminant: bool = False,
-                      no_discard: bool = False) -> ECStats:
+                      no_discard: bool = False,
+                      records=None) -> ECStats:
     """Run the full stage-2 pipeline. If `cfg_in` is given it overrides
     the individual knobs (library use); otherwise an ECConfig is built
     from the flags plus the DB geometry, with the cutoff resolved per
-    `resolve_cutoff`."""
+    `resolve_cutoff`. If `records` is given (an iterator of
+    (header, seq, qual) tuples, e.g. merge_mate_pairs.merge_records) it
+    is used instead of reading `sequences` from disk — this is how the
+    quorum driver's paired mode streams merged pairs through the
+    corrector the way the reference pipes processes together
+    (src/quorum.in:172-231)."""
     vlog("Loading mer database")
     state, meta, _header = db_format.read_db(db_path, to_device=True)
 
@@ -136,7 +142,11 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     writer = AsyncWriter([out, log])
     vlog("Correcting reads")
     try:
-        batches = prefetch(fastq.read_batches(sequences, opts.batch_size))
+        if records is not None:
+            src = fastq.batch_records(records, opts.batch_size)
+        else:
+            src = fastq.read_batches(sequences, opts.batch_size)
+        batches = prefetch(src)
         for batch in batches:
             res = correct_batch(state, meta, batch.codes, batch.quals,
                                 batch.lengths, cfg, contam=contam)
@@ -163,12 +173,18 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
             writer.close()
         finally:
             # always runs, even if the writer re-raises: gzip streams
-            # need their trailer or the output is unreadable
-            for f in (out, log):
+            # need their trailer or the output is unreadable. Close each
+            # stream independently so a failing out.close() (e.g. disk
+            # full at gzip flush) can't leave log without its trailer.
+            def _finish(f):
                 if f is not sys.stdout and f is not sys.stderr:
                     f.close()
                 else:
                     f.flush()
+            try:
+                _finish(out)
+            finally:
+                _finish(log)
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
     return stats

@@ -1,0 +1,161 @@
+"""End-to-end tests of the quorum driver (src/quorum.in) and the
+mate-pair tools (src/merge_mate_pairs.cc, src/split_mate_pairs.cc):
+quality autodetect, CDB->EC orchestration, and the paired
+merge | correct | split chain producing <prefix>_1.fa/_2.fa."""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import io
+import os
+
+import pytest
+
+from quorum_tpu.cli import merge_mate_pairs as merge_cli
+from quorum_tpu.cli import quorum as quorum_cli
+from quorum_tpu.cli.split_mate_pairs import split_stream
+from quorum_tpu.io import db_format
+from quorum_tpu.models.ec_config import ECConfig
+from quorum_tpu.models.error_correct import ECOptions, resolve_cutoff
+from quorum_tpu.models.oracle import DictDB, OracleCorrector
+
+from test_error_correct_cli import K, make_dataset, oracle_expected
+
+
+def split_dataset(tmp_path, reads, quals):
+    """Write even-indexed reads to pair1.fastq, odd to pair2.fastq."""
+    p1, p2 = tmp_path / "pair1.fastq", tmp_path / "pair2.fastq"
+    with open(p1, "w") as f1, open(p2, "w") as f2:
+        for i, (r, q) in enumerate(zip(reads, quals)):
+            f = f1 if i % 2 == 0 else f2
+            f.write(f"@read{i}\n{r}\n+\n{q}\n")
+    return str(p1), str(p2)
+
+
+def test_merge_mate_pairs_interleaves(tmp_path):
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=10)
+    p1, p2 = split_dataset(tmp_path, reads, quals)
+    out = tmp_path / "merged.fastq"
+    rc = merge_cli.main(["-o", str(out), p1, p2])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    headers = [ln[1:] for ln in lines[0::4]]
+    # merged order: read0, read1, read2, ... (even file first each pair)
+    assert headers == [f"read{i}" for i in range(10)]
+    assert lines[1::4] == reads
+
+
+def test_merge_mate_pairs_fasta_star_quals(tmp_path):
+    fa1, fa2 = tmp_path / "a.fa", tmp_path / "b.fa"
+    fa1.write_text(">a0\nACGTACGT\n")
+    fa2.write_text(">b0\nTTTTAAAA\n")
+    out = tmp_path / "merged.fastq"
+    rc = merge_cli.main(["-o", str(out), str(fa1), str(fa2)])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert lines == ["@a0", "ACGTACGT", "+", "*" * 8,
+                     "@b0", "TTTTAAAA", "+", "*" * 8]
+
+
+def test_merge_mate_pairs_unpaired_errors(tmp_path, capsys):
+    fa1, fa2 = tmp_path / "a.fa", tmp_path / "b.fa"
+    fa1.write_text(">a0\nACGT\n>a1\nACGT\n")
+    fa2.write_text(">b0\nTTTT\n")
+    rc = merge_cli.main([str(fa1), str(fa2)])
+    assert rc == 1
+    assert "not paired" in capsys.readouterr().err
+    rc = merge_cli.main([str(fa1)])
+    assert rc == 1
+
+
+def test_split_stream_alternates(tmp_path):
+    inp = io.StringIO(">r0 a b\nAAAA\n>r1 c d\nCCCC\n>r2\nN\n>r3 e f\nGGGG\n")
+    split_stream(inp, str(tmp_path / "out"))
+    assert (tmp_path / "out_1.fa").read_text() == ">r0 a b\nAAAA\n>r2\nN\n"
+    assert (tmp_path / "out_2.fa").read_text() == ">r1 c d\nCCCC\n>r3 e f\nGGGG\n"
+
+
+def test_quality_autodetect(tmp_path):
+    reads_path, _, _ = make_dataset(tmp_path, n_reads=20)
+    # dataset quality chars bottom out at 33 (error positions)
+    assert quorum_cli.detect_min_q_char(reads_path) == 33
+
+
+def test_quality_autodetect_illumina_offset(tmp_path):
+    p = tmp_path / "r.fastq"
+    # min char 66 ('B') -> special Illumina case, reports 64
+    p.write_text("@r0\nACGTACGTACGTAC\n+\nBBCDEFGHIJKLMN\n")
+    assert quorum_cli.detect_min_q_char(str(p)) == 64
+
+
+def test_quality_autodetect_unusual_errors(tmp_path):
+    p = tmp_path / "r.fastq"
+    p.write_text("@r0\nACGT\n+\nQRST\n")  # min char 'Q' = 81
+    with pytest.raises(RuntimeError, match="unusual minimum quality char"):
+        quorum_cli.detect_min_q_char(str(p))
+
+
+def test_quorum_driver_single(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    reads_path, reads, quals = make_dataset(tmp_path)
+    prefix = str(tmp_path / "qc")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "--batch-size", "64", reads_path])
+    assert rc == 0
+    db_path = prefix + "_mer_database.jf"
+    assert os.path.exists(db_path)
+
+    state, meta, _ = db_format.read_db(db_path, to_device=True)
+    cutoff = resolve_cutoff(state, meta, ECOptions())
+    cfg = ECConfig(k=K, cutoff=cutoff, poisson_dtype="float32")
+    want_fa, want_log = oracle_expected(db_path, reads, quals, cfg)
+    with open(prefix + ".fa") as f:
+        assert f.read() == want_fa
+    with open(prefix + ".log") as f:
+        assert f.read() == want_log
+
+
+def test_quorum_driver_paired(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    reads_path, reads, quals = make_dataset(tmp_path, n_reads=120)
+    p1, p2 = split_dataset(tmp_path, reads, quals)
+    prefix = str(tmp_path / "qc")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix, "-P",
+                          "--batch-size", "64", p1, p2])
+    assert rc == 0
+    # intermediate single .fa must be gone, split outputs present
+    assert not os.path.exists(prefix + ".fa")
+
+    db_path = prefix + "_mer_database.jf"
+    cutoff_state = db_format.read_db(db_path, to_device=True)
+    cutoff = resolve_cutoff(cutoff_state[0], cutoff_state[1], ECOptions())
+    cfg = ECConfig(k=K, cutoff=cutoff, no_discard=True,
+                   poisson_dtype="float32")
+    # oracle over the *merged* order, then split alternately
+    want_fa, want_log = oracle_expected(db_path, reads, quals, cfg)
+    fa_records = want_fa.splitlines(keepends=True)
+    pairs = ["".join(fa_records[i:i + 2])
+             for i in range(0, len(fa_records), 2)]
+    want_1 = "".join(pairs[0::2])
+    want_2 = "".join(pairs[1::2])
+    with open(prefix + "_1.fa") as f:
+        assert f.read() == want_1
+    with open(prefix + "_2.fa") as f:
+        assert f.read() == want_2
+    with open(prefix + ".log") as f:
+        assert f.read() == want_log
+    # every input read appears exactly once across the two files
+    n1 = want_1.count(">")
+    n2 = want_2.count(">")
+    assert n1 == n2 == 60
+
+
+def test_quorum_driver_bad_size(capsys):
+    rc = quorum_cli.main(["-s", "12Q", "whatever.fastq"])
+    assert rc == 1
+    assert "Invalid size" in capsys.readouterr().err
+
+
+def test_quorum_driver_no_files(capsys):
+    rc = quorum_cli.main([])
+    assert rc == 1
+    assert "No sequence files" in capsys.readouterr().err
