@@ -1,0 +1,199 @@
+"""Multi-chip stage 2: data-parallel correction over a device mesh.
+
+The reference corrects with N pthreads sharing one read-only mer
+database in host memory (error_correct_reads.cc thread loop; SURVEY
+§2.4). The TPU-native equivalent flips the layout for the read-heavy
+phase: reads are **data-parallel** over the mesh axis and the table is
+**replicated**, so every lookup in the corrector's probe loops is a
+local HBM gather — no per-probe collectives, and each shard's lockstep
+`lax.while_loop` retires its own lanes independently (less divergence
+waste than one global lockstep batch).
+
+The stage-1 build keeps the hash-prefix sharded layout
+(parallel/sharded.py) because building is write-heavy and needs
+exclusive ownership. Between the stages `to_read_layout` re-indexes the
+sharded table into the single-chip layout (top-owner-bits + local-slot
+probing -> plain low-bits probing) with one raw re-insert pass — the
+write-optimal and read-optimal layouts are different tables, and the
+conversion cost is one pass over the DB, amortized over the whole
+correction run. A DB that does not fit one chip's HBM would instead
+keep the sharded layout and ring-query (parallel/sharded.query_step);
+that path trades per-probe ICI hops for capacity and is the documented
+fallback, not the default.
+
+Semantics are pinned by parity tests: the shard_map'ped corrector must
+produce bit-identical BatchResults to models.corrector.correct_batch on
+the same reads (tests/test_sharded_correct.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import corrector
+from ..models.ec_config import ECConfig
+from ..ops import table
+from .sharded import AXIS, ShardedMeta, make_mesh
+
+
+def to_read_layout(sstate: table.TableState, smeta: ShardedMeta,
+                   max_grows: int = 4, chunk: int = 1 << 20):
+    """Re-index a hash-prefix sharded table into the single-chip layout.
+
+    The sharded layout stores a key at
+    ``owner(top bits) * local_size + probe(low bits)``; the single-chip
+    probe sequence (ops/table._probe_insert) uses plain low-bit
+    indexing over the whole array, so the same entries live at
+    different slots. One chunked raw re-insert builds the read-optimal
+    table; the value words transfer verbatim. Grows (rarely needed:
+    same slot count, same load factor) preserve the FULL contract.
+    Returns (state, meta) for the corrector."""
+    keys_hi = np.asarray(sstate.keys_hi)
+    keys_lo = np.asarray(sstate.keys_lo)
+    vals = np.asarray(sstate.vals)
+    meta = table.TableMeta(
+        k=smeta.k, bits=smeta.bits,
+        size_log2=smeta.local_size_log2 + smeta.owner_bits,
+        max_reprobe=smeta.max_reprobe,
+    )
+    for _ in range(max_grows + 1):
+        st = table.make_table(meta)
+        full_any = False
+        for start in range(0, len(vals), chunk):
+            kh = keys_hi[start:start + chunk]
+            kl = keys_lo[start:start + chunk]
+            vv = vals[start:start + chunk]
+            st, full = table.raw_insert(st, meta, jnp.asarray(kh),
+                                        jnp.asarray(kl), jnp.asarray(vv),
+                                        jnp.asarray(vv != table.EMPTY_VAL))
+            full_any = full_any or bool(full)
+        if not full_any:
+            return st, meta
+        meta = dataclasses.replace(meta, size_log2=meta.size_log2 + 1)
+    raise RuntimeError("Hash is full")
+
+
+def correct_step(mesh, tmeta: table.TableMeta, cfg: ECConfig,
+                 cmeta: table.TableMeta | None = None):
+    """Compile the data-parallel correction step.
+
+    Returns f(state, codes[B,L], quals[B,L], lengths[B]
+    [, contam_state]) -> BatchResult with the batch dim sharded over
+    the mesh axis and the table (and contaminant set) replicated.
+    B must be divisible by the mesh size; pad with zero-length reads
+    (status comes back != OK for them, finish_batch ignores rows >= n).
+    """
+    has_contam = cmeta is not None
+
+    def local_fn(kh, kl, v, codes, quals, lengths, ckh, ckl, cv):
+        st = table.TableState(kh, kl, v)
+        contam = ((table.TableState(ckh, ckl, cv), cmeta)
+                  if has_contam else None)
+        return corrector.correct_batch(st, tmeta, codes, quals, lengths,
+                                       cfg, contam=contam)
+
+    mapped = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(AXIS, None), P(AXIS, None), P(AXIS),
+                  P(), P(), P()),
+        out_specs=P(AXIS),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state: table.TableState, codes, quals, lengths,
+             contam_state: table.TableState | None = None):
+        if has_contam:
+            cs = contam_state
+        else:
+            cs, _ = corrector._dummy_contam(cfg.k)
+        return mapped(state.keys_hi, state.keys_lo, state.vals,
+                      jnp.asarray(codes, jnp.int32),
+                      jnp.asarray(quals, jnp.int32),
+                      jnp.asarray(lengths, jnp.int32),
+                      cs.keys_hi, cs.keys_lo, cs.vals)
+
+    return step
+
+
+def replicate_table(state: table.TableState, mesh) -> table.TableState:
+    """Place the table arrays replicated over the mesh so the DP step
+    doesn't re-transfer them every batch."""
+    sh = NamedSharding(mesh, P())
+    return table.TableState(*(jax.device_put(a, sh) for a in state))
+
+
+# ---------------------------------------------------------------------------
+# Dryrun: tiny end-to-end sharded-build -> relayout -> DP-correct
+# ---------------------------------------------------------------------------
+
+def _synthetic_reads(rng, genome_codes, n_reads: int, read_len: int,
+                     err_rate: float = 0.03):
+    """Reads sampled from a synthetic genome with planted substitution
+    errors at low-quality positions (device-ready code/qual arrays)."""
+    glen = len(genome_codes)
+    codes = np.zeros((n_reads, read_len), dtype=np.int8)
+    quals = np.full((n_reads, read_len), 70, dtype=np.uint8)
+    for i in range(n_reads):
+        s = int(rng.integers(0, glen - read_len))
+        codes[i] = genome_codes[s:s + read_len]
+        for j in range(read_len):
+            if rng.random() < err_rate:
+                codes[i, j] = (codes[i, j] + 1 + rng.integers(0, 3)) % 4
+                quals[i, j] = 34
+    lengths = np.full((n_reads,), read_len, dtype=np.int32)
+    return codes, quals, lengths
+
+
+def dryrun(mesh, n_devices: int) -> None:
+    """Stage-2 multi-chip dryrun: build a tiny DB in the sharded layout,
+    re-layout for reading, run the DP corrector over the mesh, and
+    assert bit-exact parity with the single-chip corrector on the same
+    batch. Called from __graft_entry__.dryrun_multichip."""
+    from . import sharded
+
+    k = 15
+    rng = np.random.default_rng(3)
+    genome = rng.integers(0, 4, size=512).astype(np.int8)
+    codes, quals, lengths = _synthetic_reads(rng, genome, 16 * n_devices, 48)
+
+    smeta = ShardedMeta(k=k, bits=7, local_size_log2=11, n_shards=n_devices)
+    sstate, smeta = sharded.build_database_sharded(
+        [(jnp.asarray(codes), jnp.asarray(quals))], mesh, smeta,
+        qual_thresh=53)
+
+    state, tmeta = to_read_layout(sstate, smeta)
+    cfg = ECConfig(k=k, cutoff=2, poisson_dtype="float32")
+
+    step = correct_step(mesh, tmeta, cfg)
+    rep = replicate_table(state, mesh)
+    res = step(rep, codes, quals, lengths)
+
+    single = corrector.correct_batch(state, tmeta, codes, quals, lengths,
+                                     cfg)
+    for name, a, b in (("out", res.out, single.out),
+                       ("start", res.start, single.start),
+                       ("end", res.end, single.end),
+                       ("status", res.status, single.status)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"sharded corrector mismatch on {name}")
+    for name in corrector.LogState._fields:
+        for d, logs in (("fwd", (res.fwd_log, single.fwd_log)),
+                        ("bwd", (res.bwd_log, single.bwd_log))):
+            a, b = (getattr(l, name) for l in logs)
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"sharded corrector mismatch on {d}_log.{name}")
+    n_ok = int(np.sum(np.asarray(res.status) == corrector.OK))
+    n_edits = int(np.asarray(res.fwd_log.n).sum()
+                  + np.asarray(res.bwd_log.n).sum())
+    assert n_ok > 0, "stage-2 dryrun corrected nothing"
+    assert n_edits > 0, "stage-2 dryrun made no edits"
+    print(f"dryrun stage-2: {n_ok}/{len(codes)} reads corrected, "
+          f"{n_edits} edits, parity vs single-chip OK")

@@ -1,0 +1,123 @@
+"""Multi-chip stage 2: the data-parallel shard_map corrector must be
+bit-identical to the single-chip corrector (models/corrector, itself
+pinned against the oracle), and the sharded->single table relayout must
+preserve every entry."""
+
+import conftest
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.models import corrector
+from quorum_tpu.models.ec_config import ECConfig
+from quorum_tpu.ops import table
+from quorum_tpu.parallel import sharded, sharded_correct
+
+K = 11
+
+
+def make_inputs(seed, n_reads, read_len=60, glen=None, err=0.03):
+    rng = np.random.default_rng(seed)
+    if glen is None:
+        glen = max(150, n_reads * 8)  # ~8x coverage so anchors exist
+    genome = rng.integers(0, 4, size=glen).astype(np.int8)
+    return sharded_correct._synthetic_reads(rng, genome, n_reads, read_len,
+                                            err)
+
+
+def build_single(codes, quals, qual_thresh=53):
+    from quorum_tpu.models.create_database import extract_observations
+
+    meta = table.TableMeta(k=K, bits=7, size_log2=13)
+    st = table.make_table(meta)
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), K, qual_thresh)
+    st, full = table.add_kmer_batch(st, meta, chi, clo, q, valid)
+    assert not bool(full)
+    return st, meta
+
+
+def test_to_read_layout_preserves_entries():
+    codes, quals, _ = make_inputs(0, 32)
+    mesh = sharded.make_mesh(4, devices=conftest.cpu_devices(4))
+    smeta = sharded.ShardedMeta(k=K, bits=7, local_size_log2=10, n_shards=4)
+    sstate, smeta = sharded.build_database_sharded(
+        [(jnp.asarray(codes), jnp.asarray(quals))], mesh, smeta,
+        qual_thresh=53)
+    st, meta = sharded_correct.to_read_layout(sstate, smeta)
+
+    svals = np.asarray(sstate.vals)
+    skh = np.asarray(sstate.keys_hi)
+    skl = np.asarray(sstate.keys_lo)
+    occ = svals != table.EMPTY_VAL
+    assert occ.sum() > 0
+    # every sharded entry must be found at its full value in the
+    # relayouted table via the plain single-chip lookup
+    got = np.asarray(table.lookup(st, meta, jnp.asarray(skh[occ]),
+                                  jnp.asarray(skl[occ])))
+    assert np.array_equal(got, svals[occ])
+    # and the relayouted table holds nothing else
+    occ1, _, _ = table.table_stats(st, meta)
+    assert int(occ1) == int(occ.sum())
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_dp_corrector_matches_single_chip(n_shards):
+    codes, quals, lengths = make_inputs(n_shards, 8 * n_shards)
+    st, meta = build_single(codes, quals)
+    cfg = ECConfig(k=K, cutoff=2, poisson_dtype="float32")
+
+    single = corrector.correct_batch(st, meta, codes, quals, lengths, cfg)
+
+    mesh = sharded.make_mesh(n_shards, devices=conftest.cpu_devices(n_shards))
+    step = sharded_correct.correct_step(mesh, meta, cfg)
+    rep = sharded_correct.replicate_table(st, mesh)
+    res = step(rep, codes, quals, lengths)
+
+    assert np.array_equal(np.asarray(res.out), np.asarray(single.out))
+    assert np.array_equal(np.asarray(res.start), np.asarray(single.start))
+    assert np.array_equal(np.asarray(res.end), np.asarray(single.end))
+    assert np.array_equal(np.asarray(res.status), np.asarray(single.status))
+    for fld in corrector.LogState._fields:
+        assert np.array_equal(np.asarray(getattr(res.fwd_log, fld)),
+                              np.asarray(getattr(single.fwd_log, fld)))
+        assert np.array_equal(np.asarray(getattr(res.bwd_log, fld)),
+                              np.asarray(getattr(single.bwd_log, fld)))
+    # the batch must actually exercise correction
+    assert int(np.sum(np.asarray(res.status) == corrector.OK)) > 0
+    assert int(np.asarray(res.fwd_log.n).sum()) > 0
+
+
+def test_dp_corrector_with_contaminant():
+    n_shards = 4
+    codes, quals, lengths = make_inputs(99, 8 * n_shards)
+    st, meta = build_single(codes, quals)
+    cfg = ECConfig(k=K, cutoff=2, poisson_dtype="float32")
+
+    # contaminant set: the k-mers of one read
+    cmeta = table.TableMeta(k=K, bits=1, size_log2=8)
+    cstate = table.make_table(cmeta)
+    from quorum_tpu.models.create_database import extract_observations
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes[:1]), jnp.asarray(quals[:1]), K, 0)
+    cstate, full = table.add_kmer_batch(cstate, cmeta, chi, clo, q, valid)
+    assert not bool(full)
+
+    single = corrector.correct_batch(st, meta, codes, quals, lengths, cfg,
+                                     contam=(cstate, cmeta))
+
+    mesh = sharded.make_mesh(n_shards, devices=conftest.cpu_devices(n_shards))
+    step = sharded_correct.correct_step(mesh, meta, cfg, cmeta=cmeta)
+    rep = sharded_correct.replicate_table(st, mesh)
+    crep = sharded_correct.replicate_table(cstate, mesh)
+    res = step(rep, codes, quals, lengths, crep)
+
+    assert np.array_equal(np.asarray(res.status), np.asarray(single.status))
+    assert np.array_equal(np.asarray(res.out), np.asarray(single.out))
+    # the contaminated read must be flagged
+    assert int(np.asarray(res.status)[0]) == corrector.ST_CONTAMINANT
+
+
+def test_end_to_end_dryrun():
+    mesh = sharded.make_mesh(4, devices=conftest.cpu_devices(4))
+    sharded_correct.dryrun(mesh, 4)
