@@ -1,0 +1,808 @@
+"""Compact k-mer hash table: one uint32 per entry, one gather per lookup.
+
+The TPU-native successor to ops/table.py for the hot paths. The wide
+table stores full keys (2 x uint32) plus a value word and walks an
+open-addressing probe chain per query — up to `max_reprobe` dependent
+gather rounds. On this hardware a random gather's cost is set by the
+number of gathered *indices*, and a 16-byte aligned row costs the same
+as a 4-byte element, so the profitable layout is the one Jellyfish
+itself uses (SURVEY §2.3 `RectangularBinaryMatrix`: "invertible; keys
+stored partially", reference src/mer_database.hpp:28): hash the key
+with a *bijection*, use the low bits as the address, and store only the
+remaining bits. One entry then fits a single uint32 —
+
+    [ key remainder | quality bit | count ]     (rem_bits + 1 + bits <= 32)
+
+— and a whole 4-slot bucket is one aligned 16-byte row, fetched by ONE
+gather. Displacement is bounded by construction: an entry lives only in
+its home bucket; a bucket overflow reports FULL and the caller doubles
+the table (the reference's "Hash is full -> increase size" contract,
+src/create_database.cc:87, src/mer_database.hpp:98-99). Queries
+therefore need exactly one gather, always, with no probe loop.
+
+The bijection is a 4-round Feistel network on the 2k-bit key split into
+two k-bit halves — invertible by construction (keys are recoverable
+from (bucket, remainder), used by the iterator), uniform enough that
+bucket loads are Poisson. Growing needs no inverse at all: the full
+hash is (rem << nb_log2) | bucket, and rehashing to a doubled table is
+pure bit arithmetic on that value.
+
+Value-word semantics are identical to ops/table.py (reference
+src/mer_database.hpp:94-113): count saturating at 2^bits - 1, bit 0 of
+the decoded word = quality. Build-side counting uses split hq/lq
+accumulators whose finalize applies the order-independent closed form
+(count-at-best-quality), pinned by the reference's own unit test
+(unit_tests/test_mer_database.cc:117-118).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BUCKET = 4  # slots per bucket = one aligned 16-byte gather row
+_EMPTY_TAG = np.uint32(0xFFFFFFFF)
+
+# Feistel round constants (odd, golden-ratio/derived mixers).
+_ROUND_C = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F)
+
+
+# ---------------------------------------------------------------------------
+# Meta / state
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CTableMeta:
+    """Static geometry. `nb_log2` = log2(number of buckets)."""
+
+    k: int
+    bits: int  # count field width (reference -b flag, default 7)
+    nb_log2: int
+
+    def __post_init__(self):
+        if self.rem_bits + 1 + self.bits > 32:
+            raise ValueError(
+                f"compact layout infeasible: k={self.k} nb_log2="
+                f"{self.nb_log2} bits={self.bits} needs "
+                f"{self.rem_bits + 1 + self.bits} > 32 entry bits; "
+                f"grow nb_log2 to >= {2 * self.k - (31 - self.bits)} "
+                "or use the wide table")
+        if self.nb_log2 < 0 or self.nb_log2 > 30:
+            raise ValueError(f"nb_log2 out of range: {self.nb_log2}")
+
+    @property
+    def n_buckets(self) -> int:
+        return 1 << self.nb_log2
+
+    @property
+    def size(self) -> int:
+        return self.n_buckets * BUCKET
+
+    @property
+    def rem_bits(self) -> int:
+        return max(0, 2 * self.k - self.nb_log2)
+
+    @property
+    def max_val(self) -> int:
+        return (1 << self.bits) - 1
+
+
+def min_nb_log2(k: int, bits: int = 7) -> int:
+    """Smallest nb_log2 whose compact layout fits k and bits."""
+    return max(0, 2 * k - (31 - bits))
+
+
+def layout_fits(k: int, bits: int, nb_log2: int) -> bool:
+    return max(0, 2 * k - nb_log2) + 1 + bits <= 32
+
+
+def required_nb_log2(requested_entries: int, k: int, bits: int = 7) -> int:
+    """nb_log2 for a user-requested entry count: capacity with headroom
+    (target bucket load lambda <= 1, i.e. buckets >= entries) and the
+    layout constraint."""
+    cap = max(4, int(requested_entries - 1).bit_length())
+    return max(cap, min_nb_log2(k, bits))
+
+
+class CTableState(NamedTuple):
+    """Finalized, query-side table (a pytree): flat uint32[size].
+    All resident arrays are 1-D: on this TPU a resident [n, 4] shape
+    invites a T(8,128)-tiled parameter layout whose minor-dim padding
+    is a 32x memory blowup (measured OOM when a layout-changing copy
+    materialized between executables). Slot j of bucket b lives at
+    flat index 4*b + j."""
+
+    entries: jax.Array
+
+
+class CBuildState(NamedTuple):
+    """Build-side table: key tags + split quality accumulators, each a
+    flat uint32[size] (see CTableState for why 1-D). keytag ==
+    0xFFFFFFFF marks empty."""
+
+    keytag: jax.Array
+    hq: jax.Array
+    lq: jax.Array
+
+
+def make_build_table(meta: CTableMeta) -> CBuildState:
+    size = meta.size
+    return CBuildState(
+        jnp.full((size,), _EMPTY_TAG, dtype=jnp.uint32),
+        jnp.zeros((size,), dtype=jnp.uint32),
+        jnp.zeros((size,), dtype=jnp.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Feistel bijection on 2k bits
+# ---------------------------------------------------------------------------
+
+
+def _mix32(x):
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _halves(khi, klo, k: int):
+    """(hi, lo) 2-bit-packed key -> (L, R) k-bit Feistel halves."""
+    kmask = jnp.uint32((1 << k) - 1)
+    r = klo & kmask
+    if k < 32:
+        l = (klo >> k) & kmask
+        if k > 16:
+            l = (l | (khi << (32 - k))) & kmask
+    else:  # pragma: no cover - k <= 31 always
+        l = khi & kmask
+    return l, r
+
+
+def feistel_mix(khi, klo, k: int):
+    """Bijective mix of the 2k-bit key; returns k-bit halves (L, R)."""
+    kmask = jnp.uint32((1 << k) - 1)
+    l, r = _halves(khi, klo, k)
+    for c in _ROUND_C:
+        f = _mix32(r + jnp.uint32(c)) & kmask
+        l, r = r, l ^ f
+    return l, r
+
+
+def feistel_unmix(l, r, k: int):
+    """Inverse bijection: (L, R) -> original k-bit halves."""
+    kmask = jnp.uint32((1 << k) - 1)
+    for c in reversed(_ROUND_C):
+        l, r = r ^ (_mix32(l + jnp.uint32(c)) & kmask), l
+    return l, r
+
+
+def _halves_to_key(l, r, k: int):
+    """k-bit halves -> (hi, lo) 2-bit-packed key lanes."""
+    lo = (r | (l << k)).astype(jnp.uint32) if k < 32 else r
+    if 2 * k > 32:
+        hi = (l >> (32 - k)).astype(jnp.uint32)
+    else:
+        hi = jnp.zeros_like(l)
+    return hi, lo
+
+
+def bucket_rem(khi, klo, meta: CTableMeta):
+    """Canonical key lanes -> (bucket index int32, remainder uint32)."""
+    l, r = feistel_mix(jnp.asarray(khi, jnp.uint32),
+                       jnp.asarray(klo, jnp.uint32), meta.k)
+    k, nb = meta.k, meta.nb_log2
+    flo = (r | (l << k)) if k < 32 else r  # low 32 bits of the 2k-bit hash
+    fhi = (l >> (32 - k)) if 2 * k > 32 else jnp.zeros_like(l)
+    if nb == 0:
+        bucket = jnp.zeros_like(flo)
+        rem = flo
+        if 2 * k > 32:
+            rem = rem | (fhi << 32 - 32)  # pragma: no cover - rem_bits<=24
+    else:
+        bucket = flo & jnp.uint32((1 << nb) - 1)
+        rem = flo >> nb
+        if 2 * k > nb and 2 * k > 32:
+            rem = rem | (fhi << (32 - nb))
+    rem = rem & jnp.uint32((1 << meta.rem_bits) - 1) if meta.rem_bits else \
+        jnp.zeros_like(rem)
+    return bucket.astype(jnp.int32), rem
+
+
+def rehash_grow(bucket, rem, nb_log2: int):
+    """(bucket, rem) under nb_log2 -> same under nb_log2 + 1. The full
+    hash is (rem << nb) | bucket, so doubling moves rem's low bit into
+    the bucket's top bit — no Feistel inverse needed."""
+    b = jnp.asarray(bucket, jnp.uint32)
+    nbkt = b | ((rem & jnp.uint32(1)) << nb_log2)
+    return nbkt.astype(jnp.int32), rem >> 1
+
+
+def keys_from_table(bucket, rem, meta: CTableMeta):
+    """Recover canonical key lanes from (bucket, rem) — the iterator
+    primitive (reference database_query::const_iterator,
+    src/mer_database.hpp:331-361)."""
+    k, nb = meta.k, meta.nb_log2
+    b = jnp.asarray(bucket, jnp.uint32)
+    flo = b | (rem << nb) if nb < 32 else b
+    if 2 * k > 32:
+        fhi = (rem >> (32 - nb)) if nb and meta.rem_bits > (32 - nb) else \
+            jnp.zeros_like(rem)
+        if nb == 0:  # pragma: no cover - rem_bits <= 24 < 32
+            fhi = jnp.zeros_like(rem)
+    else:
+        fhi = jnp.zeros_like(rem)
+    kmask = jnp.uint32((1 << k) - 1)
+    r = flo & kmask
+    if k < 32:
+        l = (flo >> k) & kmask
+        if 2 * k > 32:
+            l = (l | (fhi << (32 - k))) & kmask
+    else:  # pragma: no cover
+        l = fhi & kmask
+    l, r = feistel_unmix(l, r, k)
+    return _halves_to_key(l, r, k)
+
+
+# ---------------------------------------------------------------------------
+# Entry packing
+# ---------------------------------------------------------------------------
+
+
+def pack_entry(rem, qual, count, meta: CTableMeta):
+    vq = (qual.astype(jnp.uint32) << meta.bits) | count.astype(jnp.uint32)
+    return (rem << (meta.bits + 1)) | vq
+
+
+def entry_val(entry, meta: CTableMeta):
+    """Entry -> reference value word (count << 1 | qual); 0 if empty."""
+    count = entry & jnp.uint32(meta.max_val)
+    qual = (entry >> meta.bits) & jnp.uint32(1)
+    return (count << 1) | qual
+
+
+def entry_rem(entry, meta: CTableMeta):
+    return entry >> (meta.bits + 1)
+
+
+# ---------------------------------------------------------------------------
+# Query: ONE aligned row gather per key
+# ---------------------------------------------------------------------------
+
+
+def lookup_impl(state: CTableState, meta: CTableMeta, khi, klo, active=None):
+    """Batched exact lookup. Returns the value word per canonical key
+    (0 if absent). Four flat gathers over the bucket's slots plus
+    vector compares — the device boundary of SURVEY §2.1
+    (database_query::operator[], src/mer_database.hpp:284-293). The
+    tile layout (tile_lookup) is the fast path for hot queries."""
+    bucket, rem = bucket_rem(khi, klo, meta)
+    if active is not None:
+        bucket = jnp.where(active, bucket, 0)
+    base = bucket * BUCKET
+    vmask = jnp.uint32((1 << (meta.bits + 1)) - 1)
+    vals = jnp.zeros(rem.shape, dtype=jnp.uint32)
+    for j in range(BUCKET):
+        e = state.entries[base + j]
+        match = ((e & vmask) != 0) & (entry_rem(e, meta) == rem)
+        vals = jnp.where(match, entry_val(e, meta), vals)
+    if active is not None:
+        vals = jnp.where(active, vals, 0)
+    return vals
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def lookup(state: CTableState, meta: CTableMeta, khi, klo):
+    return lookup_impl(state, meta, khi, klo)
+
+
+# ---------------------------------------------------------------------------
+# Build: claim rounds over raw (possibly duplicate) observations
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def _build_round(bstate: CBuildState, meta: CTableMeta, bucket, rem,
+                 hq_add, lq_add, done):
+    """One insert round over raw lanes. Each active lane gathers its
+    bucket row and targets its matching slot, else the first empty
+    slot. The keytag array is its own claim: every attempting lane
+    scatter-sets its rem at the target (matchers rewrite the same
+    value — idempotent), then re-reads the slot; whoever's rem landed
+    won. Same-key duplicates all "win" together and their (hq, lq)
+    contributions combine natively in the scatter-add; a different-key
+    loser retries next round against the winner's tag. No table-sized
+    claim array exists (XLA lowers large scatter-min to a sort with
+    table-length temporaries — measured OOM at k=24 sizes). A lane
+    whose bucket has no match and no empty slot is a bucket overflow:
+    it stays pending and the caller grows (FULL contract). Returns
+    (bstate, done, any_left)."""
+    active = ~done
+    gbkt = jnp.where(active, bucket, 0)
+    base = gbkt * BUCKET
+    # per-slot flat gathers (no [N, 4] temp, no 2-D layouts)
+    has_match = jnp.zeros_like(done)
+    mslot = jnp.zeros(base.shape, dtype=jnp.int32)
+    has_empty = jnp.zeros_like(done)
+    eslot = jnp.zeros(base.shape, dtype=jnp.int32)
+    for j in range(BUCKET - 1, -1, -1):
+        t = bstate.keytag[base + j]
+        m = t == rem
+        has_match = has_match | m
+        mslot = jnp.where(m, j, mslot)
+        e = t == _EMPTY_TAG
+        has_empty = has_empty | e
+        eslot = jnp.where(e, j, eslot)
+    has_match = active & has_match
+
+    attempt = active & (has_match | has_empty)
+    flat = base + jnp.where(has_match, mslot, eslot)
+    size = meta.size
+    widx = jnp.where(attempt, flat, size)
+    ktag = bstate.keytag.at[widx].set(rem, mode="drop")
+    won = attempt & (ktag[jnp.where(attempt, flat, 0)] == rem)
+    aidx = jnp.where(won, flat, size)
+    hq = bstate.hq.at[aidx].add(hq_add, mode="drop")
+    lq = bstate.lq.at[aidx].add(lq_add, mode="drop")
+    ndone = done | won
+    return CBuildState(ktag, hq, lq), ndone, jnp.any(~ndone)
+
+
+@jax.jit
+def _prep_obs(qual, valid):
+    q = qual.astype(jnp.uint32)
+    hq_add = jnp.where(valid, q, 0).astype(jnp.uint32)
+    lq_add = jnp.where(valid, jnp.uint32(1) - q, 0).astype(jnp.uint32)
+    return hq_add, lq_add, ~valid
+
+
+@jax.jit
+def _finish_obs(done, valid):
+    return jnp.any(~done), done & valid
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _bucket_rem_jit(meta: CTableMeta, khi, klo):
+    return bucket_rem(khi, klo, meta)
+
+
+def insert_observations(bstate: CBuildState, meta: CTableMeta, khi, klo,
+                        qual, valid, max_rounds: int | None = None):
+    """Insert a flat batch of raw (canonical k-mer, quality-bit)
+    observations. Runs a bounded number of claim rounds (claim losers
+    resolve one per slot per round); lanes still pending at the end are
+    bucket overflows. Returns (bstate, full: bool, placed mask).
+    On full the caller grows and retries with `valid & ~placed`
+    (exact-once, matching ops/table.merge_batch's contract)."""
+    bucket, rem = _bucket_rem_jit(meta, khi, klo)
+    hq_add, lq_add, done = _prep_obs(qual, valid)
+    # At most BUCKET placements per bucket per key-chain plus duplicate
+    # claim-loser resolution: 2 rounds per slot covers it; overflows
+    # are detected by the early-exit scalar instead of a tight bound.
+    limit = max_rounds or (2 * BUCKET + 2)
+    for _ in range(limit):
+        bstate, done, left = _build_round(bstate, meta, bucket, rem,
+                                          hq_add, lq_add, done)
+        if not bool(left):
+            break
+    full, placed = _finish_obs(done, valid)
+    return bstate, bool(full), placed
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def finalize_build(bstate: CBuildState, meta: CTableMeta) -> CTableState:
+    """Pack split accumulators into entries. Count-at-best-quality:
+    hq_total if any HQ observation else lq_total, saturated at max_val
+    (closed form of src/mer_database.hpp:104-111 over any order)."""
+    occ = bstate.keytag != _EMPTY_TAG
+    q = (bstate.hq > 0) & occ
+    cnt = jnp.where(q, bstate.hq, bstate.lq)
+    cnt = jnp.minimum(cnt, jnp.uint32(meta.max_val))
+    cnt = jnp.maximum(cnt, jnp.uint32(1))  # occupied => count >= 1
+    ent = pack_entry(bstate.keytag & jnp.uint32((1 << meta.rem_bits) - 1)
+                     if meta.rem_bits else jnp.zeros_like(bstate.keytag),
+                     q, cnt, meta)
+    return CTableState(jnp.where(occ, ent, jnp.uint32(0)))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def _grow_prep(bstate: CBuildState, meta: CTableMeta, start, length: int):
+    """One chunk of build entries flattened into re-insertable lanes
+    rehashed for a doubled table (pure bit arithmetic — rehash_grow).
+    `start` is traced (one executable serves every chunk); `length` is
+    static."""
+    rem = jax.lax.dynamic_slice(bstate.keytag, (start,), (length,))
+    hq = jax.lax.dynamic_slice(bstate.hq, (start,), (length,))
+    lq = jax.lax.dynamic_slice(bstate.lq, (start,), (length,))
+    bucket = (start + jnp.arange(length, dtype=jnp.int32)) // BUCKET
+    valid = rem != _EMPTY_TAG
+    nbkt, nrem = rehash_grow(bucket, jnp.where(valid, rem, 0), meta.nb_log2)
+    return nbkt, nrem, hq, lq, valid
+
+
+def grow_build(bstate: CBuildState, meta: CTableMeta, chunk: int = 1 << 22):
+    """Double the bucket count and re-scatter all entries, chunked to
+    bound peak HBM (the host-orchestrated twin of handle_full_ary,
+    src/mer_database.hpp:137-187)."""
+    new_meta = dataclasses.replace(meta, nb_log2=meta.nb_log2 + 1)
+    new_state = make_build_table(new_meta)
+    size = meta.size
+    length = min(chunk, size)
+    for start in range(0, size, length):
+        nbkt, nrem, hq, lq, valid = _grow_prep(
+            bstate, meta, jnp.int32(start), length)
+        done = ~valid
+        left = True
+        for _ in range(2 * BUCKET + 2):
+            new_state, done, left = _build_round(new_state, new_meta, nbkt,
+                                                 nrem, hq, lq, done)
+            if not bool(left):
+                break
+        if bool(left):  # pragma: no cover - halved load can't overflow
+            raise RuntimeError("Hash is full")
+    return new_state, new_meta
+
+
+# ---------------------------------------------------------------------------
+# Stats / iteration
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def table_stats(state: CTableState, meta: CTableMeta):
+    """(n_occupied, distinct_hq_ge1, total_hq) — the reductions behind
+    compute_poisson_cutoff__ (error_correct_reads.cc:650-659)."""
+    v = entry_val(state.entries, meta)
+    occ = v != 0
+    hq_sel = ((v & 1) == 1) & (v >= 2)
+    distinct = jnp.sum(hq_sel.astype(jnp.int32))
+    total = jnp.sum(jnp.where(hq_sel, v >> 1, 0).astype(jnp.float32))
+    return jnp.sum(occ.astype(jnp.int32)), distinct, total
+
+
+def iterate_entries(state: CTableState, meta: CTableMeta):
+    """Yield (khi, klo, val) numpy arrays for all occupied entries —
+    the const_iterator twin (src/mer_database.hpp:331-361)."""
+    ent = np.asarray(state.entries)
+    occ = np.nonzero(ent != 0)[0]
+    bucket = (occ // BUCKET).astype(np.int32)
+    rem = (ent[occ] >> np.uint32(meta.bits + 1)).astype(np.uint32)
+    khi, klo = jax.device_get(
+        keys_from_table(jnp.asarray(bucket), jnp.asarray(rem), meta))
+    val = jax.device_get(entry_val(jnp.asarray(ent[occ]), meta))
+    return np.asarray(khi), np.asarray(klo), np.asarray(val)
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) mirrors — oracle tests and CLIs
+# ---------------------------------------------------------------------------
+
+
+def _mix32_np(x):
+    x = np.uint32(x)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x7FEB352D)
+        x = x ^ (x >> np.uint32(15))
+        x = x * np.uint32(0x846CA68B)
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def bucket_rem_np(khi, klo, meta: CTableMeta):
+    """Host twin of bucket_rem — must match bit-for-bit."""
+    k = meta.k
+    kmask = np.uint32((1 << k) - 1)
+    khi = np.uint32(khi)
+    klo = np.uint32(klo)
+    r = klo & kmask
+    l = (klo >> np.uint32(k)) & kmask if k < 32 else np.uint32(0)
+    if k > 16:
+        l = (l | (khi << np.uint32(32 - k))) & kmask
+    with np.errstate(over="ignore"):
+        for c in _ROUND_C:
+            f = _mix32_np(r + np.uint32(c)) & kmask
+            l, r = r, l ^ f
+        flo = np.uint32((r | (l << np.uint32(k)))) if k < 32 else r
+        fhi = (l >> np.uint32(32 - k)) if 2 * k > 32 else np.uint32(0)
+    nb = meta.nb_log2
+    if nb == 0:
+        bucket = np.uint32(0)
+        rem = flo
+    else:
+        bucket = flo & np.uint32((1 << nb) - 1)
+        rem = flo >> np.uint32(nb)
+        if 2 * k > 32:
+            rem = rem | (fhi << np.uint32(32 - nb))
+    if meta.rem_bits:
+        rem = rem & np.uint32((1 << meta.rem_bits) - 1)
+    else:
+        rem = np.uint32(0)
+    return int(bucket), np.uint32(rem)
+
+
+def lookup_np(entries, meta: CTableMeta, khi, klo):
+    """Scalar host lookup over a flat numpy entries array."""
+    bucket, rem = bucket_rem_np(khi, klo, meta)
+    row = np.asarray(entries).reshape(-1)[bucket * BUCKET:
+                                          bucket * BUCKET + BUCKET]
+    vmask = np.uint32((1 << (meta.bits + 1)) - 1)
+    for e in row:
+        e = np.uint32(e)
+        if (e & vmask) != 0 and (e >> np.uint32(meta.bits + 1)) == rem:
+            count = e & np.uint32(meta.max_val)
+            qual = (e >> np.uint32(meta.bits)) & np.uint32(1)
+            return int((count << np.uint32(1)) | qual)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Tile-bucket query layout: one 512-byte hardware tile per bucket
+# ---------------------------------------------------------------------------
+#
+# Measured on this TPU: a gather of whole 128-lane rows ([R, 128] u32,
+# minor dim exactly one tile, zero padding) completes ~75M rows/s at 4M
+# indices inside a loop — an order of magnitude faster per LOOKUP than
+# any per-element or 4-element-slice gather formulation (all of which
+# serialize at ~65M scalar elements/s), because the gather engine is
+# tile-granular. So the query-side table makes the bucket BE the tile:
+# 64 two-word entries per 128-u32 row. A lookup is ONE row gather plus
+# 64-wide vector compares. With 64 slots per bucket, overflow
+# probability is astronomically small at any sane load, and the
+# two-word entry lifts the compact layout's k-limit: every k <= 31
+# fits at any table size.
+#
+# Entry (even column = lo word, odd = hi word):
+#   lo = [ rem_low(31-bits) | qual(1) | count(bits) ]   empty <=> count==0
+#   hi = [ rem_high ]
+#
+# The build side still counts in the bucket-4 CBuildState (or the wide
+# table for k > 27); tile_from_entries packs the finished counts into
+# this layout once, collision-free, via one sort by row.
+
+TILE = 128
+TSLOTS = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class TileMeta:
+    """Static geometry of the tile-bucket query table."""
+
+    k: int
+    bits: int
+    rb_log2: int  # log2(number of rows/buckets)
+
+    def __post_init__(self):
+        if self.rb_log2 < 0 or self.rb_log2 > 30:
+            raise ValueError(f"rb_log2 out of range: {self.rb_log2}")
+        if self.rem_bits - self.rlo_bits > 32:
+            raise ValueError(
+                f"tile layout infeasible: k={self.k} rb_log2={self.rb_log2} "
+                f"bits={self.bits}: rem_high needs "
+                f"{self.rem_bits - self.rlo_bits} > 32 bits")
+
+    @property
+    def rows(self) -> int:
+        return 1 << self.rb_log2
+
+    @property
+    def rem_bits(self) -> int:
+        return max(0, 2 * self.k - self.rb_log2)
+
+    @property
+    def rlo_bits(self) -> int:
+        return 31 - self.bits
+
+    @property
+    def max_val(self) -> int:
+        return (1 << self.bits) - 1
+
+
+class TileState(NamedTuple):
+    """[rows, 128] uint32 — memmap-able, query-ready."""
+
+    rows: jax.Array
+
+
+def min_tile_rb_log2(k: int, bits: int) -> int:
+    return max(0, 2 * k - (31 - bits) - 32)
+
+
+def tile_rb_for(n_entries: int, k: int, bits: int,
+                target_load: int = 24) -> int:
+    """rows for ~target_load entries per 64-slot bucket."""
+    want = max(1, (n_entries + target_load - 1) // target_load)
+    return max(min_tile_rb_log2(k, bits), 4,
+               int(want - 1).bit_length())
+
+
+def _hash_addr_rem(khi, klo, k: int, rb_log2: int):
+    """Feistel hash -> (row address int32, rem pair (lo32, hi32))."""
+    l, r = feistel_mix(jnp.asarray(khi, jnp.uint32),
+                       jnp.asarray(klo, jnp.uint32), k)
+    flo = (r | (l << k)) if k < 32 else r
+    fhi = (l >> (32 - k)) if 2 * k > 32 else jnp.zeros_like(l)
+    rb = rb_log2
+    if rb == 0:
+        addr = jnp.zeros_like(flo)
+        rem_lo, rem_hi = flo, fhi
+    else:
+        addr = flo & jnp.uint32((1 << rb) - 1)
+        rem_lo = (flo >> rb) | (fhi << (32 - rb))
+        rem_hi = fhi >> rb
+    rem_bits = max(0, 2 * k - rb)
+    if rem_bits < 32:
+        rem_lo = rem_lo & jnp.uint32((1 << rem_bits) - 1) if rem_bits else \
+            jnp.zeros_like(rem_lo)
+        rem_hi = jnp.zeros_like(rem_hi)
+    else:
+        rem_hi = rem_hi & jnp.uint32((1 << (rem_bits - 32)) - 1) \
+            if rem_bits > 32 else jnp.zeros_like(rem_hi)
+    return addr.astype(jnp.int32), rem_lo, rem_hi
+
+
+def _split_rem(rem_lo, rem_hi, meta: TileMeta):
+    """rem pair -> (rlo (fits lo word), rhi (fits hi word))."""
+    rl = meta.rlo_bits
+    rlo = rem_lo & jnp.uint32((1 << rl) - 1)
+    rhi = (rem_lo >> rl) | (rem_hi << (32 - rl))
+    if meta.rem_bits > rl:
+        rhi = rhi & (jnp.uint32((1 << (meta.rem_bits - rl)) - 1)
+                     if meta.rem_bits - rl < 32 else jnp.uint32(0xFFFFFFFF))
+    else:
+        rhi = jnp.zeros_like(rhi)
+    return rlo, rhi
+
+
+def tile_key_parts(khi, klo, meta: TileMeta):
+    addr, rem_lo, rem_hi = _hash_addr_rem(khi, klo, meta.k, meta.rb_log2)
+    rlo, rhi = _split_rem(rem_lo, rem_hi, meta)
+    return addr, rlo, rhi
+
+
+def tile_lookup_impl(state: TileState, meta: TileMeta, khi, klo,
+                     active=None):
+    """Batched exact lookup: ONE row gather + 64-wide compare.
+    Returns the reference value word per canonical key (0 if absent)."""
+    addr, rlo, rhi = tile_key_parts(khi, klo, meta)
+    if active is not None:
+        addr = jnp.where(active, addr, 0)
+    rows = state.rows[addr]  # [N, 128]
+    lo = rows[..., 0::2]
+    hi = rows[..., 1::2]
+    count = lo & jnp.uint32(meta.max_val)
+    occ = count != 0
+    match = occ & ((lo >> (meta.bits + 1)) == rlo[..., None]) & \
+        (hi == rhi[..., None])
+    qual = (lo >> meta.bits) & jnp.uint32(1)
+    val = (count << 1) | qual
+    out = jnp.sum(jnp.where(match, val, 0), axis=-1, dtype=jnp.uint32)
+    if active is not None:
+        out = jnp.where(active, out, 0)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_lookup(state: TileState, meta: TileMeta, khi, klo):
+    return tile_lookup_impl(state, meta, khi, klo)
+
+
+def tile_from_entries(khi, klo, vals, k: int, bits: int,
+                      rb_log2: int | None = None) -> tuple[TileState,
+                                                           TileMeta]:
+    """Pack finished (key, value-word) entries into the tile layout.
+    One numpy sort by row gives collision-free slot ranks — runs once
+    per database. Grows rows while any bucket would exceed 64 entries."""
+    khi = np.asarray(khi, dtype=np.uint32)
+    klo = np.asarray(klo, dtype=np.uint32)
+    vals = np.asarray(vals, dtype=np.uint32)
+    n = len(vals)
+    rb = rb_log2 if rb_log2 is not None else tile_rb_for(n, k, bits)
+    while True:
+        meta = TileMeta(k=k, bits=bits, rb_log2=rb)
+        addr, rlo, rhi = jax.device_get(
+            tile_key_parts(jnp.asarray(khi), jnp.asarray(klo), meta))
+        counts = np.bincount(addr, minlength=meta.rows)
+        if n == 0 or counts.max() <= TSLOTS:
+            break
+        rb += 1
+    order = np.argsort(addr, kind="stable")
+    a = addr[order]
+    boundary = np.ones(n, dtype=bool)
+    boundary[1:] = a[1:] != a[:-1]
+    seg_start = np.maximum.accumulate(np.where(boundary, np.arange(n), 0))
+    rank = np.arange(n) - seg_start
+    rows = np.zeros((meta.rows, TILE), dtype=np.uint32)
+    count = vals[order] >> 1
+    qual = vals[order] & 1
+    count = np.minimum(count, meta.max_val).astype(np.uint32)
+    lo_word = (rlo[order] << np.uint32(bits + 1)) | \
+        (qual << np.uint32(bits)) | count
+    rows[a, 2 * rank] = lo_word
+    rows[a, 2 * rank + 1] = rhi[order]
+    return TileState(jnp.asarray(rows)), meta
+
+
+def tile_from_build(bstate: CBuildState, meta: CTableMeta,
+                    rb_log2: int | None = None):
+    """Finalize a bucket-4 build straight into the tile query layout."""
+    state = finalize_build(bstate, meta)
+    khi, klo, vals = iterate_entries(state, meta)
+    return tile_from_entries(khi, klo, vals, meta.k, meta.bits, rb_log2)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_stats(state: TileState, meta: TileMeta):
+    """(n_occupied, distinct_hq_ge1, total_hq) over the tile table."""
+    lo = state.rows[:, 0::2]
+    count = lo & jnp.uint32(meta.max_val)
+    occ = count != 0
+    qual = (lo >> meta.bits) & jnp.uint32(1)
+    hq_sel = occ & (qual == 1)
+    distinct = jnp.sum(hq_sel.astype(jnp.int32))
+    total = jnp.sum(jnp.where(hq_sel, count, 0).astype(jnp.float32))
+    return jnp.sum(occ.astype(jnp.int32)), distinct, total
+
+
+def tile_iterate(state: TileState, meta: TileMeta):
+    """(khi, klo, val) numpy arrays for all occupied entries."""
+    rows = np.asarray(state.rows)
+    lo = rows[:, 0::2]
+    hi = rows[:, 1::2]
+    count = lo & np.uint32(meta.max_val)
+    r, s = np.nonzero(count != 0)
+    lo = lo[r, s]
+    hi = hi[r, s]
+    rl = meta.rlo_bits
+    rlo = lo >> np.uint32(meta.bits + 1)
+    rem_lo = (rlo | (hi << np.uint32(rl))).astype(np.uint32)
+    rem_hi = (hi >> np.uint32(32 - rl)).astype(np.uint32)
+    rb = meta.rb_log2
+    # full hash = (rem << rb) | addr, re-split into 32-bit lanes
+    if rb == 0:
+        flo, fhi = rem_lo, rem_hi
+    else:
+        flo = (r.astype(np.uint32) | (rem_lo << np.uint32(rb))).astype(
+            np.uint32)
+        fhi = ((rem_lo >> np.uint32(32 - rb)) |
+               (rem_hi << np.uint32(rb))).astype(np.uint32)
+    k = meta.k
+    kmask = np.uint32((1 << k) - 1)
+    rr = flo & kmask
+    ll = (flo >> np.uint32(k)) & kmask if k < 32 else np.uint32(0)
+    if 2 * k > 32:
+        ll = (ll | (fhi << np.uint32(32 - k))) & kmask
+    l, rr = jax.device_get(feistel_unmix(jnp.asarray(ll), jnp.asarray(rr),
+                                         k))
+    khi, klo = jax.device_get(_halves_to_key(jnp.asarray(l),
+                                             jnp.asarray(rr), k))
+    val = ((count[r, s] << 1) |
+           ((lo >> np.uint32(meta.bits)) & 1)).astype(np.uint32)
+    return np.asarray(khi), np.asarray(klo), val
+
+
+def tile_lookup_np(rows, meta: TileMeta, khi, klo):
+    """Scalar host lookup over a numpy [rows, 128] array."""
+    addr, rlo, rhi = jax.device_get(
+        tile_key_parts(jnp.asarray([np.uint32(khi)]),
+                       jnp.asarray([np.uint32(klo)]), meta))
+    row = rows[int(addr[0])]
+    lo = row[0::2]
+    hi = row[1::2]
+    count = lo & np.uint32(meta.max_val)
+    match = (count != 0) & ((lo >> np.uint32(meta.bits + 1)) == rlo[0]) & \
+        (hi == rhi[0])
+    idx = np.nonzero(match)[0]
+    if len(idx) == 0:
+        return 0
+    j = idx[0]
+    return int((count[j] << np.uint32(1)) |
+               ((row[2 * j] >> np.uint32(meta.bits)) & 1))

@@ -1,0 +1,275 @@
+"""Tests for the compact one-word-per-entry table (quorum_tpu.ops.ctable).
+
+Covers: Feistel bijectivity (exhaustive for small k), device/host hash
+twins, key recovery (iterator), grow rehash consistency, build/query
+parity against both a sequential replay of the reference add() rule and
+the wide table (ops/table.py), and the bucket-overflow -> grow path
+(the reference's FULL contract, forced by undersizing — the same trick
+as unit_tests/test_mer_database.cc's small initial sizes)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.ops import ctable, table
+
+from test_table import brute_force_counts
+
+
+def split_keys(keys):
+    khi = jnp.asarray((keys >> np.uint64(32)).astype(np.uint32))
+    klo = jnp.asarray((keys & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    return khi, klo
+
+
+@pytest.mark.parametrize("k", [4, 9])
+def test_feistel_bijective_exhaustive(k):
+    n = 1 << (2 * k)
+    keys = np.arange(n, dtype=np.uint64)
+    khi, klo = split_keys(keys)
+    l, r = jax.jit(ctable.feistel_mix, static_argnums=2)(khi, klo, k)
+    full = np.asarray(l).astype(np.uint64) << np.uint64(k)
+    full = full | np.asarray(r).astype(np.uint64)
+    assert len(np.unique(full)) == n  # injective on the full domain
+    il, ir = jax.jit(ctable.feistel_unmix, static_argnums=2)(l, r, k)
+    ihi, ilo = ctable._halves_to_key(il, ir, k)
+    assert np.array_equal(np.asarray(ihi), np.asarray(khi))
+    assert np.array_equal(np.asarray(ilo), np.asarray(klo))
+
+
+@pytest.mark.parametrize("k", [9, 16, 24, 27])
+def test_bucket_rem_device_matches_host(k):
+    rng = np.random.default_rng(k)
+    meta = ctable.CTableMeta(k=k, bits=7,
+                             nb_log2=max(6, ctable.min_nb_log2(k, 7)))
+    keys = rng.integers(0, 1 << min(63, 2 * k), size=200, dtype=np.uint64)
+    keys &= (1 << np.uint64(2 * k)) - np.uint64(1)
+    khi, klo = split_keys(keys)
+    db, dr = jax.jit(ctable.bucket_rem, static_argnums=2)(khi, klo, meta)
+    for i in range(len(keys)):
+        hb, hr = ctable.bucket_rem_np(np.uint32(khi[i]), np.uint32(klo[i]),
+                                      meta)
+        assert int(db[i]) == hb
+        assert int(dr[i]) == int(hr)
+
+
+@pytest.mark.parametrize("k", [9, 24, 27])
+def test_keys_from_table_inverts(k):
+    rng = np.random.default_rng(k + 1)
+    meta = ctable.CTableMeta(k=k, bits=7,
+                             nb_log2=max(8, ctable.min_nb_log2(k, 7)))
+    keys = rng.integers(0, 1 << min(63, 2 * k), size=500, dtype=np.uint64)
+    keys &= (1 << np.uint64(2 * k)) - np.uint64(1)
+    khi, klo = split_keys(keys)
+    b, r = jax.jit(ctable.bucket_rem, static_argnums=2)(khi, klo, meta)
+    ihi, ilo = ctable.keys_from_table(b, r, meta)
+    assert np.array_equal(np.asarray(ihi), np.asarray(khi))
+    assert np.array_equal(np.asarray(ilo), np.asarray(klo))
+
+
+@pytest.mark.parametrize("k", [9, 24])
+def test_rehash_grow_matches_rehashing(k):
+    rng = np.random.default_rng(k + 2)
+    nb = max(8, ctable.min_nb_log2(k, 7))
+    meta1 = ctable.CTableMeta(k=k, bits=7, nb_log2=nb)
+    meta2 = ctable.CTableMeta(k=k, bits=7, nb_log2=nb + 1)
+    keys = rng.integers(0, 1 << min(63, 2 * k), size=300, dtype=np.uint64)
+    keys &= (1 << np.uint64(2 * k)) - np.uint64(1)
+    khi, klo = split_keys(keys)
+    b1, r1 = jax.jit(ctable.bucket_rem, static_argnums=2)(khi, klo, meta1)
+    gb, gr = ctable.rehash_grow(b1, r1, meta1.nb_log2)
+    b2, r2 = jax.jit(ctable.bucket_rem, static_argnums=2)(khi, klo, meta2)
+    assert np.array_equal(np.asarray(gb), np.asarray(b2))
+    assert np.array_equal(np.asarray(gr), np.asarray(r2))
+
+
+def build_from_obs(meta, keys, quals, batch=97, max_grows=12):
+    """insert_observations in batches with the grow-retry protocol."""
+    bstate = ctable.make_build_table(meta)
+    for start in range(0, len(keys), batch):
+        kk = keys[start:start + batch]
+        qq = quals[start:start + batch]
+        khi, klo = split_keys(kk)
+        qd = jnp.asarray(qq.astype(np.int32))
+        pending = jnp.ones(len(kk), dtype=bool)
+        for _ in range(max_grows + 1):
+            bstate, full, placed = ctable.insert_observations(
+                bstate, meta, khi, klo, qd, pending)
+            if not full:
+                break
+            pending = np.asarray(pending & ~np.asarray(placed))
+            pending = jnp.asarray(pending)
+            bstate, meta = ctable.grow_build(bstate, meta)
+        else:
+            raise RuntimeError("Hash is full")
+    return bstate, meta
+
+
+@pytest.mark.parametrize("bits", [3, 7])
+@pytest.mark.parametrize("nb_log2", [2, 6, 10])
+def test_build_matches_sequential_reference_rule(bits, nb_log2):
+    k = 12  # keeps min_nb_log2 = 0 so tiny tables force the grow path
+    rng = np.random.default_rng(nb_log2 * 100 + bits)
+    pool = rng.integers(0, 1 << (2 * k), size=60, dtype=np.uint64)
+    idx = rng.integers(0, len(pool), size=800)
+    keys = pool[idx]
+    quals = rng.integers(0, 2, size=len(keys))
+    meta = ctable.CTableMeta(k=k, bits=bits, nb_log2=nb_log2)
+    bstate, meta = build_from_obs(meta, keys, quals)
+    state = ctable.finalize_build(bstate, meta)
+
+    expect = brute_force_counts(
+        [(int(keys[i]), int(quals[i])) for i in range(len(keys))], bits)
+    entries = np.asarray(state.entries)
+    khi, klo = split_keys(np.asarray(sorted(expect), dtype=np.uint64))
+    vals = ctable.lookup(state, meta, khi, klo)
+    for i, key in enumerate(sorted(expect)):
+        cnt, q = expect[key]
+        got = int(vals[i])
+        assert got >> 1 == cnt, (hex(key), cnt, got >> 1)
+        assert got & 1 == q
+        assert ctable.lookup_np(entries, meta, np.uint32(key >> 32),
+                                np.uint32(key & 0xFFFFFFFF)) == got
+    # absent keys miss
+    absent = rng.integers(0, 1 << (2 * k), size=50, dtype=np.uint64)
+    absent = np.asarray([a for a in absent if int(a) not in expect],
+                        dtype=np.uint64)
+    if len(absent):
+        ahi, alo = split_keys(absent)
+        avals = ctable.lookup(state, meta, ahi, alo)
+        assert not np.any(np.asarray(avals))
+
+
+def test_parity_with_wide_table():
+    """Same observation stream into ctable and ops/table.py: identical
+    value words for every key."""
+    k, bits = 15, 7
+    rng = np.random.default_rng(7)
+    pool = rng.integers(0, 1 << (2 * k), size=500, dtype=np.uint64)
+    idx = rng.integers(0, len(pool), size=5000)
+    keys = pool[idx]
+    quals = rng.integers(0, 2, size=len(keys))
+
+    cmeta = ctable.CTableMeta(k=k, bits=bits, nb_log2=9)
+    bstate, cmeta = build_from_obs(cmeta, keys, quals, batch=701)
+    cstate = ctable.finalize_build(bstate, cmeta)
+
+    wmeta = table.TableMeta(k=k, bits=bits, size_log2=11)
+    wstate = table.make_table(wmeta)
+    for start in range(0, len(keys), 701):
+        kk = keys[start:start + 701]
+        qq = quals[start:start + 701]
+        khi, klo = split_keys(kk)
+        wstate, full = table.add_kmer_batch(
+            wstate, wmeta, khi, klo, jnp.asarray(qq.astype(np.int32)),
+            jnp.ones(len(kk), dtype=bool))
+        assert not bool(full)
+
+    uniq = np.unique(keys)
+    khi, klo = split_keys(uniq)
+    cv = np.asarray(ctable.lookup(cstate, cmeta, khi, klo))
+    wv = np.asarray(table.lookup(wstate, wmeta, khi, klo))
+    assert np.array_equal(cv, wv)
+
+
+def test_iterate_entries_recovers_key_set():
+    k = 14
+    rng = np.random.default_rng(3)
+    keys = np.unique(
+        rng.integers(0, 1 << (2 * k), size=300, dtype=np.uint64))
+    quals = rng.integers(0, 2, size=len(keys))
+    meta = ctable.CTableMeta(k=k, bits=7, nb_log2=8)
+    bstate, meta = build_from_obs(meta, keys, quals)
+    state = ctable.finalize_build(bstate, meta)
+    khi, klo, vals = ctable.iterate_entries(state, meta)
+    got = set((np.asarray(khi).astype(np.uint64) << np.uint64(32)
+               | np.asarray(klo).astype(np.uint64)).tolist())
+    assert got == set(keys.tolist())
+    assert np.all(vals != 0)
+
+
+def test_layout_infeasible_raises():
+    with pytest.raises(ValueError):
+        ctable.CTableMeta(k=24, bits=7, nb_log2=10)
+    assert ctable.layout_fits(24, 7, 24)
+    assert not ctable.layout_fits(24, 7, 23)
+    assert ctable.required_nb_log2(100, 24, 7) == 24
+
+
+# ---------------------------------------------------------------------------
+# Tile-bucket query layout
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [9, 24, 31])
+def test_tile_roundtrip_and_lookup(k):
+    """Synthetic (key, val) entries -> tile table: lookups hit exactly,
+    absent keys miss, iterator recovers the key set, host mirror
+    agrees."""
+    rng = np.random.default_rng(k)
+    keys = np.unique(
+        rng.integers(0, 1 << min(63, 2 * k), size=4000, dtype=np.uint64)
+        & ((1 << np.uint64(2 * k)) - np.uint64(1)))
+    vals = rng.integers(2, 256, size=len(keys), dtype=np.uint32)
+    khi, klo = split_keys(keys)
+    state, meta = ctable.tile_from_entries(np.asarray(khi), np.asarray(klo),
+                                           vals, k, bits=7)
+    got = np.asarray(ctable.tile_lookup(state, meta, khi, klo))
+    assert np.array_equal(got, vals)
+
+    absent = np.setdiff1d(
+        rng.integers(0, 1 << min(63, 2 * k), size=500, dtype=np.uint64)
+        & ((1 << np.uint64(2 * k)) - np.uint64(1)), keys)
+    ahi, alo = split_keys(absent)
+    assert not np.any(np.asarray(ctable.tile_lookup(state, meta, ahi, alo)))
+
+    ikhi, iklo, ivals = ctable.tile_iterate(state, meta)
+    got_keys = set((ikhi.astype(np.uint64) << np.uint64(32)
+                    | iklo.astype(np.uint64)).tolist())
+    assert got_keys == set(keys.tolist())
+
+    rows = np.asarray(state.rows)
+    for i in rng.integers(0, len(keys), size=30):
+        assert ctable.tile_lookup_np(rows, meta, np.uint32(khi[i]),
+                                     np.uint32(klo[i])) == int(vals[i])
+
+
+def test_tile_from_build_matches_bucket4():
+    """Full path: observations -> bucket-4 build -> tile pack; tile
+    lookups equal the bucket-4 lookups for every key."""
+    k = 13
+    rng = np.random.default_rng(5)
+    pool = rng.integers(0, 1 << (2 * k), size=400, dtype=np.uint64)
+    keys = pool[rng.integers(0, len(pool), size=4000)]
+    quals = rng.integers(0, 2, size=len(keys))
+    meta = ctable.CTableMeta(k=k, bits=7, nb_log2=8)
+    bstate, meta = build_from_obs(meta, keys, quals, batch=997)
+    cstate = ctable.finalize_build(bstate, meta)
+    tstate, tmeta = ctable.tile_from_build(bstate, meta)
+
+    uniq = np.unique(keys)
+    khi, klo = split_keys(uniq)
+    cv = np.asarray(ctable.lookup(cstate, meta, khi, klo))
+    tv = np.asarray(ctable.tile_lookup(tstate, tmeta, khi, klo))
+    assert np.array_equal(cv, tv)
+
+    co, cd, ct = ctable.table_stats(cstate, meta)
+    to, td, tt = ctable.tile_stats(tstate, tmeta)
+    assert (int(co), int(cd), float(ct)) == (int(to), int(td), float(tt))
+
+
+def test_tile_overflow_grows_rows():
+    """Force >64 entries into one bucket's worth of keys by undersizing
+    rows; packing must auto-double until it fits."""
+    k = 10
+    rng = np.random.default_rng(9)
+    keys = np.unique(rng.integers(0, 1 << (2 * k), size=600,
+                                  dtype=np.uint64))
+    vals = np.full(len(keys), 5, dtype=np.uint32)
+    khi, klo = split_keys(keys)
+    state, meta = ctable.tile_from_entries(np.asarray(khi), np.asarray(klo),
+                                           vals, k, bits=7, rb_log2=0)
+    assert meta.rb_log2 > 0  # grew
+    got = np.asarray(ctable.tile_lookup(state, meta, khi, klo))
+    assert np.array_equal(got, vals)
