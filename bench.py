@@ -1,11 +1,18 @@
 """Benchmark: end-to-end device throughput vs the reference baseline.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
-Baseline: the reference's headline claim of 48 Gbases/hour for
-correction on 48 threads (paper/bmc_article.tex:199; BASELINE.md).
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} for the
+HEADLINE metric — stage-2 correction throughput, the quantity the
+reference's 48 Gbases/hour claim measures (48 threads,
+paper/bmc_article.tex:199; BASELINE.md) — plus secondary lines for the
+stage-1 build (marked with its own baseline_metric caveat: the
+reference publishes no separate build number).
 
-Until the batched corrector lands, measures the stage-1 database-build
-throughput; afterwards it measures the full correct path.
+Shapes are production-like: k=24, 150 bp reads, 16k-read device
+batches, ~10x coverage with 1% substitution errors so the ambiguous
+paths and table load are realistic. The first run in a fresh
+environment pays one-time XLA AOT compiles (~minutes on the tunneled
+TPU); the persistent compilation cache (utils/jaxcache) makes repeat
+runs compile-free.
 """
 
 from __future__ import annotations
@@ -18,63 +25,102 @@ import numpy as np
 BASELINE_GBASES_PER_HOUR = 48.0
 
 
-def synth_reads(rng, n_reads, read_len, genome_len=200_000, err_rate=0.01):
-    """Reads sampled from a random genome with substitution errors —
-    shaped like real Illumina input so hash-table load is realistic."""
-    genome = rng.integers(0, 4, size=genome_len, dtype=np.int8)
-    starts = rng.integers(0, genome_len - read_len, size=n_reads)
+def synth_reads(rng, genome, n_reads, read_len, err_rate=0.01):
+    """Reads sampled from one genome with substitution errors — shaped
+    like real Illumina input so table load and branch mix are
+    realistic."""
+    starts = rng.integers(0, len(genome) - read_len, size=n_reads)
     idx = starts[:, None] + np.arange(read_len)[None, :]
     codes = genome[idx]
     errs = rng.random(codes.shape) < err_rate
     codes = np.where(errs, (codes + rng.integers(1, 4, size=codes.shape)) % 4,
                      codes).astype(np.int8)
-    quals = rng.integers(35, 74, size=codes.shape).astype(np.uint8)
-    quals[errs] = 33
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[errs] = 68  # still "high" for the quality bit; errors stay real
     return codes, quals
 
 
-def bench_stage1(batch=16384, read_len=150, n_batches=8, k=24):
+def main():
     import jax
     import jax.numpy as jnp
-    from quorum_tpu.ops import table
+
+    from quorum_tpu.utils.jaxcache import enable_cache
+    enable_cache()
+    from quorum_tpu.ops import ctable
     from quorum_tpu.models.create_database import extract_observations
+    from quorum_tpu.models.corrector import correct_batch
+    from quorum_tpu.models.ec_config import ECConfig
 
+    k, read_len, batch, nb = 24, 150, 16384, 8
     rng = np.random.default_rng(0)
-    meta = table.TableMeta(k=k, bits=7,
-                           size_log2=table.required_size_log2(
-                               4 * batch * read_len))
-    state = table.make_table(meta)
+    genome = rng.integers(0, 4, size=2_000_000, dtype=np.int8)
+    batches = [
+        tuple(jnp.asarray(a) for a in synth_reads(rng, genome, batch,
+                                                  read_len))
+        for _ in range(nb)
+    ]
+    jax.block_until_ready(batches)
+    # one scalar D2H switches this client into synchronous dispatch,
+    # which measures true completion time per call (async enqueue mode
+    # both distorts timing and is slower end-to-end here)
+    _ = float(jnp.zeros(()))
 
-    batches = [synth_reads(rng, batch, read_len) for _ in range(2)]
-    dev_batches = [(jnp.asarray(c), jnp.asarray(q)) for c, q in batches]
+    meta = ctable.TileMeta(k=k, bits=7,
+                           rb_log2=ctable.tile_rb_for(6_000_000, k, 7))
 
-    def step(state, codes, quals):
-        chi, clo, qb, valid = extract_observations(codes, quals, k, 53)
-        u = table.aggregate_kmers(chi, clo, qb, valid)
-        state, full, _ = table._probe_insert(state, meta, *u, raw=False)
-        return state, full
+    def build():
+        bstate = ctable.make_tile_build(meta)
+        for codes, quals in batches:
+            chi, clo, q, valid = extract_observations(codes, quals, k, 38)
+            bstate, full, _ = ctable.tile_insert_observations(
+                bstate, meta, chi, clo, q, valid)
+            assert not full, "bench table mis-sized (FULL)"
+        return ctable.tile_finalize(bstate, meta)
 
-    step = jax.jit(step, donate_argnums=(0,))
-    state, _ = step(state, *dev_batches[0])  # compile + warm
-    jax.block_until_ready(state)
-
+    state = build()  # compile/warm
+    jax.block_until_ready(ctable.tile_stats(state, meta))  # warm stats too
     t0 = time.perf_counter()
-    for i in range(n_batches):
-        state, full = step(state, *dev_batches[i % 2])
-    jax.block_until_ready(state)
+    state = build()
+    occ, _, _ = jax.block_until_ready(ctable.tile_stats(state, meta))
+    build_dt = time.perf_counter() - t0
+    bases = nb * batch * read_len
+    s1 = bases / build_dt * 3600 / 1e9
+
+    cfg = ECConfig(k=k, cutoff=4)
+    lengths = jnp.full((batch,), read_len, jnp.int32)
+
+    def correct(n):
+        res = []
+        for codes, quals in batches[:n]:
+            res.append(correct_batch(state, meta, codes, quals, lengths,
+                                     cfg))
+        return jax.block_until_ready(res)
+
+    res = correct(1)  # compile/warm
+    n2 = 4
+    t0 = time.perf_counter()
+    res = correct(n2)
     dt = time.perf_counter() - t0
-    bases = n_batches * batch * read_len
-    return bases / dt
+    ok = sum(int((np.asarray(r.status) == 0).sum()) for r in res)
+    assert ok > 0.9 * n2 * batch, f"correction mostly failing ({ok})"
+    s2 = n2 * batch * read_len / dt * 3600 / 1e9
 
-
-def main():
-    bases_per_s = bench_stage1()
-    gb_per_h = bases_per_s * 3600 / 1e9
+    # HEADLINE: stage-2 correction vs the 48 Gb/h correction baseline
+    print(json.dumps({
+        "metric": "stage2_correction_throughput",
+        "value": round(s2, 3),
+        "unit": "Gbases/hour",
+        "vs_baseline": round(s2 / BASELINE_GBASES_PER_HOUR, 3),
+    }))
+    # secondary: the reference has no published build-only number; the
+    # ratio below still divides by the CORRECTION baseline
     print(json.dumps({
         "metric": "stage1_db_build_throughput",
-        "value": round(gb_per_h, 3),
+        "value": round(s1, 3),
         "unit": "Gbases/hour",
-        "vs_baseline": round(gb_per_h / BASELINE_GBASES_PER_HOUR, 3),
+        "vs_baseline": round(s1 / BASELINE_GBASES_PER_HOUR, 3),
+        "baseline_metric": "stage2_correction_throughput_48h",
+        "distinct_mers": int(occ),
     }))
 
 

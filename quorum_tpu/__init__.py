@@ -24,4 +24,4 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
                ctypes, with a pure-Python fallback.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

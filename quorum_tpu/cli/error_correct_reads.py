@@ -69,6 +69,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
     args = build_parser().parse_args(argv)
     vlog_mod.verbose = args.verbose
 

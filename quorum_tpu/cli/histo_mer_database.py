@@ -25,12 +25,15 @@ def histo(vals: np.ndarray) -> np.ndarray:
 
 
 def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
         print(f"Usage: histo_mer_database db", file=sys.stderr)
         return 1
-    state, _, _ = db_format.read_db(argv[0], to_device=False)
-    out = histo(state.vals)
+    state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    _, _, vals = db_format.db_iterate(state, meta)
+    out = histo(vals)
     for i in range(HLEN):
         if out[i, 0] or out[i, 1]:
             print(f"{i} {out[i, 0]} {out[i, 1]}")

@@ -6,10 +6,12 @@ from __future__ import annotations
 import sys
 
 from ..io import db_format
-from ..ops import mer, table
+from ..ops import mer
 
 
 def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) < 2:
         print(f"Usage: query_mer_database db mer ...", file=sys.stderr)
@@ -23,8 +25,7 @@ def main(argv=None) -> int:
             continue
         hi, lo = mer.pack_kmer(s)
         chi, clo = mer.canonical_py(hi, lo, k)
-        v = table.lookup_np(state.keys_hi, state.keys_lo, state.vals,
-                            chi, clo, meta.max_reprobe)
+        v = db_format.db_lookup_np(state, meta, chi, clo)
         canon = mer.unpack_kmer(chi, clo, k)
         print(f"{s}:{canon} val:{v >> 1} qual:{v & 1}")
     return 0

@@ -105,6 +105,8 @@ def detect_min_q_char(path: str, max_reads: int = 1000) -> int:
 
 
 def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
     args = build_parser().parse_args(argv)
     vlog_mod.verbose = args.debug
 

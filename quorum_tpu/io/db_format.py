@@ -6,12 +6,20 @@ JSON header followed by the raw table arrays
 (reference: database_header src/mer_database.hpp:43-63,
 hash_with_quality::write :115-126, reload via database_query :270-278).
 
-We keep the reference's header spirit (format tag, geometry, provenance
-fields from file_header::fill_standard) but the payload is our TPU
-layout: three little-endian uint32 arrays (keys_hi, keys_lo, vals) of
-equal length `size`, written contiguously after the header line. Keys
-are stored in full (the reference stores partial keys recoverable via
-its invertible matrix hash — unnecessary here).
+Two payload versions:
+
+* version 2 (written by stage 1): the tile-bucket layout — ONE
+  little-endian uint32 array of shape [rows, 128], memmap-able and
+  query-ready (ops/ctable.TileState). Keys are stored partially (the
+  remainder of an invertible Feistel hash), the same trick the
+  reference's Jellyfish layer uses (RectangularBinaryMatrix,
+  src/mer_database.hpp:28).
+
+* version 1 (legacy wide): three uint32 arrays (keys_hi, keys_lo,
+  vals) of equal length `size` (ops/table.TableState). Still readable.
+
+Dispatch helpers (`db_lookup_np`, `db_iterate`, `db_stats`) work on
+either, so the inspection CLIs are format-agnostic.
 """
 
 from __future__ import annotations
@@ -25,33 +33,56 @@ import time
 import numpy as np
 import jax.numpy as jnp
 
+from ..ops import ctable, table
 from ..ops.table import TableMeta, TableState
+from ..ops.ctable import TileMeta, TileState
 
 FORMAT = "binary/quorum_tpu_db"
 
 
-def write_db(path: str, state: TableState, meta: TableMeta,
-             cmdline: list[str] | None = None) -> None:
-    keys_hi = np.asarray(state.keys_hi, dtype=np.uint32)
-    keys_lo = np.asarray(state.keys_lo, dtype=np.uint32)
-    vals = np.asarray(state.vals, dtype=np.uint32)
-    size = meta.size
-    header = {
-        "format": FORMAT,
-        "version": 1,
-        "key_len": 2 * meta.k,
-        "bits": meta.bits,
-        "size": size,
-        "size_log2": meta.size_log2,
-        "max_reprobe": meta.max_reprobe,
-        "key_bytes": int(keys_hi.nbytes + keys_lo.nbytes),
-        "value_bytes": int(vals.nbytes),
+def _header_common(cmdline):
+    return {
         # provenance, like file_header::fill_standard / set_cmdline
         "cmdline": cmdline or [],
         "hostname": socket.gethostname(),
         "pwd": os.getcwd(),
         "time": time.strftime("%Y-%m-%d %H:%M:%S"),
         "user": getpass.getuser(),
+    }
+
+
+def write_db(path: str, state, meta, cmdline: list[str] | None = None
+             ) -> None:
+    if isinstance(meta, TileMeta):
+        rows = np.asarray(state.rows, dtype=np.uint32)
+        header = {
+            "format": FORMAT,
+            "version": 2,
+            "key_len": 2 * meta.k,
+            "bits": meta.bits,
+            "rb_log2": meta.rb_log2,
+            "rows": meta.rows,
+            "value_bytes": int(rows.nbytes),
+            **_header_common(cmdline),
+        }
+        with open(path, "wb") as f:
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(rows.tobytes())
+        return
+    keys_hi = np.asarray(state.keys_hi, dtype=np.uint32)
+    keys_lo = np.asarray(state.keys_lo, dtype=np.uint32)
+    vals = np.asarray(state.vals, dtype=np.uint32)
+    header = {
+        "format": FORMAT,
+        "version": 1,
+        "key_len": 2 * meta.k,
+        "bits": meta.bits,
+        "size": meta.size,
+        "size_log2": meta.size_log2,
+        "max_reprobe": meta.max_reprobe,
+        "key_bytes": int(keys_hi.nbytes + keys_lo.nbytes),
+        "value_bytes": int(vals.nbytes),
+        **_header_common(cmdline),
     }
     with open(path, "wb") as f:
         f.write(json.dumps(header).encode() + b"\n")
@@ -72,22 +103,37 @@ def read_header(path: str) -> dict:
 
 
 def read_db(path: str, to_device: bool = True):
-    """Load a database file. Returns (state, meta, header). With
-    to_device the arrays are jnp (HBM); else host numpy views.
+    """Load a database file. Returns (state, meta, header) where state/
+    meta are (TileState, TileMeta) for version-2 files and (TableState,
+    TableMeta) for legacy version-1 files. With to_device the arrays
+    are jnp (HBM); else host numpy views.
 
     The reference mmaps by default with a --no-mmap escape hatch
     (map_or_read_file, src/mer_database.hpp:228-248); we always memmap
     on host and the `to_device` flag controls the HBM copy."""
     header = read_header(path)
-    size = header["size"]
     with open(path, "rb") as f:
         offset = len(f.readline())
+    if header.get("version", 1) == 2:
+        rows = 1 << header["rb_log2"]  # geometry source of truth
+        if header.get("rows", rows) != rows:
+            raise ValueError(f"corrupt header: rows={header.get('rows')} "
+                             f"!= 2^rb_log2={rows} in '{path}'")
+        mm = np.memmap(path, dtype=np.uint32, mode="r", offset=offset,
+                       shape=(rows, ctable.TILE))
+        assert offset + rows * ctable.TILE * 4 <= os.path.getsize(path), \
+            "truncated database"
+        meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],
+                        rb_log2=header["rb_log2"])
+        state = TileState(jnp.asarray(mm) if to_device else mm)
+        return state, meta, header
+    size = header["size"]
     nbytes = size * 4
     mm = np.memmap(path, dtype=np.uint32, mode="r", offset=offset,
                    shape=(3 * size,))
     keys_hi = mm[:size]
-    keys_lo = mm[size : 2 * size]
-    vals = mm[2 * size :]
+    keys_lo = mm[size: 2 * size]
+    vals = mm[2 * size:]
     assert offset + 3 * nbytes <= os.path.getsize(path), "truncated database"
     meta = TableMeta(
         k=header["key_len"] // 2,
@@ -102,3 +148,33 @@ def read_db(path: str, to_device: bool = True):
     else:
         state = TableState(keys_hi, keys_lo, vals)
     return state, meta, header
+
+
+# ---------------------------------------------------------------------------
+# Format-agnostic helpers (inspection CLIs, oracle)
+# ---------------------------------------------------------------------------
+
+
+def db_lookup_np(state, meta, khi, klo) -> int:
+    """Scalar host lookup on either format."""
+    if isinstance(meta, TileMeta):
+        return ctable.tile_lookup_np(np.asarray(state.rows), meta, khi, klo)
+    return table.lookup_np(state.keys_hi, state.keys_lo, state.vals,
+                           khi, klo, meta.max_reprobe)
+
+
+def db_iterate(state, meta):
+    """(khi, klo, val) numpy arrays of all occupied entries."""
+    if isinstance(meta, TileMeta):
+        return ctable.tile_iterate(state, meta)
+    vals = np.asarray(state.vals)
+    occ = np.nonzero(vals != 0)[0]
+    return (np.asarray(state.keys_hi)[occ], np.asarray(state.keys_lo)[occ],
+            vals[occ])
+
+
+def db_stats(state, meta):
+    """(n_occupied, distinct_hq_ge1, total_hq) on either format."""
+    if isinstance(meta, TileMeta):
+        return ctable.tile_stats(state, meta)
+    return table.table_stats(state, meta)

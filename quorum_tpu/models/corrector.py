@@ -42,7 +42,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops import mer, table
+from ..ops import ctable, mer, table
 from ..ops.poisson import poisson_term
 from .ec_config import (
     ECConfig,
@@ -155,6 +155,15 @@ def _pack_sub(frm, to):
 # Batched get_best_alternatives
 # ---------------------------------------------------------------------------
 
+def _db_lookup(state, tmeta, khi, klo, active=None):
+    """Backend dispatch (trace-time; tmeta is static in every caller):
+    tile-bucket tables (ops/ctable — one row gather per lookup, the
+    fast path) or legacy wide tables (ops/table — probe walk)."""
+    if isinstance(tmeta, ctable.TileMeta):
+        return ctable.tile_lookup_impl(state, tmeta, khi, klo, active)
+    return table._lookup_impl(state, tmeta, khi, klo, active)
+
+
 def _gba(state, tmeta, fhi, flo, rhi, rlo, d: int, active):
     """database_query::get_best_alternatives (src/mer_database.hpp:
     302-329) for a [B] batch: counts of the 4 base-0 variants kept only
@@ -171,7 +180,7 @@ def _gba(state, tmeta, fhi, flo, rhi, rlo, d: int, active):
     chi = jnp.stack(vhis).ravel()  # [4B], variant-major
     clo = jnp.stack(vlos).ravel()
     act4 = jnp.tile(active, 4)
-    vals = table._lookup_impl(state, tmeta, chi, clo, act4)
+    vals = _db_lookup(state, tmeta, chi, clo, act4)
     vals = vals.reshape(4, -1).T  # [B, 4]
     cnt = (vals >> 1).astype(jnp.int32)
     q = (vals & 1).astype(jnp.int32)
@@ -188,7 +197,7 @@ def _gba(state, tmeta, fhi, flo, rhi, rlo, d: int, active):
 
 def _contam_hit(contam_state, contam_meta, fhi, flo, rhi, rlo, active):
     chi, clo = mer.canonical(fhi, flo, rhi, rlo)
-    v = table._lookup_impl(contam_state, contam_meta, chi, clo, active)
+    v = _db_lookup(contam_state, contam_meta, chi, clo, active)
     return active & (v != 0)
 
 
@@ -228,12 +237,12 @@ def find_anchors(state: table.TableState, tmeta: table.TableMeta,
     chi, clo = mer.canonical(fhi, flo, rhi, rlo)
     p_idx = jnp.arange(l, dtype=jnp.int32)[None, :]
     vw = validk & (p_idx >= cfg.skip + k - 1)
-    vals = table._lookup_impl(
+    vals = _db_lookup(
         state, tmeta, chi.ravel(), clo.ravel(), vw.ravel()
     ).reshape(b, l)
     val_hq = jnp.where((vals & 1) == 1, vals >> 1, 0).astype(jnp.int32)
     if has_contam:
-        con = table._lookup_impl(
+        con = _db_lookup(
             contam_state, contam_meta, chi.ravel(), clo.ravel(), vw.ravel()
         ).reshape(b, l) != 0
     else:
@@ -287,25 +296,15 @@ class ExtendResult(NamedTuple):
     log: LogState
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 16, 17, 18))
-def extend(state: table.TableState, tmeta: table.TableMeta,
-           codes, quals, cfg: ECConfig,
-           out, fhi, flo, rhi, rlo, prev0, alive0,
-           pos0, end, status0,
-           contam_state, contam_meta, d: int, has_contam: bool):
-    """extend (error_correct_reads.cc:384-565) in lockstep over a batch.
-
-    Carries per-lane (mer, pos, opos, prev_count, alive, status, log)
-    through a while_loop; every iteration advances each live lane one
-    base. See module docstring for the branch structure."""
-    k = cfg.k
+def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
+                contam_meta, d: int, has_contam: bool):
+    """Shared helpers closed over the static extension environment."""
     window = cfg.effective_window
     error = cfg.effective_error
     b, l = codes.shape
     lane = jnp.arange(b, dtype=jnp.int32)
     codes32 = codes.astype(jnp.int32)
     quals32 = quals.astype(jnp.int32)
-    maxe = out.shape[1] + 2
 
     def in_range(pos):
         return (pos < end) if d == 1 else (pos > end)
@@ -323,6 +322,23 @@ def extend(state: table.TableState, tmeta: table.TableMeta,
         if not has_contam:
             return jnp.zeros_like(mask)
         return _contam_hit(contam_state, contam_meta, fh, fl, rh, rl, mask)
+
+    return (in_range, gather_code, take4, contam, lane, codes32, quals32,
+            window, error, b, l)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 8, 9, 10))
+def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
+                 carry, end,
+                 contam_state, contam_meta, d: int, has_contam: bool):
+    """The lockstep extension loop; the ambiguous-path continuation
+    probe runs inline via _ambig_core (see extend's docstring for why
+    inline beats parking)."""
+    k = cfg.k
+    (in_range, gather_code, take4, contam, lane, codes32, quals32,
+     window, error, b, l) = _extend_env(
+        state, tmeta, codes, quals, cfg, end, contam_state, contam_meta,
+        d, has_contam)
 
     def body(carry):
         (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log) = carry
@@ -402,99 +418,15 @@ def extend(state: table.TableState, tmeta: table.TableMeta,
         log = _append_trunc(log, t_a | t_b, cpos, window, error, d)
         alive = alive & ~(t_a | t_b)
         ambig = cm & ~keep_simple & ~t_a & ~t_b
+        env = (in_range, gather_code, take4, contam, lane, codes32,
+               quals32, window, error, b, l)
+        (fh, fl, rh, rl, pos, opos, prev, alive, status, outb,
+         log) = _ambig_core(env, state, tmeta, cfg, d,
+                            fh, fl, rh, rl, pos, opos, prev, alive,
+                            status, outb, log, ambig, cpos, ori,
+                            counts, level)
 
-        # continuation probe (cc:473-507): for each eligible variant,
-        # does any base extend it at the same-or-better level?
-        read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
-        chis, clos = [], []
-        for i in range(4):
-            ifh, ifl, irh, irl = mer.dir_replace0(
-                fh, fl, rh, rl, mer.u32(i), d, k)
-            ifh, ifl, irh, irl = mer.dir_shift(
-                ifh, ifl, irh, irl, mer.u32(0), d, k)
-            for j in range(4):
-                jfh, jfl, jrh, jrl = mer.dir_replace0(
-                    ifh, ifl, irh, irl, mer.u32(j), d, k)
-                chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
-                chis.append(chi)
-                clos.append(clo)
-        elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
-                          for i in range(4)], axis=1)  # [B, 4]
-        act16 = jnp.repeat(elig.T, 4, axis=0).reshape(-1)  # [16B] i-major
-        nvals = table._lookup_impl(
-            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
-            act16,
-        ).reshape(4, 4, b)  # [i, j, B]
-        ncnt = (nvals >> 1).astype(jnp.int32)
-        nq = (nvals & 1).astype(jnp.int32)
-        npresent = ncnt > 0
-        nlevel = jnp.max(jnp.where(npresent, nq, 0), axis=1)  # [i, B]
-        ncounts = jnp.where(npresent & (nq == nlevel[:, None, :]), ncnt, 0)
-        ncount = jnp.sum((ncounts > 0).astype(jnp.int32), axis=1)  # [i, B]
-
-        succ = jnp.stack([
-            elig[:, i] & (ncount[i] > 0) & (nlevel[i] >= level)
-            for i in range(4)], axis=1)  # [B, 4]
-        cont_counts = jnp.where(succ, counts, 0)
-        safe_nb = jnp.clip(read_nbase, 0, 3)
-        cwn = jnp.stack([
-            succ[:, i] & (read_nbase >= 0)
-            & (ncounts[i][safe_nb, lane] > 0)
-            for i in range(4)], axis=1)  # [B, 4]
-
-        check_code = jnp.where(ambig, ori, 0)
-        for i in range(4):
-            check_code = jnp.where(elig[:, i], i, check_code)
-        success = ambig & jnp.any(succ, axis=1)
-
-        # tie-break chain (cc:509-545). prev_count <= min_count takes
-        # the int-overflow dead-code path: no candidate ever matches.
-        prev_ok = prev > cfg.min_count
-        diffs = jnp.abs(cont_counts - prev[:, None])
-        min_diff = jnp.min(
-            jnp.where(cont_counts > 0, diffs, jnp.int32(2**31 - 1)), axis=1)
-        cand = success[:, None] & prev_ok[:, None] & (diffs == min_diff[:, None])
-        ncand = jnp.sum(cand.astype(jnp.int32), axis=1)
-        cc2 = jnp.full((b,), -1, jnp.int32)
-        for i in range(4):
-            cc2 = jnp.where(cand[:, i], i, cc2)
-        tie = (ncand > 1) & (read_nbase >= 0)
-        ncand = jnp.where(tie, jnp.sum((cand & cwn).astype(jnp.int32), axis=1),
-                          ncand)
-        for i in range(4):
-            cc2 = jnp.where(tie & cand[:, i] & cwn[:, i], i, cc2)
-        cc2 = jnp.where(ncand != 1, -1, cc2)
-        check_code = jnp.where(success, cc2, check_code)
-
-        sub2 = success & (check_code >= 0) & (check_code != ori)
-        nfh, nfl, nrh, nrl = mer.dir_replace0(
-            fh, fl, rh, rl, mer.u32(jnp.clip(check_code, 0)), d, k)
-        do_rep = success & (check_code >= 0)
-        fh = jnp.where(do_rep, nfh, fh)
-        fl = jnp.where(do_rep, nfl, fl)
-        rh = jnp.where(do_rep, nrh, rh)
-        rl = jnp.where(do_rep, nrl, rl)
-        con3 = contam(fh, fl, rh, rl, sub2)
-        con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
-        con3_err = con3 & ~con3_trim
-        log = _append_trunc(log, con3_trim, cpos, window, error, d)
-        status = jnp.where(con3_err, ST_CONTAMINANT, status)
-        alive = alive & ~con3
-        sub2 = sub2 & ~con3
-        log, trip2 = _log_append(
-            log, sub2, cpos, _pack_sub(ori, check_code), window, error, d)
-        log, diff2 = _log_remove_last_window(log, trip2, window, d)
-        log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d)
-        opos = jnp.where(trip2, opos - d * diff2, opos)
-        alive = alive & ~trip2
-
-        # N base with no good substitution: truncate (cc:553-556)
-        t_c = ambig & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
-        log = _append_trunc(log, t_c, cpos, window, error, d)
-        alive = alive & ~t_c
-
-        write_m = (ambig | keep_simple) & alive & active
-        write = write1 | write_m
+        write = write1 | (keep_simple & alive & active)
         base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
         # out-of-range positive sentinel: dropped (negative would wrap)
         widx = jnp.where(write, opos, l)
@@ -507,10 +439,133 @@ def extend(state: table.TableState, tmeta: table.TableMeta,
         (_, _, _, _, pos, _, _, alive, _, _, _) = carry
         return jnp.any(alive & in_range(pos))
 
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def _ambig_core(env, state, tmeta, cfg, d: int,
+                fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+                ambig, cpos, ori, counts, level):
+    """The ambiguous-path continuation probe + tie-break
+    (error_correct_reads.cc:473-545), shared by the host-orchestrated
+    resolve step and the traceable inline path (shard_map)."""
+    k = cfg.k
+    (in_range, gather_code, take4, contam, lane, codes32, quals32,
+     window, error, b, l) = env
+    read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
+    chis, clos = [], []
+    for i in range(4):
+        ifh, ifl, irh, irl = mer.dir_replace0(
+            fh, fl, rh, rl, mer.u32(i), d, k)
+        ifh, ifl, irh, irl = mer.dir_shift(
+            ifh, ifl, irh, irl, mer.u32(0), d, k)
+        for j in range(4):
+            jfh, jfl, jrh, jrl = mer.dir_replace0(
+                ifh, ifl, irh, irl, mer.u32(j), d, k)
+            chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
+            chis.append(chi)
+            clos.append(clo)
+    elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
+                      for i in range(4)], axis=1)  # [B, 4]
+    act16 = jnp.repeat(elig.T, 4, axis=0).reshape(-1)  # [16B] i-major
+    nvals = _db_lookup(
+        state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+        act16,
+    ).reshape(4, 4, b)  # [i, j, B]
+    ncnt = (nvals >> 1).astype(jnp.int32)
+    nq = (nvals & 1).astype(jnp.int32)
+    npresent = ncnt > 0
+    nlevel = jnp.max(jnp.where(npresent, nq, 0), axis=1)  # [i, B]
+    ncounts = jnp.where(npresent & (nq == nlevel[:, None, :]), ncnt, 0)
+    ncount = jnp.sum((ncounts > 0).astype(jnp.int32), axis=1)  # [i, B]
+
+    succ = jnp.stack([
+        elig[:, i] & (ncount[i] > 0) & (nlevel[i] >= level)
+        for i in range(4)], axis=1)  # [B, 4]
+    cont_counts = jnp.where(succ, counts, 0)
+    safe_nb = jnp.clip(read_nbase, 0, 3)
+    cwn = jnp.stack([
+        succ[:, i] & (read_nbase >= 0)
+        & (ncounts[i][safe_nb, lane] > 0)
+        for i in range(4)], axis=1)  # [B, 4]
+
+    check_code = jnp.where(ambig, ori, 0)
+    for i in range(4):
+        check_code = jnp.where(elig[:, i], i, check_code)
+    success = ambig & jnp.any(succ, axis=1)
+
+    # tie-break chain (cc:509-545). prev_count <= min_count takes
+    # the int-overflow dead-code path: no candidate ever matches.
+    prev_ok = prev > cfg.min_count
+    diffs = jnp.abs(cont_counts - prev[:, None])
+    min_diff = jnp.min(
+        jnp.where(cont_counts > 0, diffs, jnp.int32(2**31 - 1)), axis=1)
+    cand = success[:, None] & prev_ok[:, None] & (diffs == min_diff[:, None])
+    ncand = jnp.sum(cand.astype(jnp.int32), axis=1)
+    cc2 = jnp.full((b,), -1, jnp.int32)
+    for i in range(4):
+        cc2 = jnp.where(cand[:, i], i, cc2)
+    tie = (ncand > 1) & (read_nbase >= 0)
+    ncand = jnp.where(tie, jnp.sum((cand & cwn).astype(jnp.int32), axis=1),
+                      ncand)
+    for i in range(4):
+        cc2 = jnp.where(tie & cand[:, i] & cwn[:, i], i, cc2)
+    cc2 = jnp.where(ncand != 1, -1, cc2)
+    check_code = jnp.where(success, cc2, check_code)
+
+    sub2 = success & (check_code >= 0) & (check_code != ori)
+    nfh, nfl, nrh, nrl = mer.dir_replace0(
+        fh, fl, rh, rl, mer.u32(jnp.clip(check_code, 0)), d, k)
+    do_rep = success & (check_code >= 0)
+    fh = jnp.where(do_rep, nfh, fh)
+    fl = jnp.where(do_rep, nfl, fl)
+    rh = jnp.where(do_rep, nrh, rh)
+    rl = jnp.where(do_rep, nrl, rl)
+    con3 = contam(fh, fl, rh, rl, sub2)
+    con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
+    con3_err = con3 & ~con3_trim
+    log = _append_trunc(log, con3_trim, cpos, window, error, d)
+    status = jnp.where(con3_err, ST_CONTAMINANT, status)
+    alive = alive & ~con3
+    sub2 = sub2 & ~con3
+    log, trip2 = _log_append(
+        log, sub2, cpos, _pack_sub(ori, check_code), window, error, d)
+    log, diff2 = _log_remove_last_window(log, trip2, window, d)
+    log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d)
+    opos = jnp.where(trip2, opos - d * diff2, opos)
+    alive = alive & ~trip2
+
+    # N base with no good substitution: truncate (cc:553-556)
+    t_c = ambig & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
+    log = _append_trunc(log, t_c, cpos, window, error, d)
+    alive = alive & ~t_c
+
+    write = ambig & alive
+    base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
+    widx = jnp.where(write, opos, l)
+    outb = outb.at[lane, widx].set(base0, mode="drop")
+    opos = jnp.where(write, opos + d, opos)
+
+    return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log)
+
+
+def extend(state, tmeta, codes, quals, cfg: ECConfig,
+           out, fhi, flo, rhi, rlo, prev0, alive0,
+           pos0, end, status0,
+           contam_state, contam_meta, d: int, has_contam: bool):
+    """extend (error_correct_reads.cc:384-565) in lockstep over a batch:
+    one fused while_loop advancing every live lane one base per
+    iteration, with the ambiguous-path continuation probe inline
+    (_ambig_core). Measured on real-coverage data the ambiguous branch
+    fires on a large minority of lanes (error k-mers recorded in the DB
+    make count > 1 common), so parking/compacting those lanes loses to
+    simply keeping the probe in the loop."""
+    b = codes.shape[0]
+    maxe = out.shape[1] + 2
     log0 = make_log(b, maxe)
     carry = (fhi, flo, rhi, rlo, pos0, pos0, prev0, alive0, status0, out,
              log0)
-    carry = jax.lax.while_loop(cond, body, carry)
+    carry = _extend_loop(state, tmeta, codes, quals, cfg, carry, end,
+                         contam_state, contam_meta, d, has_contam)
     (_, _, _, _, _, opos, _, _, status, outb, log) = carry
     return ExtendResult(outb, opos, status, log)
 

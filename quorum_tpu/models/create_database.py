@@ -5,11 +5,13 @@ TPU-native rebuild of `quorum_create_database`
 pthreads that CAS into a shared hash; here each fixed-shape read batch
 becomes one device program: rolling canonical k-mers + quality-run
 tracking (the low_len/high_len logic of create_database.cc:64-91) are
-computed for every position of every read in parallel, aggregated by
-sort/segment-sum, and merged into the HBM table. The table auto-grows
+computed for every position of every read in parallel and counted
+straight into the tile-bucket table (ops/ctable: write-then-verify
+claim rounds over 64-slot hardware-tile buckets). The table auto-grows
 on overflow exactly once per key (placed-mask retry), mirroring the
 reference's cooperative resize (src/mer_database.hpp:137-187) with a
-host-orchestrated re-scatter.
+host-orchestrated re-scatter. The finished table IS the query layout —
+one row gather per lookup in stage 2.
 """
 
 from __future__ import annotations
@@ -23,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ..io import fastq, db_format
-from ..ops import mer, table
+from ..ops import ctable, mer, table
+from ..utils.pipeline import prefetch
 from ..utils.vlog import vlog
 
 
@@ -33,7 +36,7 @@ class BuildConfig:
     bits: int = 7
     qual_thresh: int = 38  # ASCII code: base qual char >= this is "high"
     initial_size: int = 200_000_000
-    max_reprobe: int = 126
+    max_reprobe: int = 126  # wide-table compatibility (unused by tile)
     batch_size: int = 8192
     max_grows: int = 16
 
@@ -62,9 +65,6 @@ extract_observations = jax.jit(extract_observations_impl,
                                static_argnums=(2, 3))
 
 
-_aggregate = jax.jit(table.aggregate_kmers)
-
-
 @dataclasses.dataclass
 class BuildStats:
     reads: int = 0
@@ -79,23 +79,22 @@ def build_database(
     cfg: BuildConfig,
     batches: Iterable[fastq.ReadBatch] | None = None,
 ):
-    """Run the full stage-1 pipeline. Returns (state, meta, stats).
+    """Run the full stage-1 pipeline. Returns
+    (TileState, TileMeta, stats) — the query-ready tile table.
 
     Raises RuntimeError("Hash is full") only if growth itself fails
     (allocation), preserving the reference's failure contract
     (create_database.cc:87, README.md:46-47).
     """
-    meta = table.TableMeta(
-        k=cfg.k,
-        bits=cfg.bits,
-        size_log2=table.required_size_log2(cfg.initial_size),
-        max_reprobe=cfg.max_reprobe,
-    )
-    state = table.make_table(meta)
+    rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
+    meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb)
+    bstate = ctable.make_tile_build(meta)
     stats = BuildStats()
 
     if batches is None:
-        batches = fastq.read_batches(paths, cfg.batch_size)
+        # host decode/encode overlaps device rounds (double buffering,
+        # the PP row of SURVEY §2.4)
+        batches = prefetch(fastq.read_batches(paths, cfg.batch_size))
     for batch in batches:
         stats.batches += 1
         stats.reads += batch.n
@@ -104,21 +103,21 @@ def build_database(
             jnp.asarray(batch.codes), jnp.asarray(batch.quals),
             cfg.k, cfg.qual_thresh,
         )
-        ukhi, uklo, hq, lq, uvalid = _aggregate(chi, clo, q, valid)
-        pending = uvalid
+        pending = valid
         for _ in range(cfg.max_grows + 1):
-            state, full, placed = table.merge_batch(
-                state, meta, ukhi, uklo, hq, lq, pending
+            bstate, full, placed = ctable.tile_insert_observations(
+                bstate, meta, chi, clo, q, pending
             )
-            if not bool(full):
+            if not full:
                 break
             pending = jnp.logical_and(pending, jnp.logical_not(placed))
-            vlog("Hash table full at size ", meta.size, "; doubling")
-            state, meta = table.grow(state, meta)
+            vlog("Hash table full at ", meta.rows, " buckets; doubling")
+            bstate, meta = ctable.tile_grow_build(bstate, meta)
             stats.grows += 1
         else:
             raise RuntimeError("Hash is full")
-    occ, _, _ = table.table_stats(state, meta)
+    state = ctable.tile_finalize(bstate, meta)
+    occ, _, _ = ctable.tile_stats(state, meta)
     stats.distinct = int(occ)
     vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
          stats.distinct, " distinct mers")

@@ -16,7 +16,11 @@ class ECConfig:
     good: int = 2
     anchor_count: int = 3
     min_count: int = 1
-    cutoff: int = 4
+    # No default in the reference CLI: unless -p is given the cutoff is
+    # COMPUTED from the database (compute_poisson_cutoff,
+    # error_correct_reads.cc:710-717) — models/error_correct.resolve_cutoff
+    # does that; library users must pass a value explicitly.
+    cutoff: int = dataclasses.field(default=None)  # type: ignore[assignment]
     qual_cutoff: int = 127  # ASCII code; numeric_limits<char>::max() default
     window: int = 10
     error: int = 3
@@ -29,6 +33,13 @@ class ECConfig:
     # in double; the device computes in float32. Tests set "float32" on
     # the oracle so both sides round identically at the threshold.
     poisson_dtype: str = "float64"
+
+    def __post_init__(self):
+        if self.cutoff is None:
+            raise TypeError(
+                "ECConfig.cutoff has no default: pass the -p value or the "
+                "database-computed cutoff (models/error_correct."
+                "resolve_cutoff)")
 
     @property
     def effective_window(self) -> int:

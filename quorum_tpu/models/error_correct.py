@@ -30,7 +30,6 @@ from typing import Sequence
 
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq
-from ..ops import table
 from ..ops.poisson import compute_poisson_cutoff
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.vlog import vlog
@@ -84,7 +83,7 @@ def resolve_cutoff(state, meta, opts: ECOptions) -> int:
     if opts.cutoff is not None:
         return opts.cutoff
     vlog("Computing Poisson cutoff")
-    _occ, distinct, total = table.table_stats(state, meta)
+    _occ, distinct, total = db_format.db_stats(state, meta)
     return compute_poisson_cutoff(
         int(distinct), int(total),
         opts.apriori_error_rate / 3.0,

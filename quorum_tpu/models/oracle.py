@@ -61,14 +61,11 @@ class DictDB:
 
     @classmethod
     def from_table(cls, state, meta) -> "DictDB":
-        keys_hi = np.asarray(state.keys_hi)
-        keys_lo = np.asarray(state.keys_lo)
-        vals = np.asarray(state.vals)
-        occ = vals != 0
-        keys = (keys_hi[occ].astype(np.uint64) << np.uint64(32)) | keys_lo[
-            occ
-        ].astype(np.uint64)
-        v = vals[occ]
+        from ..io.db_format import db_iterate
+
+        keys_hi, keys_lo, v = db_iterate(state, meta)
+        keys = (keys_hi.astype(np.uint64) << np.uint64(32)) | \
+            keys_lo.astype(np.uint64)
         return cls(
             {int(kk): (int(vv) >> 1, int(vv) & 1) for kk, vv in zip(keys, v)},
             meta.k,

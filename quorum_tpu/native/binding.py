@@ -1,0 +1,177 @@
+"""ctypes binding for the native FASTQ parser (fastq_parser.cpp).
+
+Builds the shared library on first use with the system g++ (cached in
+~/.cache/quorum_tpu), per the no-pybind11 environment; any failure —
+no compiler, unwritable cache, malformed/multi-line input — falls back
+to the pure-Python parser in io/fastq.py. Strict 4-line FASTQ only by
+design (see the .cpp header comment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import Iterator, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_CACHE = os.path.expanduser("~/.cache/quorum_tpu")
+_LIB = None
+_TRIED = False
+
+CHUNK = 8 << 20
+
+
+def _build() -> str | None:
+    src = os.path.join(_HERE, "fastq_parser.cpp")
+    out = os.path.join(_CACHE, "libqtfastq.so")
+    try:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        os.makedirs(_CACHE, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", src, "-o", out + ".tmp"],
+            check=True, capture_output=True, timeout=120)
+        os.replace(out + ".tmp", out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.qt_parse.restype = ctypes.c_long
+        lib.qt_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class Fallback(Exception):
+    """Input isn't strict 4-line FASTQ — use the Python parser."""
+
+
+def _parse_stream(f, batch_size: int, stride: int = 4096):
+    """Yield raw (codes, quals, lengths, headers, n) tuples from one
+    binary stream via the native parser. Raises Fallback on grammar
+    mismatch with no records consumed from the CURRENT buffer."""
+    lib = _load()
+    assert lib is not None
+    codes = np.empty((batch_size, stride), dtype=np.int8)
+    quals = np.empty((batch_size, stride), dtype=np.uint8)
+    lengths = np.empty((batch_size,), dtype=np.int32)
+    hdr_off = np.empty((batch_size,), dtype=np.int64)
+    hdr_len = np.empty((batch_size,), dtype=np.int32)
+    consumed = ctypes.c_int64(0)
+    buf = b""
+    eof = False
+    first = True
+    while not eof or buf:
+        while not eof and len(buf) < CHUNK:
+            chunk = f.read(CHUNK)
+            if not chunk:
+                eof = True
+                break
+            buf += chunk
+        if not buf:
+            break
+        n = lib.qt_parse(
+            buf, len(buf), int(eof),
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            quals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            hdr_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            hdr_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            batch_size, stride, ctypes.byref(consumed))
+        if n == -1:
+            if first:
+                raise Fallback()
+            raise ValueError("malformed FASTQ record (native parser)")
+        if n == -2:
+            # oversized read: grow the row stride and re-parse the same
+            # buffer — nothing yielded is lost
+            stride = min(stride * 2, 1 << 22)
+            codes = np.empty((batch_size, stride), dtype=np.int8)
+            quals = np.empty((batch_size, stride), dtype=np.uint8)
+            continue
+        if n == 0 and eof:
+            break
+        if n == 0:
+            # need more bytes for one record
+            chunk = f.read(CHUNK)
+            if not chunk:
+                eof = True
+            else:
+                buf += chunk
+            continue
+        first = False
+        headers = [
+            buf[hdr_off[i]:hdr_off[i] + hdr_len[i]].decode()
+            for i in range(n)
+        ]
+        yield codes, quals, lengths, headers, int(n)
+        buf = buf[consumed.value:]
+
+
+def read_batches(paths: Sequence[str], batch_size: int = 8192
+                 ) -> Iterator["object"]:
+    """ReadBatch iterator via the native parser, falling back per-file
+    to the Python parser for FASTA/multi-line/oversized inputs."""
+    from ..io import fastq
+
+    for path in paths:
+        if path in ("-", "/dev/fd/0", "/dev/stdin"):
+            # stdin can't be re-opened for the grammar fallback
+            yield from fastq.batch_records(fastq.iter_records([path]),
+                                           batch_size)
+            continue
+        f = fastq._open(path)
+        try:
+            try:
+                for codes, quals, lengths, headers, n in _parse_stream(
+                        f, batch_size):
+                    if n < batch_size:  # inert padding rows
+                        codes[n:] = -2
+                        quals[n:] = 0
+                        lengths[n:] = 0
+                    maxlen = int(lengths[:n].max()) if n else 1
+                    L = fastq.bucket_for(maxlen)
+                    yield fastq.ReadBatch(
+                        codes=codes[:, :L].copy(),
+                        quals=quals[:, :L].copy(),
+                        lengths=lengths.copy(),
+                        headers=headers, n=n)
+            except Fallback:
+                f.close()
+                f = fastq._open(path)
+                yield from fastq.batch_records(
+                    fastq.iter_records([path]), batch_size)
+        finally:
+            if f is not sys.stdin.buffer:
+                f.close()

@@ -581,7 +581,10 @@ class TileMeta:
     rb_log2: int  # log2(number of rows/buckets)
 
     def __post_init__(self):
-        if self.rb_log2 < 0 or self.rb_log2 > 30:
+        # 24 is the single-chip ceiling: the tag array alone is 8 GiB
+        # (2^24 rows x 512 B) and flat int32 indexing runs out at
+        # 2^31 words. Bigger tables are the sharded build's job.
+        if self.rb_log2 < 0 or self.rb_log2 > 24:
             raise ValueError(f"rb_log2 out of range: {self.rb_log2}")
         if self.rem_bits - self.rlo_bits > 32:
             raise ValueError(
@@ -806,3 +809,195 @@ def tile_lookup_np(rows, meta: TileMeta, khi, klo):
     j = idx[0]
     return int((count[j] << np.uint32(1)) |
                ((row[2 * j] >> np.uint32(meta.bits)) & 1))
+
+
+# ---------------------------------------------------------------------------
+# Tile-direct build: count straight into the query layout
+# ---------------------------------------------------------------------------
+#
+# With 64 slots per bucket, home-only placement is enough: P(bucket
+# load > 64) is astronomically small at the target load (~24-48
+# entries/bucket), so no chaining and no displacement bits — which is
+# what keeps key recovery (and therefore grow-by-rehash) exact. Batch
+# contention spreads across the 64 slots via a key-derived preferred
+# slot, so claim rounds stay ~2-3 deep even with hundreds of lanes per
+# bucket per batch. The round protocol is write-then-verify: a lane
+# whose key is absent writes its two tag words at its first
+# match-or-empty slot (rotated order from the preferred slot) and
+# checks next round; torn writes (two lanes racing different keys)
+# leave a phantom tag that matches nobody, wastes one slot, and
+# vanishes at finalize (hq|lq == 0). Same-key lanes converge on one
+# slot and their scatter-adds combine natively.
+
+
+class TBuildState(NamedTuple):
+    """Build-side tile table. tag is the [rows, 128] interleaved tag
+    array (even col = rlo tag, odd col = rhi; _EMPTY_TAG = empty); hq
+    and lq are flat uint32[rows * 64] accumulators."""
+
+    tag: jax.Array
+    hq: jax.Array
+    lq: jax.Array
+
+
+def make_tile_build(meta: TileMeta) -> TBuildState:
+    r = meta.rows
+    tag = jnp.full((r, TILE), _EMPTY_TAG, dtype=jnp.uint32)
+    return TBuildState(tag, jnp.zeros((r * TSLOTS,), jnp.uint32),
+                       jnp.zeros((r * TSLOTS,), jnp.uint32))
+
+
+def _preferred_slot(rlo, rhi):
+    return ((rlo ^ (rlo >> 7) ^ (rhi << 3)) & jnp.uint32(TSLOTS - 1)) \
+        .astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def _tile_build_round(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
+                      p0, hq_add, lq_add, done):
+    """One write-then-verify round (see section comment)."""
+    active = ~done
+    gaddr = jnp.where(active, addr, 0)
+    rows = bstate.tag[gaddr]  # [N, 128] one row gather
+    tlo = rows[:, 0::2]
+    thi = rows[:, 1::2]
+    is_match = active[:, None] & (tlo == rlo[:, None]) & (thi == rhi[:, None])
+    is_empty = tlo == _EMPTY_TAG
+
+    # rotated-order rank: match -> j, empty -> 64 + j, else inf;
+    # j = (slot - p0) mod 64 so the preferred slot is tried first
+    slot_ids = jnp.arange(TSLOTS, dtype=jnp.int32)[None, :]
+    j = (slot_ids - p0[:, None]) & (TSLOTS - 1)
+    score = jnp.where(is_match, j,
+                      jnp.where(is_empty, TSLOTS + j, 2 * TSLOTS + 1))
+    best = jnp.min(score, axis=1)
+    slot = jnp.argmin(score, axis=1).astype(jnp.int32)
+    has_match = best < TSLOTS
+    has_empty = best < 2 * TSLOTS
+
+    # matched lanes: accumulate and retire. Drop sentinels must be
+    # POSITIVE out-of-bounds values: jnp's .at[] wraps negative indices
+    # (numpy semantics), silently hitting the last slot. rows * TSLOTS
+    # <= 2^30 always fits int32; the tag path needs int32-max because
+    # rows * TILE would overflow at rb_log2 = 24.
+    win = active & has_match
+    aidx = jnp.where(win, gaddr * TSLOTS + slot, meta.rows * TSLOTS)
+    hq = bstate.hq.at[aidx].add(hq_add, mode="drop")
+    lq = bstate.lq.at[aidx].add(lq_add, mode="drop")
+
+    # absent lanes: write BOTH tag words with one windowed scatter
+    # (update window = the (rlo, rhi) pair) so a lost race can never
+    # tear the pair, then verify next round (no claim array; see
+    # section comment)
+    attempt = active & ~has_match & has_empty
+    flat = gaddr * TILE + 2 * slot
+    tag = bstate.tag.reshape(-1)
+    upd = jnp.stack([rlo, rhi], axis=1)  # [N, 2]
+    dn = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,), inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0,))
+    tag = jax.lax.scatter(
+        tag, jnp.where(attempt, flat, jnp.int32(0x7FFFFFFF))[:, None],
+        upd, dn, mode=jax.lax.GatherScatterMode.FILL_OR_DROP)
+    ndone = done | win
+    return (TBuildState(tag.reshape(meta.rows, TILE), hq, lq), ndone,
+            jnp.any(~ndone))
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _tile_parts_jit(meta: TileMeta, khi, klo):
+    addr, rlo, rhi = tile_key_parts(khi, klo, meta)
+    return addr, rlo, rhi, _preferred_slot(rlo, rhi)
+
+
+def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
+                             qual, valid, max_rounds: int = 24):
+    """Insert a flat batch of raw (canonical k-mer, quality-bit)
+    observations straight into the tile build table. Returns
+    (bstate, full: bool, placed mask); on full the caller grows and
+    retries with `valid & ~placed` (exact-once)."""
+    addr, rlo, rhi, p0 = _tile_parts_jit(meta, khi, klo)
+    hq_add, lq_add, done = _prep_obs(qual, valid)
+    for _ in range(max_rounds):
+        bstate, done, left = _tile_build_round(bstate, meta, addr, rlo, rhi,
+                                               p0, hq_add, lq_add, done)
+        if not bool(left):
+            break
+    full, placed = _finish_obs(done, valid)
+    return bstate, bool(full), placed
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_finalize(bstate: TBuildState, meta: TileMeta) -> TileState:
+    """Pack accumulators into the query layout in place: lo word =
+    rlo | qual | count (count-at-best-quality closed form), phantom and
+    empty slots -> 0."""
+    tlo = bstate.tag[:, 0::2]
+    thi = bstate.tag[:, 1::2]
+    sh = (meta.rows, TSLOTS)
+    hq = bstate.hq.reshape(sh)
+    lq = bstate.lq.reshape(sh)
+    occ = (tlo != _EMPTY_TAG) & ((hq | lq) != 0)
+    q = (hq > 0) & occ
+    cnt = jnp.where(q, hq, lq)
+    cnt = jnp.minimum(cnt, jnp.uint32(meta.max_val))
+    lo = jnp.where(occ,
+                   (tlo << (meta.bits + 1)) |
+                   (q.astype(jnp.uint32) << meta.bits) | cnt,
+                   jnp.uint32(0))
+    hi = jnp.where(occ, thi, jnp.uint32(0))
+    rows = jnp.zeros((meta.rows, TILE), dtype=jnp.uint32)
+    rows = rows.at[:, 0::2].set(lo)
+    rows = rows.at[:, 1::2].set(hi)
+    return TileState(rows)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def _tile_grow_prep(bstate: TBuildState, meta: TileMeta, start, length: int):
+    """One chunk of build slots rehashed for a doubled table: the full
+    hash is (rem << rb) | addr with rem = rhi:rlo, so doubling moves
+    rem's low bit into the address top bit."""
+    rb = meta.rb_log2
+    rl = meta.rlo_bits
+    tag = jax.lax.dynamic_slice(bstate.tag.reshape(-1), (2 * start,),
+                                (2 * length,))
+    rlo = tag[0::2]
+    rhi = tag[1::2]
+    hq = jax.lax.dynamic_slice(bstate.hq, (start,), (length,))
+    lq = jax.lax.dynamic_slice(bstate.lq, (start,), (length,))
+    slot = start + jnp.arange(length, dtype=jnp.int32)
+    addr = slot // TSLOTS
+    valid = (rlo != _EMPTY_TAG) & ((hq | lq) != 0)
+    naddr = addr | ((rlo & 1) << rb).astype(jnp.int32)
+    nrlo = (rlo >> 1) | ((rhi & 1) << (rl - 1))
+    nrhi = rhi >> 1
+    nrlo = jnp.where(valid, nrlo, 0)
+    nrhi = jnp.where(valid, nrhi, 0)
+    return (naddr, nrlo, nrhi, _preferred_slot(nrlo, nrhi), hq, lq, valid)
+
+
+def tile_grow_build(bstate: TBuildState, meta: TileMeta,
+                    chunk: int = 1 << 22):
+    """Double the row count and re-scatter all entries, chunked."""
+    try:
+        new_meta = dataclasses.replace(meta, rb_log2=meta.rb_log2 + 1)
+    except ValueError as e:
+        # single-chip geometry ceiling: surface the reference's FULL
+        # contract (README.md:46-47) instead of a layout error
+        raise RuntimeError("Hash is full") from e
+    new_state = make_tile_build(new_meta)
+    n_slots = meta.rows * TSLOTS
+    length = min(chunk, n_slots)
+    for start in range(0, n_slots, length):
+        naddr, nrlo, nrhi, p0, hq, lq, valid = _tile_grow_prep(
+            bstate, meta, jnp.int32(start), length)
+        done = ~valid
+        left = True
+        for _ in range(24):
+            new_state, done, left = _tile_build_round(
+                new_state, new_meta, naddr, nrlo, nrhi, p0, hq, lq, done)
+            if not bool(left):
+                break
+        if bool(left):  # pragma: no cover - halved load can't overflow
+            raise RuntimeError("Hash is full")
+    return new_state, new_meta

@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache.
+
+On the target deployment (TPU behind the axon tunnel) every jit
+compilation round-trips an HTTP AOT helper at ~40-100s per executable —
+by far the dominant fixed cost of a pipeline run. jax's persistent
+compilation cache eliminates it across processes (measured: 99s first
+compile, 0.45s reload). Every CLI entry point calls enable_cache();
+user-set JAX_COMPILATION_CACHE_DIR or an already-configured cache dir
+is respected.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.expanduser("~/.cache/quorum_tpu/jax")
+
+
+def enable_cache(path: str | None = None) -> str | None:
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return jax.config.jax_compilation_cache_dir
+    target = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT
+    try:
+        os.makedirs(target, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (OSError, AttributeError):  # unwritable dir / very old jax
+        return None
+    return target

@@ -419,3 +419,77 @@ def test_force_truncate_binary_parity():
         # the backward sub annotation must have been dropped only if its
         # raw position <= trim point; construct guarantees it is inside
         raise AssertionError(f"unexpected bwd log: {o.bwd_log}")
+
+
+# ---------------------------------------------------------------------------
+# Tile-backend parity: the same scenarios through the ctable tile table
+# ---------------------------------------------------------------------------
+
+from quorum_tpu.ops import ctable  # noqa: E402
+
+
+def tile_from_dict(d, k):
+    """Tile device table + DictDB with exact (count, qual) per mer."""
+    khis, klos, vals = [], [], []
+    dd = {}
+    for s, (cnt, q) in d.items():
+        hi, lo = mer.pack_kmer(s, k)
+        chi, clo = mer.canonical_py(hi, lo, k)
+        dd[(int(chi) << 32) | int(clo)] = (cnt, q)
+        khis.append(chi)
+        klos.append(clo)
+        vals.append((cnt << 1) | q)
+    state, meta = ctable.tile_from_entries(
+        np.array(khis, np.uint32), np.array(klos, np.uint32),
+        np.array(vals, np.uint32), k, bits=7)
+    return state, meta, DictDB(dd, k)
+
+
+def test_tile_backend_matches_wide_and_oracle():
+    """A coverage-rich random-genome scenario through BOTH table
+    backends: device-on-tile must equal device-on-wide must equal the
+    oracle, including substitutions, truncations, and window trips."""
+    rng = _rng()
+    genome = rand_seq(rng, 300)
+    db = {}
+    add_seq(db, genome, 30, 1)
+    wstate, wmeta, dictdb = table_from_dict(db, K)
+    tstate, tmeta, _ = tile_from_dict(db, K)
+
+    reads, quals_list = [], []
+    for _ in range(48):
+        start = int(rng.integers(0, len(genome) - 60))
+        r = list(genome[start:start + 60])
+        for _e in range(int(rng.integers(0, 3))):
+            p = int(rng.integers(0, len(r)))
+            r[p] = BASES[int(rng.integers(0, 4))]
+        reads.append("".join(r))
+        quals_list.append(rand_quals(rng, 60))
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+
+    b = len(reads)
+    l = max(len(r) for r in reads)
+    codes = np.full((b, l), -2, np.int8)
+    quals = np.zeros((b, l), np.uint8)
+    lengths = np.zeros((b,), np.int32)
+    for i, (r, q) in enumerate(zip(reads, quals_list)):
+        codes[i, :len(r)] = mer.seq_to_codes(r)
+        quals[i, :len(r)] = np.frombuffer(q.encode(), np.uint8)
+        lengths[i] = len(r)
+
+    wres = corrector.correct_batch(wstate, wmeta, codes, quals, lengths, cfg)
+    tres = corrector.correct_batch(tstate, tmeta, codes, quals, lengths, cfg)
+    wdev = corrector.finish_batch(wres, b, cfg)
+    tdev = corrector.finish_batch(tres, b, cfg)
+    oc = OracleCorrector(dictdb, cfg)
+    n_sub = 0
+    for i in range(b):
+        o = oc.correct(reads[i], quals_list[i])
+        w, t = wdev[i], tdev[i]
+        key = (o.ok, o.error, o.seq, o.fwd_log, o.bwd_log, o.start, o.end)
+        assert key == (w.ok, w.error, w.seq, w.fwd_log, w.bwd_log,
+                       w.start, w.end), f"wide mismatch read {i}"
+        assert key == (t.ok, t.error, t.seq, t.fwd_log, t.bwd_log,
+                       t.start, t.end), f"tile mismatch read {i}"
+        n_sub += o.fwd_log.count("sub")
+    assert n_sub > 0  # corrections actually happened

@@ -99,17 +99,17 @@ def test_cdb_cli_end_to_end(tmp_path, synthetic_reads, k):
 
     state, meta, header = db_format.read_db(out, to_device=False)
     assert header["key_len"] == 2 * k
+    assert header["version"] == 2
     expect = brute_counts(synthetic_reads, k, qual_thresh, bits=7)
     # every brute-force key present with exact value
     for key, (cnt, q) in expect.items():
-        v = table.lookup_np(
-            state.keys_hi, state.keys_lo, state.vals,
-            (key >> 32) & 0xFFFFFFFF, key & 0xFFFFFFFF,
-            meta.max_reprobe,
-        )
+        v = db_format.db_lookup_np(state, meta,
+                                   (key >> 32) & 0xFFFFFFFF,
+                                   key & 0xFFFFFFFF)
         assert (v >> 1, v & 1) == (cnt, q), f"key {key:x}"
     # and no extra keys
-    assert int((np.asarray(state.vals) != 0).sum()) == len(expect)
+    _, _, vals = db_format.db_iterate(state, meta)
+    assert len(vals) == len(expect)
 
 
 def test_cdb_growth_from_tiny(tmp_path, synthetic_reads):
@@ -124,11 +124,11 @@ def test_cdb_growth_from_tiny(tmp_path, synthetic_reads):
     assert rc == 0
     state, meta, _ = db_format.read_db(out, to_device=False)
     expect = brute_counts(synthetic_reads, 17, 38, bits=3)
-    assert int((np.asarray(state.vals) != 0).sum()) == len(expect)
+    _, _, _vals = db_format.db_iterate(state, meta)
+    assert len(_vals) == len(expect)
     items = list(expect.items())
     for key, (cnt, q) in items[:200]:
-        v = table.lookup_np(
-            state.keys_hi, state.keys_lo, state.vals,
-            (key >> 32) & 0xFFFFFFFF, key & 0xFFFFFFFF, meta.max_reprobe,
-        )
+        v = db_format.db_lookup_np(state, meta,
+                                   (key >> 32) & 0xFFFFFFFF,
+                                   key & 0xFFFFFFFF)
         assert (v >> 1, v & 1) == (cnt, q)

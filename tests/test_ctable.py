@@ -273,3 +273,73 @@ def test_tile_overflow_grows_rows():
     assert meta.rb_log2 > 0  # grew
     got = np.asarray(ctable.tile_lookup(state, meta, khi, klo))
     assert np.array_equal(got, vals)
+
+
+def tile_build_from_obs(meta, keys, quals, batch=97, max_grows=12):
+    """tile_insert_observations with the grow-retry protocol."""
+    bstate = ctable.make_tile_build(meta)
+    for start in range(0, len(keys), batch):
+        kk = keys[start:start + batch]
+        qq = quals[start:start + batch]
+        khi, klo = split_keys(kk)
+        qd = jnp.asarray(qq.astype(np.int32))
+        pending = jnp.ones(len(kk), dtype=bool)
+        for _ in range(max_grows + 1):
+            bstate, full, placed = ctable.tile_insert_observations(
+                bstate, meta, khi, klo, qd, pending)
+            if not full:
+                break
+            pending = jnp.asarray(np.asarray(pending) & ~np.asarray(placed))
+            bstate, meta = ctable.tile_grow_build(bstate, meta)
+        else:
+            raise RuntimeError("Hash is full")
+    return bstate, meta
+
+
+@pytest.mark.parametrize("k,rb_log2", [(12, 0), (12, 4), (24, 6), (31, 8)])
+def test_tile_direct_build_matches_reference_rule(k, rb_log2):
+    bits = 7
+    rng = np.random.default_rng(k * 10 + rb_log2)
+    pool = rng.integers(0, 1 << min(63, 2 * k), size=300,
+                        dtype=np.uint64) & ((1 << np.uint64(2 * k)) -
+                                            np.uint64(1))
+    keys = pool[rng.integers(0, len(pool), size=3000)]
+    quals = rng.integers(0, 2, size=len(keys))
+    rb = max(rb_log2, ctable.min_tile_rb_log2(k, bits))
+    meta = ctable.TileMeta(k=k, bits=bits, rb_log2=rb)
+    bstate, meta = tile_build_from_obs(meta, keys, quals, batch=997)
+    state = ctable.tile_finalize(bstate, meta)
+
+    expect = brute_force_counts(
+        [(int(keys[i]), int(quals[i])) for i in range(len(keys))], bits)
+    uk = np.asarray(sorted(expect), dtype=np.uint64)
+    khi, klo = split_keys(uk)
+    vals = np.asarray(ctable.tile_lookup(state, meta, khi, klo))
+    for i, key in enumerate(uk):
+        cnt, q = expect[int(key)]
+        assert int(vals[i]) == (cnt << 1) | q, (hex(int(key)), cnt, q,
+                                                int(vals[i]))
+    # iterator recovers exactly the inserted key set
+    ikhi, iklo, _ = ctable.tile_iterate(state, meta)
+    got = set((ikhi.astype(np.uint64) << np.uint64(32)
+               | iklo.astype(np.uint64)).tolist())
+    assert got == set(int(x) for x in uk)
+
+
+def test_tile_direct_build_duplicate_flood():
+    """Thousands of copies of few keys in one batch: same-key lanes
+    must converge without slot waste."""
+    k, bits = 16, 7
+    rng = np.random.default_rng(0)
+    pool = rng.integers(0, 1 << (2 * k), size=5, dtype=np.uint64)
+    keys = pool[rng.integers(0, 5, size=8000)]
+    quals = np.ones(len(keys), dtype=np.int64)
+    meta = ctable.TileMeta(k=k, bits=bits, rb_log2=2)
+    bstate, meta = tile_build_from_obs(meta, keys, quals, batch=8000)
+    state = ctable.tile_finalize(bstate, meta)
+    occ, distinct, _ = ctable.tile_stats(state, meta)
+    assert int(occ) == len(np.unique(keys))
+    khi, klo = split_keys(np.unique(keys))
+    vals = np.asarray(ctable.tile_lookup(state, meta, khi, klo))
+    assert np.all(vals >> 1 == 127)  # saturated at max_val
+    assert np.all(vals & 1 == 1)

@@ -1,0 +1,86 @@
+"""Native FASTQ parser (quorum_tpu/native) vs the pure-Python parser:
+identical batches on strict 4-line FASTQ; graceful fallback on FASTA."""
+
+import numpy as np
+import pytest
+
+from quorum_tpu.io import fastq
+from quorum_tpu.native import binding
+
+
+pytestmark = pytest.mark.skipif(not binding.available(),
+                                reason="no g++ / native lib")
+
+BASES = "ACGTN"
+
+
+def write_fastq(path, rng, n, minlen=40, maxlen=120, crlf=False,
+                trailing_newline=True):
+    recs = []
+    with open(path, "w", newline="") as f:
+        eol = "\r\n" if crlf else "\n"
+        for i in range(n):
+            m = int(rng.integers(minlen, maxlen))
+            seq = "".join(BASES[c] for c in rng.integers(0, 5, m))
+            qual = "".join(chr(int(c)) for c in rng.integers(33, 74, m))
+            recs.append((f"r{i} extra", seq, qual))
+            tail = eol if (trailing_newline or i < n - 1) else ""
+            f.write(f"@r{i} extra{eol}{seq}{eol}+{eol}{qual}{tail}")
+    return recs
+
+
+@pytest.mark.parametrize("crlf,trailing", [(False, True), (True, True),
+                                           (False, False)])
+def test_native_matches_python(tmp_path, crlf, trailing):
+    rng = np.random.default_rng(1)
+    path = str(tmp_path / "r.fastq")
+    write_fastq(path, rng, 1000, crlf=crlf, trailing_newline=trailing)
+    nat = list(binding.read_batches([path], batch_size=256))
+    py = list(fastq.batch_records(fastq.iter_records([path]), 256))
+    assert sum(b.n for b in nat) == sum(b.n for b in py) == 1000
+    ni = ((b, i) for b in nat for i in range(b.n))
+    pi = ((b, i) for b in py for i in range(b.n))
+    for (nb, j), (pb, k) in zip(ni, pi):
+        assert nb.headers[j] == pb.headers[k]
+        L = nb.lengths[j]
+        assert L == pb.lengths[k]
+        assert np.array_equal(nb.codes[j, :L], pb.codes[k, :L])
+        assert np.array_equal(nb.quals[j, :L], pb.quals[k, :L])
+        assert np.all(nb.codes[j, L:] == -2)
+
+
+def test_fasta_falls_back(tmp_path):
+    path = str(tmp_path / "r.fa")
+    with open(path, "w") as f:
+        f.write(">a\nACGTACGTACGT\nACGT\n>b\nTTTT\n")
+    batches = list(binding.read_batches([path], batch_size=8))
+    assert sum(b.n for b in batches) == 2
+    assert batches[0].headers[0] == "a"
+    assert batches[0].lengths[0] == 16  # multi-line joined
+
+
+def test_gzip_input(tmp_path):
+    import gzip
+    rng = np.random.default_rng(2)
+    plain = str(tmp_path / "r.fastq")
+    recs = write_fastq(plain, rng, 100)
+    gz = str(tmp_path / "r.fastq.gz")
+    with open(plain, "rb") as f, gzip.open(gz, "wb") as g:
+        g.write(f.read())
+    nat = list(binding.read_batches([gz], batch_size=64))
+    assert sum(b.n for b in nat) == 100
+    assert nat[0].headers[0] == recs[0][0]
+
+
+def test_oversized_read_grows_stride(tmp_path):
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "r.fastq")
+    with open(path, "w") as f:
+        f.write("@short\nACGT\n+\nIIII\n")
+        seq = "".join("ACGT"[c] for c in rng.integers(0, 4, 6000))
+        f.write(f"@long\n{seq}\n+\n{'I' * 6000}\n")
+    batches = list(binding.read_batches([path], batch_size=4))
+    total = sum(b.n for b in batches)
+    assert total == 2
+    lens = sorted(int(l) for b in batches for l in b.lengths[:b.n])
+    assert lens == [4, 6000]

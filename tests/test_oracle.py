@@ -30,6 +30,7 @@ def genome():
 
 
 def cfg(**kw):
+    kw.setdefault("cutoff", 4)
     return ECConfig(k=K, **kw)
 
 
