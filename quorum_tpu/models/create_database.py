@@ -116,6 +116,10 @@ def build_database(
             stats.grows += 1
         else:
             raise RuntimeError("Hash is full")
+    if bool(ctable.tile_dup_check(bstate, meta)):  # pragma: no cover
+        raise RuntimeError(
+            "internal error: duplicate tag pair in a bucket (torn tag "
+            "write) — please report")
     state = ctable.tile_finalize(bstate, meta)
     occ, _, _ = ctable.tile_stats(state, meta)
     stats.distinct = int(occ)

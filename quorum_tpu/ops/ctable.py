@@ -885,20 +885,21 @@ def _tile_build_round(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
     hq = bstate.hq.at[aidx].add(hq_add, mode="drop")
     lq = bstate.lq.at[aidx].add(lq_add, mode="drop")
 
-    # absent lanes: write BOTH tag words with one windowed scatter
-    # (update window = the (rlo, rhi) pair) so a lost race can never
-    # tear the pair, then verify next round (no claim array; see
-    # section comment)
+    # absent lanes: write both tag words at the first empty slot and
+    # verify next round. Two scatter-sets with IDENTICAL index arrays:
+    # XLA applies duplicate updates in the same deterministic order for
+    # both, so the winning lane's pair lands whole. (A single windowed
+    # lax.scatter would guarantee it structurally but lowers to a sort
+    # with operand-length temporaries — measured ~20x slower per
+    # round.) tile_finalize's duplicate-tag check backstops the
+    # determinism assumption.
     attempt = active & ~has_match & has_empty
     flat = gaddr * TILE + 2 * slot
+    sent = jnp.int32(0x7FFFFFFF)
+    widx = jnp.where(attempt, flat, sent)
     tag = bstate.tag.reshape(-1)
-    upd = jnp.stack([rlo, rhi], axis=1)  # [N, 2]
-    dn = jax.lax.ScatterDimensionNumbers(
-        update_window_dims=(1,), inserted_window_dims=(),
-        scatter_dims_to_operand_dims=(0,))
-    tag = jax.lax.scatter(
-        tag, jnp.where(attempt, flat, jnp.int32(0x7FFFFFFF))[:, None],
-        upd, dn, mode=jax.lax.GatherScatterMode.FILL_OR_DROP)
+    tag = tag.at[widx].set(rlo, mode="drop")
+    tag = tag.at[jnp.where(attempt, flat + 1, sent)].set(rhi, mode="drop")
     ndone = done | win
     return (TBuildState(tag.reshape(meta.rows, TILE), hq, lq), ndone,
             jnp.any(~ndone))
@@ -925,6 +926,23 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
             break
     full, placed = _finish_obs(done, valid)
     return bstate, bool(full), placed
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_dup_check(bstate: TBuildState, meta: TileMeta):
+    """True iff any bucket holds two occupied slots with the same tag
+    pair — impossible unless the two tag scatters ever disagreed on a
+    winner (see _tile_build_round). Checked once per build."""
+    tlo = bstate.tag[:, 0::2]
+    thi = bstate.tag[:, 1::2]
+    sh = (meta.rows, TSLOTS)
+    occ = (tlo != _EMPTY_TAG) &         ((bstate.hq.reshape(sh) | bstate.lq.reshape(sh)) != 0)
+    # sort by a 64-bit tag key within each bucket; duplicates adjacent
+    key_hi = jnp.where(occ, thi, jnp.uint32(0xFFFFFFFF))
+    key_lo = jnp.where(occ, tlo, jnp.uint32(0xFFFFFFFF))
+    shi, slo = jax.lax.sort((key_hi, key_lo), dimension=1, num_keys=2)
+    dup = (shi[:, 1:] == shi[:, :-1]) & (slo[:, 1:] == slo[:, :-1]) &         (shi[:, 1:] != jnp.uint32(0xFFFFFFFF))
+    return jnp.any(dup)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
