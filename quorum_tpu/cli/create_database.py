@@ -34,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Maximum number of reprobes")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--profile", metavar="dir", default=None,
+                   help="Write a jax.profiler trace to this directory")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("reads", nargs="+", help="Read files")
     return p
@@ -71,6 +73,7 @@ def main(argv=None) -> int:
         initial_size=parse_size(args.size),
         max_reprobe=args.reprobe,
         batch_size=args.batch_size,
+        profile=args.profile,
     )
     try:
         create_database_main(args.reads, args.output, cfg,

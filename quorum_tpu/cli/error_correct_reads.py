@@ -63,6 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true", help="Be verbose")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--profile", metavar="dir", default=None,
+                   help="Write a jax.profiler trace to this directory")
     p.add_argument("db", help="Mer database")
     p.add_argument("sequence", nargs="+", help="Input sequence")
     return p
@@ -102,6 +104,7 @@ def main(argv=None) -> int:
         apriori_error_rate=args.apriori_error_rate,
         poisson_threshold=args.poisson_threshold,
         batch_size=args.batch_size,
+        profile=args.profile,
     )
     try:
         run_error_correct(

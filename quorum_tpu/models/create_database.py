@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..io import fastq, db_format
 from ..ops import ctable, mer, table
 from ..utils.pipeline import prefetch
+from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
 
 
@@ -39,6 +40,7 @@ class BuildConfig:
     max_reprobe: int = 126  # wide-table compatibility (unused by tile)
     batch_size: int = 8192
     max_grows: int = 16
+    profile: str | None = None  # --profile DIR: jax.profiler trace
 
 
 def extract_observations_impl(codes_i8, quals_u8, k: int, qual_thresh: int):
@@ -95,27 +97,37 @@ def build_database(
         # host decode/encode overlaps device rounds (double buffering,
         # the PP row of SURVEY §2.4)
         batches = prefetch(fastq.read_batches(paths, cfg.batch_size))
-    for batch in batches:
-        stats.batches += 1
-        stats.reads += batch.n
-        stats.bases += int(batch.lengths.sum())
-        chi, clo, q, valid = extract_observations(
-            jnp.asarray(batch.codes), jnp.asarray(batch.quals),
-            cfg.k, cfg.qual_thresh,
-        )
-        pending = valid
-        for _ in range(cfg.max_grows + 1):
-            bstate, full, placed = ctable.tile_insert_observations(
-                bstate, meta, chi, clo, q, pending
-            )
-            if not full:
-                break
-            pending = jnp.logical_and(pending, jnp.logical_not(placed))
-            vlog("Hash table full at ", meta.rows, " buckets; doubling")
-            bstate, meta = ctable.tile_grow_build(bstate, meta)
-            stats.grows += 1
-        else:
-            raise RuntimeError("Hash is full")
+    timer = StageTimer()
+    with trace(cfg.profile):
+        for batch in batches:
+            stats.batches += 1
+            stats.reads += batch.n
+            nb = int(batch.lengths.sum())
+            stats.bases += nb
+            timer.add_units("insert", nb)
+            with timer.stage("extract"):
+                chi, clo, q, valid = extract_observations(
+                    jnp.asarray(batch.codes), jnp.asarray(batch.quals),
+                    cfg.k, cfg.qual_thresh,
+                )
+                jax.block_until_ready(valid)
+            with timer.stage("insert"):
+                pending = valid
+                for _ in range(cfg.max_grows + 1):
+                    bstate, full, placed = ctable.tile_insert_observations(
+                        bstate, meta, chi, clo, q, pending
+                    )
+                    if not full:
+                        break
+                    pending = jnp.logical_and(pending,
+                                              jnp.logical_not(placed))
+                    vlog("Hash table full at ", meta.rows,
+                         " buckets; doubling")
+                    bstate, meta = ctable.tile_grow_build(bstate, meta)
+                    stats.grows += 1
+                else:
+                    raise RuntimeError("Hash is full")
+    timer.report(stats.bases)
     if bool(ctable.tile_dup_check(bstate, meta)):  # pragma: no cover
         raise RuntimeError(
             "internal error: duplicate tag pair in a bucket (torn tag "

@@ -28,10 +28,13 @@ import gzip as gzip_mod
 import sys
 from typing import Sequence
 
+import jax
+
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq
 from ..ops.poisson import compute_poisson_cutoff
 from ..utils.pipeline import AsyncWriter, prefetch
+from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
 from .corrector import correct_batch, finish_batch
 from .ec_config import ECConfig
@@ -59,6 +62,7 @@ class ECOptions:
     apriori_error_rate: float = 0.01
     poisson_threshold: float = 1e-6
     batch_size: int = 8192
+    profile: str | None = None  # --profile DIR: jax.profiler trace
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -139,6 +143,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     log = _open_out(opts.output, ".log", sys.stderr, opts.gzip)
     stats = ECStats(cutoff=cutoff)
     writer = AsyncWriter([out, log])
+    timer = StageTimer()
     vlog("Correcting reads")
     try:
         if records is not None:
@@ -146,27 +151,36 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         else:
             src = fastq.read_batches(sequences, opts.batch_size)
         batches = prefetch(src)
-        for batch in batches:
-            res = correct_batch(state, meta, batch.codes, batch.quals,
-                                batch.lengths, cfg, contam=contam)
-            results = finish_batch(res, batch.n, cfg)
-            fa_parts: list[str] = []
-            log_parts: list[str] = []
-            for hdr, r in zip(batch.headers, results):
-                if r.ok:
-                    fa_parts.append(f">{hdr} {r.fwd_log} {r.bwd_log}\n"
-                                    f"{r.seq}\n")
-                    stats.corrected += 1
-                    stats.bases_out += r.end - r.start
-                else:
-                    log_parts.append(f"Skipped {hdr}: {r.error}\n")
-                    stats.skipped += 1
-                    if cfg.no_discard:
-                        fa_parts.append(f">{hdr}\nN\n")
-            stats.reads += batch.n
-            stats.bases_in += int(batch.lengths[:batch.n].sum())
-            writer.write(0, "".join(fa_parts))
-            writer.write(1, "".join(log_parts))
+        with trace(opts.profile):
+            for batch in batches:
+                with timer.stage("device"):
+                    res = correct_batch(state, meta, batch.codes,
+                                        batch.quals, batch.lengths, cfg,
+                                        contam=contam)
+                    jax.block_until_ready(res)
+                with timer.stage("finish"):
+                    results = finish_batch(res, batch.n, cfg)
+                with timer.stage("render"):
+                    fa_parts: list[str] = []
+                    log_parts: list[str] = []
+                    for hdr, r in zip(batch.headers, results):
+                        if r.ok:
+                            fa_parts.append(
+                                f">{hdr} {r.fwd_log} {r.bwd_log}\n"
+                                f"{r.seq}\n")
+                            stats.corrected += 1
+                            stats.bases_out += r.end - r.start
+                        else:
+                            log_parts.append(f"Skipped {hdr}: {r.error}\n")
+                            stats.skipped += 1
+                            if cfg.no_discard:
+                                fa_parts.append(f">{hdr}\nN\n")
+                    stats.reads += batch.n
+                    nb = int(batch.lengths[:batch.n].sum())
+                    stats.bases_in += nb
+                    timer.add_units("device", nb)
+                    writer.write(0, "".join(fa_parts))
+                    writer.write(1, "".join(log_parts))
     finally:
         try:
             writer.close()
@@ -184,6 +198,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                 _finish(out)
             finally:
                 _finish(log)
+    timer.report(stats.bases_in)
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
     return stats

@@ -1,0 +1,89 @@
+"""Tracing and per-stage timing (SURVEY §5 tracing/profiling row).
+
+Two complementary surfaces, both opt-in and zero-cost when off:
+
+* `trace(dir)` — wraps ``jax.profiler.trace``: the two device CLIs
+  (create_database, error_correct_reads) accept ``--profile DIR`` and
+  write an XLA/TensorBoard trace there (device HLO timeline, host
+  Python events). This is the deep tool — the equivalent visibility
+  the reference gets from `perf`/gprof on its pthread pipeline.
+* `StageTimer` — cheap wall-clock accumulators for the coarse pipeline
+  stages (parse, device compute, host finish, write). The per-stage
+  split is the first question any throughput regression asks; the
+  reference answers it with vlog timestamps (src/verbose_log.hpp),
+  we answer with an explicit table, printed through vlog at exit.
+
+Timers deliberately measure *completion* (``block_until_ready`` is the
+caller's job where it matters): on the tunneled single-chip client the
+first D2H flips dispatch synchronous (see PERF_NOTES.md), so wall time
+per stage is the honest unit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .vlog import vlog
+
+
+@contextlib.contextmanager
+def trace(profile_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given, no-op when not.
+
+    Imports jax lazily so host-only callers (tests, future host tools)
+    don't pay the import when profiling is off.
+    """
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield
+    vlog("Wrote profiler trace to ", profile_dir)
+
+
+class StageTimer:
+    """Named wall-clock accumulators: ``with t.stage("correct"): ...``.
+
+    Also counts units (reads/bases) per stage via ``add_units`` so the
+    report can print a rate, not just a duration.
+    """
+
+    def __init__(self) -> None:
+        self.seconds: dict[str, float] = {}
+        self.calls: dict[str, int] = {}
+        self.units: dict[str, int] = {}
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def add_units(self, name: str, n: int) -> None:
+        self.units[name] = self.units.get(name, 0) + n
+
+    def report(self, total_units: int = 0, unit: str = "bases") -> None:
+        """Print the stage table through vlog (visible with -v)."""
+        total = time.perf_counter() - self._t0
+        for name in self.seconds:
+            s = self.seconds[name]
+            line = (f"stage {name:<12} {s:8.3f}s "
+                    f"({100.0 * s / total:5.1f}%) x{self.calls[name]}")
+            if name in self.units and s > 0:
+                line += f"  {self.units[name] / s / 1e6:.2f} M{unit}/s"
+            vlog(line)
+        accounted = sum(self.seconds.values())
+        vlog(f"stage {'(other)':<12} {total - accounted:8.3f}s "
+             f"({100.0 * (total - accounted) / total:5.1f}%)")
+        if total_units and total > 0:
+            vlog(f"total {total:.3f}s, "
+                 f"{total_units / total * 3600 / 1e9:.3f} G{unit}/hour "
+                 "end-to-end")

@@ -1,0 +1,60 @@
+"""Profiling surfaces: StageTimer accounting and the trace context."""
+
+import os
+
+from quorum_tpu.utils import vlog as vlog_mod
+from quorum_tpu.utils.profiling import StageTimer, trace
+
+
+def test_stage_timer_accumulates_and_reports(capsys):
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("a"):
+        pass
+    with t.stage("b"):
+        pass
+    t.add_units("a", 1000)
+    assert t.calls["a"] == 2
+    assert t.calls["b"] == 1
+    assert t.seconds["a"] >= 0.0
+    old = vlog_mod.verbose
+    vlog_mod.verbose = True
+    try:
+        t.report(total_units=2000)
+    finally:
+        vlog_mod.verbose = old
+    err = capsys.readouterr().err
+    assert "stage a" in err
+    assert "stage b" in err
+    assert "Gbases/hour" in err
+
+
+def test_stage_timer_exception_still_counts():
+    t = StageTimer()
+    try:
+        with t.stage("x"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert t.calls["x"] == 1
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        pass
+    with trace(""):
+        pass
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace(d):
+        import jax.numpy as jnp
+
+        _ = (jnp.zeros((8,)) + 1).sum()
+    # jax.profiler.trace writes plugins/profile/<ts>/ under the dir
+    found = []
+    for root, _dirs, files in os.walk(d):
+        found.extend(files)
+    assert found, "profiler trace directory is empty"
