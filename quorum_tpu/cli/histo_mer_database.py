@@ -31,7 +31,11 @@ def main(argv=None) -> int:
     if len(argv) != 1:
         print(f"Usage: histo_mer_database db", file=sys.stderr)
         return 1
-    state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    try:
+        state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    except (RuntimeError, ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
     _, _, vals = db_format.db_iterate(state, meta)
     out = histo(vals)
     for i in range(HLEN):

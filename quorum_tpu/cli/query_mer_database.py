@@ -16,7 +16,11 @@ def main(argv=None) -> int:
     if len(argv) < 2:
         print(f"Usage: query_mer_database db mer ...", file=sys.stderr)
         return 1
-    state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    try:
+        state, meta, _ = db_format.read_db(argv[0], to_device=False)
+    except (RuntimeError, ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
     k = meta.k
     print(k)
     for s in argv[1:]:

@@ -94,7 +94,20 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None
 def read_header(path: str) -> dict:
     with open(path, "rb") as f:
         line = f.readline()
-    header = json.loads(line)
+    try:
+        header = json.loads(line)
+    except ValueError:  # JSONDecodeError, or UnicodeDecodeError on binary
+        # not ours — a reference-built (Jellyfish-header) file gives a
+        # precise diagnostic instead of a JSON parse error
+        from . import ref_db
+
+        try:
+            ref_header, _ = ref_db.read_ref_header(path)
+        except ref_db.RefHeaderError:
+            raise ValueError(
+                f"'{path}' is not a quorum_tpu database (no JSON header)"
+            ) from None
+        raise ref_db.ref_db_error(path, ref_header) from None
     if header.get("format") != FORMAT:
         raise ValueError(
             f"Wrong type '{header.get('format')}' for file '{path}'"

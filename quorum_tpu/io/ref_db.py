@@ -1,0 +1,118 @@
+"""Best-effort reader for reference `binary/quorum_db` file headers.
+
+The reference's database files are written by Jellyfish's
+`file_header` (JSON text, then binary payload): database_header adds
+`bits`, `key_bytes`, `value_bytes` and the `binary/quorum_db` format
+tag (/root/reference/src/mer_database.hpp:43-63), and
+`hash_with_quality::write` appends the raw `large_hash::array` +
+`atomic_bits_array` planes (:115-126).
+
+What we can and cannot do in this environment:
+
+* The JSON header is self-describing — this module parses it (a
+  brace-matching scan, since the document is multi-line and followed
+  immediately by binary data) and reports the full geometry: hash
+  size, key length, value bits, reprobe limit, payload byte counts.
+  `db_format.read_header` uses it to give a precise diagnostic when a
+  reference-built file is passed to our tools.
+* The payload is Jellyfish's offsets-packed hash-array memory dump —
+  slot words interleave partial keys, reprobe offsets and "large"
+  entries at bit granularity. Jellyfish is not available here (the
+  reference links it externally via pkg-config, configure.ac:28; no
+  sources in-tree, no network), so a decoder could not be validated
+  against a single real file. Rather than ship an unverifiable
+  bit-layout guess, SURVEY §2.1's sanctioned alternative applies: our
+  own format (db_format) carries the same header fields, and this
+  module makes the boundary explicit instead of failing with a JSON
+  parse error.
+"""
+
+from __future__ import annotations
+
+import json
+
+REF_FORMAT = "binary/quorum_db"
+JF_FORMATS = (REF_FORMAT, "binary/jellyfish", "binary/sorted")
+
+
+class RefHeaderError(ValueError):
+    """File does not carry a parseable Jellyfish-style JSON header."""
+
+
+def parse_jf_header(data: bytes) -> tuple[dict, int]:
+    """Parse a Jellyfish-style JSON header from the start of `data`.
+
+    The document is arbitrary formatted JSON followed immediately by
+    binary payload, so the end is found by brace matching (tracking
+    strings and escapes), not by line structure. Returns
+    (header_dict, end_offset) where end_offset is one past the closing
+    brace."""
+    i = 0
+    while i < len(data) and data[i:i + 1].isspace():
+        i += 1
+    if i >= len(data) or data[i] != ord("{"):
+        raise RefHeaderError("no JSON object at start of file")
+    depth = 0
+    in_str = False
+    esc = False
+    for j in range(i, len(data)):
+        c = data[j]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == ord("\\"):
+                esc = True
+            elif c == ord('"'):
+                in_str = False
+        elif c == ord('"'):
+            in_str = True
+        elif c == ord("{"):
+            depth += 1
+        elif c == ord("}"):
+            depth -= 1
+            if depth == 0:
+                try:
+                    return json.loads(data[i:j + 1]), j + 1
+                except json.JSONDecodeError as e:
+                    raise RefHeaderError(f"malformed JSON header: {e}") from e
+    raise RefHeaderError("unterminated JSON header")
+
+
+def read_ref_header(path: str, max_header: int = 1 << 20
+                    ) -> tuple[dict, int]:
+    """Read and parse the header of a reference-format database file.
+
+    Returns (header, payload_offset). payload_offset is the aligned
+    position after the JSON document (the `alignment` root field when
+    present, Jellyfish's generic_file_header convention; 8 otherwise)
+    — best-effort, since no reference-built file can be generated
+    in-environment to pin the padding byte-for-byte."""
+    with open(path, "rb") as f:
+        data = f.read(max_header)
+    header, end = parse_jf_header(data)
+    align = int(header.get("alignment", 8) or 8)
+    payload = -(-end // align) * align
+    return header, payload
+
+
+def describe(header: dict) -> str:
+    """One-line geometry summary for diagnostics."""
+    fields = []
+    for key in ("format", "key_len", "bits", "size", "max_reprobe",
+                "key_bytes", "value_bytes", "alignment"):
+        if key in header:
+            fields.append(f"{key}={header[key]}")
+    return ", ".join(fields) if fields else "no geometry fields"
+
+
+def ref_db_error(path: str, header: dict) -> RuntimeError:
+    """The diagnostic raised when a reference-built DB is passed to a
+    tool of ours."""
+    return RuntimeError(
+        f"'{path}' is a reference-format quorum database "
+        f"({describe(header)}). Its payload is a Jellyfish "
+        "offsets-packed hash-array dump, which this framework does not "
+        "decode (Jellyfish is not available to validate the bit "
+        "layout). Re-create the database with quorum_create_database "
+        "from the original reads."
+    )

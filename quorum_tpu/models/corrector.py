@@ -249,35 +249,39 @@ def find_anchors(state: table.TableState, tmeta: table.TableMeta,
         con = jnp.zeros((b, l), bool)
     checked = vw & (p_idx <= (lengths[:, None] - 2))
 
-    # lax.scan over positions with per-lane counters
-    def scan_step(carry, x):
-        found, done, anchor_p, contam_flag = carry
-        vwp, chkp, valp, conp, p = x
-        is_checked = chkp & ~done
-        con_event = is_checked & conp & (not cfg.trim_contaminant)
-        contam_flag = contam_flag | con_event
-        done = done | con_event
-        upd = is_checked & ~conp & ~con_event
-        found = jnp.where(
-            upd, jnp.where(valp >= cfg.anchor_count, found + 1, 0), found)
-        hit = upd & (found >= cfg.good) & ~done
-        anchor_p = jnp.where(hit, p, anchor_p)
-        done = done | hit
-        found = jnp.where(~vwp & ~done, 0, found)
-        return (found, done, anchor_p, contam_flag), None
+    # The reference's sequential scan, in closed form. Classify every
+    # position: A (checked, clean, HQ count >= anchor_count) extends
+    # the good run; Z (invalid window, or checked-clean with a low
+    # count) resets it; everything else (past the checked range, or a
+    # contaminant window under --trim-contaminant) leaves it alone.
+    # run(p) = #A since the last Z, via cumsum minus its value at the
+    # last Z (a cummax of Z positions).
+    a = checked & ~con & (val_hq >= cfg.anchor_count)
+    z = (~vw) | (checked & ~con & (val_hq < cfg.anchor_count))
+    cum_a = jnp.cumsum(a.astype(jnp.int32), axis=1)
+    last_z = jax.lax.cummax(jnp.where(z, p_idx, jnp.int32(-1)), axis=1)
+    cum_at_z = jnp.take_along_axis(cum_a, jnp.clip(last_z, 0), axis=1)
+    run = cum_a - jnp.where(last_z >= 0, cum_at_z, 0)
+    hit = a & (run >= cfg.good)
+    has_hit = jnp.any(hit, axis=1)
+    anchor_p = jnp.argmax(hit, axis=1).astype(jnp.int32)  # first True
 
-    z = jnp.zeros((b,), jnp.int32)
-    fz = jnp.zeros((b,), bool)
-    xs = (vw.T, checked.T, val_hq.T, con.T,
-          jnp.arange(l, dtype=jnp.int32)[:, None] + jnp.zeros((l, b), jnp.int32))
-    (found, done, anchor_p, contam_flag), _ = jax.lax.scan(
-        scan_step, (z, fz, z, fz), xs)
+    # a contaminant window kills the read only if the scan reaches it
+    # before the anchor (is_checked & ~done in the sequential form)
+    if has_contam and not cfg.trim_contaminant:
+        kill = checked & con
+        has_kill = jnp.any(kill, axis=1)
+        kill_p = jnp.argmax(kill, axis=1).astype(jnp.int32)
+        contam_flag = has_kill & (~has_hit | (kill_p < anchor_p))
+        anchor_found = has_hit & ~contam_flag
+    else:
+        contam_flag = jnp.zeros((b,), bool)
+        anchor_found = has_hit
 
-    anchor_found = done & ~contam_flag
     status = jnp.where(anchor_found, OK,
                        jnp.where(contam_flag, ST_CONTAMINANT, ST_NO_ANCHOR))
     lane = jnp.arange(b, dtype=jnp.int32)
-    ap = jnp.clip(anchor_p, 0)
+    ap = jnp.where(anchor_found, anchor_p, 0)
     return AnchorResult(
         anchor_found, status, anchor_p + 1,
         fhi[lane, ap], flo[lane, ap], rhi[lane, ap], rlo[lane, ap],
@@ -327,13 +331,23 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
             window, error, b, l)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 8, 9, 10))
+# Steps per while_loop iteration. Each step is fully masked
+# (active = alive & in_range), so running several per iteration is a
+# pure strength reduction: same total work, fewer loop iterations —
+# ~20% faster at 2 on the v5e. 4 is marginally faster still but its
+# XLA compile time is prohibitive (the whole loop body is cloned per
+# step; see PERF_NOTES.md).
+UNROLL = 2
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 8, 9, 10, 11, 12))
 def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
                  carry, end,
-                 contam_state, contam_meta, d: int, has_contam: bool):
+                 contam_state, contam_meta, d: int, has_contam: bool,
+                 unroll: int = UNROLL, ambig_cap: int = 1 << 30):
     """The lockstep extension loop; the ambiguous-path continuation
-    probe runs inline via _ambig_core (see extend's docstring for why
-    inline beats parking)."""
+    probe runs inline via _ambig_core, over compacted lanes (see its
+    docstring)."""
     k = cfg.k
     (in_range, gather_code, take4, contam, lane, codes32, quals32,
      window, error, b, l) = _extend_env(
@@ -350,6 +364,8 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         qualc = jnp.where(active,
                           gather_code(quals32, cpos, active), 0)
 
+        # pre-step mers, restored for lanes stalled by the ambig cap
+        pfh, pfl, prh, prl = fh, fl, rh, rl
         shift_code = mer.u32(jnp.maximum(ori, 0))
         sfh, sfl, srh, srl = mer.dir_shift(fh, fl, rh, rl, shift_code, d, k)
         fh = jnp.where(active, sfh, fh)
@@ -361,7 +377,6 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         con1 = contam(fh, fl, rh, rl, active & (ori >= 0))
         con1_trim = con1 if cfg.trim_contaminant else jnp.zeros_like(con1)
         con1_err = con1 & ~con1_trim
-        log = _append_trunc(log, con1_trim, cpos, window, error, d)
         status = jnp.where(con1_err, ST_CONTAMINANT, status)
         alive = alive & ~con1
         live = active & ~con1
@@ -371,7 +386,6 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
 
         # count == 0: truncate (cc:416-419)
         t0 = live & (count == 0)
-        log = _append_trunc(log, t0, cpos, window, error, d)
         alive = alive & ~t0
         live = live & ~t0
 
@@ -390,7 +404,6 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         con2 = contam(fh, fl, rh, rl, sub1)
         con2_trim = con2 if cfg.trim_contaminant else jnp.zeros_like(con2)
         con2_err = con2 & ~con2_trim
-        log = _append_trunc(log, con2_trim, cpos, window, error, d)
         status = jnp.where(con2_err, ST_CONTAMINANT, status)
         alive = alive & ~con2
         sub1 = sub1 & ~con2
@@ -415,16 +428,30 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         keep_simple = keep_cut | keep_poi
         t_a = cm & (ori >= 0) & ~ori_hi & (level == 0) & (c_ori == 0)
         t_b = cm & (ori < 0) & (level == 0)
-        log = _append_trunc(log, t_a | t_b, cpos, window, error, d)
         alive = alive & ~(t_a | t_b)
+        # one merged truncation append: the five masks are disjoint per
+        # lane (each lane takes one branch), all at cpos, and no
+        # intermediate computation reads the log — 5 sets of [B, E]
+        # log ops become 1
+        log = _append_trunc(log, con1_trim | t0 | con2_trim | t_a | t_b,
+                            cpos, window, error, d)
         ambig = cm & ~keep_simple & ~t_a & ~t_b
         env = (in_range, gather_code, take4, contam, lane, codes32,
                quals32, window, error, b, l)
         (fh, fl, rh, rl, pos, opos, prev, alive, status, outb,
-         log) = _ambig_core(env, state, tmeta, cfg, d,
-                            fh, fl, rh, rl, pos, opos, prev, alive,
-                            status, outb, log, ambig, cpos, ori,
-                            counts, level)
+         log, stalled) = _ambig_core(env, state, tmeta, cfg, d,
+                                     fh, fl, rh, rl, pos, opos, prev,
+                                     alive, status, outb, log, ambig,
+                                     cpos, ori, counts, level, ambig_cap)
+
+        # stalled lanes redo the whole step next iteration: rewind
+        # their position and pre-shift mers (they took no branch, wrote
+        # nothing, and appended nothing this iteration)
+        pos = jnp.where(stalled, cpos, pos)
+        fh = jnp.where(stalled, pfh, fh)
+        fl = jnp.where(stalled, pfl, fl)
+        rh = jnp.where(stalled, prh, rh)
+        rl = jnp.where(stalled, prl, rl)
 
         write = write1 | (keep_simple & alive & active)
         base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
@@ -435,27 +462,54 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
 
         return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log)
 
+    def body_unrolled(carry):
+        for _ in range(unroll):
+            carry = body(carry)
+        return carry
+
     def cond(carry):
         (_, _, _, _, pos, _, _, alive, _, _, _) = carry
         return jnp.any(alive & in_range(pos))
 
-    return jax.lax.while_loop(cond, body, carry)
+    return jax.lax.while_loop(cond, body_unrolled, carry)
 
 
 def _ambig_core(env, state, tmeta, cfg, d: int,
                 fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
-                ambig, cpos, ori, counts, level):
+                ambig, cpos, ori, counts, level, ambig_cap: int):
     """The ambiguous-path continuation probe + tie-break
-    (error_correct_reads.cc:473-545), shared by the host-orchestrated
-    resolve step and the traceable inline path (shard_map)."""
+    (error_correct_reads.cc:473-545).
+
+    The 16-variant continuation lookup is the extend loop's dominant
+    gather (16 rows/lane/iteration) but fires on a sparse minority of
+    lanes, and masked gather indices cost the same as live ones
+    (PERF_NOTES.md: no dedupe). So ambiguous lanes are COMPACTED into
+    at most `ambig_cap` slots before the probe — the lookup shrinks
+    from 16B to 16*cap rows. Lanes past the cap stall: the caller
+    rewinds their position/mer so they retry the whole step next
+    iteration (pure delay, bit-identical outcomes; the first `cap`
+    ambiguous lanes always fit, so progress is guaranteed). Returns
+    (carry..., stalled)."""
     k = cfg.k
     (in_range, gather_code, take4, contam, lane, codes32, quals32,
      window, error, b, l) = env
+    cap = min(max(1, ambig_cap), b)  # cap<1 would stall lanes forever
     read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
+    elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
+                      for i in range(4)], axis=1)  # [B, 4]
+
+    slot = jnp.cumsum(ambig.astype(jnp.int32)) - 1  # per-lane order
+    fitted = ambig & (slot < cap)
+    stalled = ambig & ~fitted
+    lane_of = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(fitted, slot, cap)].set(lane, mode="drop")
+
+    cfh, cfl = fh[lane_of], fl[lane_of]
+    crh, crl = rh[lane_of], rl[lane_of]
     chis, clos = [], []
     for i in range(4):
         ifh, ifl, irh, irl = mer.dir_replace0(
-            fh, fl, rh, rl, mer.u32(i), d, k)
+            cfh, cfl, crh, crl, mer.u32(i), d, k)
         ifh, ifl, irh, irl = mer.dir_shift(
             ifh, ifl, irh, irl, mer.u32(0), d, k)
         for j in range(4):
@@ -464,34 +518,43 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
             chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
             chis.append(chi)
             clos.append(clo)
-    elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
-                      for i in range(4)], axis=1)  # [B, 4]
-    act16 = jnp.repeat(elig.T, 4, axis=0).reshape(-1)  # [16B] i-major
+    n_fit = jnp.sum(fitted.astype(jnp.int32))
+    arange_cap = jnp.arange(cap, dtype=jnp.int32)
+    elig_c = elig[lane_of] & (arange_cap < n_fit)[:, None]  # [cap, 4]
+    act16 = jnp.repeat(elig_c.T, 4, axis=0).reshape(-1)  # [16*cap] i-major
     nvals = _db_lookup(
         state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
         act16,
-    ).reshape(4, 4, b)  # [i, j, B]
+    ).reshape(4, 4, cap)  # [i, j, cap]
     ncnt = (nvals >> 1).astype(jnp.int32)
     nq = (nvals & 1).astype(jnp.int32)
     npresent = ncnt > 0
-    nlevel = jnp.max(jnp.where(npresent, nq, 0), axis=1)  # [i, B]
+    nlevel = jnp.max(jnp.where(npresent, nq, 0), axis=1)  # [i, cap]
     ncounts = jnp.where(npresent & (nq == nlevel[:, None, :]), ncnt, 0)
-    ncount = jnp.sum((ncounts > 0).astype(jnp.int32), axis=1)  # [i, B]
+    ncount = jnp.sum((ncounts > 0).astype(jnp.int32), axis=1)  # [i, cap]
 
-    succ = jnp.stack([
-        elig[:, i] & (ncount[i] > 0) & (nlevel[i] >= level)
-        for i in range(4)], axis=1)  # [B, 4]
+    level_c = level[lane_of]
+    nb_c = read_nbase[lane_of]
+    safe_nb_c = jnp.clip(nb_c, 0, 3)
+    arange_c = jnp.arange(cap, dtype=jnp.int32)
+    succ_c = jnp.stack([
+        elig_c[:, i] & (ncount[i] > 0) & (nlevel[i] >= level_c)
+        for i in range(4)], axis=1)  # [cap, 4]
+    cwn_c = jnp.stack([
+        succ_c[:, i] & (nb_c >= 0)
+        & (ncounts[i][safe_nb_c, arange_c] > 0)
+        for i in range(4)], axis=1)  # [cap, 4]
+
+    # scatter back to full width (gather by slot, masked by fitted)
+    safe_slot = jnp.clip(slot, 0, cap - 1)
+    succ = jnp.where(fitted[:, None], succ_c[safe_slot], False)
+    cwn = jnp.where(fitted[:, None], cwn_c[safe_slot], False)
+
     cont_counts = jnp.where(succ, counts, 0)
-    safe_nb = jnp.clip(read_nbase, 0, 3)
-    cwn = jnp.stack([
-        succ[:, i] & (read_nbase >= 0)
-        & (ncounts[i][safe_nb, lane] > 0)
-        for i in range(4)], axis=1)  # [B, 4]
-
     check_code = jnp.where(ambig, ori, 0)
     for i in range(4):
         check_code = jnp.where(elig[:, i], i, check_code)
-    success = ambig & jnp.any(succ, axis=1)
+    success = fitted & jnp.any(succ, axis=1)
 
     # tie-break chain (cc:509-545). prev_count <= min_count takes
     # the int-overflow dead-code path: no candidate ever matches.
@@ -523,7 +586,6 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
     con3 = contam(fh, fl, rh, rl, sub2)
     con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
     con3_err = con3 & ~con3_trim
-    log = _append_trunc(log, con3_trim, cpos, window, error, d)
     status = jnp.where(con3_err, ST_CONTAMINANT, status)
     alive = alive & ~con3
     sub2 = sub2 & ~con3
@@ -534,38 +596,53 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
     opos = jnp.where(trip2, opos - d * diff2, opos)
     alive = alive & ~trip2
 
-    # N base with no good substitution: truncate (cc:553-556)
-    t_c = ambig & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
-    log = _append_trunc(log, t_c, cpos, window, error, d)
+    # N base with no good substitution: truncate (cc:553-556); merged
+    # with the con3_trim truncation — disjoint lanes, same position
+    t_c = fitted & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
+    log = _append_trunc(log, con3_trim | t_c, cpos, window, error, d)
     alive = alive & ~t_c
 
-    write = ambig & alive
+    write = fitted & alive
     base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
     widx = jnp.where(write, opos, l)
     outb = outb.at[lane, widx].set(base0, mode="drop")
     opos = jnp.where(write, opos + d, opos)
 
-    return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log)
+    return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+            stalled)
 
 
 def extend(state, tmeta, codes, quals, cfg: ECConfig,
            out, fhi, flo, rhi, rlo, prev0, alive0,
            pos0, end, status0,
-           contam_state, contam_meta, d: int, has_contam: bool):
+           contam_state, contam_meta, d: int, has_contam: bool,
+           ambig_cap: int | None = None):
     """extend (error_correct_reads.cc:384-565) in lockstep over a batch:
     one fused while_loop advancing every live lane one base per
-    iteration, with the ambiguous-path continuation probe inline
-    (_ambig_core). Measured on real-coverage data the ambiguous branch
-    fires on a large minority of lanes (error k-mers recorded in the DB
-    make count > 1 common), so parking/compacting those lanes loses to
-    simply keeping the probe in the loop."""
+    iteration, with the ambiguous-path continuation probe inline over
+    capacity-compacted lanes (_ambig_core; stall-and-retry keeps it
+    bit-exact). The default cap (b/8, min 256) covers the measured
+    ambiguous rate at real coverage (~1-3% of lanes/iteration) with an
+    order of magnitude of headroom; pathological batches stall some
+    lanes into extra iterations rather than breaking."""
     b = codes.shape[0]
-    maxe = out.shape[1] + 2
+    # Entry-capacity bound: the window budget retires a lane once any
+    # window-span holds more than `error` entries (check_nb_error), so
+    # a live log retains <= error+1 entries per window-sized block of
+    # the read, plus a couple of truncation entries. Every [B, E] log
+    # op scales with E, so the tight bound matters at 150 bp (64 vs
+    # 152 lanes of per-iteration work).
+    l = out.shape[1]
+    w = max(1, cfg.effective_window)
+    maxe = min(l + 2, -(-l // w) * (cfg.effective_error + 1) + 8)
     log0 = make_log(b, maxe)
+    if ambig_cap is None:
+        ambig_cap = max(256, b // 8)
     carry = (fhi, flo, rhi, rlo, pos0, pos0, prev0, alive0, status0, out,
              log0)
     carry = _extend_loop(state, tmeta, codes, quals, cfg, carry, end,
-                         contam_state, contam_meta, d, has_contam)
+                         contam_state, contam_meta, d, has_contam,
+                         UNROLL, ambig_cap)
     (_, _, _, _, _, opos, _, _, status, outb, log) = carry
     return ExtendResult(outb, opos, status, log)
 
@@ -592,11 +669,14 @@ def _dummy_contam(k: int):
 
 def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                   codes, quals, lengths, cfg: ECConfig,
-                  contam=None) -> BatchResult:
+                  contam=None, ambig_cap: int | None = None
+                  ) -> BatchResult:
     """Correct a batch of reads on device. `contam` is an optional
     (TableState, TableMeta) k-mer membership set (value word != 0).
     Mirrors error_correct_instance::start (error_correct_reads.cc:
-    246-341): anchor, forward extend, backward extend."""
+    246-341): anchor, forward extend, backward extend. `ambig_cap`
+    overrides the ambiguous-lane compaction capacity (tests use tiny
+    caps to exercise the stall path)."""
     codes = jnp.asarray(codes, jnp.int32)
     quals = jnp.asarray(quals, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
@@ -615,7 +695,7 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                  anc.fhi, anc.flo, anc.rhi, anc.rlo,
                  anc.prev_count, anc.found,
                  anc.start_off, lengths, anc.status,
-                 cstate, cmeta, 1, has_contam)
+                 cstate, cmeta, 1, has_contam, ambig_cap)
     bwd_alive = anc.found & (fwd.status == OK)
     bpos0 = anc.start_off - cfg.k - 1
     bend = jnp.full((b,), -1, jnp.int32)
@@ -623,22 +703,107 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                  anc.fhi, anc.flo, anc.rhi, anc.rlo,
                  anc.prev_count, bwd_alive,
                  bpos0, bend, fwd.status,
-                 cstate, cmeta, -1, has_contam)
+                 cstate, cmeta, -1, has_contam, ambig_cap)
     return BatchResult(bwd.out, bwd.opos + 1, fwd.opos, bwd.status,
                        fwd.log, bwd.log)
 
 
-def _render_entries(pos, meta, n, trunc_string: str) -> str:
-    parts = []
-    for j in range(n):
-        m = int(meta[j])
-        if m & 1:
-            parts.append(f"{int(pos[j])}:{trunc_string}")
-        else:
-            frm = (m >> 1) & 7
-            to = (m >> 4) & 7
-            parts.append(f"{int(pos[j])}:sub:{_BASES[frm]}-{_BASES[to]}")
-    return " ".join(parts)
+def _render_dir(nv: np.ndarray, pos: np.ndarray, meta: np.ndarray,
+                trunc_string: str) -> list[str]:
+    """Batched log rendering: one flat pass over every entry in the
+    batch (total entries ~ a few per read), then per-read joins."""
+    width = pos.shape[1]
+    msk = np.arange(width)[None, :] < nv[:, None]
+    li, lj = np.nonzero(msk)
+    p = pos[li, lj].tolist()
+    m = meta[li, lj]
+    is_tr = (m & 1).astype(bool).tolist()
+    frm = ((m >> 1) & 7).tolist()
+    to = ((m >> 4) & 7).tolist()
+    ents = [
+        f"{pp}:{trunc_string}" if t
+        else f"{pp}:sub:{_BASES[f]}-{_BASES[tt]}"
+        for pp, t, f, tt in zip(p, is_tr, frm, to)
+    ]
+    offs = np.concatenate([[0], np.cumsum(nv)])
+    return [" ".join(ents[offs[i]:offs[i + 1]]) for i in range(len(nv))]
+
+
+# host LUT: packed byte -> 4 ASCII base chars (little codes first)
+_UNPACK_LUT = np.empty((256, 4), np.uint8)
+for _b in range(256):
+    for _j in range(4):
+        _UNPACK_LUT[_b, _j] = b"ACGT"[(_b >> (2 * _j)) & 3]
+
+
+def _i16_bytes(x):
+    """[B, W] int16 -> [B, 2W] u8 (little-endian byte planes)."""
+    lo = (x.astype(jnp.uint16) & 0xFF).astype(jnp.uint8)
+    hi = (x.astype(jnp.uint16) >> 8).astype(jnp.uint8)
+    return jnp.stack([lo, hi], axis=2).reshape(x.shape[0], -1)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _pack_finish(res: BatchResult, width: int):
+    """Device-side compression before D2H: ONE u8 buffer per batch.
+
+    The tunnel's D2H path costs ~90 ms fixed per transfer plus
+    ~170 ms/MB (PERF_NOTES.md) — transferring the raw BatchResult
+    (50 MB, 8 transfers) cost 2.5x the device compute. Packing 2-bit
+    codes + int16-clipped logs into a single [B, row_bytes] u8 plane
+    makes it one ~1.5 MB transfer.
+
+    Row layout (all int16 little-endian unless noted):
+    [seq 2-bit packed: ceil(L/4) u8][start][end][status]
+    [f_n][b_n][f_pos width][f_meta width][b_pos width][b_meta width]
+    """
+    codes4 = jnp.clip(res.out, 0, 3).astype(jnp.uint32)
+    b, l = codes4.shape
+    l4 = -(-l // 4) * 4
+    codes4 = jnp.pad(codes4, ((0, 0), (0, l4 - l)))
+    g = codes4.reshape(b, l4 // 4, 4)
+    packed = (g[:, :, 0] | (g[:, :, 1] << 2) | (g[:, :, 2] << 4)
+              | (g[:, :, 3] << 6)).astype(jnp.uint8)
+
+    def clip(lg: LogState):
+        return (_i16_bytes(lg.pos[:, :width].astype(jnp.int16)),
+                _i16_bytes(lg.meta[:, :width].astype(jnp.int16)))
+
+    fp, fm = clip(res.fwd_log)
+    bp, bm = clip(res.bwd_log)
+    cols = [packed]
+    for v in (res.start, res.end, res.status, res.fwd_log.n,
+              res.bwd_log.n):
+        cols.append(_i16_bytes(v.astype(jnp.int16)[:, None]))
+    cols.extend([fp, fm, bp, bm])
+    return jnp.concatenate(cols, axis=1)
+
+
+def _unpack_finish(buf: np.ndarray, l: int, width: int):
+    """Host-side inverse of `_pack_finish`'s row layout."""
+    nb = -(-l // 4)
+    seq_ascii = _UNPACK_LUT[buf[:, :nb]].reshape(buf.shape[0], -1)[:, :l]
+
+    def i16(col):
+        u = (buf[:, col].astype(np.uint16)
+             | (buf[:, col + 1].astype(np.uint16) << 8))
+        return u.view(np.int16)
+
+    def i16w(col, w):
+        raw = buf[:, col:col + 2 * w].reshape(buf.shape[0], w, 2)
+        u = (raw[:, :, 0].astype(np.uint16)
+             | (raw[:, :, 1].astype(np.uint16) << 8))
+        return np.ascontiguousarray(u).view(np.int16)
+
+    o = nb
+    start, end, status, f_n, b_n = (i16(o), i16(o + 2), i16(o + 4),
+                                    i16(o + 6), i16(o + 8))
+    o += 10
+    f_pos = i16w(o, width)
+    f_meta = i16w(o + 2 * width, width)
+    b_pos = i16w(o + 4 * width, width)
+    b_meta = i16w(o + 6 * width, width)
+    return seq_ascii, start, end, status, f_n, f_pos, f_meta, b_n, b_pos, b_meta
 
 
 def _homo_trim_np(out, start, end, ok, homo_trim_val: int):
@@ -666,22 +831,35 @@ def _homo_trim_np(out, start, end, ok, homo_trim_val: int):
 def finish_batch(res: BatchResult, n: int, cfg: ECConfig
                  ) -> list[ReadResult]:
     """Host post-processing: optional homo-trim, log rendering, and
-    ReadResult assembly (same shape as the oracle's results)."""
-    out = np.asarray(res.out)
-    start = np.asarray(res.start).copy()
-    end = np.asarray(res.end).copy()
-    status = np.asarray(res.status).copy()
-    f_n = np.asarray(res.fwd_log.n).copy()
-    f_pos = np.asarray(res.fwd_log.pos).copy()
-    f_meta = np.asarray(res.fwd_log.meta).copy()
-    b_n = np.asarray(res.bwd_log.n).copy()
-    b_pos = np.asarray(res.bwd_log.pos).copy()
-    b_meta = np.asarray(res.bwd_log.meta).copy()
+    ReadResult assembly (same shape as the oracle's results).
+
+    Vectorized end to end: one small D2H for the entry counts picks the
+    clip width, `_pack_finish` compresses everything else on device,
+    and rendering runs as flat numpy passes + per-read joins (the old
+    per-read loop at 16k-read batches cost more than the device
+    compute; see PERF_NOTES.md)."""
+    maxe = res.fwd_log.pos.shape[1]
+    # the packed D2H narrows positions to int16
+    assert res.out.shape[1] < (1 << 15), \
+        f"read length {res.out.shape[1]} overflows the int16 packed layout"
+    # one tiny D2H decides the clip width, one packed D2H moves the rest
+    nmax = np.asarray(jnp.maximum(jnp.max(res.fwd_log.n),
+                                  jnp.max(res.bwd_log.n)))
+    maxn = int(nmax)
+    assert maxn <= maxe, f"log overflow: {maxn} entries > buffer {maxe}"
+    width = 1
+    while width < maxn:
+        width *= 2
+    width = min(width, maxe)
+    l = res.out.shape[1]
+    buf = np.asarray(_pack_finish(res, width))
+    (out_u8, start, end, status, f_n, f_pos, f_meta, b_n, b_pos,
+     b_meta) = _unpack_finish(buf, l, width)
 
     extra_fwd: dict[int, list[tuple[int, int]]] = {}
     if cfg.do_homo_trim:
         ok = status[:n] == OK
-        trim, max_pos = _homo_trim_np(out[:n], start[:n], end[:n], ok,
+        trim, max_pos = _homo_trim_np(out_u8[:n], start[:n], end[:n], ok,
                                       cfg.homo_trim)
         for i in np.nonzero(trim)[0]:
             mp = int(max_pos[i])
@@ -701,6 +879,10 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig
             extra_fwd[int(i)] = [(mp, _T_TRUNC)]
             end[i] = mp
 
+    fwd_strs = _render_dir(f_n[:n], f_pos[:n], f_meta[:n], "3_trunc")
+    bwd_strs = _render_dir(b_n[:n], b_pos[:n], b_meta[:n], "5_trunc")
+    seq_buf = out_u8[:n].tobytes()
+
     results: list[ReadResult] = []
     for i in range(n):
         st = int(status[i])
@@ -708,12 +890,10 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig
             results.append(ReadResult(False, STATUS_ERRORS[st]))
             continue
         s, e = int(start[i]), int(end[i])
-        seq_codes = out[i, s:e]
-        seq = mer.codes_to_seq(seq_codes) if e > s else ""
-        fwd_s = _render_entries(f_pos[i], f_meta[i], int(f_n[i]), "3_trunc")
-        if int(i) in extra_fwd:
-            extra = " ".join(f"{p}:3_trunc" for p, _ in extra_fwd[int(i)])
+        seq = seq_buf[i * l + s:i * l + e].decode() if e > s else ""
+        fwd_s = fwd_strs[i]
+        if i in extra_fwd:
+            extra = " ".join(f"{p}:3_trunc" for p, _ in extra_fwd[i])
             fwd_s = f"{fwd_s} {extra}" if fwd_s else extra
-        bwd_s = _render_entries(b_pos[i], b_meta[i], int(b_n[i]), "5_trunc")
-        results.append(ReadResult(True, "", seq, fwd_s, bwd_s, s, e))
+        results.append(ReadResult(True, "", seq, fwd_s, bwd_strs[i], s, e))
     return results

@@ -190,11 +190,18 @@ def dir_replace0(fhi, flo, rhi, rlo, code_u32, d: int, k: int):
 
 
 def rolling_kmers(codes, k: int):
-    """All k-mer windows of a batch of code sequences, via one scan.
+    """All k-mer windows of a batch of code sequences, fully vectorized.
 
     TPU-native replacement for the per-base rolling loop of
-    create_database.cc:72-91: instead of one thread walking one read, the
-    scan advances every read in the batch one base per step.
+    create_database.cc:72-91. An earlier version advanced a lax.scan
+    one base per step; at L=150 the scan's ~L sequential steps cost
+    ~110 ms/batch on the v5e (PERF_NOTES.md), so the window values are
+    instead built from k statically-unrolled shifted taps (the base at
+    p-j lands at bit 2j of the forward mer, 2(k-1-j) of the reverse
+    complement) — all top-level [B, L] elementwise work. Outputs are
+    bit-identical to the scan: positions before the window fills see
+    zero-filled high taps, and non-ACGT bases enter as code 0, exactly
+    like the scan's zero init and where(ok, c, 0).
 
     Args:
       codes: int32[B, L] base codes, -1 for non-ACGT/padding.
@@ -207,20 +214,29 @@ def rolling_kmers(codes, k: int):
       matching the low_len logic of create_database.cc:80-85).
     """
     B, L = codes.shape
-    codes_t = jnp.transpose(codes)  # [L, B]
-
-    def step(carry, c):
-        fhi, flo, rhi, rlo, run = carry
-        ok = c >= 0
-        cc = u32(jnp.where(ok, c, 0))
-        nfhi, nflo = shift_left(fhi, flo, cc, k)
-        nrhi, nrlo = shift_right(rhi, rlo, u32(3) - cc, k)
-        nrun = jnp.where(ok, run + 1, 0)
-        out = (nfhi, nflo, nrhi, nrlo, nrun >= k)
-        return (nfhi, nflo, nrhi, nrlo, nrun), out
-
-    zero = jnp.zeros((B,), dtype=jnp.uint32)
-    init = (zero, zero, zero, zero, jnp.zeros((B,), dtype=jnp.int32))
-    _, (fhi, flo, rhi, rlo, valid) = jax.lax.scan(step, init, codes_t)
-    tr = lambda a: jnp.transpose(a)
-    return tr(fhi), tr(flo), tr(rhi), tr(rlo), tr(valid)
+    ok = codes >= 0
+    c = jnp.where(ok, codes, 0).astype(jnp.uint32)
+    rc = u32(3) - c
+    z = jnp.zeros((B, L), jnp.uint32)
+    fhi, flo, rhi, rlo = z, z, z, z
+    for j in range(k):
+        # tap j: the base at position p-j (zeros where p < j)
+        if j:
+            cj = jnp.pad(c, ((0, 0), (j, 0)))[:, :L]
+            rj = jnp.pad(rc, ((0, 0), (j, 0)))[:, :L]
+        else:
+            cj, rj = c, rc
+        s = 2 * j
+        if s < 32:
+            flo = flo | (cj << s)
+        else:
+            fhi = fhi | (cj << (s - 32))
+        t = 2 * (k - 1 - j)
+        if t < 32:
+            rlo = rlo | (rj << t)
+        else:
+            rhi = rhi | (rj << (t - 32))
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    last_bad = jax.lax.cummax(jnp.where(~ok, pos, jnp.int32(-1)), axis=1)
+    valid = (pos - last_bad) >= k
+    return fhi, flo, rhi, rlo, valid

@@ -493,3 +493,46 @@ def test_tile_backend_matches_wide_and_oracle():
                        t.start, t.end), f"tile mismatch read {i}"
         n_sub += o.fwd_log.count("sub")
     assert n_sub > 0  # corrections actually happened
+
+
+def test_ambig_cap_stall_parity():
+    """The ambiguous-lane compaction cap forces stall-and-retry when
+    more lanes are ambiguous than fit: results must be bit-identical to
+    an uncapped run and to the oracle (delay, not divergence)."""
+    rng = _rng()
+    core = rand_seq(rng, 40)
+    db = {}
+    branch_a = core[:20] + "A" + core[20:]
+    branch_c = core[:20] + "C" + core[20:]
+    add_seq(db, branch_a, 10, 1)
+    add_seq(db, branch_c, 7, 1)
+    state, meta, dictdb = table_from_dict(db, K)
+    # a batch of identical ambiguous reads: every lane hits the probe
+    # at the same iteration, so cap=1 stalls all but one per round
+    read = branch_a[:20] + "G" + branch_a[21:35]
+    reads, quals = zip(*[_mk_read(read) for _ in range(8)])
+    cfg = ECConfig(k=K, cutoff=30, poisson_dtype="float32")
+
+    b = len(reads)
+    l = max(len(r) for r in reads)
+    codes = np.full((b, l), -2, np.int8)
+    qarr = np.zeros((b, l), np.uint8)
+    lengths = np.zeros((b,), np.int32)
+    for i, (r, q) in enumerate(zip(reads, quals)):
+        codes[i, : len(r)] = mer.seq_to_codes(r)
+        qarr[i, : len(r)] = np.frombuffer(q.encode(), np.uint8)
+        lengths[i] = len(r)
+
+    res_cap = corrector.correct_batch(state, meta, codes, qarr, lengths,
+                                      cfg, ambig_cap=1)
+    res_unc = corrector.correct_batch(state, meta, codes, qarr, lengths,
+                                      cfg)
+    fin_cap = corrector.finish_batch(res_cap, b, cfg)
+    fin_unc = corrector.finish_batch(res_unc, b, cfg)
+    assert fin_cap == fin_unc
+    # and against the oracle
+    oc = OracleCorrector(dictdb, cfg)
+    for i, (r, q) in enumerate(zip(reads, quals)):
+        o = oc.correct(r, q)
+        assert fin_cap[i] == o
+    assert "20:sub:G-A" in fin_cap[0].fwd_log

@@ -1,0 +1,96 @@
+"""Reference-format DB header parsing (io/ref_db).
+
+The fixture header is synthetic — written here in the multi-line
+styled-JSON shape Jellyfish's file_header produces — because the
+reference toolchain (which links Jellyfish externally) cannot run in
+this environment to produce a real one. These tests pin OUR parser's
+contract: brace-matched JSON extraction from a binary file, geometry
+reporting, and the diagnostic path through db_format.read_header."""
+
+import json
+
+import numpy as np
+import pytest
+
+from quorum_tpu.io import db_format, ref_db
+
+STYLED_HEADER = b"""{
+   "alignment" : 8,
+   "bits" : 7,
+   "cmdline" : [ "quorum_create_database", "-s", "200M", "reads.fastq" ],
+   "format" : "binary/quorum_db",
+   "key_bytes" : 1073741824,
+   "key_len" : 48,
+   "matrix" : {
+      "c" : 64,
+      "identity" : false,
+      "r" : 48
+   },
+   "max_reprobe" : 126,
+   "size" : 134217728,
+   "value_bytes" : 134217728
+}"""
+
+
+def _fixture(tmp_path, header: bytes = STYLED_HEADER):
+    path = tmp_path / "ref.qdb"
+    align = 8
+    pad = (-len(header)) % align
+    payload = np.arange(64, dtype=np.uint64).tobytes()
+    path.write_bytes(header + b"\x00" * pad + payload)
+    return str(path)
+
+
+def test_parse_styled_header(tmp_path):
+    path = _fixture(tmp_path)
+    header, payload_off = ref_db.read_ref_header(path)
+    assert header["format"] == "binary/quorum_db"
+    assert header["key_len"] == 48
+    assert header["bits"] == 7
+    assert header["size"] == 134217728
+    assert header["max_reprobe"] == 126
+    assert payload_off % 8 == 0
+    assert payload_off >= len(STYLED_HEADER)
+
+
+def test_parse_compact_header(tmp_path):
+    compact = json.dumps({"format": "binary/quorum_db", "size": 16,
+                          "key_len": 30, "bits": 1}).encode()
+    path = _fixture(tmp_path, compact)
+    header, off = ref_db.read_ref_header(path)
+    assert header["size"] == 16
+    assert off % 8 == 0
+
+
+def test_braces_inside_strings_do_not_confuse_parser():
+    data = b'{"cmdline": ["weird {path} with } brace"], "format": "x"}BIN'
+    header, end = ref_db.parse_jf_header(data)
+    assert header["cmdline"] == ["weird {path} with } brace"]
+    assert data[end:] == b"BIN"
+
+
+def test_not_json_raises():
+    with pytest.raises(ref_db.RefHeaderError):
+        ref_db.parse_jf_header(b"\x89PNG not a header")
+    with pytest.raises(ref_db.RefHeaderError):
+        ref_db.parse_jf_header(b'{"unterminated": tru')
+
+
+def test_describe_lists_geometry():
+    header, _ = ref_db.parse_jf_header(STYLED_HEADER + b"")
+    s = ref_db.describe(header)
+    assert "key_len=48" in s
+    assert "bits=7" in s
+
+
+def test_read_header_diagnoses_reference_file(tmp_path):
+    path = _fixture(tmp_path)
+    with pytest.raises(RuntimeError, match="reference-format quorum"):
+        db_format.read_header(path)
+
+
+def test_read_header_still_rejects_garbage(tmp_path):
+    path = tmp_path / "junk.qdb"
+    path.write_bytes(b"\x00\x01binary junk")
+    with pytest.raises(ValueError, match="not a quorum_tpu database"):
+        db_format.read_header(str(path))
