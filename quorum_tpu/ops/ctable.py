@@ -852,10 +852,11 @@ def _preferred_slot(rlo, rhi):
         .astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
-def _tile_build_round(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
-                      p0, hq_add, lq_add, done):
-    """One write-then-verify round (see section comment)."""
+def _tile_round_body(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
+                     p0, hq_add, lq_add, done):
+    """One write-then-verify round (see section comment). Plain
+    traceable function — jitted wrappers below choose the batch shape
+    (full-width round 1, compacted survivors afterwards)."""
     active = ~done
     gaddr = jnp.where(active, addr, 0)
     rows = bstate.tag[gaddr]  # [N, 128] one row gather
@@ -905,6 +906,65 @@ def _tile_build_round(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
             jnp.any(~ndone))
 
 
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def _tile_round1(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
+                 p0, hq_add, lq_add, done):
+    return _tile_round_body(bstate, meta, addr, rlo, rhi, p0, hq_add,
+                            lq_add, done)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 9, 10), donate_argnums=(0,))
+def _tile_compact_rounds(bstate: TBuildState, meta: TileMeta, addr, rlo,
+                         rhi, p0, hq_add, lq_add, done,
+                         rounds: int, cap: int):
+    """Run the write-verify rounds on COMPACTED unresolved lanes.
+
+    After round 1 the unresolved lanes (first-seen keys awaiting their
+    verify, plus race losers) are a small fraction of the batch, but a
+    full-width round still pays full gather/scatter cost — masked
+    indices don't dedupe (PERF_NOTES.md). So survivors are compacted
+    into `cap` slots and the remaining rounds run as ONE device
+    while_loop (no per-round host sync) at cap width. Lanes beyond cap
+    stay pending; the caller loops until none remain. Returns
+    (bstate, done, n_failed, n_unfit): n_failed > 0 means a compacted
+    lane exhausted `rounds` without placing (bucket genuinely full),
+    n_unfit is how many unresolved lanes didn't fit this call."""
+    n = addr.shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    rem = ~done
+    slotix = jnp.cumsum(rem.astype(jnp.int32)) - 1
+    fit = rem & (slotix < cap)
+    lane_of = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(fit, slotix, cap)].set(lane, mode="drop")
+    n_fit = jnp.sum(fit.astype(jnp.int32))
+    cdone0 = jnp.arange(cap, dtype=jnp.int32) >= n_fit
+    caddr = addr[lane_of]
+    crlo = rlo[lane_of]
+    crhi = rhi[lane_of]
+    cp0 = p0[lane_of]
+    chq = hq_add[lane_of]
+    clq = lq_add[lane_of]
+
+    def cond(c):
+        i, _, cdone = c
+        return (i < rounds) & jnp.any(~cdone)
+
+    def body(c):
+        i, bst, cdone = c
+        bst, cdone, _ = _tile_round_body(bst, meta, caddr, crlo, crhi,
+                                         cp0, chq, clq, cdone)
+        return i + 1, bst, cdone
+
+    _, bstate, cdone = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), bstate, cdone0))
+
+    newly = jnp.where(fit, cdone[jnp.clip(slotix, 0, cap - 1)], False)
+    done = done | newly
+    n_failed = jnp.sum((fit & ~newly).astype(jnp.int32))
+    n_unfit = jnp.sum((rem & ~fit).astype(jnp.int32))
+    return bstate, done, n_failed, n_unfit
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _tile_parts_jit(meta: TileMeta, khi, klo):
     addr, rlo, rhi = tile_key_parts(khi, klo, meta)
@@ -916,14 +976,30 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
     """Insert a flat batch of raw (canonical k-mer, quality-bit)
     observations straight into the tile build table. Returns
     (bstate, full: bool, placed mask); on full the caller grows and
-    retries with `valid & ~placed` (exact-once)."""
+    retries with `valid & ~placed` (exact-once).
+
+    Round structure: one full-width round (every observation gathers
+    its bucket; matches retire by scatter-add, absent keys write their
+    tags), then the surviving minority — verify-pending writers and
+    race losers — run compacted at 1/8 width with all remaining rounds
+    fused into one device while_loop (see _tile_compact_rounds)."""
     addr, rlo, rhi, p0 = _tile_parts_jit(meta, khi, klo)
     hq_add, lq_add, done = _prep_obs(qual, valid)
-    for _ in range(max_rounds):
-        bstate, done, left = _tile_build_round(bstate, meta, addr, rlo, rhi,
-                                               p0, hq_add, lq_add, done)
-        if not bool(left):
-            break
+    bstate, done, left = _tile_round1(bstate, meta, addr, rlo, rhi, p0,
+                                      hq_add, lq_add, done)
+    if bool(left):
+        n = int(addr.shape[0])
+        cap = min(n, max(1024, n // 8))
+        # each call resolves up to cap survivors; n/cap + 1 calls cover
+        # even the everyone-survives worst case. Any lane still ~done
+        # at exit (bucket full, or the unreachable bound exhaustion)
+        # surfaces through _finish_obs as full.
+        for _ in range(-(-n // cap) + 1):
+            bstate, done, n_failed, n_unfit = _tile_compact_rounds(
+                bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
+                max_rounds - 1, cap)
+            if int(n_failed) > 0 or int(n_unfit) == 0:
+                break
     full, placed = _finish_obs(done, valid)
     return bstate, bool(full), placed
 
@@ -1012,7 +1088,7 @@ def tile_grow_build(bstate: TBuildState, meta: TileMeta,
         done = ~valid
         left = True
         for _ in range(24):
-            new_state, done, left = _tile_build_round(
+            new_state, done, left = _tile_round1(
                 new_state, new_meta, naddr, nrlo, nrhi, p0, hq, lq, done)
             if not bool(left):
                 break
