@@ -7,19 +7,22 @@ flow (src/error_correct_reads.cc: find_starting_mer :609-643, extend
 batch of reads in lockstep:
 
 * **Anchor phase** (`find_anchors`): rolling k-mers for every position
-  of every read are computed by one scan, their DB values fetched by one
-  batched lookup, and the reference's sequential anchor scan (k "good"
-  mers in a row, contaminant discard, N-resets) becomes a `lax.scan`
-  over positions with per-lane counters.
+  of every read are computed by vectorized taps, their DB values
+  fetched by one batched lookup, and the reference's sequential anchor
+  scan (k "good" mers in a row, contaminant discard, N-resets) is
+  evaluated in closed form (cumsum/cummax run lengths).
 
-* **Extension phase** (`extend`, one jit per direction): a
-  `lax.while_loop` advances every read one base per iteration. Each
-  iteration does the shifted-mer contaminant check, one batched
-  `get_best_alternatives` (4 lookups/lane), and — for lanes on the
-  ambiguous path — the 16-lookup continuation probe, all masked so
-  retired/finished lanes cost no probes. Per-lane edit logs (the
-  reference's err_log window machinery, including remove_last_window
-  rewind) live in fixed-size device buffers.
+* **Extension phase** (`extend`, ONE jit for both directions): a
+  `lax.while_loop` advances every lane one base per iteration, 2B
+  lanes wide — the backward half runs in the reverse-complement frame
+  (rc codes, swapped mer strands, mirrored positions; `correct_batch`
+  docstring), so forward and backward extension share one d=+1
+  executable and overlap in time. Each iteration does the shifted-mer
+  contaminant check, one batched `get_best_alternatives` (4
+  lookups/lane), and — for the sparse ambiguous lanes, compacted into
+  a fixed capacity — the 16-lookup continuation probe. Per-lane edit
+  logs (the reference's err_log window machinery, including
+  remove_last_window rewind) live in fixed-size device buffers.
 
 Semantics are pinned to the pure-Python oracle (models/oracle.py),
 which is itself pinned to the reference binary (bug-compatibility
@@ -101,47 +104,62 @@ def _advance_lwin(pos_buf, n, lwin, back, guard, window: int, d: int):
 
 
 def _log_append(log: LogState, mask, raw_pos, meta_val, window: int,
-                error: int, d: int):
+                error: int, d: int, thresh=None):
     """Append an entry for `mask` lanes and run check_nb_error.
-    Returns (log, trip) where trip = error budget exceeded."""
+    Returns (log, trip) where trip = error budget exceeded.
+
+    `thresh` is the guard threshold: the advance runs only once the
+    append position is more than a window past the direction origin —
+    `d * (raw - thresh) > 0` expresses both the forward (raw > window)
+    and backward (raw < window) forms of err_log.hpp:89. It defaults to
+    the scalar window; the merged fwd+bwd loop passes a per-lane array
+    (len-1-window for reverse-complement-frame lanes)."""
     b = log.n.shape[0]
     maxe = log.pos.shape[1]
     lane = jnp.arange(b, dtype=jnp.int32)
+    if thresh is None:
+        thresh = window
     # masked lanes scatter to index maxe, dropped as out-of-bounds
     # (negative sentinels would *wrap*, silently hitting the last slot)
     idx = jnp.where(mask, log.n, maxe)
     pos_buf = log.pos.at[lane, idx].set(raw_pos, mode="drop")
     meta_buf = log.meta.at[lane, idx].set(meta_val, mode="drop")
     n = log.n + mask.astype(jnp.int32)
-    guard = mask & ((raw_pos > window) if d == 1 else (raw_pos < window))
+    guard = mask & (d * (raw_pos - thresh) > 0)
     lwin = _advance_lwin(pos_buf, n, log.lwin, raw_pos, guard, window, d)
     trip = mask & ((n - lwin - 1) >= error)
     return LogState(n, lwin, pos_buf, meta_buf), trip
 
 
-def _log_remove_last_window(log: LogState, mask, window: int, d: int):
+def _log_remove_last_window(log: LogState, mask, window: int, d: int,
+                            thresh=None):
     """err_log::remove_last_window (err_log.hpp:97-106): erase entries
     [lwin:], reset lwin, re-run check_nb_error. Returns (log, diff)
     with diff in direction units (0 for unmasked lanes)."""
     b = log.n.shape[0]
     lane = jnp.arange(b, dtype=jnp.int32)
+    if thresh is None:
+        thresh = window
     back = log.pos[lane, jnp.clip(log.n - 1, 0)]
     at_lwin = log.pos[lane, jnp.clip(log.lwin, 0)]
     diff = jnp.where(mask & (log.n > 0), d * (back - at_lwin), 0)
     n = jnp.where(mask, jnp.where(log.n > 0, log.lwin, 0), log.n)
     lwin = jnp.where(mask, 0, log.lwin)
     nb = log.pos[lane, jnp.clip(n - 1, 0)]
-    guard = mask & (n > 0) & ((nb > window) if d == 1 else (nb < window))
+    guard = mask & (n > 0) & (d * (nb - thresh) > 0)
     lwin = _advance_lwin(log.pos, n, lwin, nb, guard, window, d)
     return LogState(n, lwin, log.pos, log.meta), diff
 
 
-def _append_trunc(log: LogState, mask, cpos, window: int, error: int, d: int):
+def _append_trunc(log: LogState, mask, cpos, window: int, error: int, d: int,
+                  thresh=None):
     """log.truncation(cpos): the backward log records pos-1 in direction
-    units = raw+1 (error_correct_reads.hpp:170-172)."""
+    units = raw+1 (error_correct_reads.hpp:170-172). The merged loop
+    runs backward lanes in the reverse-complement frame with d=+1; the
+    +1 quirk is applied there by the entry remap in _bwd_epilogue."""
     raw = cpos + (1 if d == -1 else 0)
     meta_val = jnp.full_like(cpos, _T_TRUNC)
-    log, _ = _log_append(log, mask, raw, meta_val, window, error, d)
+    log, _ = _log_append(log, mask, raw, meta_val, window, error, d, thresh)
     return log
 
 
@@ -301,7 +319,7 @@ class ExtendResult(NamedTuple):
 
 
 def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
-                contam_meta, d: int, has_contam: bool):
+                contam_meta, d: int, has_contam: bool, guard_thresh=None):
     """Shared helpers closed over the static extension environment."""
     window = cfg.effective_window
     error = cfg.effective_error
@@ -309,6 +327,7 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
     lane = jnp.arange(b, dtype=jnp.int32)
     codes32 = codes.astype(jnp.int32)
     quals32 = quals.astype(jnp.int32)
+    thresh = window if guard_thresh is None else guard_thresh
 
     def in_range(pos):
         return (pos < end) if d == 1 else (pos > end)
@@ -328,7 +347,7 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
         return _contam_hit(contam_state, contam_meta, fh, fl, rh, rl, mask)
 
     return (in_range, gather_code, take4, contam, lane, codes32, quals32,
-            window, error, b, l)
+            window, error, b, l, thresh)
 
 
 # Steps per while_loop iteration. Each step is fully masked
@@ -340,9 +359,9 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
 UNROLL = 2
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 8, 9, 10, 11, 12))
+@functools.partial(jax.jit, static_argnums=(1, 4, 9, 10, 11, 12, 13))
 def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
-                 carry, end,
+                 carry, end, guard_thresh,
                  contam_state, contam_meta, d: int, has_contam: bool,
                  unroll: int = UNROLL, ambig_cap: int = 1 << 30):
     """The lockstep extension loop; the ambiguous-path continuation
@@ -350,9 +369,9 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
     docstring)."""
     k = cfg.k
     (in_range, gather_code, take4, contam, lane, codes32, quals32,
-     window, error, b, l) = _extend_env(
+     window, error, b, l, thresh) = _extend_env(
         state, tmeta, codes, quals, cfg, end, contam_state, contam_meta,
-        d, has_contam)
+        d, has_contam, guard_thresh)
 
     def body(carry):
         (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log) = carry
@@ -408,9 +427,10 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         alive = alive & ~con2
         sub1 = sub1 & ~con2
         log, trip1 = _log_append(
-            log, sub1, cpos, _pack_sub(ori, ucode), window, error, d)
-        log, diff1 = _log_remove_last_window(log, trip1, window, d)
-        log = _append_trunc(log, trip1, cpos - d * diff1, window, error, d)
+            log, sub1, cpos, _pack_sub(ori, ucode), window, error, d, thresh)
+        log, diff1 = _log_remove_last_window(log, trip1, window, d, thresh)
+        log = _append_trunc(log, trip1, cpos - d * diff1, window, error, d,
+                            thresh)
         opos = jnp.where(trip1, opos - d * diff1, opos)
         alive = alive & ~trip1
         write1 = c1 & ~con2 & ~trip1
@@ -434,10 +454,10 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         # intermediate computation reads the log — 5 sets of [B, E]
         # log ops become 1
         log = _append_trunc(log, con1_trim | t0 | con2_trim | t_a | t_b,
-                            cpos, window, error, d)
+                            cpos, window, error, d, thresh)
         ambig = cm & ~keep_simple & ~t_a & ~t_b
         env = (in_range, gather_code, take4, contam, lane, codes32,
-               quals32, window, error, b, l)
+               quals32, window, error, b, l, thresh)
         (fh, fl, rh, rl, pos, opos, prev, alive, status, outb,
          log, stalled) = _ambig_core(env, state, tmeta, cfg, d,
                                      fh, fl, rh, rl, pos, opos, prev,
@@ -492,7 +512,7 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
     (carry..., stalled)."""
     k = cfg.k
     (in_range, gather_code, take4, contam, lane, codes32, quals32,
-     window, error, b, l) = env
+     window, error, b, l, thresh) = env
     cap = min(max(1, ambig_cap), b)  # cap<1 would stall lanes forever
     read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
     elig = jnp.stack([ambig & (counts[:, i] > cfg.min_count)
@@ -590,16 +610,19 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
     alive = alive & ~con3
     sub2 = sub2 & ~con3
     log, trip2 = _log_append(
-        log, sub2, cpos, _pack_sub(ori, check_code), window, error, d)
-    log, diff2 = _log_remove_last_window(log, trip2, window, d)
-    log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d)
+        log, sub2, cpos, _pack_sub(ori, check_code), window, error, d,
+        thresh)
+    log, diff2 = _log_remove_last_window(log, trip2, window, d, thresh)
+    log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d,
+                        thresh)
     opos = jnp.where(trip2, opos - d * diff2, opos)
     alive = alive & ~trip2
 
     # N base with no good substitution: truncate (cc:553-556); merged
     # with the con3_trim truncation — disjoint lanes, same position
     t_c = fitted & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
-    log = _append_trunc(log, con3_trim | t_c, cpos, window, error, d)
+    log = _append_trunc(log, con3_trim | t_c, cpos, window, error, d,
+                        thresh)
     alive = alive & ~t_c
 
     write = fitted & alive
@@ -616,7 +639,7 @@ def extend(state, tmeta, codes, quals, cfg: ECConfig,
            out, fhi, flo, rhi, rlo, prev0, alive0,
            pos0, end, status0,
            contam_state, contam_meta, d: int, has_contam: bool,
-           ambig_cap: int | None = None):
+           ambig_cap: int | None = None, guard_thresh=None):
     """extend (error_correct_reads.cc:384-565) in lockstep over a batch:
     one fused while_loop advancing every live lane one base per
     iteration, with the ambiguous-path continuation probe inline over
@@ -638,11 +661,13 @@ def extend(state, tmeta, codes, quals, cfg: ECConfig,
     log0 = make_log(b, maxe)
     if ambig_cap is None:
         ambig_cap = max(256, b // 8)
+    if guard_thresh is None:
+        guard_thresh = jnp.full((b,), cfg.effective_window, jnp.int32)
     carry = (fhi, flo, rhi, rlo, pos0, pos0, prev0, alive0, status0, out,
              log0)
     carry = _extend_loop(state, tmeta, codes, quals, cfg, carry, end,
-                         contam_state, contam_meta, d, has_contam,
-                         UNROLL, ambig_cap)
+                         guard_thresh, contam_state, contam_meta, d,
+                         has_contam, UNROLL, ambig_cap)
     (_, _, _, _, _, opos, _, _, status, outb, log) = carry
     return ExtendResult(outb, opos, status, log)
 
@@ -667,6 +692,72 @@ def _dummy_contam(k: int):
     return table.make_table(meta), meta
 
 
+def _rev_rows(x, lengths, uniform_len: int | None, fill):
+    """x[b, len-1-p] per lane, `fill` past the length; returns
+    (reversed, in_read mask). With a uniform (static) length this is
+    flip+static-roll — pure layout ops; the per-lane take_along_axis
+    fallback costs ~100 ms/batch at 16k x 150 (the slow gather class,
+    PERF_NOTES.md)."""
+    l = x.shape[1]
+    p = jnp.arange(l, dtype=jnp.int32)[None, :]
+    if uniform_len is not None:
+        f = jnp.flip(x, axis=1)
+        if uniform_len != l:
+            f = jnp.roll(f, uniform_len - l, axis=1)
+        valid = jnp.broadcast_to(p < uniform_len, x.shape)
+        return jnp.where(valid, f, fill), valid
+    idx = lengths[:, None] - 1 - p
+    g = jnp.take_along_axis(x, jnp.clip(idx, 0, l - 1), axis=1)
+    valid = idx >= 0
+    return jnp.where(valid, g, fill), valid
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _rc_prologue(codes, quals, lengths, uniform_len: int | None):
+    """Per-lane reverse-complement frame: rc[p'] = comp(read[len-1-p'])
+    with -2 padding past the length; quals reversed without
+    complement."""
+    rev, _ = _rev_rows(codes, lengths, uniform_len, jnp.int32(-2))
+    rc_codes = jnp.where(rev >= 0, 3 - rev, rev)
+    rc_quals, _ = _rev_rows(quals, lengths, uniform_len, jnp.int32(0))
+    return rc_codes, rc_quals
+
+
+@functools.partial(jax.jit, static_argnums=(8,))
+def _bwd_epilogue(out_f, status_f, out_rc, opos_rc, status_rc,
+                  lengths, bpos0, blog: LogState,
+                  uniform_len: int | None = None):
+    """Map the rc-frame backward lane results to the original frame.
+
+    out: positions <= bpos0 come from the complemented, re-reversed rc
+    plane (unwritten rc positions carry the original codes, so the
+    blend is exact for truncated lanes too). start = len - opos_rc
+    (one-past-last in rc = first kept original index). Log entries:
+    sub at rc p' happened at original len-1-p'; truncation entries get
+    the backward log's +1 quirk (error_correct_reads.hpp:170-172), so
+    len-1-p'+1 = len-p'. status: forward wins ties so a read that
+    failed forward reports the forward reason, exactly like the
+    sequential form where backward never ran."""
+    l = out_f.shape[1]
+    p = jnp.arange(l, dtype=jnp.int32)[None, :]
+    rev, in_read = _rev_rows(out_rc, lengths, uniform_len, jnp.int32(-2))
+    from_rc = jnp.where(rev >= 0, 3 - rev, rev)
+    out = jnp.where((p <= bpos0[:, None]) & in_read, from_rc, out_f)
+    start = lengths - opos_rc
+    status = jnp.where(status_f != OK, status_f, status_rc)
+    is_tr = (blog.meta & 1) == 1
+    mapped = jnp.where(is_tr, lengths[:, None] - blog.pos,
+                       lengths[:, None] - 1 - blog.pos)
+    # sub entries recorded rc-frame base codes: complement them back
+    # (N, code 4, is its own complement here)
+    frm = (blog.meta >> 1) & 7
+    to = (blog.meta >> 4) & 7
+    cfrm = jnp.where(frm < 4, 3 - frm, frm)
+    cto = jnp.where(to < 4, 3 - to, to)
+    meta = jnp.where(is_tr, blog.meta, _T_SUB | (cfrm << 1) | (cto << 4))
+    return out, start, status, LogState(blog.n, blog.lwin, mapped, meta)
+
+
 def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                   codes, quals, lengths, cfg: ECConfig,
                   contam=None, ambig_cap: int | None = None
@@ -674,9 +765,33 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
     """Correct a batch of reads on device. `contam` is an optional
     (TableState, TableMeta) k-mer membership set (value word != 0).
     Mirrors error_correct_instance::start (error_correct_reads.cc:
-    246-341): anchor, forward extend, backward extend. `ambig_cap`
-    overrides the ambiguous-lane compaction capacity (tests use tiny
-    caps to exercise the stall path)."""
+    246-341): anchor, then forward and backward extension run
+    CONCURRENTLY as one 2B-lane d=+1 loop — the backward half operates
+    on the reverse-complement frame (rc codes, swapped mer strands,
+    mirrored positions), which is the same computation the reference
+    expresses with its backward_* pointer adapters, and halves the
+    sequential iteration count vs running two loops back to back.
+    Backward lanes run even when forward later fails; the epilogue's
+    forward-priority status combine makes that unobservable (a failed
+    read's backward output is discarded), matching the sequential
+    semantics bit-for-bit. `ambig_cap` overrides the ambiguous-lane
+    compaction capacity (tests use tiny caps to exercise the stall
+    path)."""
+    # uniform-length batches (the Illumina norm) get a static flip
+    # reversal instead of per-lane gathers; decided host-side, ideally
+    # from the numpy lengths the reader hands over (no D2H). Under a
+    # trace (sharded_correct's shard_map) lengths are abstract — use
+    # the general per-lane gather path.
+    # Only full pad-free batches take it: a trailing partial batch is
+    # "accidentally uniform" (often a single read), and letting it pick
+    # arbitrary static lengths would compile fresh executables per
+    # distinct tail length. One gather-path compile for the tail beats
+    # unbounded churn.
+    uniform = None
+    if not isinstance(lengths, jax.core.Tracer):
+        ln = np.asarray(lengths)
+        if len(ln) and (ln > 0).all() and (ln == ln[0]).all():
+            uniform = int(ln[0])
     codes = jnp.asarray(codes, jnp.int32)
     quals = jnp.asarray(quals, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
@@ -690,22 +805,30 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
     anc = find_anchors(state, tmeta, codes, lengths, cfg,
                        cstate, cmeta, has_contam)
     b = codes.shape[0]
-    out0 = codes
-    fwd = extend(state, tmeta, codes, quals, cfg, out0,
-                 anc.fhi, anc.flo, anc.rhi, anc.rlo,
-                 anc.prev_count, anc.found,
-                 anc.start_off, lengths, anc.status,
-                 cstate, cmeta, 1, has_contam, ambig_cap)
-    bwd_alive = anc.found & (fwd.status == OK)
-    bpos0 = anc.start_off - cfg.k - 1
-    bend = jnp.full((b,), -1, jnp.int32)
-    bwd = extend(state, tmeta, codes, quals, cfg, fwd.out,
-                 anc.fhi, anc.flo, anc.rhi, anc.rlo,
-                 anc.prev_count, bwd_alive,
-                 bpos0, bend, fwd.status,
-                 cstate, cmeta, -1, has_contam, ambig_cap)
-    return BatchResult(bwd.out, bwd.opos + 1, fwd.opos, bwd.status,
-                       fwd.log, bwd.log)
+    rc_codes, rc_quals = _rc_prologue(codes, quals, lengths, uniform)
+    w = cfg.effective_window
+    cat = jnp.concatenate
+    codes2 = cat([codes, rc_codes])
+    quals2 = cat([quals, rc_quals])
+    pos0 = cat([anc.start_off, lengths - anc.start_off + cfg.k])
+    end2 = cat([lengths, lengths])
+    thresh = cat([jnp.full((b,), w, jnp.int32), lengths - 1 - w])
+    res = extend(state, tmeta, codes2, quals2, cfg, codes2,
+                 cat([anc.fhi, anc.rhi]), cat([anc.flo, anc.rlo]),
+                 cat([anc.rhi, anc.fhi]), cat([anc.rlo, anc.flo]),
+                 cat([anc.prev_count, anc.prev_count]),
+                 cat([anc.found, anc.found]),
+                 pos0, end2, cat([anc.status, anc.status]),
+                 cstate, cmeta, 1, has_contam, ambig_cap, thresh)
+    flog = LogState(res.log.n[:b], res.log.lwin[:b], res.log.pos[:b],
+                    res.log.meta[:b])
+    blog_rc = LogState(res.log.n[b:], res.log.lwin[b:], res.log.pos[b:],
+                       res.log.meta[b:])
+    out, start, status, blog = _bwd_epilogue(
+        res.out[:b], res.status[:b], res.out[b:], res.opos[b:],
+        res.status[b:], lengths, anc.start_off - cfg.k - 1, blog_rc,
+        uniform)
+    return BatchResult(out, start, res.opos[:b], status, flog, blog)
 
 
 def _render_dir(nv: np.ndarray, pos: np.ndarray, meta: np.ndarray,
