@@ -93,7 +93,9 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None
 
 def read_header(path: str) -> dict:
     with open(path, "rb") as f:
-        line = f.readline()
+        # bounded: an arbitrary binary file with no newline (e.g. a raw
+        # array dump) must not be slurped whole before the parse fails
+        line = f.readline(1 << 20)
     try:
         header = json.loads(line)
     except ValueError:  # JSONDecodeError, or UnicodeDecodeError on binary

@@ -156,7 +156,14 @@ def _append_trunc(log: LogState, mask, cpos, window: int, error: int, d: int,
     """log.truncation(cpos): the backward log records pos-1 in direction
     units = raw+1 (error_correct_reads.hpp:170-172). The merged loop
     runs backward lanes in the reverse-complement frame with d=+1; the
-    +1 quirk is applied there by the entry remap in _bwd_epilogue."""
+    +1 quirk is applied there by the entry remap in _bwd_epilogue.
+
+    INVARIANT: truncation is terminal — every call site retires the
+    lane (alive &= ~mask) in the same iteration, so the lwin/trip
+    produced here (computed with the merged loop's sub-entry guard
+    threshold, an off-by-one vs the reference's raw backward trunc
+    guard on raw+1) are never read afterwards. A future non-terminal
+    truncation append must NOT reuse this helper as-is."""
     raw = cpos + (1 if d == -1 else 0)
     meta_val = jnp.full_like(cpos, _T_TRUNC)
     log, _ = _log_append(log, mask, raw, meta_val, window, error, d, thresh)
@@ -962,14 +969,20 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig
     per-read loop at 16k-read batches cost more than the device
     compute; see PERF_NOTES.md)."""
     maxe = res.fwd_log.pos.shape[1]
-    # the packed D2H narrows positions to int16
-    assert res.out.shape[1] < (1 << 15), \
-        f"read length {res.out.shape[1]} overflows the int16 packed layout"
+    # the packed D2H narrows positions to int16; real errors, not
+    # asserts — under python -O an overflow would silently drop log
+    # entries (mode="drop" scatter) and misalign _render_dir's offsets
+    if res.out.shape[1] >= (1 << 15):
+        raise ValueError(
+            f"read length {res.out.shape[1]} overflows the int16 packed "
+            "layout")
     # one tiny D2H decides the clip width, one packed D2H moves the rest
     nmax = np.asarray(jnp.maximum(jnp.max(res.fwd_log.n),
                                   jnp.max(res.bwd_log.n)))
     maxn = int(nmax)
-    assert maxn <= maxe, f"log overflow: {maxn} entries > buffer {maxe}"
+    if maxn > maxe:
+        raise RuntimeError(
+            f"log overflow: {maxn} entries > buffer {maxe}")
     width = 1
     while width < maxn:
         width *= 2

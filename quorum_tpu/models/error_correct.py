@@ -185,6 +185,13 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         try:
             writer.close()
         finally:
+            # first, so interrupted runs (and disk-full stream closes
+            # below) still print the per-stage table under -v; guarded
+            # so a broken stderr can't replace the propagating error
+            try:
+                timer.report(stats.bases_in)
+            except Exception:
+                pass
             # always runs, even if the writer re-raises: gzip streams
             # need their trailer or the output is unreadable. Close each
             # stream independently so a failing out.close() (e.g. disk
@@ -198,7 +205,6 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                 _finish(out)
             finally:
                 _finish(log)
-    timer.report(stats.bases_in)
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
     return stats
