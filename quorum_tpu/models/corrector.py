@@ -227,8 +227,46 @@ def _contam_hit(contam_state, contam_meta, fhi, flo, rhi, rlo, active):
 
 
 # ---------------------------------------------------------------------------
-# Anchor phase
+# Position sweep + anchor phase
 # ---------------------------------------------------------------------------
+
+class SweepResult(NamedTuple):
+    """Per-position facts about the ORIGINAL read windows, shared by the
+    anchor scan and the event-driven extension planes: one batched
+    lookup covers both (the canonical mer of a window is
+    strand-invariant, so the forward and reverse-complement frames
+    share it too)."""
+
+    fhi: jax.Array  # uint32[B, L] forward mer of window ending at p
+    flo: jax.Array
+    rhi: jax.Array  # uint32[B, L] revcomp mer
+    rlo: jax.Array
+    validk: jax.Array  # bool[B, L] window is k consecutive ACGT
+    vals: jax.Array  # value word of the canonical window mer (0 absent)
+    con: jax.Array  # bool[B, L] contaminant hit (all-False w/o contam DB)
+
+
+def _position_sweep(state, tmeta, codes32, cfg: ECConfig,
+                    contam_state, contam_meta, has_contam: bool
+                    ) -> SweepResult:
+    """ONE batched lookup per read position (plus one contaminant
+    lookup when a contaminant DB is present)."""
+    k = cfg.k
+    b, l = codes32.shape
+    fhi, flo, rhi, rlo, validk = mer.rolling_kmers(codes32, k)
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    vals = _db_lookup(
+        state, tmeta, chi.ravel(), clo.ravel(), validk.ravel()
+    ).reshape(b, l)
+    if has_contam:
+        con = _db_lookup(
+            contam_state, contam_meta, chi.ravel(), clo.ravel(),
+            validk.ravel()
+        ).reshape(b, l) != 0
+    else:
+        con = jnp.zeros((b, l), bool)
+    return SweepResult(fhi, flo, rhi, rlo, validk, vals, con)
+
 
 class AnchorResult(NamedTuple):
     found: jax.Array  # bool[B]
@@ -241,11 +279,10 @@ class AnchorResult(NamedTuple):
     prev_count: jax.Array  # int32[B] get_val(anchor mer)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 6, 7))
 def find_anchors(state: table.TableState, tmeta: table.TableMeta,
                  codes, lengths, cfg: ECConfig,
-                 contam_state, contam_meta, has_contam: bool
-                 ) -> AnchorResult:
+                 contam_state, contam_meta, has_contam: bool,
+                 sweep: SweepResult | None = None) -> AnchorResult:
     """find_starting_mer (error_correct_reads.cc:609-643) over a batch.
 
     The sequential build/check loop is equivalent to scanning all
@@ -258,20 +295,16 @@ def find_anchors(state: table.TableState, tmeta: table.TableMeta,
     k = cfg.k
     b, l = codes.shape
     codes32 = codes.astype(jnp.int32)
-    fhi, flo, rhi, rlo, validk = mer.rolling_kmers(codes32, k)
-    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    if sweep is None:
+        sweep = _position_sweep(state, tmeta, codes32, cfg,
+                                contam_state, contam_meta, has_contam)
+    fhi, flo, rhi, rlo = sweep.fhi, sweep.flo, sweep.rhi, sweep.rlo
+    validk, vals, con = sweep.validk, sweep.vals, sweep.con
     p_idx = jnp.arange(l, dtype=jnp.int32)[None, :]
     vw = validk & (p_idx >= cfg.skip + k - 1)
-    vals = _db_lookup(
-        state, tmeta, chi.ravel(), clo.ravel(), vw.ravel()
-    ).reshape(b, l)
-    val_hq = jnp.where((vals & 1) == 1, vals >> 1, 0).astype(jnp.int32)
-    if has_contam:
-        con = _db_lookup(
-            contam_state, contam_meta, chi.ravel(), clo.ravel(), vw.ravel()
-        ).reshape(b, l) != 0
-    else:
-        con = jnp.zeros((b, l), bool)
+    val_hq = jnp.where(vw & ((vals & 1) == 1), vals >> 1,
+                       0).astype(jnp.int32)
+    con = con & vw
     checked = vw & (p_idx <= (lengths[:, None] - 2))
 
     # The reference's sequential scan, in closed form. Classify every
@@ -362,26 +395,275 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
 # pure strength reduction: same total work, fewer loop iterations —
 # ~20% faster at 2 on the v5e. 4 is marginally faster still but its
 # XLA compile time is prohibitive (the whole loop body is cloned per
-# step; see PERF_NOTES.md).
+# step; see PERF_NOTES.md). The event-driven loop (planes != None)
+# uses 1: iterations are few and the body is much bigger.
 UNROLL = 2
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 9, 10, 11, 12, 13))
+class EventPlanes(NamedTuple):
+    """Per-frame-position planes driving event-driven stepping, all
+    [B, L] in frame coordinates (p = window END index). Derived from
+    ONE lookup per original-read position (SweepResult) — the sweep's
+    canonical window value is strand-invariant, so the forward and
+    reverse-complement frames share it.
+
+    clean[p] is a PROOF from that single lookup that the live step at p
+    keeps the original base and appends nothing: HQ bit set and count
+    >= max(cutoff, min_count+1) makes keep_cut fire when count>1 and
+    forces ucode==ori when count==1 (the ori variant is present at the
+    best level). Positions without the proof are EVENTS and run live."""
+
+    clean: jax.Array  # bool[B, L]
+    nd: jax.Array  # int32[B, L] first event index >= p (L if none)
+    vals: jax.Array  # uint32[B, L] window value word (count<<1 | qbit)
+    mfh: jax.Array  # uint32[B, L] frame-forward mer of window ending at p
+    mfl: jax.Array
+    mrh: jax.Array  # uint32[B, L] frame-revcomp mer
+    mrl: jax.Array
+
+
 def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
                  carry, end, guard_thresh,
                  contam_state, contam_meta, d: int, has_contam: bool,
-                 unroll: int = UNROLL, ambig_cap: int = 1 << 30):
+                 unroll: int = UNROLL, ambig_cap: int = 1 << 30,
+                 planes: EventPlanes | None = None, bs_chunk: int = 8):
     """The lockstep extension loop; the ambiguous-path continuation
     probe runs inline via _ambig_core, over compacted lanes (see its
-    docstring)."""
+    docstring).
+
+    With `planes`, the loop is EVENT-DRIVEN: lanes whose mer equals the
+    original window mer (synced) teleport over runs of proven-clean
+    positions (one gather instead of one iteration per base; skipped
+    keeps write nothing — the out buffer already holds the original
+    codes — and append nothing to the log); after a substitution the
+    lane is desynced for k-1 positions and a compacted TAIL PROBE
+    (full 4-variant gba of the would-be mers under a no-further-edit
+    assumption) teleports over the exact-keep prefix in one step; and
+    `prev_count` — read only by the ambiguous path — is reconstructed
+    lazily by a compacted backward sibling scan (stall-and-retry) over
+    the teleported run, instead of paying 4 lookups per skipped
+    position. Iterations collapse from ~L to ~(events per worst lane):
+    measured 1.5 mean / 8 max events per 150 bp read at 40x coverage
+    (PERF_NOTES.md round 4)."""
     k = cfg.k
     (in_range, gather_code, take4, contam, lane, codes32, quals32,
      window, error, b, l, thresh) = _extend_env(
         state, tmeta, codes, quals, cfg, end, contam_state, contam_meta,
         d, has_contam, guard_thresh)
+    if planes is not None:
+        assert d == 1, "event-driven stepping runs in the merged d=+1 frame"
+    tail_t = k - 1
+    cap_c = max(1, b // 8)  # compaction capacity (backscan + tail probes)
+
+    def gat(plane, idx):
+        safe = jnp.clip(idx, 0, l - 1)
+        return jnp.take_along_axis(plane, safe[:, None], axis=1)[:, 0]
+
+    def _compact(mask):
+        """cumsum/scatter compaction (same scheme as _ambig_core):
+        returns (slot, fitted, lane_of, slot_live)."""
+        slot = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        fitted = mask & (slot < cap_c)
+        lane_of = jnp.zeros((cap_c,), jnp.int32).at[
+            jnp.where(fitted, slot, cap_c)].set(lane, mode="drop")
+        n_fit = jnp.sum(fitted.astype(jnp.int32))
+        slot_live = jnp.arange(cap_c, dtype=jnp.int32) < n_fit
+        return slot, fitted, lane_of, slot_live
+
+    def _backscan(need_bs, cpos, prev, prevdef, bs_q):
+        """One chunk of the lazy prev reconstruction: for stalled
+        ambiguous lanes, walk bs_chunk positions backward over the
+        stale range [prevdef, cpos) testing exact count==1 (the ori
+        variant's value comes from the sweep plane; the 3 siblings are
+        looked up). The stale range contains only synced original-
+        window keeps (teleported cleans and live count>1 keeps), so
+        plane data is ground truth there. prev := value at the LAST
+        count==1 position; if the scan exhausts the range, the carried
+        prev already accounts for everything below."""
+        scanning = need_bs
+        bs_q = jnp.where(scanning,
+                         jnp.where(bs_q < 0, cpos - 1, bs_q),
+                         jnp.int32(-1))
+        slot, fitted, lane_of, slot_live = _compact(scanning)
+        li = lane_of[:, None]
+        qs = (bs_q[lane_of][:, None]
+              - jnp.arange(bs_chunk, dtype=jnp.int32)[None, :])
+        floor = prevdef[lane_of]
+        qvalid = slot_live[:, None] & (qs >= floor[:, None])
+        sq = jnp.clip(qs, 0, l - 1)
+        wfh, wfl = planes.mfh[li, sq], planes.mfl[li, sq]
+        wrh, wrl = planes.mrh[li, sq], planes.mrl[li, sq]
+        oriq = codes32[li, sq]
+        oval = planes.vals[li, sq]
+        chis, clos, acts = [], [], []
+        for i in range(4):
+            vfh, vfl, vrh, vrl = mer.dir_replace0(
+                wfh, wfl, wrh, wrl, mer.u32(i), d, k)
+            chi, clo = mer.canonical(vfh, vfl, vrh, vrl)
+            chis.append(chi)
+            clos.append(clo)
+            acts.append(qvalid & (oriq != i))
+        act4 = jnp.stack(acts)
+        sv = _db_lookup(
+            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+            act4.ravel(),
+        ).reshape(4, cap_c, bs_chunk)
+        # exact count==1 at level: 4-variant logic with the ori value
+        # from the plane (live count>1 keeps in the range may be LQ)
+        svc = jnp.where(act4, (sv >> 1).astype(jnp.int32),
+                        jnp.where(oriq[None] == jnp.arange(4)[:, None, None],
+                                  (oval >> 1).astype(jnp.int32)[None], 0))
+        svq = jnp.where(act4, (sv & 1).astype(jnp.int32),
+                        (oval & 1).astype(jnp.int32)[None])
+        spresent = svc > 0
+        slevel = jnp.max(jnp.where(spresent, svq, 0), axis=0)
+        scount = jnp.sum((spresent & (svq == slevel[None])).astype(jnp.int32),
+                         axis=0)
+        c1_at = qvalid & (scount == 1)
+        # count==1 in the range implies the single variant is ori (the
+        # range holds only keeps), so prev = the plane count
+        has_c1 = jnp.any(c1_at, axis=1)
+        t_star = jnp.argmax(c1_at, axis=1)  # first True = largest q
+        arange_cap = jnp.arange(cap_c, dtype=jnp.int32)
+        prev_new = (oval >> 1).astype(jnp.int32)[arange_cap, t_star]
+        exhausted = ~has_c1 & ((bs_q[lane_of] - bs_chunk) < floor)
+        safe_slot = jnp.clip(slot, 0, cap_c - 1)
+        l_hasc1 = fitted & has_c1[safe_slot]
+        l_done = fitted & (has_c1 | exhausted)[safe_slot]
+        prev = jnp.where(l_hasc1, prev_new[safe_slot], prev)
+        prevdef = jnp.where(l_done, cpos, prevdef)
+        bs_q = jnp.where(scanning & fitted & ~l_done, bs_q - bs_chunk, bs_q)
+        return prev, prevdef, bs_q
+
+    def _tail_probe(want, fh, fl, rh, rl, pos, opos, prev, prevdef,
+                    resync):
+        """Teleport through the desync region after a substitution:
+        compute the next `tail_t` mers under a no-further-edit
+        assumption (the shifted-in bases are the original read), run
+        the full 4-variant gba on each, and advance over the maximal
+        EXACT-KEEP prefix (c1-keep with ucode==ori, keep_cut, or
+        Poisson keep; anything else — another sub, ambiguity,
+        truncation, contaminant, N — stops the teleport and is
+        re-processed live, which is always correct). prev updates from
+        count==1 positions in the prefix are exact (full sibling
+        info), so prevdef advances with the jump."""
+        slot, fitted, lane_of, slot_live = _compact(want)
+        li = lane_of[:, None]
+        tpos = pos[lane_of]
+        tend = jnp.minimum(resync[lane_of], end[lane_of])
+        tq = tpos[:, None] + jnp.arange(tail_t, dtype=jnp.int32)[None, :]
+        stq = jnp.clip(tq, 0, l - 1)
+        tori = codes32[li, stq]  # [cap, T]
+        tqual = quals32[li, stq]
+        t_in = slot_live[:, None] & (tq < tend[:, None])
+        cfh, cfl = fh[lane_of], fl[lane_of]
+        crh, crl = rh[lane_of], rl[lane_of]
+        m_fh, m_fl, m_rh, m_rl = [cfh], [cfl], [crh], [crl]
+        chis, clos, acts = [], [], []
+        cchis, cclos = [], []
+        for t in range(tail_t):
+            code_t = mer.u32(jnp.maximum(tori[:, t], 0))
+            nfh, nfl, nrh, nrl = mer.dir_shift(
+                m_fh[-1], m_fl[-1], m_rh[-1], m_rl[-1], code_t, d, k)
+            m_fh.append(nfh)
+            m_fl.append(nfl)
+            m_rh.append(nrh)
+            m_rl.append(nrl)
+            if has_contam:
+                cchi, cclo = mer.canonical(nfh, nfl, nrh, nrl)
+                cchis.append(cchi)
+                cclos.append(cclo)
+            for i in range(4):
+                vfh, vfl, vrh, vrl = mer.dir_replace0(
+                    nfh, nfl, nrh, nrl, mer.u32(i), d, k)
+                chi, clo = mer.canonical(vfh, vfl, vrh, vrl)
+                chis.append(chi)
+                clos.append(clo)
+                acts.append(t_in[:, t] & (tori[:, t] >= 0))
+        act = jnp.stack(acts).ravel()
+        tv = _db_lookup(
+            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+            act,
+        ).reshape(tail_t, 4, cap_c)
+        tc = (tv >> 1).astype(jnp.int32)
+        tqb = (tv & 1).astype(jnp.int32)
+        tpresent = tc > 0
+        tlevel = jnp.max(jnp.where(tpresent, tqb, 0), axis=1)  # [T, cap]
+        tcounts = jnp.where(tpresent & (tqb == tlevel[:, None, :]), tc, 0)
+        tcount = jnp.sum((tcounts > 0).astype(jnp.int32), axis=1)
+        toriT = tori.T  # [T, cap]
+        tqualT = tqual.T
+        safe_ori = jnp.clip(toriT, 0, 3)
+        tcori = jnp.take_along_axis(tcounts, safe_ori[:, None, :],
+                                    axis=1)[:, 0, :]
+        tcori = jnp.where(toriT >= 0, tcori, 0)
+        tucode = jnp.zeros_like(tcount)
+        for i in range(4):
+            tucode = jnp.where(tcounts[:, i, :] > 0, i, tucode)
+        if has_contam:
+            tcon = _db_lookup(
+                contam_state, contam_meta,
+                jnp.stack(cchis).ravel(), jnp.stack(cclos).ravel(),
+                (t_in & (tori >= 0)).T.ravel(),
+            ).reshape(tail_t, cap_c) != 0
+        else:
+            tcon = jnp.zeros((tail_t, cap_c), bool)
+        c1keep = (tcount == 1) & (tucode == toriT)
+        hi = tcori > cfg.min_count
+        keepcut = (tcount > 1) & hi & ((tcori >= cfg.cutoff)
+                                      | (tqualT >= cfg.qual_cutoff))
+        lam = (jnp.sum(tcounts, axis=1).astype(jnp.float32)
+               * jnp.float32(cfg.collision_prob))
+        keeppoi = ((tcount > 1) & hi & ~keepcut
+                   & (poisson_term(lam, tcori) < cfg.poisson_threshold))
+        keep_t = ((c1keep | keepcut | keeppoi) & t_in.T & (toriT >= 0)
+                  & ~tcon)
+        pk = jnp.cumprod(keep_t.astype(jnp.int32), axis=0) > 0  # [T, cap]
+        plen = jnp.sum(pk.astype(jnp.int32), axis=0)  # [cap]
+        c1p = c1keep & pk
+        has_c1p = jnp.any(c1p, axis=0)
+        t_last = (tail_t - 1) - jnp.argmax(c1p[::-1, :], axis=0)
+        arange_cap = jnp.arange(cap_c, dtype=jnp.int32)
+        prev_t = tcori[t_last, arange_cap]
+        # mer after the kept prefix: m_stack[plen]
+        sel_fh = jnp.stack(m_fh)[plen, arange_cap]
+        sel_fl = jnp.stack(m_fl)[plen, arange_cap]
+        sel_rh = jnp.stack(m_rh)[plen, arange_cap]
+        sel_rl = jnp.stack(m_rl)[plen, arange_cap]
+        safe_slot = jnp.clip(slot, 0, cap_c - 1)
+        adv = jnp.where(fitted, plen[safe_slot], 0)
+        fh = jnp.where(fitted, sel_fh[safe_slot], fh)
+        fl = jnp.where(fitted, sel_fl[safe_slot], fl)
+        rh = jnp.where(fitted, sel_rh[safe_slot], rh)
+        rl = jnp.where(fitted, sel_rl[safe_slot], rl)
+        pos = pos + adv
+        opos = opos + adv
+        prev = jnp.where(fitted & has_c1p[safe_slot], prev_t[safe_slot],
+                         prev)
+        prevdef = jnp.where(fitted, pos, prevdef)
+        return fh, fl, rh, rl, pos, opos, prev, prevdef
 
     def body(carry):
-        (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log) = carry
+        (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+         resync, prevdef, bs_q) = carry
+
+        if planes is not None:
+            # ---- teleport phase: synced lanes jump to the next event
+            synced = pos >= resync
+            at_clean = alive & in_range(pos) & synced & gat(planes.clean,
+                                                            pos)
+            tgt = jnp.minimum(gat(planes.nd, pos), end)
+            nfh = gat(planes.mfh, tgt - 1)
+            nfl = gat(planes.mfl, tgt - 1)
+            nrh = gat(planes.mrh, tgt - 1)
+            nrl = gat(planes.mrl, tgt - 1)
+            fh = jnp.where(at_clean, nfh, fh)
+            fl = jnp.where(at_clean, nfl, fl)
+            rh = jnp.where(at_clean, nrh, rh)
+            rl = jnp.where(at_clean, nrl, rl)
+            opos = opos + jnp.where(at_clean, tgt - pos, 0)
+            pos = jnp.where(at_clean, tgt, pos)
+
         active = alive & in_range(pos)
         cpos = pos
         pos = jnp.where(active, pos + d, pos)
@@ -463,13 +745,20 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         log = _append_trunc(log, con1_trim | t0 | con2_trim | t_a | t_b,
                             cpos, window, error, d, thresh)
         ambig = cm & ~keep_simple & ~t_a & ~t_b
+        # lazy-prev gate: an ambiguous lane whose prev is stale over a
+        # teleported run stalls and runs backscan chunks instead
+        if planes is not None:
+            need_bs = ambig & (prevdef < cpos)
+        else:
+            need_bs = jnp.zeros_like(ambig)
         env = (in_range, gather_code, take4, contam, lane, codes32,
                quals32, window, error, b, l, thresh)
         (fh, fl, rh, rl, pos, opos, prev, alive, status, outb,
-         log, stalled) = _ambig_core(env, state, tmeta, cfg, d,
-                                     fh, fl, rh, rl, pos, opos, prev,
-                                     alive, status, outb, log, ambig,
-                                     cpos, ori, counts, level, ambig_cap)
+         log, stalled, mer_ch2) = _ambig_core(
+            env, state, tmeta, cfg, d, fh, fl, rh, rl, pos, opos, prev,
+            alive, status, outb, log, ambig & ~need_bs,
+            cpos, ori, counts, level, ambig_cap)
+        stalled = stalled | need_bs
 
         # stalled lanes redo the whole step next iteration: rewind
         # their position and pre-shift mers (they took no branch, wrote
@@ -487,7 +776,28 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         outb = outb.at[lane, widx].set(base0, mode="drop")
         opos = jnp.where(write, opos + d, opos)
 
-        return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log)
+        if planes is not None:
+            processed = active & ~stalled
+            # prev-validity bookkeeping: a c1 step resets prev
+            # absolutely; any other processed step extends validity
+            # only if prev was already valid through cpos (teleports
+            # leave a stale gap behind on purpose)
+            prevdef = jnp.where(
+                c1 & ~stalled, cpos + 1,
+                jnp.where(processed & (prevdef >= cpos), cpos + 1,
+                          prevdef))
+            mer_changed = (sub1 | mer_ch2) & ~stalled
+            resync = jnp.where(mer_changed, cpos + k, resync)
+            prev, prevdef, bs_q = _backscan(need_bs, cpos, prev, prevdef,
+                                            bs_q)
+            want_tail = (alive & in_range(pos) & (pos < resync)
+                         & (prevdef >= pos) & ~stalled)
+            (fh, fl, rh, rl, pos, opos, prev, prevdef) = _tail_probe(
+                want_tail, fh, fl, rh, rl, pos, opos, prev, prevdef,
+                resync)
+
+        return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+                resync, prevdef, bs_q)
 
     def body_unrolled(carry):
         for _ in range(unroll):
@@ -495,7 +805,7 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
         return carry
 
     def cond(carry):
-        (_, _, _, _, pos, _, _, alive, _, _, _) = carry
+        pos, alive = carry[4], carry[7]
         return jnp.any(alive & in_range(pos))
 
     return jax.lax.while_loop(cond, body_unrolled, carry)
@@ -638,15 +948,20 @@ def _ambig_core(env, state, tmeta, cfg, d: int,
     outb = outb.at[lane, widx].set(base0, mode="drop")
     opos = jnp.where(write, opos + d, opos)
 
+    # lanes whose mer now differs from the pre-step shifted mer (an
+    # actual base replacement happened): the event-driven loop uses
+    # this to mark the lane desynced from the original-window planes
+    mer_changed = do_rep & (check_code != ori)
     return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
-            stalled)
+            stalled, mer_changed)
 
 
 def extend(state, tmeta, codes, quals, cfg: ECConfig,
            out, fhi, flo, rhi, rlo, prev0, alive0,
            pos0, end, status0,
            contam_state, contam_meta, d: int, has_contam: bool,
-           ambig_cap: int | None = None, guard_thresh=None):
+           ambig_cap: int | None = None, guard_thresh=None,
+           planes: EventPlanes | None = None):
     """extend (error_correct_reads.cc:384-565) in lockstep over a batch:
     one fused while_loop advancing every live lane one base per
     iteration, with the ambiguous-path continuation probe inline over
@@ -670,12 +985,15 @@ def extend(state, tmeta, codes, quals, cfg: ECConfig,
         ambig_cap = max(256, b // 8)
     if guard_thresh is None:
         guard_thresh = jnp.full((b,), cfg.effective_window, jnp.int32)
+    resync0 = jnp.full((b,), -(1 << 30), jnp.int32)
+    bs_q0 = jnp.full((b,), -1, jnp.int32)
     carry = (fhi, flo, rhi, rlo, pos0, pos0, prev0, alive0, status0, out,
-             log0)
+             log0, resync0, pos0, bs_q0)
+    unroll = 1 if planes is not None else UNROLL
     carry = _extend_loop(state, tmeta, codes, quals, cfg, carry, end,
                          guard_thresh, contam_state, contam_meta, d,
-                         has_contam, UNROLL, ambig_cap)
-    (_, _, _, _, _, opos, _, _, status, outb, log) = carry
+                         has_contam, unroll, ambig_cap, planes)
+    opos, status, outb, log = carry[5], carry[8], carry[9], carry[10]
     return ExtendResult(outb, opos, status, log)
 
 
@@ -765,10 +1083,44 @@ def _bwd_epilogue(out_f, status_f, out_rc, opos_rc, status_rc,
     return out, start, status, LogState(blog.n, blog.lwin, mapped, meta)
 
 
+def _event_planes(sweep: SweepResult, lengths, cfg: ECConfig,
+                  uniform_len: int | None, l: int) -> EventPlanes:
+    """Build the [2B, L] event-driven planes (see EventPlanes) for the
+    merged fwd+rc loop from the shared position sweep. The rc half is a
+    pure index remap of the forward half: the window ending at rc
+    position p' is the original window ending at len+k-2-p', and the
+    rc-frame forward/revcomp mer words are the original window's
+    revcomp/forward words."""
+    k = cfg.k
+    q1 = (sweep.vals & 1) == 1
+    c = (sweep.vals >> 1).astype(jnp.int32)
+    clean_f = (sweep.validk & q1 & (c >= cfg.cutoff)
+               & (c > cfg.min_count) & ~sweep.con)
+
+    def rc_map(x, fill):
+        rev, valid = _rev_rows(x, lengths, uniform_len, fill)
+        if k > 1:
+            rev = jnp.pad(rev[:, :l - (k - 1)], ((0, 0), (k - 1, 0)),
+                          constant_values=fill)
+        return rev
+
+    cat = jnp.concatenate
+    clean2 = cat([clean_f, rc_map(clean_f, False)])
+    vals2 = cat([sweep.vals, rc_map(sweep.vals, 0)])
+    mfh2 = cat([sweep.fhi, rc_map(sweep.rhi, 0)])
+    mfl2 = cat([sweep.flo, rc_map(sweep.rlo, 0)])
+    mrh2 = cat([sweep.rhi, rc_map(sweep.fhi, 0)])
+    mrl2 = cat([sweep.rlo, rc_map(sweep.flo, 0)])
+    p_idx = jnp.arange(l, dtype=jnp.int32)[None, :]
+    nd2 = jax.lax.cummin(jnp.where(clean2, jnp.int32(l), p_idx), axis=1,
+                         reverse=True)
+    return EventPlanes(clean2, nd2, vals2, mfh2, mfl2, mrh2, mrl2)
+
+
 def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                   codes, quals, lengths, cfg: ECConfig,
-                  contam=None, ambig_cap: int | None = None
-                  ) -> BatchResult:
+                  contam=None, ambig_cap: int | None = None,
+                  event_driven: bool = False) -> BatchResult:
     """Correct a batch of reads on device. `contam` is an optional
     (TableState, TableMeta) k-mer membership set (value word != 0).
     Mirrors error_correct_instance::start (error_correct_reads.cc:
@@ -808,11 +1160,29 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
         raise ValueError(
             f"Contaminant mer length ({cmeta.k}) different than correction "
             f"mer length ({cfg.k})")
+    if ambig_cap is None:
+        ambig_cap = max(256, (2 * codes.shape[0]) // 8)
+    return _correct_device(state, tmeta, codes, quals, lengths, cfg,
+                           cstate, cmeta, has_contam, uniform, ambig_cap,
+                           event_driven)
 
+
+@functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11))
+def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
+                    cstate, cmeta, has_contam: bool, uniform: int | None,
+                    ambig_cap: int, event_driven: bool) -> BatchResult:
+    """The whole device-side correction of one batch as ONE executable:
+    position sweep, anchor scan, rc prologue, event planes, the merged
+    extension loop, and the backward epilogue (separate dispatches cost
+    ~25 ms each through the tunnel; see PERF_NOTES.md)."""
+    b, l = codes.shape
+    sweep = _position_sweep(state, tmeta, codes, cfg, cstate, cmeta,
+                            has_contam)
     anc = find_anchors(state, tmeta, codes, lengths, cfg,
-                       cstate, cmeta, has_contam)
-    b = codes.shape[0]
+                       cstate, cmeta, has_contam, sweep)
     rc_codes, rc_quals = _rc_prologue(codes, quals, lengths, uniform)
+    planes = (_event_planes(sweep, lengths, cfg, uniform, l)
+              if event_driven else None)
     w = cfg.effective_window
     cat = jnp.concatenate
     codes2 = cat([codes, rc_codes])
@@ -826,7 +1196,7 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                  cat([anc.prev_count, anc.prev_count]),
                  cat([anc.found, anc.found]),
                  pos0, end2, cat([anc.status, anc.status]),
-                 cstate, cmeta, 1, has_contam, ambig_cap, thresh)
+                 cstate, cmeta, 1, has_contam, ambig_cap, thresh, planes)
     flog = LogState(res.log.n[:b], res.log.lwin[:b], res.log.pos[:b],
                     res.log.meta[:b])
     blog_rc = LogState(res.log.n[b:], res.log.lwin[b:], res.log.pos[b:],

@@ -1,0 +1,125 @@
+"""Event-driven stepping (round 4): bit-parity of the teleporting loop
+against the plain lockstep loop on data shaped to exercise every new
+mechanism — long clean runs (teleports), isolated and clustered errors
+(tail probes, incl. tail stops), ambiguous sites after teleported runs
+(lazy-prev backscan), N bases, and tiny compaction capacities (stall
+paths). The plain loop (event_driven=False) is itself pinned to the
+oracle by tests/test_corrector.py, so parity here closes the chain."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.ops import ctable
+from quorum_tpu.models import corrector
+from quorum_tpu.models.ec_config import ECConfig
+from quorum_tpu.models.create_database import extract_observations
+
+K, RLEN, B = 9, 50, 1024
+BASES = "ACGT"
+
+
+def _build(rng, codes, quals):
+    meta = ctable.TileMeta(k=K, bits=7, rb_log2=ctable.tile_rb_for(
+        200_000, K, 7))
+    bstate = ctable.make_tile_build(meta)
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), K, 38)
+    bstate, full, _ = ctable.tile_insert_observations(
+        bstate, meta, chi, clo, q, valid)
+    assert not full
+    return ctable.tile_finalize(bstate, meta), meta
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(7)
+    genome = rng.integers(0, 4, size=2000, dtype=np.int8)
+    starts = rng.integers(0, len(genome) - RLEN, size=B)
+    codes = genome[starts[:, None] + np.arange(RLEN)[None, :]].astype(np.int8)
+    errs = rng.random(codes.shape) < 0.02
+    # clustered errors (within k) on a slice of reads: tail-stop paths
+    errs[:64, 20] = True
+    errs[:64, 24] = True
+    codes = np.where(errs, (codes + rng.integers(1, 4, size=codes.shape)) % 4,
+                     codes).astype(np.int8)
+    # N bases on another slice
+    codes[64:96, 30] = -1
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[errs] = 68
+    state, meta = _build(rng, codes, quals)
+    return codes, quals, state, meta
+
+
+def _run(batch, event_driven, ambig_cap=None):
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    lengths = jnp.full((B,), RLEN, jnp.int32)
+    return corrector.correct_batch(state, meta, jnp.asarray(codes),
+                                   jnp.asarray(quals), lengths, cfg,
+                                   ambig_cap=ambig_cap,
+                                   event_driven=event_driven)
+
+
+def _assert_same(a, b):
+    np.testing.assert_array_equal(np.asarray(a.out), np.asarray(b.out))
+    np.testing.assert_array_equal(np.asarray(a.start), np.asarray(b.start))
+    np.testing.assert_array_equal(np.asarray(a.end), np.asarray(b.end))
+    np.testing.assert_array_equal(np.asarray(a.status), np.asarray(b.status))
+    for name in corrector.LogState._fields:
+        if name == "lwin":  # internal scratch; n/pos/meta are the output
+            continue
+        for la, lb in ((a.fwd_log, b.fwd_log), (a.bwd_log, b.bwd_log)):
+            av, bv = np.asarray(getattr(la, name)), np.asarray(
+                getattr(lb, name))
+            if name in ("pos", "meta"):
+                # compare only the live entries
+                n = np.asarray(a.fwd_log.n if la is a.fwd_log else
+                               a.bwd_log.n)
+                w = min(av.shape[1], bv.shape[1])
+                msk = np.arange(w)[None, :] < n[:, None]
+                np.testing.assert_array_equal(
+                    np.where(msk, av[:, :w], 0), np.where(msk, bv[:, :w], 0))
+            else:
+                np.testing.assert_array_equal(av, bv)
+
+
+def test_planes_actually_teleport(batch):
+    """The fixture must genuinely exercise the fast path: most
+    positions provably clean."""
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    sweep = corrector._position_sweep(
+        state, meta, jnp.asarray(codes, jnp.int32), cfg,
+        *corrector._dummy_contam(K), False)
+    lengths = jnp.full((B,), RLEN, jnp.int32)
+    planes = corrector._event_planes(sweep, lengths, cfg, RLEN, RLEN)
+    clean = np.asarray(planes.clean)[:, K - 1:]
+    assert clean.mean() > 0.5, f"fixture too dirty ({clean.mean():.2f})"
+
+
+def test_event_parity(batch):
+    _assert_same(_run(batch, True), _run(batch, False))
+
+
+def test_event_parity_tiny_ambig_cap(batch):
+    """ambig-cap stalls interleaved with backscan stalls."""
+    _assert_same(_run(batch, True, ambig_cap=1), _run(batch, False))
+
+
+def test_event_parity_variable_lengths(batch):
+    """Non-uniform lengths take the gather-path planes remap."""
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    rng = np.random.default_rng(3)
+    lengths = rng.integers(K + 5, RLEN + 1, size=B).astype(np.int32)
+    c = codes.copy()
+    for i, ln in enumerate(lengths):
+        c[i, ln:] = -2
+    a = corrector.correct_batch(state, meta, jnp.asarray(c),
+                                jnp.asarray(quals), jnp.asarray(lengths),
+                                cfg, event_driven=True)
+    b = corrector.correct_batch(state, meta, jnp.asarray(c),
+                                jnp.asarray(quals), jnp.asarray(lengths),
+                                cfg, event_driven=False)
+    _assert_same(a, b)
