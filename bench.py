@@ -1,120 +1,193 @@
-"""Benchmark: end-to-end device throughput vs the reference baseline.
+"""Benchmark: end-to-end CLI throughput vs the reference baseline.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} for the
-HEADLINE metric — stage-2 correction throughput, the quantity the
+Drives the REAL console-script paths (quorum_create_database then
+quorum_error_correct_reads) over a generated FASTQ file, so FASTQ
+parsing, H2D/D2H through the tunnel, device compute, log rendering and
+file output are all inside the timed window — the same work the
 reference's 48 Gbases/hour claim measures (48 threads,
-paper/bmc_article.tex:199; BASELINE.md) — plus secondary lines for the
-stage-1 build (marked with its own baseline_metric caveat: the
-reference publishes no separate build number).
+paper/bmc_article.tex:199; BASELINE.md).
 
-Shapes are production-like: k=24, 150 bp reads, 16k-read device
-batches, ~10x coverage with 1% substitution errors so the ambiguous
-paths and table load are realistic. The first run in a fresh
-environment pays one-time XLA AOT compiles (~minutes on the tunneled
-TPU); the persistent compilation cache (utils/jaxcache) makes repeat
-runs compile-free.
+Dataset: k=24, 150 bp uniform reads at ~40x coverage with 1%
+substitution errors — the paper's operating regime (its datasets are
+43-180x; below ~20x coverage anchors and cutoffs degrade for any
+corrector). Ground truth is kept, so the paper's accuracy triple
+(errors remaining / errors introduced / bases trimmed,
+bmc_article.tex:615-651) is printed alongside throughput.
+
+Output: one JSON line per metric; the HEADLINE (stage-2 correction
+throughput, the published-baseline quantity) prints LAST so the driver
+records it. A warm-up run absorbs one-time XLA compiles into the
+persistent cache (utils/jaxcache) — what a steady-state user sees.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 BASELINE_GBASES_PER_HOUR = 48.0
 
+K = 24
+READ_LEN = 150
+GENOME = 1_200_000
+COVERAGE = 40
+ERR_RATE = 0.01
+BATCH = 16384
+
 
 def synth_reads(rng, genome, n_reads, read_len, err_rate=0.01):
     """Reads sampled from one genome with substitution errors — shaped
     like real Illumina input so table load and branch mix are
-    realistic."""
+    realistic. Returns (codes, quals, starts, errs)."""
     starts = rng.integers(0, len(genome) - read_len, size=n_reads)
     idx = starts[:, None] + np.arange(read_len)[None, :]
-    codes = genome[idx]
-    errs = rng.random(codes.shape) < err_rate
-    codes = np.where(errs, (codes + rng.integers(1, 4, size=codes.shape)) % 4,
-                     codes).astype(np.int8)
+    truth = genome[idx]
+    errs = rng.random(truth.shape) < err_rate
+    codes = np.where(errs, (truth + rng.integers(1, 4, size=truth.shape)) % 4,
+                     truth).astype(np.int8)
     quals = np.full(codes.shape, 70, np.uint8)
     quals[errs] = 68  # still "high" for the quality bit; errors stay real
-    return codes, quals
+    return codes, quals, starts, errs
+
+
+_BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+def write_fastq(path, codes, quals):
+    n, l = codes.shape
+    seqs = _BASES[codes].reshape(n, l)
+    with open(path, "wb") as f:
+        qrow = quals.view(np.uint8)
+        for i in range(n):
+            f.write(b"@r%d\n" % i)
+            f.write(seqs[i].tobytes())
+            f.write(b"\n+\n")
+            f.write(qrow[i].tobytes())
+            f.write(b"\n")
+
+
+def parse_fasta(path):
+    """-> {read_id: seq_bytes}"""
+    out = {}
+    with open(path, "rb") as f:
+        hdr = None
+        for line in f:
+            if line.startswith(b">"):
+                hdr = int(line[2:].split(None, 1)[0])
+            elif hdr is not None:
+                out[hdr] = line.strip()
+                hdr = None
+    return out
+
+
+def accuracy_triple(recs, genome, starts, errs, codes):
+    """The paper's metrics (bmc_article.tex:615-651): % of original
+    errors remaining after trim+correction, % errors introduced (new
+    mismatches vs truth on kept bases), % bases trimmed/discarded.
+    Reads are substitution-only, so the corrected sequence is a
+    contiguous slice of the read's coordinates; its offset is 0 for
+    untrimmed reads and found by best-match for trimmed ones."""
+    n, l = codes.shape
+    injected = int(errs.sum())
+    total_bases = n * l
+    remaining = introduced = kept_bases = 0
+    code_of = np.full(256, -1, np.int8)
+    for i, b in enumerate(b"ACGT"):
+        code_of[b] = i
+    for rid in range(n):
+        seq = recs.get(rid)
+        if seq is None:
+            continue
+        cseq = code_of[np.frombuffer(seq, np.uint8)]
+        m = len(cseq)
+        truth = genome[starts[rid]:starts[rid] + l]
+        if m == l:
+            off = 0
+        else:
+            offs = np.arange(l - m + 1)
+            mism = np.array([
+                (cseq != truth[o:o + m]).sum() for o in offs])
+            off = int(offs[mism.argmin()])
+        tw = truth[off:off + m]
+        ew = errs[rid, off:off + m]
+        mm = cseq != tw
+        kept_bases += m
+        remaining += int((mm & ew).sum())
+        introduced += int((mm & ~ew).sum())
+    trimmed = total_bases - kept_bases
+    return {
+        "pct_errors_remaining": round(100.0 * remaining / injected, 4),
+        "pct_errors_introduced": round(100.0 * introduced / injected, 4),
+        "pct_bases_trimmed": round(100.0 * trimmed / total_bases, 4),
+        "injected_errors": injected,
+        "reads_kept": len(recs),
+    }
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
-
     from quorum_tpu.utils.jaxcache import enable_cache
     enable_cache()
-    from quorum_tpu.ops import ctable
-    from quorum_tpu.models.create_database import extract_observations
-    from quorum_tpu.models.corrector import correct_batch, finish_batch
-    from quorum_tpu.models.ec_config import ECConfig
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import error_correct_reads as ec_cli
 
-    k, read_len, batch, nb = 24, 150, 16384, 8
+    tmp = "/tmp/quorum_bench"
+    os.makedirs(tmp, exist_ok=True)
     rng = np.random.default_rng(0)
-    genome = rng.integers(0, 4, size=2_000_000, dtype=np.int8)
-    batches = [
-        tuple(jnp.asarray(a) for a in synth_reads(rng, genome, batch,
-                                                  read_len))
-        for _ in range(nb)
-    ]
-    jax.block_until_ready(batches)
-    # one scalar D2H switches this client into synchronous dispatch,
-    # which measures true completion time per call (async enqueue mode
-    # both distorts timing and is slower end-to-end here)
-    _ = float(jnp.zeros(()))
+    genome = rng.integers(0, 4, size=GENOME, dtype=np.int8)
+    n_reads = GENOME * COVERAGE // READ_LEN
+    n_reads -= n_reads % BATCH  # whole device batches
+    codes, quals, starts, errs = synth_reads(rng, genome, n_reads,
+                                             READ_LEN, ERR_RATE)
+    fq = f"{tmp}/reads.fastq"
+    write_fastq(fq, codes, quals)
+    bases = n_reads * READ_LEN
+    # table sizing: genome mers + ~k error mers per error
+    size = int((GENOME + bases * ERR_RATE * K * 1.3) * 1.25) + 1_000_000
 
-    meta = ctable.TileMeta(k=k, bits=7,
-                           rb_log2=ctable.tile_rb_for(6_000_000, k, 7))
+    # warm-up: absorbs one-time XLA compiles into the persistent cache
+    # (what a steady-state user sees). Stage 1 warms on a slice (same
+    # batch/geometry executables); the timed stage-1 run follows, and
+    # THEN stage 2 warms against the REAL database — the Poisson
+    # cutoff is a compile-time constant of the corrector executable,
+    # and a slice-built DB would compute a different one.
+    wq = f"{tmp}/warm.fastq"
+    write_fastq(wq, codes[:BATCH], quals[:BATCH])
+    wdb = f"{tmp}/warm_db.qdb"
+    cdb_cli.main(["-s", str(size), "-m", str(K), "-b", "7", "-q", "38",
+                  "-o", wdb, "--batch-size", str(BATCH), wq])
 
-    def build():
-        bstate = ctable.make_tile_build(meta)
-        for codes, quals in batches:
-            chi, clo, q, valid = extract_observations(codes, quals, k, 38)
-            bstate, full, _ = ctable.tile_insert_observations(
-                bstate, meta, chi, clo, q, valid)
-            assert not full, "bench table mis-sized (FULL)"
-        return ctable.tile_finalize(bstate, meta)
-
-    state = build()  # compile/warm
-    jax.block_until_ready(ctable.tile_stats(state, meta))  # warm stats too
+    # the timed runs play the quorum driver's role: stage 1 and 2 run
+    # in one process and stage 2 receives the still-device-resident
+    # table (cli/quorum.py does the same), mirroring the reference
+    # driver whose stage-2 re-mmap of the just-written file is free
+    # (page cache). Reads parsing, H2D, device compute, D2H, rendering
+    # and file output are all inside the timed windows.
+    db = f"{tmp}/bench_db.qdb"
+    handoff: dict = {}
     t0 = time.perf_counter()
-    state = build()
-    occ, _, _ = jax.block_until_ready(ctable.tile_stats(state, meta))
-    build_dt = time.perf_counter() - t0
-    bases = nb * batch * read_len
-    s1 = bases / build_dt * 3600 / 1e9
+    rc = cdb_cli.main(["-s", str(size), "-m", str(K), "-b", "7", "-q", "38",
+                       "-o", db, "--batch-size", str(BATCH), fq],
+                      handoff=handoff)
+    s1_dt = time.perf_counter() - t0
+    assert rc == 0, "create_database failed"
+    s1 = bases / s1_dt * 3600 / 1e9
 
-    cfg = ECConfig(k=k, cutoff=4)
-    lengths = jnp.full((batch,), read_len, jnp.int32)
-
-    def correct(n):
-        # device correction + host finishing (log render, seq assembly)
-        # — the end-to-end work the 48 Gb/h baseline measures, minus
-        # only file I/O (which overlaps via the async writer in the CLI)
-        results = []
-        for codes, quals in batches[:n]:
-            res = correct_batch(state, meta, codes, quals, lengths, cfg)
-            results.append(finish_batch(res, batch, cfg))
-        return results
-
-    results = correct(1)  # compile/warm
-    n2 = 4
+    ec_cli.main(["-o", f"{tmp}/warm_out", "--batch-size", str(BATCH),
+                 db, wq], db=handoff.get("db"))
     t0 = time.perf_counter()
-    results = correct(n2)
-    dt = time.perf_counter() - t0
-    ok = sum(sum(1 for r in rs if r.ok) for rs in results)
-    assert ok > 0.9 * n2 * batch, f"correction mostly failing ({ok})"
-    s2 = n2 * batch * read_len / dt * 3600 / 1e9
+    rc = ec_cli.main(["-o", f"{tmp}/bench_out", "--batch-size", str(BATCH),
+                      db, fq], db=handoff.get("db"))
+    s2_dt = time.perf_counter() - t0
+    assert rc == 0, "error_correct_reads failed"
+    s2 = bases / s2_dt * 3600 / 1e9
 
-    # HEADLINE: stage-2 correction vs the 48 Gb/h correction baseline
-    print(json.dumps({
-        "metric": "stage2_correction_throughput",
-        "value": round(s2, 3),
-        "unit": "Gbases/hour",
-        "vs_baseline": round(s2 / BASELINE_GBASES_PER_HOUR, 3),
-    }))
+    recs = parse_fasta(f"{tmp}/bench_out.fa")
+    assert len(recs) > 0.9 * n_reads, f"correction mostly failing ({len(recs)})"
+    acc = accuracy_triple(recs, genome, starts, errs, codes)
+
     # secondary: the reference has no published build-only number; the
     # ratio below still divides by the CORRECTION baseline
     print(json.dumps({
@@ -122,8 +195,20 @@ def main():
         "value": round(s1, 3),
         "unit": "Gbases/hour",
         "vs_baseline": round(s1 / BASELINE_GBASES_PER_HOUR, 3),
-        "baseline_metric": "stage2_correction_throughput_48h",
-        "distinct_mers": int(occ),
+        "baseline_metric": "stage2_correction_throughput_48t",
+        "bases": bases,
+    }))
+    print(json.dumps({"metric": "accuracy", **acc}))
+    # HEADLINE last (the driver records the final line): stage-2
+    # correction, end to end through the CLI, vs the 48 Gb/h baseline
+    print(json.dumps({
+        "metric": "stage2_correction_throughput",
+        "value": round(s2, 3),
+        "unit": "Gbases/hour",
+        "vs_baseline": round(s2 / BASELINE_GBASES_PER_HOUR, 3),
+        "bases": bases,
+        **{f"acc_{k}": v for k, v in acc.items()
+           if k.startswith("pct_")},
     }))
 
 

@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Maximum number of reprobes")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--ref-format", action="store_true",
+                   help="Write the reference's binary/quorum_db format "
+                        "instead of the native format")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("-v", "--verbose", action="store_true")
@@ -41,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None) -> int:
+def main(argv=None, handoff: dict | None = None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
@@ -73,11 +76,14 @@ def main(argv=None) -> int:
         initial_size=parse_size(args.size),
         max_reprobe=args.reprobe,
         batch_size=args.batch_size,
+        threads=args.threads,
         profile=args.profile,
     )
     try:
         create_database_main(args.reads, args.output, cfg,
-                             cmdline=list(sys.argv))
+                             cmdline=list(sys.argv),
+                             ref_format=args.ref_format,
+                             handoff=handoff)
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
         return 1

@@ -142,7 +142,8 @@ def main(argv=None) -> int:
         cdb_argv.append("-v")
         print("+ quorum_create_database " + " ".join(cdb_argv)
               + " " + " ".join(args.reads), file=sys.stderr)
-    if cdb_cli.main(cdb_argv + list(args.reads)) != 0:
+    handoff: dict = {}
+    if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff) != 0:
         print("Creating the mer database failed. Most likely the size "
               "passed to the -s switch is too small.", file=sys.stderr)
         return 1
@@ -172,7 +173,7 @@ def main(argv=None) -> int:
         if args.debug:
             print("+ quorum_error_correct_reads " + " ".join(ec_argv),
                   file=sys.stderr)
-        if ec_cli.main(ec_argv) != 0:
+        if ec_cli.main(ec_argv, db=handoff.get("db")) != 0:
             print("Error correction failed", file=sys.stderr)
             return 1
         return 0
@@ -198,7 +199,8 @@ def main(argv=None) -> int:
             kwargs[key] = val
     try:
         run_error_correct(db_file, [], None, opts,
-                          records=merge_records(args.reads), **kwargs)
+                          records=merge_records(args.reads),
+                          db=handoff.get("db"), **kwargs)
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)
         print("Error correction failed", file=sys.stderr)

@@ -53,11 +53,27 @@ def load_contaminant(path: str, k: int):
     """Load a contaminant k-mer set for correction at mer length k.
     Returns (TableState, TableMeta). Raises ValueError on k mismatch
     (reference message, error_correct_reads.cc:703-705)."""
-    if _is_quorum_db(path):
+    from . import jf_binary, quorum_db
+
+    if _is_quorum_db(path) or quorum_db.is_ref_db(path):
         state, meta, _hdr = db_format.read_db(path, to_device=True)
         if meta.k != k:
             raise ValueError(
                 f"Contaminant mer length ({meta.k}) different than "
                 f"correction mer length ({k})")
         return state, meta
+    if jf_binary.is_jf_binary(path):
+        # the reference's own surface: a `jellyfish count` adapter DB
+        # (error_correct_reads.cc:693-708)
+        import numpy as np
+
+        from ..ops import ctable
+
+        khi, klo, counts, kk = jf_binary.read_jf_binary(path)
+        if kk != k:
+            raise ValueError(
+                f"Contaminant mer length ({kk}) different than "
+                f"correction mer length ({k})")
+        vals = np.where(counts > 0, 2, 0).astype(np.uint32)  # member bit
+        return ctable.tile_from_entries(khi, klo, vals, k, bits=7)
     return build_kmer_set([path], k)

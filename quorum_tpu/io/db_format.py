@@ -6,13 +6,20 @@ JSON header followed by the raw table arrays
 (reference: database_header src/mer_database.hpp:43-63,
 hash_with_quality::write :115-126, reload via database_query :270-278).
 
-Two payload versions:
+Three payload versions:
 
-* version 2 (written by stage 1): the tile-bucket layout — ONE
-  little-endian uint32 array of shape [rows, 128], memmap-able and
-  query-ready (ops/ctable.TileState). Keys are stored partially (the
-  remainder of an invertible Feistel hash), the same trick the
-  reference's Jellyfish layer uses (RectangularBinaryMatrix,
+* version 3 (written by stage 1, round 4): entry-compact tile layout —
+  the occupied slots only, as (bucket address, lo word, hi word)
+  triplets. A ~30%-occupied table is ~4-5x smaller on disk AND moves
+  ~4-5x fewer bytes over the tunnel in both directions (the write's
+  D2H and the standalone reload's H2D each cost ~0.1-0.17 s/MB;
+  PERF_NOTES.md round 4).
+
+* version 2: the raw tile-bucket layout — ONE little-endian uint32
+  array of shape [rows, 128], memmap-able and query-ready
+  (ops/ctable.TileState). Keys are stored partially (the remainder of
+  an invertible Feistel hash), the same trick the reference's
+  Jellyfish layer uses (RectangularBinaryMatrix,
   src/mer_database.hpp:28).
 
 * version 1 (legacy wide): three uint32 arrays (keys_hi, keys_lo,
@@ -51,9 +58,42 @@ def _header_common(cmdline):
     }
 
 
-def write_db(path: str, state, meta, cmdline: list[str] | None = None
-             ) -> None:
+def write_db(path: str, state, meta, cmdline: list[str] | None = None,
+             compact: bool = True) -> None:
     if isinstance(meta, TileMeta):
+        if compact:
+            # v3: occupied entries only (addr, lo, hi — 12 B each).
+            # A ~30%-occupied table moves ~4-5x fewer bytes through
+            # the tunnel's ~0.17 s/MB D2H than the raw row plane, and
+            # the read side re-uploads the same compact arrays.
+            occ, _d, _t = ctable.tile_stats(state, meta)
+            n = int(occ)
+            # cap is a STATIC jit arg: round up to a power of two so
+            # the compaction executable cache-hits across runs instead
+            # of recompiling per distinct occupancy
+            cap = 1 << max(10, (max(1, n) - 1).bit_length())
+            addr, lo, hi, _n = ctable.tile_compact_device(state, meta,
+                                                          cap)
+            addr = np.asarray(addr)[:n]
+            lo = np.asarray(lo)[:n]
+            hi = np.asarray(hi)[:n]
+            header = {
+                "format": FORMAT,
+                "version": 3,
+                "key_len": 2 * meta.k,
+                "bits": meta.bits,
+                "rb_log2": meta.rb_log2,
+                "rows": meta.rows,
+                "n_entries": n,
+                "value_bytes": int(addr.nbytes + lo.nbytes + hi.nbytes),
+                **_header_common(cmdline),
+            }
+            with open(path, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(addr.tobytes())
+                f.write(lo.tobytes())
+                f.write(hi.tobytes())
+            return
         rows = np.asarray(state.rows, dtype=np.uint32)
         header = {
             "format": FORMAT,
@@ -117,7 +157,8 @@ def read_header(path: str) -> dict:
     return header
 
 
-def read_db(path: str, to_device: bool = True):
+def read_db(path: str, to_device: bool = True,
+            no_mmap: bool = False):
     """Load a database file. Returns (state, meta, header) where state/
     meta are (TileState, TileMeta) for version-2 files and (TableState,
     TableMeta) for legacy version-1 files. With to_device the arrays
@@ -125,17 +166,60 @@ def read_db(path: str, to_device: bool = True):
 
     The reference mmaps by default with a --no-mmap escape hatch
     (map_or_read_file, src/mer_database.hpp:228-248); we always memmap
-    on host and the `to_device` flag controls the HBM copy."""
+    on host and the `to_device` flag controls the HBM copy.
+
+    Reference-format files (`binary/quorum_db`, io/quorum_db) are
+    decoded into a tile table transparently, so every tool that reads
+    databases accepts them. `no_mmap` (-M) slurps the payload instead
+    of memmapping, like the reference's suck_in_file escape hatch
+    (mer_database.hpp:189-248)."""
+    from . import quorum_db
+
+    if quorum_db.is_ref_db(path):
+        khi, klo, vals, k, bits = quorum_db.read_ref_db(path)
+        state, meta = ctable.tile_from_entries(khi, klo, vals, k, bits)
+        if not to_device:
+            state = TileState(np.asarray(state.rows))
+        header = {"format": quorum_db.REF_FORMAT, "version": 2,
+                  "key_len": 2 * k, "bits": bits,
+                  "rb_log2": meta.rb_log2}
+        return state, meta, header
     header = read_header(path)
     with open(path, "rb") as f:
         offset = len(f.readline())
+
+    def plane(dtype, off, shape):
+        if no_mmap:
+            count = int(np.prod(shape))
+            with open(path, "rb") as f:
+                f.seek(off)
+                return np.fromfile(f, dtype=dtype,
+                                   count=count).reshape(shape)
+        return np.memmap(path, dtype=dtype, mode="r", offset=off,
+                         shape=shape)
+
+    if header.get("version", 1) == 3:
+        n = header["n_entries"]
+        meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],
+                        rb_log2=header["rb_log2"])
+        addr = plane(np.int32, offset, (n,))
+        lo = plane(np.uint32, offset + 4 * n, (n,))
+        hi = plane(np.uint32, offset + 8 * n, (n,))
+        if to_device:
+            row, col = ctable.tile_compact_placement(addr)
+            state = ctable.tile_rows_device_from_compact(
+                jnp.asarray(row), jnp.asarray(col), jnp.asarray(lo),
+                jnp.asarray(hi), meta)
+        else:
+            rows = ctable.tile_rows_from_compact(addr, lo, hi, meta)
+            state = TileState(rows)
+        return state, meta, header
     if header.get("version", 1) == 2:
         rows = 1 << header["rb_log2"]  # geometry source of truth
         if header.get("rows", rows) != rows:
             raise ValueError(f"corrupt header: rows={header.get('rows')} "
                              f"!= 2^rb_log2={rows} in '{path}'")
-        mm = np.memmap(path, dtype=np.uint32, mode="r", offset=offset,
-                       shape=(rows, ctable.TILE))
+        mm = plane(np.uint32, offset, (rows, ctable.TILE))
         assert offset + rows * ctable.TILE * 4 <= os.path.getsize(path), \
             "truncated database"
         meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],
@@ -144,8 +228,7 @@ def read_db(path: str, to_device: bool = True):
         return state, meta, header
     size = header["size"]
     nbytes = size * 4
-    mm = np.memmap(path, dtype=np.uint32, mode="r", offset=offset,
-                   shape=(3 * size,))
+    mm = plane(np.uint32, offset, (3 * size,))
     keys_hi = mm[:size]
     keys_lo = mm[size: 2 * size]
     vals = mm[2 * size:]

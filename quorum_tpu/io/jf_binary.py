@@ -1,0 +1,102 @@
+"""Jellyfish `binary_dumper` record files — the `jellyfish count`
+output the reference consumes for `--contaminant` (adapter.jf, built by
+`jellyfish count -m 24 -s 5k` at reference build time, Makefile.am:
+50-56; loaded via `binary_reader` at error_correct_reads.cc:693-708).
+
+Record layout (derived from the reference's binary_reader usage and
+Jellyfish 2's documented design; the same validation boundary as
+io/quorum_db.py applies — no Jellyfish build exists here to diff
+against): a Jellyfish JSON `file_header`, then fixed-size records of
+`ceil(key_len/8)` key bytes (the 2-bit packed mer, little-endian,
+base 0 of the mer in the least-significant bits — the same packing as
+ops/mer) followed by `counter_len` count bytes (little-endian).
+
+The reference checks `header.format() == binary_dumper::format` and
+`key_len == 2k` before reading; we accept the plausible format-tag
+spellings and enforce the same k check at the call site."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import ref_db
+
+# binary_dumper's tag; accepted spellings across Jellyfish 2.x
+FORMATS = ("binary/sorted", "binary/jellyfish", "binary/binary_dumper")
+
+
+def is_jf_binary(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            head = f.read(1 << 16)
+        header, _ = ref_db.parse_jf_header(head)
+        return header.get("format") in FORMATS
+    except (OSError, ref_db.RefHeaderError):
+        return False
+
+
+def read_jf_binary(path: str):
+    """-> (khi u32[N], klo u32[N], counts u64[N], k)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    header, off = ref_db.parse_jf_header(data)
+    if header.get("format") not in FORMATS:
+        raise ValueError(
+            f"'{path}': format '{header.get('format')}' is not a "
+            "binary_dumper file")
+    key_len = int(header["key_len"])
+    if key_len > 64:
+        raise ValueError(f"'{path}': key_len {key_len} > 64 unsupported")
+    counter_len = int(header.get("counter_len", 4))
+    kbytes = -(-key_len // 8)
+    rec = kbytes + counter_len
+    payload = data[off:]
+    n = len(payload) // rec
+    if n * rec != len(payload):
+        raise ValueError(
+            f"'{path}': payload size {len(payload)} is not a multiple of "
+            f"the record size {rec}")
+    raw = np.frombuffer(payload, np.uint8, n * rec).reshape(n, rec)
+
+    def le_int(cols):
+        v = np.zeros(n, np.uint64)
+        for i in range(cols.shape[1]):
+            v |= cols[:, i].astype(np.uint64) << np.uint64(8 * i)
+        return v
+
+    keys = le_int(raw[:, :kbytes]) & np.uint64((1 << key_len) - 1)
+    counts = le_int(raw[:, kbytes:])
+    khi = (keys >> np.uint64(32)).astype(np.uint32)
+    klo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return khi, klo, counts, key_len // 2
+
+
+def write_jf_binary(path: str, khi, klo, counts, k: int,
+                    counter_len: int = 4) -> None:
+    """Write records in the same layout (testing + producing adapter
+    sets without a Jellyfish build)."""
+    khi = np.asarray(khi, np.uint64)
+    klo = np.asarray(klo, np.uint64)
+    counts = np.asarray(counts, np.uint64)
+    keys = (khi << np.uint64(32)) | klo
+    key_len = 2 * k
+    kbytes = -(-key_len // 8)
+    n = len(keys)
+    rec = np.zeros((n, kbytes + counter_len), np.uint8)
+    for i in range(kbytes):
+        rec[:, i] = ((keys >> np.uint64(8 * i))
+                     & np.uint64(0xFF)).astype(np.uint8)
+    for i in range(counter_len):
+        rec[:, kbytes + i] = ((counts >> np.uint64(8 * i))
+                              & np.uint64(0xFF)).astype(np.uint8)
+    import json
+    header = {
+        "format": FORMATS[0],
+        "key_len": key_len,
+        "counter_len": counter_len,
+        "size": int(max(16, 1 << (max(1, n - 1)).bit_length())),
+        "canonical": True,
+    }
+    with open(path, "wb") as f:
+        f.write(json.dumps(header).encode())
+        f.write(rec.tobytes())

@@ -16,15 +16,19 @@ What we can and cannot do in this environment:
   `db_format.read_header` uses it to give a precise diagnostic when a
   reference-built file is passed to our tools.
 * The payload is Jellyfish's offsets-packed hash-array memory dump —
-  slot words interleave partial keys, reprobe offsets and "large"
-  entries at bit granularity. Jellyfish is not available here (the
-  reference links it externally via pkg-config, configure.ac:28; no
-  sources in-tree, no network), so a decoder could not be validated
-  against a single real file. Rather than ship an unverifiable
-  bit-layout guess, SURVEY §2.1's sanctioned alternative applies: our
-  own format (db_format) carries the same header fields, and this
-  module makes the boundary explicit instead of failing with a JSON
-  parse error.
+  slot words interleave partial keys and reprobe offsets at bit
+  granularity. io/quorum_db implements a full encoder/decoder for that
+  design (round 4): the geometry comes entirely from the header, the
+  matrix is inverted to recover partially-stored keys, and
+  db_format.read_db routes `binary/quorum_db` files through it, so the
+  inspection tools and the corrector accept reference-format files and
+  `quorum_create_database --ref-format` produces them. Jellyfish
+  itself is still not buildable here (external pkg-config dep,
+  configure.ac:28; no network), so the codec is validated by
+  round-trip and header byte-count consistency, NOT by diffing against
+  a Jellyfish-produced file — that residual risk is the documented
+  boundary, and this module keeps giving precise diagnostics for
+  files whose geometry the codec rejects.
 """
 
 from __future__ import annotations

@@ -39,6 +39,7 @@ class BuildConfig:
     initial_size: int = 200_000_000
     max_reprobe: int = 126  # wide-table compatibility (unused by tile)
     batch_size: int = 8192
+    threads: int = 1  # -t: parallel host decode workers (multi-file)
     max_grows: int = 16
     profile: str | None = None  # --profile DIR: jax.profiler trace
 
@@ -95,8 +96,12 @@ def build_database(
 
     if batches is None:
         # host decode/encode overlaps device rounds (double buffering,
-        # the PP row of SURVEY §2.4)
-        batches = prefetch(fastq.read_batches(paths, cfg.batch_size))
+        # the PP row of SURVEY §2.4). H2D stays on the MAIN thread in
+        # the narrow int8/uint8 dtypes: device_put from the prefetch
+        # thread measured slower (tunnel client degrades under
+        # concurrent access; PERF_NOTES.md round 4).
+        batches = prefetch(fastq.read_batches(paths, cfg.batch_size,
+                                              threads=cfg.threads))
     timer = StageTimer()
     with trace(cfg.profile):
         for batch in batches:
@@ -145,7 +150,27 @@ def create_database_main(
     output: str,
     cfg: BuildConfig,
     cmdline: list[str] | None = None,
+    ref_format: bool = False,
+    handoff: dict | None = None,
 ) -> BuildStats:
+    """With `handoff` (a dict), the built device-resident table is
+    stashed as handoff["db"] = (state, meta) so an in-process stage-2
+    can skip re-reading and re-uploading it (the tunnel H2D of a
+    full-size table costs ~0.1 s/MB — ~50 s for a 0.5 GB table — while
+    the reference's equivalent, re-mmapping a page-cached file, is
+    free; quorum.in:154-231 runs both stages over the same file)."""
     state, meta, stats = build_database(paths, cfg)
-    db_format.write_db(output, state, meta, cmdline)
+    if handoff is not None:
+        handoff["db"] = (state, meta)
+    if ref_format:
+        # the reference's own binary/quorum_db on-disk format
+        # (io/quorum_db; mer_database.hpp:115-126)
+        from ..io import quorum_db
+        from ..ops import ctable
+
+        khi, klo, vals = ctable.tile_iterate(state, meta)
+        quorum_db.write_ref_db(output, khi, klo, vals, meta.k, meta.bits,
+                               cmdline=cmdline)
+    else:
+        db_format.write_db(output, state, meta, cmdline)
     return stats

@@ -62,6 +62,8 @@ class ECOptions:
     apriori_error_rate: float = 0.01
     poisson_threshold: float = 1e-6
     batch_size: int = 8192
+    threads: int = 1  # -t: parallel host decode workers (multi-file)
+    no_mmap: bool = False  # -M: slurp the DB instead of memmapping
     profile: str | None = None  # --profile DIR: jax.profiler trace
 
 
@@ -103,7 +105,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                       homo_trim: int | None = None,
                       trim_contaminant: bool = False,
                       no_discard: bool = False,
-                      records=None) -> ECStats:
+                      records=None, db=None) -> ECStats:
     """Run the full stage-2 pipeline. If `cfg_in` is given it overrides
     the individual knobs (library use); otherwise an ECConfig is built
     from the flags plus the DB geometry, with the cutoff resolved per
@@ -114,7 +116,14 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     corrector the way the reference pipes processes together
     (src/quorum.in:172-231)."""
     vlog("Loading mer database")
-    state, meta, _header = db_format.read_db(db_path, to_device=True)
+    if db is not None:
+        # in-process handoff from stage 1: the table is already device
+        # resident (re-uploading a full-size table through the tunnel
+        # costs ~0.1 s/MB; the reference's page-cached re-mmap is free)
+        state, meta = db
+    else:
+        state, meta, _header = db_format.read_db(db_path, to_device=True,
+                                                 no_mmap=opts.no_mmap)
 
     cutoff = resolve_cutoff(state, meta, opts)
     vlog("Using cutoff of ", cutoff)
@@ -149,7 +158,15 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         if records is not None:
             src = fastq.batch_records(records, opts.batch_size)
         else:
-            src = fastq.read_batches(sequences, opts.batch_size)
+            src = fastq.read_batches(sequences, opts.batch_size,
+                                     threads=opts.threads)
+
+        # NOTE: H2D stays on the MAIN thread — device_put from the
+        # prefetch thread measured SLOWER end-to-end (3.2 vs 1.4
+        # s/batch): the tunnel client degrades under concurrent
+        # access, so the prefetch thread does host decode only and
+        # transfers ride the narrow int8/uint8 dtypes instead
+        # (PERF_NOTES.md round 4).
         batches = prefetch(src)
         with trace(opts.profile):
             for batch in batches:
@@ -159,7 +176,8 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                                         contam=contam)
                     jax.block_until_ready(res)
                 with timer.stage("finish"):
-                    results = finish_batch(res, batch.n, cfg)
+                    results = finish_batch(res, batch.n, cfg,
+                                           codes=batch.codes)
                 with timer.stage("render"):
                     fa_parts: list[str] = []
                     log_parts: list[str] = []

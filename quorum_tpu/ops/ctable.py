@@ -755,6 +755,80 @@ def tile_stats(state: TileState, meta: TileMeta):
     return jnp.sum(occ.astype(jnp.int32)), distinct, total
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def tile_compact_device(state: TileState, meta: TileMeta, cap: int):
+    """Device-side entry compaction for the v3 on-disk format: the
+    occupied slots' (bucket address, lo word, hi word), compacted to
+    `cap` lanes. A 30%-occupied table D2Hs ~4-5x fewer bytes than the
+    raw row plane (~0.17 s/MB through the tunnel; PERF_NOTES.md).
+    Returns (addr i32[cap], lo u32[cap], hi u32[cap], n)."""
+    lo = state.rows[:, 0::2]
+    hi = state.rows[:, 1::2]
+    occ = (lo & jnp.uint32(meta.max_val)) != 0
+    flat = occ.ravel()
+    slot = jnp.cumsum(flat.astype(jnp.int32)) - 1
+    n = jnp.sum(flat.astype(jnp.int32))
+    sidx = jnp.where(flat & (slot < cap), slot, cap)
+    rowno = (jnp.arange(flat.shape[0], dtype=jnp.int32) // TSLOTS)
+    addr = jnp.zeros((cap,), jnp.int32).at[sidx].set(rowno, mode="drop")
+    clo = jnp.zeros((cap,), jnp.uint32).at[sidx].set(lo.ravel(),
+                                                     mode="drop")
+    chi = jnp.zeros((cap,), jnp.uint32).at[sidx].set(hi.ravel(),
+                                                     mode="drop")
+    return addr, clo, chi, n
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def tile_rows_device_from_compact(row, col, lo, hi, meta: TileMeta
+                                  ) -> TileState:
+    """Device-side inverse of tile_compact_device: scatter compact
+    entries (precomputed row/col placement) into a fresh row plane.
+    2-D scatter indices — a flat index would overflow int32 at
+    rb_log2=24."""
+    rows = jnp.zeros((meta.rows, TILE), jnp.uint32)
+    rows = rows.at[row, col].set(lo)
+    rows = rows.at[row, col + 1].set(hi)
+    return TileState(rows)
+
+
+def tile_compact_placement(addr) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side slot assignment for compact entries: (row, col) with
+    col = 2 * within-bucket rank (slot order within a bucket is free —
+    lookups compare all 64 slots)."""
+    addr = np.asarray(addr, np.int64)
+    order = np.argsort(addr, kind="stable")
+    a = addr[order]
+    n = len(a)
+    rank = np.zeros(n, np.int64)
+    if n:
+        boundary = np.ones(n, bool)
+        boundary[1:] = a[1:] != a[:-1]
+        seg = np.maximum.accumulate(np.where(boundary, np.arange(n), 0))
+        rank = np.arange(n) - seg
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    return a[inv].astype(np.int32), (2 * rank[inv]).astype(np.int32)
+
+
+def tile_rows_from_compact(addr, lo, hi, meta: TileMeta) -> np.ndarray:
+    """Host-side inverse: rebuild the [rows, 128] plane from compact
+    entries (slot order within a bucket is free — lookups compare all
+    64 slots)."""
+    addr = np.asarray(addr, np.int64)
+    order = np.argsort(addr, kind="stable")
+    a = addr[order]
+    n = len(a)
+    rows = np.zeros((meta.rows, TILE), np.uint32)
+    if n:
+        boundary = np.ones(n, bool)
+        boundary[1:] = a[1:] != a[:-1]
+        seg = np.maximum.accumulate(np.where(boundary, np.arange(n), 0))
+        rank = np.arange(n) - seg
+        rows[a, 2 * rank] = np.asarray(lo, np.uint32)[order]
+        rows[a, 2 * rank + 1] = np.asarray(hi, np.uint32)[order]
+    return rows
+
+
 def tile_iterate(state: TileState, meta: TileMeta):
     """(khi, klo, val) numpy arrays for all occupied entries."""
     rows = np.asarray(state.rows)
@@ -917,6 +991,13 @@ def _tile_round1(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
 def _tile_compact_rounds(bstate: TBuildState, meta: TileMeta, addr, rlo,
                          rhi, p0, hq_add, lq_add, done,
                          rounds: int, cap: int):
+    return _tile_compact_rounds_body(bstate, meta, addr, rlo, rhi, p0,
+                                     hq_add, lq_add, done, rounds, cap)
+
+
+def _tile_compact_rounds_body(bstate: TBuildState, meta: TileMeta, addr,
+                              rlo, rhi, p0, hq_add, lq_add, done,
+                              rounds: int, cap: int):
     """Run the write-verify rounds on COMPACTED unresolved lanes.
 
     After round 1 the unresolved lanes (first-seen keys awaiting their
@@ -971,6 +1052,25 @@ def _tile_parts_jit(meta: TileMeta, khi, klo):
     return addr, rlo, rhi, _preferred_slot(rlo, rhi)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 6, 7), donate_argnums=(0,))
+def _tile_insert_fused(bstate: TBuildState, meta: TileMeta, khi, klo,
+                       qual, valid, rounds: int, cap: int):
+    """parts + prep + round 1 + the first compacted-rounds call as ONE
+    executable: each extra dispatch through the tunnel costs ~25-90 ms
+    (PERF_NOTES.md), and the old flow paid 3-4 per batch plus a
+    mid-path bool() sync."""
+    addr, rlo, rhi = tile_key_parts(khi, klo, meta)
+    p0 = _preferred_slot(rlo, rhi)
+    hq_add, lq_add, done = _prep_obs(qual, valid)
+    bstate, done, _left = _tile_round_body(bstate, meta, addr, rlo, rhi,
+                                           p0, hq_add, lq_add, done)
+    bstate, done, n_failed, n_unfit = _tile_compact_rounds_body(
+        bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
+        rounds, cap)
+    return bstate, (addr, rlo, rhi, p0, hq_add, lq_add), done, \
+        n_failed, n_unfit
+
+
 def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
                              qual, valid, max_rounds: int = 24):
     """Insert a flat batch of raw (canonical k-mer, quality-bit)
@@ -982,14 +1082,21 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
     its bucket; matches retire by scatter-add, absent keys write their
     tags), then the surviving minority — verify-pending writers and
     race losers — run compacted at 1/8 width with all remaining rounds
-    fused into one device while_loop (see _tile_compact_rounds)."""
-    addr, rlo, rhi, p0 = _tile_parts_jit(meta, khi, klo)
-    hq_add, lq_add, done = _prep_obs(qual, valid)
-    bstate, done, left = _tile_round1(bstate, meta, addr, rlo, rhi, p0,
-                                      hq_add, lq_add, done)
-    if bool(left):
-        n = int(addr.shape[0])
-        cap = min(n, max(1024, n // 8))
+    fused into one device while_loop (see _tile_compact_rounds). The
+    whole steady-state path is ONE dispatch (_tile_insert_fused); only
+    batches whose survivors overflow the compaction cap (early batches
+    of a fresh table, where every key is first-seen) pay extra
+    compacted calls."""
+    n = int(khi.shape[0])
+    cap = min(n, max(1024, n // 8))
+    bstate, parts, done, n_failed, n_unfit = _tile_insert_fused(
+        bstate, meta, khi, klo, qual, valid, max_rounds - 1, cap)
+    # ONE scalar D2H for both counters (each sync costs a tunnel
+    # round trip)
+    n_failed, n_unfit = (int(x) for x in
+                         np.asarray(jnp.stack([n_failed, n_unfit])))
+    if n_failed == 0 and n_unfit > 0:
+        addr, rlo, rhi, p0, hq_add, lq_add = parts
         # each call resolves up to cap survivors; n/cap + 1 calls cover
         # even the everyone-survives worst case. Any lane still ~done
         # at exit (bucket full, or the unreachable bound exhaustion)
@@ -998,7 +1105,10 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
             bstate, done, n_failed, n_unfit = _tile_compact_rounds(
                 bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
                 max_rounds - 1, cap)
-            if int(n_failed) > 0 or int(n_unfit) == 0:
+            n_failed, n_unfit = (int(x) for x in
+                                 np.asarray(jnp.stack([n_failed,
+                                                       n_unfit])))
+            if n_failed > 0 or n_unfit == 0:
                 break
     full, placed = _finish_obs(done, valid)
     return bstate, bool(full), placed

@@ -19,6 +19,34 @@ import jax  # noqa: E402
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
+# Tests must NOT share the persistent compile cache with TPU-tunnel
+# processes: the tunnel's AOT helper caches CPU executables compiled
+# with ITS machine features, and loading them here warns "Machine type
+# ... doesn't match ... could lead to execution errors such as SIGILL"
+# — observed as Fatal aborts late in full-suite runs (round 4). The
+# CLI entry points call jaxcache.enable_cache(), which respects an
+# already-configured dir, so pin a test-local one first.
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/quorum_tpu_test_jaxcache")
+
+import pytest  # noqa: E402
+
+_last_module = [None]
+
+
+@pytest.fixture(autouse=True)
+def _clear_jax_caches_between_modules(request):
+    """The suite compiles hundreds of CPU executables; letting them
+    accumulate for the whole session has produced allocator aborts
+    near the end of full runs (round 4). Dropping jax's caches at each
+    module boundary bounds live executables at the cost of
+    recompiling shared helpers per module."""
+    mod = request.node.nodeid.split("::", 1)[0]
+    if _last_module[0] is not None and _last_module[0] != mod:
+        jax.clear_caches()
+    _last_module[0] = mod
+    yield
+
 
 def cpu_devices(n=None):
     devs = jax.devices("cpu")

@@ -86,16 +86,22 @@ def _assert_same(a, b):
 
 def test_planes_actually_teleport(batch):
     """The fixture must genuinely exercise the fast path: most
-    positions provably clean."""
+    positions provably clean, and the ambig pre-pass must cover a
+    nonempty set of positions."""
     codes, quals, state, meta = batch
     cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    codes32 = jnp.asarray(codes, jnp.int32)
     sweep = corrector._position_sweep(
-        state, meta, jnp.asarray(codes, jnp.int32), cfg,
-        *corrector._dummy_contam(K), False)
+        state, meta, codes32, cfg, *corrector._dummy_contam(K), False)
     lengths = jnp.full((B,), RLEN, jnp.int32)
-    planes = corrector._event_planes(sweep, lengths, cfg, RLEN, RLEN)
-    clean = np.asarray(planes.clean)[:, K - 1:]
+    start_off = jnp.full((B,), K + 1, jnp.int32)
+    planes = corrector._event_planes(
+        state, meta, sweep, codes32, jnp.asarray(quals, jnp.int32),
+        lengths, start_off, cfg, RLEN, max(256, (B * RLEN) // 16))
+    clean = np.asarray(planes.clean)[:B, K - 1:]
     assert clean.mean() > 0.5, f"fixture too dirty ({clean.mean():.2f})"
+    pre = (np.asarray(planes.aux) >> corrector._AX_PRE) & 1
+    assert pre.sum() > 0, "ambig pre-pass covered nothing"
 
 
 def test_event_parity(batch):
@@ -105,6 +111,22 @@ def test_event_parity(batch):
 def test_event_parity_tiny_ambig_cap(batch):
     """ambig-cap stalls interleaved with backscan stalls."""
     _assert_same(_run(batch, True, ambig_cap=1), _run(batch, False))
+
+
+@pytest.mark.parametrize("homo", [None, 2])
+def test_finish_lean_parity(batch, homo):
+    """The lean finish path (no seq plane, compacted entries) must
+    produce identical ReadResults to the packed-plane path, including
+    under homo-trim entry edits."""
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32", homo_trim=homo)
+    lengths = jnp.full((B,), RLEN, jnp.int32)
+    res = corrector.correct_batch(state, meta, jnp.asarray(codes),
+                                  jnp.asarray(quals), lengths, cfg,
+                                  event_driven=True)
+    wide = corrector.finish_batch(res, B, cfg)
+    lean = corrector.finish_batch(res, B, cfg, codes=codes)
+    assert wide == lean
 
 
 def test_event_parity_variable_lengths(batch):

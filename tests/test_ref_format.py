@@ -1,0 +1,184 @@
+"""Reference on-disk format codecs (round 4, VERDICT missing #1/#2):
+binary/quorum_db round-trip through the offsets-packed layout
+(io/quorum_db) and Jellyfish binary_dumper record files (io/jf_binary),
+wired into read_db, the inspection CLIs, and --contaminant."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from quorum_tpu.io import db_format, jf_binary, quorum_db
+from quorum_tpu.ops import ctable, mer
+
+
+def _rand_entries(rng, n, k):
+    """n distinct canonical keys with nonzero value words."""
+    seen = {}
+    while len(seen) < n:
+        codes = rng.integers(0, 4, size=k)
+        hi, lo = mer.pack_kmer("".join("ACGT"[c] for c in codes), k)
+        chi, clo = mer.canonical_py(hi, lo, k)
+        seen[(chi, clo)] = rng.integers(1, 1 << 8)
+    keys = list(seen)
+    khi = np.array([h for h, _ in keys], np.uint32)
+    klo = np.array([lo_ for _, lo_ in keys], np.uint32)
+    vals = np.array([seen[kk] for kk in keys], np.uint32)
+    return khi, klo, vals
+
+
+@pytest.mark.parametrize("k,n", [(24, 500), (15, 64), (31, 200)])
+def test_quorum_db_roundtrip(tmp_path, k, n):
+    rng = np.random.default_rng(k * 1000 + n)
+    khi, klo, vals = _rand_entries(rng, n, k)
+    path = str(tmp_path / "db.jf")
+    quorum_db.write_ref_db(path, khi, klo, vals, k, bits=7)
+    rhi, rlo, rvals, rk, rbits = quorum_db.read_ref_db(path)
+    assert (rk, rbits) == (k, 7)
+    got = {(int(h), int(lo)): int(v) for h, lo, v in zip(rhi, rlo, rvals)}
+    want = {(int(h), int(lo)): int(v) & 0xFF
+            for h, lo, v in zip(khi, klo, vals)}
+    assert got == want
+
+
+def test_quorum_db_collision_pressure(tmp_path):
+    """A small table under heavy load exercises deep reprobe chains
+    and the grow-on-placement-failure path."""
+    k = 24
+    rng = np.random.default_rng(7)
+    khi, klo, vals = _rand_entries(rng, 3000, k)
+    path = str(tmp_path / "db.jf")
+    quorum_db.write_ref_db(path, khi, klo, vals, k, bits=7, min_fill=0.99)
+    rhi, rlo, rvals, _, _ = quorum_db.read_ref_db(path)
+    assert len(rhi) == 3000
+    got = {(int(h), int(lo)): int(v) for h, lo, v in zip(rhi, rlo, rvals)}
+    want = {(int(h), int(lo)): int(v) & 0xFF
+            for h, lo, v in zip(khi, klo, vals)}
+    assert got == want
+
+
+def test_quorum_db_header_contract(tmp_path):
+    """Header carries every field database_query consumes
+    (mer_database.hpp:270-278) and the byte counts match the payload."""
+    import os
+
+    from quorum_tpu.io.ref_db import parse_jf_header
+
+    k = 24
+    rng = np.random.default_rng(1)
+    khi, klo, vals = _rand_entries(rng, 100, k)
+    path = str(tmp_path / "db.jf")
+    quorum_db.write_ref_db(path, khi, klo, vals, k, bits=7,
+                           cmdline=["quorum_create_database", "x"])
+    with open(path, "rb") as f:
+        data = f.read()
+    header, off = parse_jf_header(data)
+    for field in ("format", "size", "key_len", "val_len", "max_reprobe",
+                  "matrix", "bits", "key_bytes", "value_bytes"):
+        assert field in header, field
+    assert header["format"] == "binary/quorum_db"
+    assert header["key_len"] == 2 * k
+    assert os.path.getsize(path) == (off + header["key_bytes"]
+                                     + header["value_bytes"])
+
+
+def test_read_db_accepts_ref_format(tmp_path):
+    """read_db transparently decodes reference-format files into the
+    tile layout; lookups agree."""
+    k = 24
+    rng = np.random.default_rng(3)
+    khi, klo, vals = _rand_entries(rng, 300, k)
+    path = str(tmp_path / "db.jf")
+    quorum_db.write_ref_db(path, khi, klo, vals, k, bits=7)
+    state, meta, header = db_format.read_db(path, to_device=False)
+    assert isinstance(meta, ctable.TileMeta)
+    for h, lo, v in zip(khi[:50], klo[:50], vals[:50]):
+        assert db_format.db_lookup_np(state, meta, int(h), int(lo)) \
+            == int(v) & 0xFF
+
+
+def test_tools_read_ref_format(tmp_path):
+    """query_mer_database and histo_mer_database accept reference
+    files and agree with the native-format outputs."""
+    k = 24
+    rng = np.random.default_rng(5)
+    khi, klo, vals = _rand_entries(rng, 200, k)
+    ref = str(tmp_path / "ref.jf")
+    quorum_db.write_ref_db(ref, khi, klo, vals, k, bits=7)
+    mers = [mer.unpack_kmer(int(h), int(lo), k)
+            for h, lo in zip(khi[:5], klo[:5])]
+    out = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.query_mer_database",
+         ref, *mers], capture_output=True, text=True, check=True).stdout
+    for m, h, lo, v in zip(mers, khi, klo, vals):
+        assert f"val:{int(v) >> 1} qual:{int(v) & 1}" in out
+        assert m in out
+    histo = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.histo_mer_database", ref],
+        capture_output=True, text=True, check=True).stdout
+    assert histo.strip(), "histo produced nothing"
+
+
+def test_jf_binary_roundtrip(tmp_path):
+    k = 24
+    rng = np.random.default_rng(11)
+    khi, klo, vals = _rand_entries(rng, 150, k)
+    path = str(tmp_path / "adapter.jf")
+    jf_binary.write_jf_binary(path, khi, klo, vals, k)
+    assert jf_binary.is_jf_binary(path)
+    rhi, rlo, counts, rk = jf_binary.read_jf_binary(path)
+    assert rk == k
+    assert set(zip(rhi.tolist(), rlo.tolist())) \
+        == set(zip(khi.tolist(), klo.tolist()))
+
+
+def test_contaminant_accepts_jf_binary(tmp_path):
+    """--contaminant with a binary_dumper adapter DB: member k-mers
+    hit, others miss, and a k mismatch dies with the reference
+    message."""
+    from quorum_tpu.io.contaminant import load_contaminant
+
+    k = 9
+    rng = np.random.default_rng(13)
+    khi, klo, vals = _rand_entries(rng, 40, k)
+    path = str(tmp_path / "adapter.jf")
+    jf_binary.write_jf_binary(path, khi, klo, vals, k)
+    state, meta = load_contaminant(path, k)
+    for h, lo in zip(khi[:10], klo[:10]):
+        assert db_format.db_lookup_np(state, meta, int(h), int(lo)) != 0
+    miss_hi, miss_lo, _ = _rand_entries(rng, 5, k)
+    member = set(zip(khi.tolist(), klo.tolist()))
+    for h, lo in zip(miss_hi, miss_lo):
+        if (int(h), int(lo)) in member:
+            continue
+        assert db_format.db_lookup_np(state, meta, int(h), int(lo)) == 0
+    with pytest.raises(ValueError, match="Contaminant mer length"):
+        load_contaminant(path, k + 1)
+
+
+def test_create_database_ref_format(tmp_path):
+    """--ref-format end to end: build a DB from FASTQ, write the
+    reference format, read it back and compare with the native file."""
+    rng = np.random.default_rng(17)
+    fq = tmp_path / "reads.fastq"
+    with open(fq, "w") as f:
+        for i in range(60):
+            seq = "".join("ACGT"[c] for c in rng.integers(0, 4, size=60))
+            f.write(f"@r{i}\n{seq}\n+\n{'F' * 60}\n")
+    from quorum_tpu.cli import create_database as cdb
+
+    nat = str(tmp_path / "nat.qdb")
+    ref = str(tmp_path / "ref.jf")
+    args = ["-s", "100k", "-m", "15", "-b", "7", "-q", "38"]
+    assert cdb.main([*args, "-o", nat, str(fq)]) == 0
+    assert cdb.main([*args, "-o", ref, "--ref-format", str(fq)]) == 0
+    ns, nm, _ = db_format.read_db(nat, to_device=False)
+    nkhi, nklo, nvals = db_format.db_iterate(ns, nm)
+    rhi, rlo, rvals, rk, rbits = quorum_db.read_ref_db(ref)
+    assert (rk, rbits) == (15, 7)
+    nat_d = {(int(h), int(lo)): int(v)
+             for h, lo, v in zip(nkhi, nklo, nvals)}
+    ref_d = {(int(h), int(lo)): int(v)
+             for h, lo, v in zip(rhi, rlo, rvals)}
+    assert nat_d == ref_d
