@@ -44,24 +44,9 @@ class BuildConfig:
     profile: str | None = None  # --profile DIR: jax.profiler trace
 
 
-def extract_observations_impl(codes_i8, quals_u8, k: int, qual_thresh: int):
-    """codes/quals [B, L] -> flat canonical k-mer observations.
-
-    Returns (chi, clo, qualbit, valid), each [B*L]. qualbit is 1 iff all
-    k bases of the window have quality >= qual_thresh (high_len >= k,
-    create_database.cc:80-86); valid iff the window holds k consecutive
-    ACGT bases. Unjitted so the sharded build can call it under
-    shard_map; use `extract_observations` elsewhere.
-    """
-    codes = codes_i8.astype(jnp.int32)
-    B, L = codes.shape
-    fhi, flo, rhi, rlo, valid = mer.rolling_kmers(codes, k)
-    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
-    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
-    reset = (codes < 0) | (quals_u8.astype(jnp.int32) < qual_thresh)
-    last_reset = jax.lax.cummax(jnp.where(reset, pos, -1), axis=1)
-    qualbit = ((pos - last_reset) >= k).astype(jnp.int32)
-    return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
+# canonical home is ops/ctable (so the fused stage-1 dispatch can use
+# it); re-exported here for the sharded builds and tests
+extract_observations_impl = ctable.extract_observations_impl
 
 
 extract_observations = jax.jit(extract_observations_impl,
@@ -110,28 +95,30 @@ def build_database(
             nb = int(batch.lengths.sum())
             stats.bases += nb
             timer.add_units("insert", nb)
-            with timer.stage("extract"):
-                chi, clo, q, valid = extract_observations(
-                    jnp.asarray(batch.codes), jnp.asarray(batch.quals),
-                    cfg.k, cfg.qual_thresh,
-                )
-                jax.block_until_ready(valid)
             with timer.stage("insert"):
-                pending = valid
+                # ONE dispatch: extract + insert fused
+                bstate, full, (chi, clo, q, valid, placed) = \
+                    ctable.tile_insert_reads(
+                        bstate, meta, jnp.asarray(batch.codes),
+                        jnp.asarray(batch.quals), cfg.qual_thresh)
+                if full:
+                    pending = jnp.logical_and(valid,
+                                              jnp.logical_not(placed))
                 for _ in range(cfg.max_grows + 1):
-                    bstate, full, placed = ctable.tile_insert_observations(
-                        bstate, meta, chi, clo, q, pending
-                    )
                     if not full:
                         break
-                    pending = jnp.logical_and(pending,
-                                              jnp.logical_not(placed))
                     vlog("Hash table full at ", meta.rows,
                          " buckets; doubling")
                     bstate, meta = ctable.tile_grow_build(bstate, meta)
                     stats.grows += 1
+                    bstate, full, placed = ctable.tile_insert_observations(
+                        bstate, meta, chi, clo, q, pending
+                    )
+                    pending = jnp.logical_and(pending,
+                                              jnp.logical_not(placed))
                 else:
-                    raise RuntimeError("Hash is full")
+                    if full:
+                        raise RuntimeError("Hash is full")
     timer.report(stats.bases)
     if bool(ctable.tile_dup_check(bstate, meta)):  # pragma: no cover
         raise RuntimeError(

@@ -1046,6 +1046,92 @@ def _tile_compact_rounds_body(bstate: TBuildState, meta: TileMeta, addr,
     return bstate, done, n_failed, n_unfit
 
 
+def extract_observations_impl(codes_i8, quals_u8, k: int,
+                              qual_thresh: int):
+    """codes/quals [B, L] -> flat canonical k-mer observations.
+
+    Returns (chi, clo, qualbit, valid), each [B*L]. qualbit is 1 iff
+    all k bases of the window have quality >= qual_thresh (high_len >=
+    k, create_database.cc:80-86); valid iff the window holds k
+    consecutive ACGT bases. Lives here (not models/) so the fused
+    insert below can extract and insert in ONE dispatch; unjitted so
+    the sharded builds can call it under shard_map."""
+    from . import mer
+
+    codes = codes_i8.astype(jnp.int32)
+    B, L = codes.shape
+    fhi, flo, rhi, rlo, valid = mer.rolling_kmers(codes, k)
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    reset = (codes < 0) | (quals_u8.astype(jnp.int32) < qual_thresh)
+    last_reset = jax.lax.cummax(jnp.where(reset, pos, -1), axis=1)
+    qualbit = ((pos - last_reset) >= k).astype(jnp.int32)
+    return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 5, 6),
+                   donate_argnums=(0,))
+def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
+                             codes_i8, quals_u8, qual_thresh: int,
+                             rounds: int, cap: int):
+    """extract + parts + round 1 + compacted rounds as ONE executable
+    (each extra dispatch costs ~25-90 ms through the tunnel)."""
+    chi, clo, qual, valid = extract_observations_impl(
+        codes_i8, quals_u8, meta.k, qual_thresh)
+    addr, rlo, rhi = tile_key_parts(chi, clo, meta)
+    p0 = _preferred_slot(rlo, rhi)
+    hq_add, lq_add, done = _prep_obs(qual, valid)
+    bstate, done, _left = _tile_round_body(bstate, meta, addr, rlo, rhi,
+                                           p0, hq_add, lq_add, done)
+    bstate, done, n_failed, n_unfit = _tile_compact_rounds_body(
+        bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
+        rounds, cap)
+    return bstate, (chi, clo, qual, valid), done, n_failed, n_unfit
+
+
+def _drain_survivors(bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add,
+                     done, max_rounds: int, cap: int, n: int):
+    """Host loop over compacted verify-round calls until every lane
+    resolves or genuinely fails; shared by both insert entry points.
+    One fused scalar D2H per call (tunnel round trips are ~25-90 ms)."""
+    n_failed = n_unfit = 0
+    for _ in range(-(-n // cap) + 1):
+        bstate, done, n_failed, n_unfit = _tile_compact_rounds(
+            bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
+            max_rounds - 1, cap)
+        n_failed, n_unfit = (int(x) for x in
+                             np.asarray(jnp.stack([n_failed, n_unfit])))
+        if n_failed > 0 or n_unfit == 0:
+            break
+    return bstate, done
+
+
+def tile_insert_reads(bstate: TBuildState, meta: TileMeta, codes_i8,
+                      quals_u8, qual_thresh: int, max_rounds: int = 24):
+    """One-dispatch steady-state stage-1 batch: extract observations
+    AND insert them. Returns (bstate, full, (chi, clo, qual, valid,
+    placed)) — on full the caller grows and retries the returned
+    observations via tile_insert_observations with pending =
+    valid & ~placed (exact-once)."""
+    b, l = codes_i8.shape
+    n = b * l
+    cap = min(n, max(1024, n // 8))
+    bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused(
+        bstate, meta, codes_i8, quals_u8, qual_thresh, max_rounds - 1,
+        cap)
+    chi, clo, qual, valid = obs
+    n_failed, n_unfit = (int(x) for x in
+                         np.asarray(jnp.stack([n_failed, n_unfit])))
+    if n_failed == 0 and n_unfit > 0:
+        addr, rlo, rhi, p0 = _tile_parts_jit(meta, chi, clo)
+        hq_add, lq_add, _d0 = _prep_obs(qual, valid)
+        bstate, done = _drain_survivors(bstate, meta, addr, rlo, rhi, p0,
+                                        hq_add, lq_add, done, max_rounds,
+                                        cap, n)
+    full, placed = _finish_obs(done, valid)
+    return bstate, bool(full), (chi, clo, qual, valid, placed)
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _tile_parts_jit(meta: TileMeta, khi, klo):
     addr, rlo, rhi = tile_key_parts(khi, klo, meta)
@@ -1097,19 +1183,9 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
                          np.asarray(jnp.stack([n_failed, n_unfit])))
     if n_failed == 0 and n_unfit > 0:
         addr, rlo, rhi, p0, hq_add, lq_add = parts
-        # each call resolves up to cap survivors; n/cap + 1 calls cover
-        # even the everyone-survives worst case. Any lane still ~done
-        # at exit (bucket full, or the unreachable bound exhaustion)
-        # surfaces through _finish_obs as full.
-        for _ in range(-(-n // cap) + 1):
-            bstate, done, n_failed, n_unfit = _tile_compact_rounds(
-                bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
-                max_rounds - 1, cap)
-            n_failed, n_unfit = (int(x) for x in
-                                 np.asarray(jnp.stack([n_failed,
-                                                       n_unfit])))
-            if n_failed > 0 or n_unfit == 0:
-                break
+        bstate, done = _drain_survivors(bstate, meta, addr, rlo, rhi, p0,
+                                        hq_add, lq_add, done, max_rounds,
+                                        cap, n)
     full, placed = _finish_obs(done, valid)
     return bstate, bool(full), placed
 
