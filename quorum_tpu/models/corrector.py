@@ -1296,7 +1296,7 @@ def _event_planes(state, tmeta, sweep: SweepResult, codes32, quals32,
 def correct_batch(state: table.TableState, tmeta: table.TableMeta,
                   codes, quals, lengths, cfg: ECConfig,
                   contam=None, ambig_cap: int | None = None,
-                  event_driven: bool = True) -> BatchResult:
+                  event_driven: bool = True, pack_cap: int | None = None):
     """Correct a batch of reads on device. `contam` is an optional
     (TableState, TableMeta) k-mer membership set (value word != 0).
     Mirrors error_correct_instance::start (error_correct_reads.cc:
@@ -1343,13 +1343,14 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
         ambig_cap = max(256, (2 * codes.shape[0]) // 8)
     return _correct_device(state, tmeta, codes, quals, lengths, cfg,
                            cstate, cmeta, has_contam, uniform, ambig_cap,
-                           event_driven)
+                           event_driven, pack_cap)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11))
+@functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11, 12))
 def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
                     cstate, cmeta, has_contam: bool, uniform: int | None,
-                    ambig_cap: int, event_driven: bool) -> BatchResult:
+                    ambig_cap: int, event_driven: bool,
+                    pack_cap: int | None = None):
     """The whole device-side correction of one batch as ONE executable:
     position sweep, anchor scan, rc prologue, event planes, the merged
     extension loop, and the backward epilogue (separate dispatches cost
@@ -1394,7 +1395,13 @@ def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
         res.out[:b], res.status[:b], res.out[b:], res.opos[b:],
         res.status[b:], lengths, anc.start_off - cfg.k - 1, blog_rc,
         uniform)
-    return BatchResult(out, start, res.opos[:b], status, flog, blog)
+    result = BatchResult(out, start, res.opos[:b], status, flog, blog)
+    if pack_cap is not None:
+        # the lean finish buffer fused into the SAME executable: one
+        # dispatch instead of two per batch (each costs ~25-90 ms
+        # through the tunnel)
+        return result, _pack_finish_lean(result, pack_cap)
+    return result
 
 
 
@@ -1639,7 +1646,7 @@ def _finish_host(n: int, l: int, cfg: ECConfig, seq_ascii, start, end,
 
 
 def finish_batch(res: BatchResult, n: int, cfg: ECConfig,
-                 codes=None) -> list[ReadResult]:
+                 codes=None, packed=None) -> list[ReadResult]:
     """Host post-processing: optional homo-trim, log rendering, and
     ReadResult assembly (same shape as the oracle's results).
 
@@ -1664,11 +1671,18 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig,
     if codes is not None:
         # the buffer's leading geometry scalars replace a separate
         # scalar D2H; the entry capacity guess self-tunes per shape
-        # and a rare overflow re-packs once with the exact size
+        # and a rare overflow re-packs once with the exact size.
+        # `packed` is the same buffer already produced INSIDE the
+        # correction executable (correct_batch(pack_cap=...)) — one
+        # dispatch instead of two.
         b = res.out.shape[0]
         key = (b, maxe)
-        cap_e = _LEAN_CAP_CACHE.get(key, 16384)
-        buf = np.asarray(_pack_finish_lean(res, cap_e))
+        if packed is not None:
+            buf = np.asarray(packed)
+            cap_e = len(buf) - 2 - 3 * b
+        else:
+            cap_e = _LEAN_CAP_CACHE.get(key, 16384)
+            buf = np.asarray(_pack_finish_lean(res, cap_e))
         maxn, total = int(buf[0]), int(buf[1])
         if maxn > maxe:
             raise RuntimeError(
@@ -1678,10 +1692,13 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig,
             while cap_e < total:
                 cap_e *= 2
             buf = np.asarray(_pack_finish_lean(res, cap_e))
-        # monotone per shape: a shrinking guess would re-pack every
-        # other batch when totals straddle a pow2 boundary
-        _LEAN_CAP_CACHE[key] = max(
-            cap_e, 4096, 1 << (max(1, total) - 1).bit_length())
+        if packed is None:
+            # monotone per shape: a shrinking guess would re-pack
+            # every other batch when totals straddle a pow2 boundary.
+            # (Not updated on the prepacked path — its cap is the
+            # caller's fixed choice, not a tuned guess.)
+            _LEAN_CAP_CACHE[key] = max(
+                cap_e, 4096, 1 << (max(1, total) - 1).bit_length())
         buf = buf[2:]
         h1, h2, h3 = buf[:b], buf[b:2 * b], buf[2 * b:3 * b]
         flat = buf[3 * b:]

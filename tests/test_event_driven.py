@@ -117,7 +117,10 @@ def test_event_parity_tiny_ambig_cap(batch):
 def test_finish_lean_parity(batch, homo):
     """The lean finish path (no seq plane, compacted entries) must
     produce identical ReadResults to the packed-plane path, including
-    under homo-trim entry edits."""
+    under homo-trim entry edits — and the FUSED pack (the buffer
+    produced inside the correction executable, the production CLI
+    path) must match too, including with a cap that forces the
+    overflow re-pack."""
     codes, quals, state, meta = batch
     cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32", homo_trim=homo)
     lengths = jnp.full((B,), RLEN, jnp.int32)
@@ -127,6 +130,19 @@ def test_finish_lean_parity(batch, homo):
     wide = corrector.finish_batch(res, B, cfg)
     lean = corrector.finish_batch(res, B, cfg, codes=codes)
     assert wide == lean
+    res2, packed = corrector.correct_batch(
+        state, meta, jnp.asarray(codes), jnp.asarray(quals), lengths,
+        cfg, event_driven=True, pack_cap=4 * B)
+    fused = corrector.finish_batch(res2, B, cfg, codes=codes,
+                                   packed=packed)
+    assert wide == fused
+    # a too-small fused cap must trigger the exact-size re-pack
+    res3, packed3 = corrector.correct_batch(
+        state, meta, jnp.asarray(codes), jnp.asarray(quals), lengths,
+        cfg, event_driven=True, pack_cap=8)
+    small = corrector.finish_batch(res3, B, cfg, codes=codes,
+                                   packed=packed3)
+    assert wide == small
 
 
 def test_event_parity_variable_lengths(batch):
