@@ -44,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None, handoff: dict | None = None) -> int:
+def main(argv=None, handoff: dict | None = None, batches=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
@@ -83,7 +83,7 @@ def main(argv=None, handoff: dict | None = None) -> int:
         create_database_main(args.reads, args.output, cfg,
                              cmdline=list(sys.argv),
                              ref_format=args.ref_format,
-                             handoff=handoff)
+                             handoff=handoff, batches=batches)
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
         return 1

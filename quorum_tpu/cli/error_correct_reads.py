@@ -70,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None, db=None) -> int:
+def main(argv=None, db=None, prepacked=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
@@ -115,7 +115,7 @@ def main(argv=None, db=None) -> int:
             anchor_count=args.anchor_count, min_count=args.min_count,
             window=args.window, error=args.error, homo_trim=args.homo_trim,
             trim_contaminant=args.trim_contaminant,
-            no_discard=args.no_discard, db=db,
+            no_discard=args.no_discard, db=db, prepacked=prepacked,
         )
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)

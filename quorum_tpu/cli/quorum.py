@@ -17,8 +17,32 @@ import os
 import re
 import sys
 
-from ..io import fastq
+import dataclasses
+
+from ..io import fastq, packing
 from ..models.error_correct import ECOptions, run_error_correct
+
+# EC's default quality cutoff when the driver passes no -q/-Q to it —
+# numeric_limits<char>::max(), matching the reference driver which
+# never forwards a qual cutoff (quorum.in:160-171)
+_EC_QUAL_CUTOFF = 127
+
+# Replay-cache budget: the driver keeps stage 1's decoded+packed
+# batches in RAM so stage 2 skips the second parse (the reference gets
+# this for free from the page cache, quorum.in:154-231). Beyond the
+# budget the cache is dropped and stage 2 re-reads from disk.
+# QUORUM_REPLAY_CACHE_BYTES accepts k/M/G/T suffixes (utils/sizes).
+def _replay_cap() -> int:
+    from ..utils.sizes import parse_size
+    raw = os.environ.get("QUORUM_REPLAY_CACHE_BYTES")
+    if raw is None:
+        return 6 * 1024 ** 3
+    try:
+        return parse_size(raw)
+    except (ValueError, TypeError):
+        print(f"Ignoring invalid QUORUM_REPLAY_CACHE_BYTES={raw!r}",
+              file=sys.stderr)
+        return 6 * 1024 ** 3
 from ..utils import vlog as vlog_mod
 from ..utils.vlog import vlog
 from . import create_database as cdb_cli
@@ -132,24 +156,73 @@ def main(argv=None) -> int:
             return 1
     vlog("Using min quality char ", min_q_char, " (+", args.min_quality, ")")
 
-    # Stage 1: quorum_create_database -s SIZE -m K -q char+qual -b 7
-    # (quorum.in:154-160)
+    # CPU-count autodetect, like the reference driver's /proc/cpuinfo
+    # scan (quorum.in:110-120); forwarded to both stages' host decode
+    threads = args.threads if args.threads else (os.cpu_count() or 1)
+    vlog("Using ", threads, " threads for host decode")
+
+    # Stage 1: quorum_create_database -s SIZE -m K -q char+qual -t N
+    # -b 7 (quorum.in:154-160)
     db_file = args.prefix + "_mer_database.jf"
     cdb_argv = ["-s", args.size, "-m", str(args.kmer_len),
                 "-q", str(min_q_char + args.min_quality), "-b", "7",
+                "-t", str(threads),
                 "-o", db_file, "--batch-size", str(args.batch_size)]
     if args.debug:
         cdb_argv.append("-v")
         print("+ quorum_create_database " + " ".join(cdb_argv)
               + " " + " ".join(args.reads), file=sys.stderr)
+
+    # Parse + pack the reads ONCE for both stages (unpaired mode):
+    # stage 1 consumes this generator; every yielded (batch, packed)
+    # pair is retained (packed with both stages' quality thresholds)
+    # and replayed into stage 2, sparing the second disk parse + H2D
+    # re-pack that the two-process reference gets from the page cache.
+    reads_cache: list = []
+    cache_state = {"bytes": 0, "ok": not args.paired_files}
+
+    def _cached_batches():
+        from ..utils.pipeline import prefetch
+        t1 = min_q_char + args.min_quality
+        src = fastq.read_batches(args.reads, args.batch_size,
+                                 threads=threads)
+
+        def _pack_and_keep(it):
+            for b in it:
+                # the EC qual plane is only packed while the replay
+                # cache is live (paired mode / overflowed runs would
+                # never consume it)
+                ts = (t1, _EC_QUAL_CUTOFF) if cache_state["ok"] else (t1,)
+                pk = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                        thresholds=ts)
+                # stage 2 never touches host quals (only the packed
+                # plane); drop them from the cached copy
+                item = (dataclasses.replace(b, quals=None), pk)
+                if cache_state["ok"]:
+                    # count the retained headers too (~90 B of str +
+                    # list-slot overhead each), not just the arrays
+                    cache_state["bytes"] += (
+                        b.codes.nbytes + pk.nbytes
+                        + sum(len(h) + 90 for h in b.headers))
+                    if cache_state["bytes"] > _replay_cap():
+                        cache_state["ok"] = False
+                        reads_cache.clear()
+                    else:
+                        reads_cache.append(item)
+                yield item
+        return prefetch(_pack_and_keep(src))
+
     handoff: dict = {}
-    if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff) != 0:
+    if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff,
+                    batches=_cached_batches()) != 0:
         print("Creating the mer database failed. Most likely the size "
               "passed to the -s switch is too small.", file=sys.stderr)
         return 1
+    prepacked = reads_cache if cache_state["ok"] and reads_cache else None
 
     # Stage 2: error correction (quorum.in:162-231)
-    ec_common = ["--batch-size", str(args.batch_size)]
+    ec_common = ["--batch-size", str(args.batch_size),
+                 "-t", str(threads)]
     for flag, val in (("--min-count", args.min_count),
                       ("--skip", args.skip),
                       ("--good", args.anchor),
@@ -173,7 +246,8 @@ def main(argv=None) -> int:
         if args.debug:
             print("+ quorum_error_correct_reads " + " ".join(ec_argv),
                   file=sys.stderr)
-        if ec_cli.main(ec_argv, db=handoff.get("db")) != 0:
+        if ec_cli.main(ec_argv, db=handoff.get("db"),
+                       prepacked=prepacked) != 0:
             print("Error correction failed", file=sys.stderr)
             return 1
         return 0
@@ -187,7 +261,7 @@ def main(argv=None) -> int:
               f"{db_file} /dev/fd/0 | split_mate_pairs {args.prefix}",
               file=sys.stderr)
     opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
-                     batch_size=args.batch_size)
+                     batch_size=args.batch_size, threads=threads)
     kwargs = dict(no_discard=True,
                   trim_contaminant=args.trim_contaminant)
     for key, val in (("min_count", args.min_count), ("skip", args.skip),

@@ -1,0 +1,95 @@
+"""Bit-packed read transport for the host->device link.
+
+The corrector consumes quality ONLY as the predicate
+``qual >= qual_cutoff`` (models/corrector.py: the three uses) and the
+database builder only as ``qual < qual_thresh``
+(ops/ctable.extract_observations_impl); the reference does the same —
+quality chars are compared against one threshold in both binaries
+(src/create_database.cc:80-84 `*q++ >= args.min_qual_arg`,
+src/error_correct_reads.cc:440-444 `qual >= qual_cutoff`). So the
+wire format between host parser and device needs only:
+
+  * 2 bits/base of sequence (A/C/G/T),
+  * 1 bit/base "this position is a non-ACGT base" (N mask),
+  * 1 bit/base per quality THRESHOLD in play (the predicate itself,
+    computed host-side).
+
+= 0.5 B/base with one threshold vs the 2 B/base of int8 codes +
+uint8 quals — a 4x cut to the dominant per-batch cost on the
+tunneled TPU (H2D measured ~0.1-0.17 s/MB, PERF_NOTES.md). On device
+the planes widen back to the exact int32 codes (-1 for N, -2 beyond
+length) and a SYNTHETIC qual plane (threshold where the predicate
+held, 0 where not) that makes every downstream comparison bit-identical.
+
+Packing is plain numpy on the host (runs in the decode/prefetch
+thread); unpacking is elementwise [B, L] work fused into the head of
+the device executables (near-free per the measured cost model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PackedReads:
+    """Wire-format read batch. `hq[t]` is the 1-bit plane of
+    ``qual >= t`` for each threshold t requested at pack time."""
+
+    pcodes: np.ndarray  # uint8 [B, ceil(L/4)], base i at bits 2*(i%4)
+    nmask: np.ndarray   # uint8 [B, ceil(L/8)], bit i%8: code < 0 at i
+    hq: dict            # {threshold: uint8 [B, ceil(L/8)]}
+    lengths: np.ndarray  # int32 [B]
+    length: int          # L (unpacked row width)
+
+    @property
+    def nbytes(self) -> int:
+        return (self.pcodes.nbytes + self.nmask.nbytes
+                + sum(a.nbytes for a in self.hq.values())
+                + self.lengths.nbytes)
+
+    def require_plane(self, threshold: int) -> np.ndarray:
+        """The qual>=threshold plane, or a clear error naming what was
+        packed (shared guard of both stages' packed entry points)."""
+        hq = self.hq.get(int(threshold))
+        if hq is None:
+            raise KeyError(
+                f"packed batch lacks the qual>={threshold} plane "
+                f"(has {sorted(self.hq)})")
+        return hq
+
+
+def pack_reads(codes: np.ndarray, quals: np.ndarray, lengths: np.ndarray,
+               thresholds=()) -> PackedReads:
+    """Pack int8 codes [B, L] (-1 non-ACGT, -2 pad) + uint8 quals
+    [B, L] into the wire format. `thresholds` lists every quality
+    threshold the device side will need as a predicate plane."""
+    codes = np.asarray(codes, np.int8)
+    B, L = codes.shape
+    pad4 = (-L) % 4
+    c = np.clip(codes, 0, 3).astype(np.uint8)
+    if pad4:
+        c = np.pad(c, ((0, 0), (0, pad4)))
+    c = c.reshape(B, -1, 4)
+    pcodes = (c[:, :, 0] | (c[:, :, 1] << 2) | (c[:, :, 2] << 4)
+              | (c[:, :, 3] << 6)).astype(np.uint8)
+    nmask = np.packbits(codes < 0, axis=1, bitorder="little")
+    hq = {
+        int(t): np.packbits(np.asarray(quals, np.uint8) >= t, axis=1,
+                            bitorder="little")
+        for t in thresholds
+    }
+    return PackedReads(pcodes=pcodes, nmask=nmask, hq=hq,
+                       lengths=np.asarray(lengths, np.int32), length=L)
+
+
+# Device-side widening lives in ops/mer.py (ops must not import io —
+# io/db_format imports ops.ctable); re-exported here so transport
+# callers see one module.
+from ..ops.mer import (  # noqa: E402,F401
+    synth_quals_device,
+    unpack_bits_device,
+    unpack_codes_device,
+)

@@ -45,6 +45,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..io import packing
 from ..ops import ctable, mer, table
 from ..ops.poisson import poisson_term
 from .ec_config import (
@@ -1312,6 +1313,23 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
     semantics bit-for-bit. `ambig_cap` overrides the ambiguous-lane
     compaction capacity (tests use tiny caps to exercise the stall
     path)."""
+    codes = jnp.asarray(codes)
+    quals = jnp.asarray(quals)
+    uniform, cstate, cmeta, has_contam, ambig_cap = _batch_prologue(
+        lengths, codes.shape[0], cfg, contam, ambig_cap)
+    # H2D in the NARROW dtype (int8 codes / uint8 quals are 4x smaller
+    # than int32 over the ~170 ms/MB tunnel); _correct_device widens on
+    # device. (correct_batch_packed goes further: 0.5 B/base planes.)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    return _correct_device(state, tmeta, codes, quals, lengths, cfg,
+                           cstate, cmeta, has_contam, uniform, ambig_cap,
+                           event_driven, pack_cap)
+
+
+def _batch_prologue(lengths, b: int, cfg: ECConfig, contam,
+                    ambig_cap: int | None):
+    """Host-side prologue shared by the packed and unpacked entry
+    points (they must stay bit-identical; tests/test_packing.py)."""
     # uniform-length batches (the Illumina norm) get a static flip
     # reversal instead of per-lane gathers; decided host-side, ideally
     # from the numpy lengths the reader hands over (no D2H). Under a
@@ -1327,12 +1345,6 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
         ln = np.asarray(lengths)
         if len(ln) and (ln > 0).all() and (ln == ln[0]).all():
             uniform = int(ln[0])
-    # H2D in the NARROW dtype (int8 codes / uint8 quals are 4x smaller
-    # than int32 over the ~170 ms/MB tunnel); _correct_device widens on
-    # device
-    codes = jnp.asarray(codes)
-    quals = jnp.asarray(quals)
-    lengths = jnp.asarray(lengths, jnp.int32)
     has_contam = contam is not None
     cstate, cmeta = contam if has_contam else _dummy_contam(cfg.k)
     if has_contam and cmeta.k != cfg.k:
@@ -1340,10 +1352,29 @@ def correct_batch(state: table.TableState, tmeta: table.TableMeta,
             f"Contaminant mer length ({cmeta.k}) different than correction "
             f"mer length ({cfg.k})")
     if ambig_cap is None:
-        ambig_cap = max(256, (2 * codes.shape[0]) // 8)
-    return _correct_device(state, tmeta, codes, quals, lengths, cfg,
-                           cstate, cmeta, has_contam, uniform, ambig_cap,
-                           event_driven, pack_cap)
+        ambig_cap = max(256, (2 * b) // 8)
+    return uniform, cstate, cmeta, has_contam, ambig_cap
+
+
+def correct_batch_packed(state: table.TableState, tmeta: table.TableMeta,
+                         packed, cfg: ECConfig,
+                         contam=None, ambig_cap: int | None = None,
+                         event_driven: bool = True,
+                         pack_cap: int | None = None):
+    """correct_batch over the bit-packed wire format (io/packing
+    .PackedReads): 0.5 B/base crosses the H2D link instead of 2, the
+    device widens. Requires the batch to have been packed with
+    cfg.qual_cutoff among its thresholds. Bit-identical to
+    correct_batch (tests/test_packing.py)."""
+    hq = packed.require_plane(cfg.qual_cutoff)
+    uniform, cstate, cmeta, has_contam, ambig_cap = _batch_prologue(
+        packed.lengths, packed.pcodes.shape[0], cfg, contam, ambig_cap)
+    return _correct_device_packed(
+        state, tmeta, jnp.asarray(packed.pcodes),
+        jnp.asarray(packed.nmask), jnp.asarray(hq),
+        jnp.asarray(packed.lengths, jnp.int32), cfg, cstate, cmeta,
+        has_contam, uniform, ambig_cap, event_driven, pack_cap,
+        packed.length)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11, 12))
@@ -1355,9 +1386,38 @@ def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
     position sweep, anchor scan, rc prologue, event planes, the merged
     extension loop, and the backward epilogue (separate dispatches cost
     ~25 ms each through the tunnel; see PERF_NOTES.md)."""
-    b, l = codes.shape
     codes = codes.astype(jnp.int32)
     quals = quals.astype(jnp.int32)
+    return _correct_core(state, tmeta, codes, quals, lengths, cfg,
+                         cstate, cmeta, has_contam, uniform, ambig_cap,
+                         event_driven, pack_cap)
+
+
+@functools.partial(jax.jit,
+                   static_argnums=(1, 6, 8, 9, 10, 11, 12, 13, 14))
+def _correct_device_packed(state, tmeta, pcodes, nmask, hq, lengths,
+                           cfg: ECConfig, cstate, cmeta,
+                           has_contam: bool, uniform: int | None,
+                           ambig_cap: int, event_driven: bool,
+                           pack_cap: int | None, length: int):
+    """Same executable as _correct_device but fed the bit-packed wire
+    format (io/packing.py: 2-bit codes + N mask + the 1-bit
+    qual>=cutoff predicate plane — 0.5 B/base over the tunnel instead
+    of 2). The widening at the head is elementwise [B, L] work; the
+    synthetic qual plane is bit-equivalent under the corrector's only
+    quality use, the >= qual_cutoff predicate."""
+    codes = packing.unpack_codes_device(pcodes, nmask, lengths, length)
+    quals = packing.synth_quals_device(hq, length, cfg.qual_cutoff)
+    return _correct_core(state, tmeta, codes, quals, lengths, cfg,
+                         cstate, cmeta, has_contam, uniform, ambig_cap,
+                         event_driven, pack_cap)
+
+
+def _correct_core(state, tmeta, codes, quals, lengths, cfg: ECConfig,
+                  cstate, cmeta, has_contam: bool, uniform: int | None,
+                  ambig_cap: int, event_driven: bool,
+                  pack_cap: int | None = None):
+    b, l = codes.shape
     sweep = _position_sweep(state, tmeta, codes, cfg, cstate, cmeta,
                             has_contam)
     anc = find_anchors(state, tmeta, codes, lengths, cfg,

@@ -18,13 +18,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..io import fastq, db_format
+from ..io import fastq, db_format, packing
 from ..ops import ctable, mer, table
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
@@ -65,10 +65,15 @@ class BuildStats:
 def build_database(
     paths: Sequence[str],
     cfg: BuildConfig,
-    batches: Iterable[fastq.ReadBatch] | None = None,
+    batches=None,
 ):
     """Run the full stage-1 pipeline. Returns
     (TileState, TileMeta, stats) — the query-ready tile table.
+
+    `batches` (optional) overrides the disk readers: an iterable of
+    (ReadBatch, PackedReads) pairs whose hq planes include
+    cfg.qual_thresh (the quorum driver uses this to share one
+    parse+pack between both stages).
 
     Raises RuntimeError("Hash is full") only if growth itself fails
     (allocation), preserving the reference's failure contract
@@ -80,16 +85,21 @@ def build_database(
     stats = BuildStats()
 
     if batches is None:
-        # host decode/encode overlaps device rounds (double buffering,
-        # the PP row of SURVEY §2.4). H2D stays on the MAIN thread in
-        # the narrow int8/uint8 dtypes: device_put from the prefetch
-        # thread measured slower (tunnel client degrades under
-        # concurrent access; PERF_NOTES.md round 4).
-        batches = prefetch(fastq.read_batches(paths, cfg.batch_size,
-                                              threads=cfg.threads))
+        # host decode/encode/bit-packing overlaps device rounds (double
+        # buffering, the PP row of SURVEY §2.4). H2D stays on the MAIN
+        # thread in the packed wire format (io/packing.py, 0.5 B/base):
+        # device_put from the prefetch thread measured slower (tunnel
+        # client degrades under concurrent access; PERF_NOTES.md r4).
+        def _pack(it):
+            for b in it:
+                yield b, packing.pack_reads(
+                    b.codes, b.quals, b.lengths,
+                    thresholds=(cfg.qual_thresh,))
+        batches = prefetch(_pack(fastq.read_batches(
+            paths, cfg.batch_size, threads=cfg.threads)))
     timer = StageTimer()
     with trace(cfg.profile):
-        for batch in batches:
+        for batch, pk in batches:
             stats.batches += 1
             stats.reads += batch.n
             nb = int(batch.lengths.sum())
@@ -98,9 +108,8 @@ def build_database(
             with timer.stage("insert"):
                 # ONE dispatch: extract + insert fused
                 bstate, full, (chi, clo, q, valid, placed) = \
-                    ctable.tile_insert_reads(
-                        bstate, meta, jnp.asarray(batch.codes),
-                        jnp.asarray(batch.quals), cfg.qual_thresh)
+                    ctable.tile_insert_reads_packed(
+                        bstate, meta, pk, cfg.qual_thresh)
                 if full:
                     pending = jnp.logical_and(valid,
                                               jnp.logical_not(placed))
@@ -139,6 +148,7 @@ def create_database_main(
     cmdline: list[str] | None = None,
     ref_format: bool = False,
     handoff: dict | None = None,
+    batches=None,
 ) -> BuildStats:
     """With `handoff` (a dict), the built device-resident table is
     stashed as handoff["db"] = (state, meta) so an in-process stage-2
@@ -146,7 +156,7 @@ def create_database_main(
     full-size table costs ~0.1 s/MB — ~50 s for a 0.5 GB table — while
     the reference's equivalent, re-mmapping a page-cached file, is
     free; quorum.in:154-231 runs both stages over the same file)."""
-    state, meta, stats = build_database(paths, cfg)
+    state, meta, stats = build_database(paths, cfg, batches=batches)
     if handoff is not None:
         handoff["db"] = (state, meta)
     if ref_format:

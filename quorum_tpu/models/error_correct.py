@@ -31,13 +31,20 @@ from typing import Sequence
 import jax
 
 from ..io import contaminant as contaminant_mod
-from ..io import db_format, fastq
+from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
-from .corrector import correct_batch, finish_batch
+from .corrector import correct_batch_packed, finish_batch
 from .ec_config import ECConfig
+
+
+def pack_for_stage2(batch: fastq.ReadBatch, cfg: ECConfig):
+    """Bit-pack one ReadBatch for the corrector's wire format (runs in
+    the decode/prefetch thread; the main thread only does H2D)."""
+    return packing.pack_reads(batch.codes, batch.quals, batch.lengths,
+                              thresholds=(cfg.qual_cutoff,))
 
 
 @dataclasses.dataclass
@@ -105,7 +112,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                       homo_trim: int | None = None,
                       trim_contaminant: bool = False,
                       no_discard: bool = False,
-                      records=None, db=None) -> ECStats:
+                      records=None, db=None, prepacked=None) -> ECStats:
     """Run the full stage-2 pipeline. If `cfg_in` is given it overrides
     the individual knobs (library use); otherwise an ECConfig is built
     from the flags plus the DB geometry, with the cutoff resolved per
@@ -114,7 +121,12 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     is used instead of reading `sequences` from disk — this is how the
     quorum driver's paired mode streams merged pairs through the
     corrector the way the reference pipes processes together
-    (src/quorum.in:172-231)."""
+    (src/quorum.in:172-231). If `prepacked` is given (an iterable of
+    (ReadBatch, PackedReads) pairs whose hq planes include this run's
+    qual_cutoff) the reads are neither re-read nor re-packed — the
+    quorum driver replays stage 1's cache through stage 2, sparing the
+    second full parse the reference gets for free from the page
+    cache."""
     vlog("Loading mer database")
     if db is not None:
         # in-process handoff from stage 1: the table is already device
@@ -157,6 +169,10 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     try:
         if records is not None:
             src = fastq.batch_records(records, opts.batch_size)
+        elif prepacked is not None:
+            # quorum-driver replay: stage 1 already parsed AND packed
+            # these reads (run_quorum); skip the second disk parse
+            src = None
         else:
             src = fastq.read_batches(sequences, opts.batch_size,
                                      threads=opts.threads)
@@ -164,12 +180,18 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         # NOTE: H2D stays on the MAIN thread — device_put from the
         # prefetch thread measured SLOWER end-to-end (3.2 vs 1.4
         # s/batch): the tunnel client degrades under concurrent
-        # access, so the prefetch thread does host decode only and
-        # transfers ride the narrow int8/uint8 dtypes instead
-        # (PERF_NOTES.md round 4).
-        batches = prefetch(src)
+        # access, so the prefetch thread does host decode AND
+        # bit-packing only; transfers ride the packed wire format
+        # (io/packing.py, 0.5 B/base) from the main thread.
+        if prepacked is not None:
+            batches = prepacked
+        else:
+            def _pack(it):
+                for b in it:
+                    yield b, pack_for_stage2(b, cfg)
+            batches = prefetch(_pack(src))
         with trace(opts.profile):
-            for batch in batches:
+            for batch, pk in batches:
                 with timer.stage("device"):
                     # the lean finish buffer packs inside the same
                     # executable (one dispatch per batch instead of
@@ -181,9 +203,9 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                     # headroom; rarer batches overflow and re-pack
                     # once in finish_batch.
                     cap = 4 * batch.codes.shape[0]
-                    res, packed = correct_batch(
-                        state, meta, batch.codes, batch.quals,
-                        batch.lengths, cfg, contam=contam, pack_cap=cap)
+                    res, packed = correct_batch_packed(
+                        state, meta, pk, cfg, contam=contam,
+                        pack_cap=cap)
                     jax.block_until_ready(packed)
                 with timer.stage("finish"):
                     results = finish_batch(res, batch.n, cfg,

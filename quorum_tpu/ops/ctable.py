@@ -45,6 +45,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import mer
+
 BUCKET = 4  # slots per bucket = one aligned 16-byte gather row
 _EMPTY_TAG = np.uint32(0xFFFFFFFF)
 
@@ -1056,8 +1058,6 @@ def extract_observations_impl(codes_i8, quals_u8, k: int,
     consecutive ACGT bases. Lives here (not models/) so the fused
     insert below can extract and insert in ONE dispatch; unjitted so
     the sharded builds can call it under shard_map."""
-    from . import mer
-
     codes = codes_i8.astype(jnp.int32)
     B, L = codes.shape
     fhi, flo, rhi, rlo, valid = mer.rolling_kmers(codes, k)
@@ -1069,15 +1069,11 @@ def extract_observations_impl(codes_i8, quals_u8, k: int,
     return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 5, 6),
-                   donate_argnums=(0,))
-def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
-                             codes_i8, quals_u8, qual_thresh: int,
+def _insert_reads_fused_core(bstate: TBuildState, meta: TileMeta,
+                             codes, quals, qual_thresh: int,
                              rounds: int, cap: int):
-    """extract + parts + round 1 + compacted rounds as ONE executable
-    (each extra dispatch costs ~25-90 ms through the tunnel)."""
     chi, clo, qual, valid = extract_observations_impl(
-        codes_i8, quals_u8, meta.k, qual_thresh)
+        codes, quals, meta.k, qual_thresh)
     addr, rlo, rhi = tile_key_parts(chi, clo, meta)
     p0 = _preferred_slot(rlo, rhi)
     hq_add, lq_add, done = _prep_obs(qual, valid)
@@ -1087,6 +1083,35 @@ def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
         bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
         rounds, cap)
     return bstate, (chi, clo, qual, valid), done, n_failed, n_unfit
+
+
+@functools.partial(jax.jit, static_argnums=(1, 4, 5, 6),
+                   donate_argnums=(0,))
+def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
+                             codes_i8, quals_u8, qual_thresh: int,
+                             rounds: int, cap: int):
+    """extract + parts + round 1 + compacted rounds as ONE executable
+    (each extra dispatch costs ~25-90 ms through the tunnel)."""
+    return _insert_reads_fused_core(bstate, meta, codes_i8, quals_u8,
+                                    qual_thresh, rounds, cap)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 6, 7, 8, 9),
+                   donate_argnums=(0,))
+def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
+                                    pcodes, nmask, hq, lengths,
+                                    qual_thresh: int, rounds: int,
+                                    cap: int, length: int):
+    """The fused insert fed the bit-packed wire format (io/packing.py:
+    2-bit codes + N mask + the 1-bit qual>=thresh plane — 0.5 B/base
+    over the tunnel instead of 2). Widening is elementwise [B, L] work
+    at the head of the same executable; the synthetic qual plane is
+    bit-equivalent under extract_observations_impl's only quality use,
+    the < qual_thresh reset predicate."""
+    codes = mer.unpack_codes_device(pcodes, nmask, lengths, length)
+    quals = mer.synth_quals_device(hq, length, qual_thresh)
+    return _insert_reads_fused_core(bstate, meta, codes, quals,
+                                    qual_thresh, rounds, cap)
 
 
 def _drain_survivors(bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add,
@@ -1119,6 +1144,35 @@ def tile_insert_reads(bstate: TBuildState, meta: TileMeta, codes_i8,
     bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused(
         bstate, meta, codes_i8, quals_u8, qual_thresh, max_rounds - 1,
         cap)
+    return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
+                              max_rounds, cap, n)
+
+
+def tile_insert_reads_packed(bstate: TBuildState, meta: TileMeta,
+                             packed, qual_thresh: int,
+                             max_rounds: int = 24):
+    """tile_insert_reads over the bit-packed wire format
+    (io/packing.PackedReads) — 0.5 B/base crosses the H2D link instead
+    of 2; bit-identical table (tests/test_packing.py). The batch must
+    have been packed with `qual_thresh` among its thresholds."""
+    hq = packed.require_plane(qual_thresh)
+    b, length = packed.pcodes.shape[0], packed.length
+    n = b * length
+    cap = min(n, max(1024, n // 8))
+    bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused_packed(
+        bstate, meta, jnp.asarray(packed.pcodes),
+        jnp.asarray(packed.nmask), jnp.asarray(hq),
+        jnp.asarray(packed.lengths, jnp.int32), qual_thresh,
+        max_rounds - 1, cap, length)
+    return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
+                              max_rounds, cap, n)
+
+
+def _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
+                       max_rounds: int, cap: int, n: int):
+    """Host tail shared by both insert entry points: scalar readback,
+    survivor drain under bucket pressure, and the full/placed verdict
+    (they must produce identical tables; tests/test_packing.py)."""
     chi, clo, qual, valid = obs
     n_failed, n_unfit = (int(x) for x in
                          np.asarray(jnp.stack([n_failed, n_unfit])))

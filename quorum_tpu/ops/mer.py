@@ -240,3 +240,43 @@ def rolling_kmers(codes, k: int):
     last_bad = jax.lax.cummax(jnp.where(~ok, pos, jnp.int32(-1)), axis=1)
     valid = (pos - last_bad) >= k
     return fhi, flo, rhi, rlo, valid
+
+
+# ------------------------------------------------- packed-wire widening
+# Device side of the bit-packed read transport (host side + format doc:
+# io/packing.py). All elementwise broadcast/reshape — no gathers — so
+# fusing these into the head of the stage executables is near-free on
+# the measured cost model (PERF_NOTES.md).
+
+
+def unpack_bits_device(plane, L: int):
+    """uint8 [B, ceil(L/8)] -> int32 [B, L] of 0/1 (little bit order)."""
+    x = plane.astype(jnp.int32)
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    y = (x[:, :, None] >> shifts[None, None, :]) & 1
+    return y.reshape(x.shape[0], -1)[:, :L]
+
+
+def unpack_codes_device(pcodes, nmask, lengths, L: int):
+    """Widen wire planes back to the exact int32 code array the kernels
+    consume: 0..3 bases, -1 at N-mask bits, -2 at/after each row's
+    length."""
+    x = pcodes.astype(jnp.int32)
+    shifts = jnp.array([0, 2, 4, 6], jnp.int32)
+    y = (x[:, :, None] >> shifts[None, None, :]) & 3
+    codes = y.reshape(x.shape[0], -1)[:, :L]
+    nbit = unpack_bits_device(nmask, L)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    codes = jnp.where(nbit == 1, -1, codes)
+    codes = jnp.where(pos >= lengths[:, None], -2, codes)
+    return codes
+
+
+def synth_quals_device(hq_plane, L: int, threshold: int):
+    """Reconstruct a quality plane bit-equivalent UNDER THE PREDICATE
+    ``qual >= threshold`` (equally ``qual < threshold``): threshold
+    where the bit is set, 0 where clear. With threshold <= 0 the
+    predicate is vacuously true, matching a set bit from the host side
+    (uint8 quals are always >= 0)."""
+    bits = unpack_bits_device(hq_plane, L)
+    return (bits * jnp.int32(max(threshold, 0))).astype(jnp.int32)

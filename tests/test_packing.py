@@ -1,0 +1,122 @@
+"""Bit-packed read transport (io/packing.py): the wire format must be
+a pure re-encoding — device-side widening reproduces the exact code
+array, and both stage entry points produce bit-identical results
+through the packed path. (The packed path is what the CLIs ship over
+the tunnel; these tests close the parity chain back to the oracle via
+tests/test_corrector.py and tests/test_ctable.py.)"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.io import packing
+from quorum_tpu.ops import ctable, mer
+from quorum_tpu.models import corrector
+from quorum_tpu.models.create_database import extract_observations
+from quorum_tpu.models.ec_config import ECConfig
+
+K, RLEN, B = 9, 50, 512
+
+
+def _random_reads(rng, b=B, lmax=RLEN, uniform=False):
+    genome = rng.integers(0, 4, size=2000, dtype=np.int8)
+    starts = rng.integers(0, len(genome) - lmax, size=b)
+    codes = genome[starts[:, None] + np.arange(lmax)[None, :]].astype(np.int8)
+    errs = rng.random(codes.shape) < 0.02
+    codes = np.where(errs, (codes + rng.integers(1, 4, size=codes.shape)) % 4,
+                     codes).astype(np.int8)
+    codes[5:9, 30] = -1  # N bases
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[errs] = 68
+    quals[rng.random(codes.shape) < 0.1] = 30
+    if uniform:
+        lengths = np.full(b, lmax, np.int32)
+    else:
+        lengths = rng.integers(K + 2, lmax + 1, size=b).astype(np.int32)
+        pos = np.arange(lmax)[None, :]
+        codes = np.where(pos >= lengths[:, None], -2, codes).astype(np.int8)
+        quals = np.where(pos >= lengths[:, None], 0, quals).astype(np.uint8)
+    return codes, quals, lengths
+
+
+@pytest.mark.parametrize("lmax", [RLEN, 47])  # 47: L % 4 != 0, L % 8 != 0
+def test_roundtrip(lmax):
+    rng = np.random.default_rng(3)
+    codes, quals, lengths = _random_reads(rng, lmax=lmax)
+    p = packing.pack_reads(codes, quals, lengths, thresholds=(38, 65))
+    got = np.asarray(mer.unpack_codes_device(
+        jnp.asarray(p.pcodes), jnp.asarray(p.nmask),
+        jnp.asarray(lengths), lmax))
+    np.testing.assert_array_equal(got, codes.astype(np.int32))
+    for t in (38, 65):
+        syn = np.asarray(mer.synth_quals_device(jnp.asarray(p.hq[t]),
+                                                lmax, t))
+        np.testing.assert_array_equal(syn >= t, quals >= t)
+    # the whole point: the wire is 4x smaller than int8+uint8
+    assert p.nbytes < (codes.nbytes + quals.nbytes) / 2.5
+
+
+def _build_db(codes, quals):
+    meta = ctable.TileMeta(k=K, bits=7,
+                           rb_log2=ctable.tile_rb_for(200_000, K, 7))
+    bstate = ctable.make_tile_build(meta)
+    chi, clo, q, valid = extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), K, 38)
+    bstate, full, _ = ctable.tile_insert_observations(
+        bstate, meta, chi, clo, q, valid)
+    assert not full
+    return ctable.tile_finalize(bstate, meta), meta
+
+
+@pytest.mark.parametrize("uniform", [True, False])
+def test_corrector_parity(uniform):
+    rng = np.random.default_rng(11)
+    codes, quals, lengths = _random_reads(rng, uniform=uniform)
+    state, meta = _build_db(codes, quals)
+    cfg = ECConfig(k=K, cutoff=4, qual_cutoff=65, poisson_dtype="float32")
+    ref = corrector.correct_batch(state, meta, jnp.asarray(codes),
+                                  jnp.asarray(quals),
+                                  jnp.asarray(lengths, jnp.int32), cfg)
+    p = packing.pack_reads(codes, quals, lengths,
+                           thresholds=(cfg.qual_cutoff,))
+    got = corrector.correct_batch_packed(state, meta, p, cfg)
+    np.testing.assert_array_equal(np.asarray(ref.out), np.asarray(got.out))
+    np.testing.assert_array_equal(np.asarray(ref.start),
+                                  np.asarray(got.start))
+    np.testing.assert_array_equal(np.asarray(ref.end), np.asarray(got.end))
+    np.testing.assert_array_equal(np.asarray(ref.status),
+                                  np.asarray(got.status))
+    for la, lb in ((ref.fwd_log, got.fwd_log), (ref.bwd_log, got.bwd_log)):
+        np.testing.assert_array_equal(np.asarray(la.n), np.asarray(lb.n))
+        n = np.asarray(la.n)
+        msk = np.arange(la.pos.shape[1])[None, :] < n[:, None]
+        for name in ("pos", "meta"):
+            av = np.asarray(getattr(la, name))
+            bv = np.asarray(getattr(lb, name))
+            np.testing.assert_array_equal(np.where(msk, av, 0),
+                                          np.where(msk, bv, 0))
+
+
+def test_insert_parity():
+    rng = np.random.default_rng(5)
+    codes, quals, lengths = _random_reads(rng)
+    meta = ctable.TileMeta(k=K, bits=7,
+                           rb_log2=ctable.tile_rb_for(200_000, K, 7))
+
+    b1 = ctable.make_tile_build(meta)
+    b1, full1, _ = ctable.tile_insert_reads(
+        b1, meta, jnp.asarray(codes), jnp.asarray(quals), 38)
+    assert not full1
+    s1 = ctable.tile_finalize(b1, meta)
+
+    p = packing.pack_reads(codes, quals, lengths, thresholds=(38,))
+    b2 = ctable.make_tile_build(meta)
+    b2, full2, _ = ctable.tile_insert_reads_packed(b2, meta, p, 38)
+    assert not full2
+    s2 = ctable.tile_finalize(b2, meta)
+
+    # same finalized table, entry for entry (iterate is order-stable:
+    # it walks buckets/slots)
+    for a, b in zip(ctable.tile_iterate(s1, meta),
+                    ctable.tile_iterate(s2, meta)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -159,3 +159,56 @@ def test_quorum_driver_no_files(capsys):
     rc = quorum_cli.main([])
     assert rc == 1
     assert "No sequence files" in capsys.readouterr().err
+
+
+def test_driver_thread_plumbing_and_single_parse(tmp_path, monkeypatch):
+    """-t autodetect/forwarding (quorum.in:110-120) and the parse-once
+    replay: the reads hit the disk parser exactly once for both
+    stages."""
+    monkeypatch.chdir(tmp_path)
+    reads_path, reads, quals = make_dataset(tmp_path)
+    prefix = str(tmp_path / "qc")
+
+    seen = {"cdb": None, "ec": None, "parses": 0}
+    real_cdb, real_ec = quorum_cli.cdb_cli.main, quorum_cli.ec_cli.main
+    real_read = quorum_cli.fastq.read_batches
+
+    def spy_cdb(argv, **kw):
+        seen["cdb"] = list(argv)
+        return real_cdb(argv, **kw)
+
+    def spy_ec(argv, **kw):
+        seen["ec"] = list(argv)
+        seen["ec_prepacked"] = kw.get("prepacked")
+        return real_ec(argv, **kw)
+
+    def spy_read(paths, *a, **kw):
+        seen["parses"] += 1
+        return real_read(paths, *a, **kw)
+
+    monkeypatch.setattr(quorum_cli.cdb_cli, "main", spy_cdb)
+    monkeypatch.setattr(quorum_cli.ec_cli, "main", spy_ec)
+    monkeypatch.setattr(quorum_cli.fastq, "read_batches", spy_read)
+    monkeypatch.setattr(
+        "quorum_tpu.models.error_correct.fastq.read_batches", spy_read)
+    monkeypatch.setattr(
+        "quorum_tpu.models.create_database.fastq.read_batches", spy_read)
+
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "-t", "3", "--batch-size", "64", reads_path])
+    assert rc == 0
+    # -t forwarded to both stages
+    assert seen["cdb"][seen["cdb"].index("-t") + 1] == "3"
+    assert seen["ec"][seen["ec"].index("-t") + 1] == "3"
+    # stage 2 got the replay cache; the disk parser ran exactly once
+    assert seen["ec_prepacked"] is not None
+    assert len(seen["ec_prepacked"]) > 0
+    assert seen["parses"] == 1
+
+    # autodetect path: no -t -> cpu count
+    seen["cdb"] = None
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "--batch-size", "64", reads_path])
+    assert rc == 0
+    want = str(os.cpu_count() or 1)
+    assert seen["cdb"][seen["cdb"].index("-t") + 1] == want
