@@ -205,6 +205,22 @@ def read_db(path: str, to_device: bool = True,
         addr = plane(np.int32, offset, (n,))
         lo = plane(np.uint32, offset + 4 * n, (n,))
         hi = plane(np.uint32, offset + 8 * n, (n,))
+        # validate untrusted header payload BEFORE the scatter: JAX's
+        # default clip mode would silently fold out-of-range bucket
+        # addresses into a wrong-but-well-formed table (and the host
+        # path would wrap negatives via Python indexing)
+        if n:
+            a = np.asarray(addr)
+            amin, amax = int(a.min()), int(a.max())
+            if amin < 0 or amax >= meta.rows:
+                raise ValueError(
+                    f"corrupt v3 database '{path}': bucket address "
+                    f"range [{amin}, {amax}] outside [0, {meta.rows})")
+            per_bucket = np.bincount(a, minlength=1).max()
+            if per_bucket > ctable.TILE // 2:
+                raise ValueError(
+                    f"corrupt v3 database '{path}': {per_bucket} entries "
+                    f"in one bucket (capacity {ctable.TILE // 2})")
         if to_device:
             row, col = ctable.tile_compact_placement(addr)
             state = ctable.tile_rows_device_from_compact(

@@ -202,6 +202,18 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
     claim = itertools.count()
     claim_lock = threading.Lock()
 
+    def put_or_stop(i, item) -> bool:
+        """Stop-aware bounded put; False if the consumer went away
+        (an unbounded put here would strand the worker forever on a
+        full queue after the generator is abandoned)."""
+        while not stop.is_set():
+            try:
+                qs[i].put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def worker():
         while not stop.is_set():
             with claim_lock:
@@ -210,17 +222,12 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
                 return
             try:
                 for b in _read_batches_one([paths[i]], batch_size):
-                    while not stop.is_set():
-                        try:
-                            qs[i].put(b, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    else:
+                    if not put_or_stop(i, b):
                         return
-                qs[i].put(None)
+                if not put_or_stop(i, None):
+                    return
             except BaseException as e:  # noqa: BLE001 - forwarded
-                qs[i].put(("__err__", e))
+                put_or_stop(i, ("__err__", e))
                 return
 
     ts = [threading.Thread(target=worker, daemon=True)

@@ -48,6 +48,11 @@ def read_jf_binary(path: str):
     if key_len > 64:
         raise ValueError(f"'{path}': key_len {key_len} > 64 unsupported")
     counter_len = int(header.get("counter_len", 4))
+    if not (1 <= counter_len <= 8):
+        # counter_len > 8 would drive uint64 shifts >= 64 in le_int
+        # (undefined numpy results); <= 0 degenerates the record size
+        raise ValueError(
+            f"'{path}': counter_len {counter_len} outside 1..8")
     kbytes = -(-key_len // 8)
     rec = kbytes + counter_len
     payload = data[off:]

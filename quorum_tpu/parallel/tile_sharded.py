@@ -166,8 +166,11 @@ def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
     single-chip write-then-verify rounds on the local slice (GLOBAL
     key parts, localized row index), and route per-lane placed flags
     back. Lanes with hq_add == lq_add == 0 are inactive. Returns
-    (bst, placed, any_fail_local) where any_fail_local covers both
-    bucket overflow (lane not sent) and local placement failure."""
+    (bst, placed, place_fail_local, overflow_local): place_fail means
+    a routed lane genuinely failed to place (table pressure — grow);
+    overflow means a valid lane missed the send-bucket cap (a
+    bucket_slack/skew artifact — the un-placed lanes just need another
+    exchange pass, NOT a grow)."""
     S = meta.n_shards
     local = meta.local_meta
     n = chi.shape[0]
@@ -223,8 +226,9 @@ def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
     ok_back = _a2a(done.reshape(S, cap)).reshape(-1)
     placed = fitted & ok_back[jnp.clip(owner * cap + rank, 0,
                                        S * cap - 1)]
-    any_fail = jnp.any(~done) | jnp.any(valid & ~fitted)
-    return bst, placed, any_fail
+    place_fail = jnp.any(~done)
+    overflow = jnp.any(valid & ~fitted)
+    return bst, placed, place_fail, overflow
 
 
 def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
@@ -232,11 +236,13 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
     """Compile the sharded tile build step.
 
     Returns f(bstate, codes_i8[B,L], quals_u8[B,L], pending[B*L]) ->
-    (bstate, full, placed[B*L]) with reads sharded over the mesh axis
-    and the table sharded by leading row bits; `full` is the global
-    any-shard-failed flag and the exact-once grow-retry contract is
-    `pending & ~placed` (same as the single-chip
-    tile_insert_observations)."""
+    (bstate, full, overflow, placed[B*L]) with reads sharded over the
+    mesh axis and the table sharded by leading row bits. `full` is the
+    global any-shard-PLACEMENT-failed flag (grow); `overflow` means
+    some valid lane missed its send-bucket cap (skew artifact — rerun
+    the step with `pending & ~placed`, no grow). The exact-once
+    grow-retry contract is `pending & ~placed` either way (same as the
+    single-chip tile_insert_observations)."""
     S = meta.n_shards
 
     def fn(tag, hq, lq, codes_i8, quals_u8, pending):
@@ -248,24 +254,25 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
         cap = n if S == 1 else max(64, int(n // S * bucket_slack))
         hq_add = jnp.where(valid & (q == 1), 1, 0).astype(jnp.uint32)
         lq_add = jnp.where(valid & (q == 0), 1, 0).astype(jnp.uint32)
-        bst, placed, any_fail = _routed_insert_local(
+        bst, placed, place_fail, overflow = _routed_insert_local(
             bst, meta, chi, clo, hq_add, lq_add, cap)
-        full = lax.pmax(any_fail.astype(jnp.int32), AXIS) > 0
-        return bst.tag, bst.hq, bst.lq, full, placed & valid
+        full = lax.pmax(place_fail.astype(jnp.int32), AXIS) > 0
+        over = lax.pmax(overflow.astype(jnp.int32), AXIS) > 0
+        return bst.tag, bst.hq, bst.lq, full, over, placed & valid
 
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS, None),
                   P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P(AXIS)),
         check_vma=False,
     )
 
     @jax.jit
     def step(bstate: ctable.TBuildState, codes_i8, quals_u8, pending):
-        tag, hq, lq, full, placed = mapped(
+        tag, hq, lq, full, over, placed = mapped(
             bstate.tag, bstate.hq, bstate.lq, codes_i8, quals_u8, pending)
-        return ctable.TBuildState(tag, hq, lq), full, placed
+        return ctable.TBuildState(tag, hq, lq), full, over, placed
 
     return step
 
@@ -335,9 +342,12 @@ def _try_place_all(khi, klo, hqc, lqc, nmeta: TileShardedMeta, mesh: Mesh,
     def fn(tag, hq, lq, e_hi, e_lo, e_hq, e_lq):
         bst = ctable.TBuildState(tag, hq, lq)
         cap = e_hi.shape[0]  # worst case: every entry owned by one shard
-        bst, placed, any_fail = _routed_insert_local(
+        # cap == lane count makes send-bucket overflow impossible, so
+        # any failure here is genuine table pressure
+        bst, placed, place_fail, overflow = _routed_insert_local(
             bst, nmeta, e_hi, e_lo, e_hq, e_lq, cap)
-        full = lax.pmax(any_fail.astype(jnp.int32), AXIS) > 0
+        full = lax.pmax((place_fail | overflow).astype(jnp.int32),
+                        AXIS) > 0
         return bst.tag, bst.hq, bst.lq, full, placed
 
     mapped = jax.shard_map(
@@ -392,14 +402,29 @@ def build_database_tile_sharded(batches, mesh: Mesh,
     bstate = make_build_state(meta, mesh)
     step = build_step(mesh, meta, qual_thresh)
     for codes, quals in batches:
-        pending = jnp.ones((codes.shape[0] * codes.shape[1],), bool)
-        for _ in range(max_grows + 1):
-            bstate, full, placed = step(bstate, codes, quals, pending)
-            if not bool(full):
+        n = codes.shape[0] * codes.shape[1]
+        pending = jnp.ones((n,), bool)
+        grows = 0
+        # overflow-only retries always make progress (every fitted
+        # lane places or trips `full`), so the pass count is bounded
+        # by lanes/cap per grow level; the generous bound below only
+        # guards against a logic bug wedging the loop
+        for _ in range(max_grows + 2 * meta.n_shards + 8):
+            bstate, full, over, placed = step(bstate, codes, quals,
+                                              pending)
+            if not (bool(full) or bool(over)):
                 break
             pending = jnp.logical_and(pending, jnp.logical_not(placed))
-            bstate, meta = grow(bstate, meta, mesh)
-            step = build_step(mesh, meta, qual_thresh)
+            if bool(full):
+                # genuine table pressure -> grow (exact-once retry)
+                if grows > max_grows:
+                    raise RuntimeError("Hash is full")
+                grows += 1
+                bstate, meta = grow(bstate, meta, mesh)
+                step = build_step(mesh, meta, qual_thresh)
+            # else: send-bucket overflow only — re-exchange the
+            # un-placed lanes at the same size (ADVICE r4: skew must
+            # not trigger doubling while table space remains)
         else:
             raise RuntimeError("Hash is full")
     return finalize(bstate, meta, mesh), meta

@@ -182,3 +182,73 @@ def test_create_database_ref_format(tmp_path):
     ref_d = {(int(h), int(lo)): int(v)
              for h, lo, v in zip(rhi, rlo, rvals)}
     assert nat_d == ref_d
+
+
+def test_jf_binary_rejects_bad_counter_len(tmp_path):
+    """ADVICE r4: counter_len outside 1..8 must be a clean parse error,
+    not undefined uint64 shifts / degenerate record sizes."""
+    k = 9
+    rng = np.random.default_rng(17)
+    khi, klo, vals = _rand_entries(rng, 10, k)
+    path = str(tmp_path / "bad.jf")
+    jf_binary.write_jf_binary(path, khi, klo, vals, k)
+    raw = open(path, "rb").read()
+    for bad in (0, 9, -1):
+        mangled = raw.replace(b'"counter_len": 4', f'"counter_len": {bad}'
+                              .encode(), 1)
+        assert mangled != raw
+        p = str(tmp_path / f"bad{bad}.jf")
+        open(p, "wb").write(mangled)
+        with pytest.raises(ValueError, match="counter_len"):
+            jf_binary.read_jf_binary(p)
+
+
+def test_v3_db_rejects_corrupt_addr(tmp_path):
+    """ADVICE r4: out-of-range v3 bucket addresses must raise, not be
+    silently clamped into a wrong table by the device scatter."""
+    import json as _json
+    import quorum_tpu.ops.ctable as _ct
+
+    k = 9
+    rng = np.random.default_rng(19)
+    khi, klo, vals = _rand_entries(rng, 30, k)
+    state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
+    path = str(tmp_path / "db.qdb")
+    db_format.write_db(path, state, meta)
+
+    raw = open(path, "rb").read()
+    nl = raw.index(b"\n") + 1
+    hdr = _json.loads(raw[:nl])
+    n = hdr["n_entries"]
+    addr = np.frombuffer(raw[nl:nl + 4 * n], np.int32).copy()
+
+    def rewrite(new_addr, name):
+        p = str(tmp_path / name)
+        open(p, "wb").write(raw[:nl] + new_addr.tobytes()
+                            + raw[nl + 4 * n:])
+        return p
+
+    bad = addr.copy()
+    bad[0] = meta.rows + 3
+    with pytest.raises(ValueError, match="bucket address"):
+        db_format.read_db(rewrite(bad, "hi.qdb"), to_device=True)
+    bad = addr.copy()
+    bad[0] = -2
+    with pytest.raises(ValueError, match="bucket address"):
+        db_format.read_db(rewrite(bad, "neg.qdb"), to_device=False)
+    # >64 entries claiming one bucket
+    bad = addr.copy()
+    bad[:] = addr[0] if n <= 64 else bad[0]
+    if n <= 64:
+        # replicate rows to exceed capacity via duplicated addresses
+        reps = 65 // max(n, 1) + 1
+        big_addr = np.tile(addr[:1], 65)
+        lo = np.frombuffer(raw[nl + 4 * n:nl + 8 * n], np.uint32)
+        hi = np.frombuffer(raw[nl + 8 * n:nl + 12 * n], np.uint32)
+        hdr2 = dict(hdr, n_entries=65)
+        p = str(tmp_path / "crowd.qdb")
+        open(p, "wb").write(
+            (_json.dumps(hdr2) + "\n").encode() + big_addr.tobytes()
+            + np.tile(lo[:1], 65).tobytes() + np.tile(hi[:1], 65).tobytes())
+        with pytest.raises(ValueError, match="entries"):
+            db_format.read_db(p, to_device=False)
