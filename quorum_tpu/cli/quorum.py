@@ -195,9 +195,11 @@ def main(argv=None) -> int:
                 ts = (t1, _EC_QUAL_CUTOFF) if cache_state["ok"] else (t1,)
                 pk = packing.pack_reads(b.codes, b.quals, b.lengths,
                                         thresholds=ts)
-                # stage 2 never touches host quals (only the packed
-                # plane); drop them from the cached copy
-                item = (dataclasses.replace(b, quals=None), pk)
+                # compact() keeps ONLY the fused wire buffer (the
+                # standalone planes duplicate its bytes), built here
+                # off the main thread; stage 2 never touches host
+                # quals either, so they drop from the cached copy too
+                item = (dataclasses.replace(b, quals=None), pk.compact())
                 if cache_state["ok"]:
                     # count the retained headers too (~90 B of str +
                     # list-slot overhead each), not just the arrays

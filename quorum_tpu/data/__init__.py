@@ -1,0 +1,75 @@
+"""Built-in contaminant data: Illumina adapter k-mer set.
+
+The reference ships `data/adapter.fa` and builds `data/adapter.jf`
+from it at build time with `jellyfish count -m 24 -s 5k -C`
+(reference: Makefile.am:50-56), for use with the corrector's
+`--contaminant` flag. Its fasta is the set of standard Illumina
+TruSeq/PE adapter+primer sequences PLUS every single-base substitution
+variant of each (an error-tolerant membership set — one sequencing
+error in an adapter still hits).
+
+Rather than shipping the ~880-record expansion, this module keeps the
+canonical public adapter sequences and regenerates the same expansion
+on demand; `adapter_fasta()` materializes it (cached) and the
+`--contaminant` loaders accept fasta directly (io/contaminant.py), so
+`--contaminant $(python -m quorum_tpu.data)` reproduces the
+reference's batteries-included workflow without a Jellyfish build.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Standard Illumina adapter / sequencing-primer sequences (public
+# Illumina documentation; same set the reference's data/adapter.fa is
+# built from): TruSeq universal/indexed adapter stems, the PE flow-cell
+# P5/P7-extended primers, and the multiplexing read-2 primer region.
+ADAPTERS = (
+    "GATCGGAAGAGCTCGTATGCCGTCTTCTGCTTG",
+    "ACACTCTTTCCCTACACGACGCTCTTCCGATCT",
+    "AATGATACGGCGACCACCGAGATCTACACTCTTTCCCTACACGACGCTCTTCCGATCT",
+    "CAAGCAGAAGACGGCATACGAGCTCTTCCGATCT",
+    "GATCGGAAGAGCGGTTCAGCAGGAATGCCGAG",
+    "CAAGCAGAAGACGGCATACGAGATCGGTCTCGGCATTCCTGCTGAACCGCTCTTCCGATCT",
+    "CGGTCTCGGCATTCCTGCTGAACCGCTCTTCCGATCT",
+)
+
+
+def adapter_records():
+    """Yield (header, sequence) for the full error-tolerant set: each
+    canonical adapter followed by all of its 1-substitution variants
+    (dedup'd across the whole set, originals kept first)."""
+    seen = set()
+    for i, s in enumerate(ADAPTERS):
+        if s not in seen:
+            seen.add(s)
+            yield str(i + 1), s
+    n = 0
+    for s in ADAPTERS:
+        for j, c in enumerate(s):
+            for x in "ACGT":
+                if x == c:
+                    continue
+                v = s[:j] + x + s[j + 1:]
+                if v in seen:
+                    continue
+                seen.add(v)
+                yield str(n), v
+                n += 1
+
+
+def adapter_fasta(path: str | None = None) -> str:
+    """Write (or reuse) the adapter fasta; returns its path. Default
+    location is the package cache dir."""
+    if path is None:
+        cache = os.path.expanduser("~/.cache/quorum_tpu")
+        os.makedirs(cache, exist_ok=True)
+        path = os.path.join(cache, "adapters.fa")
+        if os.path.exists(path):
+            return path
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for hdr, seq in adapter_records():
+            f.write(f">{hdr}\n{seq}\n")
+    os.replace(tmp, path)
+    return path

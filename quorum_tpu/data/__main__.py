@@ -1,0 +1,13 @@
+"""`python -m quorum_tpu.data [out.fa]` — materialize the built-in
+Illumina adapter contaminant fasta and print its path (the reference
+ships the equivalent as data/adapter.fa / adapter.jf,
+Makefile.am:50-56). Use with `--contaminant <path>` in
+quorum / quorum_error_correct_reads."""
+
+import sys
+
+from . import adapter_fasta
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else None
+    print(adapter_fasta(path))

@@ -216,7 +216,9 @@ def read_db(path: str, to_device: bool = True,
                 raise ValueError(
                     f"corrupt v3 database '{path}': bucket address "
                     f"range [{amin}, {amax}] outside [0, {meta.rows})")
-            per_bucket = np.bincount(a, minlength=1).max()
+            # bounded by n_entries, not table rows (np.bincount would
+            # allocate O(rows) for one max)
+            per_bucket = int(np.unique(a, return_counts=True)[1].max())
             if per_bucket > ctable.TILE // 2:
                 raise ValueError(
                     f"corrupt v3 database '{path}': {per_bucket} entries "

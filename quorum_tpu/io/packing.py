@@ -38,27 +38,73 @@ class PackedReads:
     """Wire-format read batch. `hq[t]` is the 1-bit plane of
     ``qual >= t`` for each threshold t requested at pack time."""
 
-    pcodes: np.ndarray  # uint8 [B, ceil(L/4)], base i at bits 2*(i%4)
-    nmask: np.ndarray   # uint8 [B, ceil(L/8)], bit i%8: code < 0 at i
-    hq: dict            # {threshold: uint8 [B, ceil(L/8)]}
+    pcodes: np.ndarray | None  # uint8 [B, ceil(L/4)], base i at 2*(i%4)
+    nmask: np.ndarray | None   # uint8 [B, ceil(L/8)], bit: code < 0
+    hq: dict            # {threshold: uint8 [B, ceil(L/8)] | None}
     lengths: np.ndarray  # int32 [B]
     length: int          # L (unpacked row width)
+    _wire: np.ndarray | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _b: int | None = dataclasses.field(default=None, repr=False,
+                                       compare=False)
+
+    @property
+    def n_reads(self) -> int:
+        return self.pcodes.shape[0] if self.pcodes is not None else self._b
 
     @property
     def nbytes(self) -> int:
-        return (self.pcodes.nbytes + self.nmask.nbytes
-                + sum(a.nbytes for a in self.hq.values())
-                + self.lengths.nbytes)
+        arrs = [self.pcodes, self.nmask, self.lengths, self._wire,
+                *self.hq.values()]
+        return sum(a.nbytes for a in arrs if a is not None)
 
-    def require_plane(self, threshold: int) -> np.ndarray:
-        """The qual>=threshold plane, or a clear error naming what was
-        packed (shared guard of both stages' packed entry points)."""
-        hq = self.hq.get(int(threshold))
-        if hq is None:
+    def require_plane(self, threshold: int) -> None:
+        """Raise unless the batch was packed with the qual>=threshold
+        plane (shared guard of both stages' packed entry points; the
+        plane itself rides the wire buffer)."""
+        if int(threshold) not in self.hq:
             raise KeyError(
                 f"packed batch lacks the qual>={threshold} plane "
                 f"(has {sorted(self.hq)})")
-        return hq
+
+    def compact(self) -> "PackedReads":
+        """A replay-cache-friendly copy holding ONLY the fused wire
+        buffer plus geometry — the standalone plane arrays duplicate
+        the wire's bytes and nothing reads them after to_wire()."""
+        wire = self.to_wire()
+        return PackedReads(
+            pcodes=None, nmask=None,
+            hq={t: None for t in self.hq}, lengths=self.lengths,
+            length=self.length, _wire=wire,
+            _b=self.n_reads)
+
+    @property
+    def thresholds(self) -> tuple:
+        return tuple(sorted(self.hq))
+
+    def to_wire(self) -> np.ndarray:
+        """Concatenate every plane into ONE flat u8 buffer. The
+        tunnel's H2D pays a large FIXED cost per transfer (measured
+        ~60-120 ms regardless of size, PERF_NOTES.md round 5), so one
+        fused buffer beats four small arrays even at identical bytes.
+        Layout (canonical, all row-major): pcodes | nmask | hq planes
+        in ascending threshold order | lengths as little-endian u8x4.
+        The device side (ops/mer.wire_parts_device) slices it back by
+        the same static layout. Cached — the CLIs warm it from the
+        decode/prefetch thread so the main thread only does H2D."""
+        if self._wire is None:
+            if self.pcodes is None:
+                raise ValueError("compacted PackedReads lost its planes "
+                                 "before the wire was built")
+            if self.lengths.dtype != np.int32:
+                raise TypeError(
+                    "lengths must be int32 for the wire layout")
+            parts = [self.pcodes.reshape(-1), self.nmask.reshape(-1)]
+            parts += [self.hq[t].reshape(-1) for t in self.thresholds]
+            parts.append(np.ascontiguousarray(self.lengths)
+                         .view(np.uint8))
+            self._wire = np.concatenate(parts)
+        return self._wire
 
 
 def pack_reads(codes: np.ndarray, quals: np.ndarray, lengths: np.ndarray,

@@ -1366,15 +1366,13 @@ def correct_batch_packed(state: table.TableState, tmeta: table.TableMeta,
     device widens. Requires the batch to have been packed with
     cfg.qual_cutoff among its thresholds. Bit-identical to
     correct_batch (tests/test_packing.py)."""
-    hq = packed.require_plane(cfg.qual_cutoff)
+    packed.require_plane(cfg.qual_cutoff)
     uniform, cstate, cmeta, has_contam, ambig_cap = _batch_prologue(
-        packed.lengths, packed.pcodes.shape[0], cfg, contam, ambig_cap)
+        packed.lengths, packed.n_reads, cfg, contam, ambig_cap)
     return _correct_device_packed(
-        state, tmeta, jnp.asarray(packed.pcodes),
-        jnp.asarray(packed.nmask), jnp.asarray(hq),
-        jnp.asarray(packed.lengths, jnp.int32), cfg, cstate, cmeta,
+        state, tmeta, jnp.asarray(packed.to_wire()), cfg, cstate, cmeta,
         has_contam, uniform, ambig_cap, event_driven, pack_cap,
-        packed.length)
+        packed.n_reads, packed.length, packed.thresholds)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11, 12))
@@ -1394,20 +1392,25 @@ def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
 
 
 @functools.partial(jax.jit,
-                   static_argnums=(1, 6, 8, 9, 10, 11, 12, 13, 14))
-def _correct_device_packed(state, tmeta, pcodes, nmask, hq, lengths,
-                           cfg: ECConfig, cstate, cmeta,
+                   static_argnums=(1, 3, 5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _correct_device_packed(state, tmeta, wire, cfg: ECConfig,
+                           cstate, cmeta,
                            has_contam: bool, uniform: int | None,
                            ambig_cap: int, event_driven: bool,
-                           pack_cap: int | None, length: int):
+                           pack_cap: int | None, b: int, length: int,
+                           thresholds: tuple):
     """Same executable as _correct_device but fed the bit-packed wire
     format (io/packing.py: 2-bit codes + N mask + the 1-bit
     qual>=cutoff predicate plane — 0.5 B/base over the tunnel instead
-    of 2). The widening at the head is elementwise [B, L] work; the
-    synthetic qual plane is bit-equivalent under the corrector's only
-    quality use, the >= qual_cutoff predicate."""
+    of 2), fused into ONE u8 H2D buffer (the tunnel charges a large
+    fixed cost PER TRANSFER). The widening at the head is elementwise
+    [B, L] work; the synthetic qual plane is bit-equivalent under the
+    corrector's only quality use, the >= qual_cutoff predicate."""
+    pcodes, nmask, hq, lengths = mer.wire_parts_device(
+        wire, b, length, thresholds)
     codes = packing.unpack_codes_device(pcodes, nmask, lengths, length)
-    quals = packing.synth_quals_device(hq, length, cfg.qual_cutoff)
+    quals = packing.synth_quals_device(hq[int(cfg.qual_cutoff)], length,
+                                       cfg.qual_cutoff)
     return _correct_core(state, tmeta, codes, quals, lengths, cfg,
                          cstate, cmeta, has_contam, uniform, ambig_cap,
                          event_driven, pack_cap)

@@ -92,9 +92,10 @@ def build_database(
         # client degrades under concurrent access; PERF_NOTES.md r4).
         def _pack(it):
             for b in it:
-                yield b, packing.pack_reads(
-                    b.codes, b.quals, b.lengths,
-                    thresholds=(cfg.qual_thresh,))
+                pk = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                        thresholds=(cfg.qual_thresh,))
+                pk.to_wire()  # warm the fused H2D buffer off-thread
+                yield b, pk
         batches = prefetch(_pack(fastq.read_batches(
             paths, cfg.batch_size, threads=cfg.threads)))
     timer = StageTimer()

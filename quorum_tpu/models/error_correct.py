@@ -43,8 +43,10 @@ from .ec_config import ECConfig
 def pack_for_stage2(batch: fastq.ReadBatch, cfg: ECConfig):
     """Bit-pack one ReadBatch for the corrector's wire format (runs in
     the decode/prefetch thread; the main thread only does H2D)."""
-    return packing.pack_reads(batch.codes, batch.quals, batch.lengths,
-                              thresholds=(cfg.qual_cutoff,))
+    pk = packing.pack_reads(batch.codes, batch.quals, batch.lengths,
+                            thresholds=(cfg.qual_cutoff,))
+    pk.to_wire()  # warm the fused H2D buffer off the main thread
+    return pk
 
 
 @dataclasses.dataclass

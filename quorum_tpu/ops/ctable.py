@@ -1096,20 +1096,25 @@ def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
                                     qual_thresh, rounds, cap)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 6, 7, 8, 9),
+@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 6, 7, 8),
                    donate_argnums=(0,))
 def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
-                                    pcodes, nmask, hq, lengths,
-                                    qual_thresh: int, rounds: int,
-                                    cap: int, length: int):
+                                    wire, qual_thresh: int, rounds: int,
+                                    cap: int, b: int, length: int,
+                                    thresholds: tuple):
     """The fused insert fed the bit-packed wire format (io/packing.py:
     2-bit codes + N mask + the 1-bit qual>=thresh plane — 0.5 B/base
-    over the tunnel instead of 2). Widening is elementwise [B, L] work
-    at the head of the same executable; the synthetic qual plane is
-    bit-equivalent under extract_observations_impl's only quality use,
-    the < qual_thresh reset predicate."""
+    over the tunnel instead of 2, fused into ONE u8 H2D buffer since
+    the tunnel charges a large fixed cost per transfer). Widening is
+    elementwise [B, L] work at the head of the same executable; the
+    synthetic qual plane is bit-equivalent under
+    extract_observations_impl's only quality use, the < qual_thresh
+    reset predicate."""
+    pcodes, nmask, hq, lengths = mer.wire_parts_device(
+        wire, b, length, thresholds)
     codes = mer.unpack_codes_device(pcodes, nmask, lengths, length)
-    quals = mer.synth_quals_device(hq, length, qual_thresh)
+    quals = mer.synth_quals_device(hq[int(qual_thresh)], length,
+                                   qual_thresh)
     return _insert_reads_fused_core(bstate, meta, codes, quals,
                                     qual_thresh, rounds, cap)
 
@@ -1155,15 +1160,13 @@ def tile_insert_reads_packed(bstate: TBuildState, meta: TileMeta,
     (io/packing.PackedReads) — 0.5 B/base crosses the H2D link instead
     of 2; bit-identical table (tests/test_packing.py). The batch must
     have been packed with `qual_thresh` among its thresholds."""
-    hq = packed.require_plane(qual_thresh)
-    b, length = packed.pcodes.shape[0], packed.length
+    packed.require_plane(qual_thresh)
+    b, length = packed.n_reads, packed.length
     n = b * length
     cap = min(n, max(1024, n // 8))
     bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused_packed(
-        bstate, meta, jnp.asarray(packed.pcodes),
-        jnp.asarray(packed.nmask), jnp.asarray(hq),
-        jnp.asarray(packed.lengths, jnp.int32), qual_thresh,
-        max_rounds - 1, cap, length)
+        bstate, meta, jnp.asarray(packed.to_wire()), qual_thresh,
+        max_rounds - 1, cap, b, length, packed.thresholds)
     return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
                               max_rounds, cap, n)
 

@@ -280,3 +280,24 @@ def synth_quals_device(hq_plane, L: int, threshold: int):
     (uint8 quals are always >= 0)."""
     bits = unpack_bits_device(hq_plane, L)
     return (bits * jnp.int32(max(threshold, 0))).astype(jnp.int32)
+
+
+def wire_parts_device(wire, b: int, L: int, thresholds: tuple):
+    """Slice the fused u8 wire buffer (io/packing.PackedReads.to_wire)
+    back into (pcodes, nmask, {thresh: hq_plane}, lengths) on device.
+    Pure static-slice/reshape work; lengths are rebuilt from their
+    little-endian u8x4 lanes."""
+    c4 = -(-L // 4)
+    c8 = -(-L // 8)
+    o = 0
+    pcodes = wire[o:o + b * c4].reshape(b, c4)
+    o += b * c4
+    nmask = wire[o:o + b * c8].reshape(b, c8)
+    o += b * c8
+    hq = {}
+    for t in thresholds:
+        hq[int(t)] = wire[o:o + b * c8].reshape(b, c8)
+        o += b * c8
+    lb = wire[o:o + 4 * b].reshape(b, 4).astype(jnp.int32)
+    lengths = lb[:, 0] | (lb[:, 1] << 8) | (lb[:, 2] << 16) | (lb[:, 3] << 24)
+    return pcodes, nmask, hq, lengths

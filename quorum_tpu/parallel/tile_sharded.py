@@ -406,10 +406,14 @@ def build_database_tile_sharded(batches, mesh: Mesh,
         pending = jnp.ones((n,), bool)
         grows = 0
         # overflow-only retries always make progress (every fitted
-        # lane places or trips `full`), so the pass count is bounded
-        # by lanes/cap per grow level; the generous bound below only
-        # guards against a logic bug wedging the loop
-        for _ in range(max_grows + 2 * meta.n_shards + 8):
+        # lane places or trips `full`), so passes per grow level are
+        # bounded by lanes/cap; the per-LEVEL budget below resets on
+        # each grow and only guards against a logic bug wedging the
+        # loop (a shared budget could spuriously exhaust under skew
+        # recurring at several grow levels)
+        level_budget = 2 * meta.n_shards + 8
+        passes = 0
+        while True:
             bstate, full, over, placed = step(bstate, codes, quals,
                                               pending)
             if not (bool(full) or bool(over)):
@@ -417,16 +421,19 @@ def build_database_tile_sharded(batches, mesh: Mesh,
             pending = jnp.logical_and(pending, jnp.logical_not(placed))
             if bool(full):
                 # genuine table pressure -> grow (exact-once retry)
-                if grows > max_grows:
+                if grows >= max_grows:
                     raise RuntimeError("Hash is full")
                 grows += 1
+                passes = 0
                 bstate, meta = grow(bstate, meta, mesh)
                 step = build_step(mesh, meta, qual_thresh)
-            # else: send-bucket overflow only — re-exchange the
-            # un-placed lanes at the same size (ADVICE r4: skew must
-            # not trigger doubling while table space remains)
-        else:
-            raise RuntimeError("Hash is full")
+            else:
+                # send-bucket overflow only — re-exchange the
+                # un-placed lanes at the same size (ADVICE r4: skew
+                # must not trigger doubling while table space remains)
+                passes += 1
+                if passes > level_budget:
+                    raise RuntimeError("Hash is full")
     return finalize(bstate, meta, mesh), meta
 
 

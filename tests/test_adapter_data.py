@@ -1,0 +1,52 @@
+"""Built-in adapter contaminant set (quorum_tpu/data): the
+error-tolerant expansion rule (canonical Illumina adapters + all
+1-substitution variants, reference data/adapter.fa) and its use as a
+--contaminant input."""
+
+import numpy as np
+
+from quorum_tpu.data import ADAPTERS, adapter_fasta, adapter_records
+from quorum_tpu.io.contaminant import load_contaminant
+from quorum_tpu.io import db_format
+from quorum_tpu.ops import mer
+
+
+def test_expansion_rule():
+    recs = list(adapter_records())
+    seqs = [s for _, s in recs]
+    assert len(seqs) == len(set(seqs))  # dedup'd
+    # originals first
+    assert seqs[:len(set(ADAPTERS))] == list(dict.fromkeys(ADAPTERS))
+    # every record is hamming<=1 from a canonical adapter
+    for s in seqs:
+        ok = any(len(s) == len(b)
+                 and sum(a != c for a, c in zip(s, b)) <= 1
+                 for b in ADAPTERS)
+        assert ok, s
+    # and the expansion is complete: 7 canonical + 3*len 1-sub variants
+    # minus cross-set duplicates = the reference's 871-sequence set
+    want = set()
+    for b in ADAPTERS:
+        want.add(b)
+        for j, c in enumerate(b):
+            for x in "ACGT":
+                if x != c:
+                    want.add(b[:j] + x + b[j + 1:])
+    assert set(seqs) == want
+    assert len(want) == 871
+
+
+def test_adapter_fasta_loads_as_contaminant(tmp_path):
+    path = adapter_fasta(str(tmp_path / "adapters.fa"))
+    k = 24
+    state, meta = load_contaminant(path, k)
+    # a k-mer from inside an adapter is a member
+    s = ADAPTERS[2][:k]
+    codes = mer.seq_to_codes(s)
+    fhi, flo, rhi, rlo, valid = mer.rolling_kmers(
+        np.asarray(codes, np.int8)[None, :], k)
+    chi, clo = mer.canonical(fhi, flo, rhi, rlo)
+    assert db_format.db_lookup_np(state, meta, int(chi[0, k - 1]),
+                                  int(clo[0, k - 1])) != 0
+    # a random non-adapter k-mer is not
+    assert db_format.db_lookup_np(state, meta, 0x12345678, 0x9abcdef0) == 0

@@ -236,19 +236,15 @@ def test_v3_db_rejects_corrupt_addr(tmp_path):
     bad[0] = -2
     with pytest.raises(ValueError, match="bucket address"):
         db_format.read_db(rewrite(bad, "neg.qdb"), to_device=False)
-    # >64 entries claiming one bucket
-    bad = addr.copy()
-    bad[:] = addr[0] if n <= 64 else bad[0]
-    if n <= 64:
-        # replicate rows to exceed capacity via duplicated addresses
-        reps = 65 // max(n, 1) + 1
-        big_addr = np.tile(addr[:1], 65)
-        lo = np.frombuffer(raw[nl + 4 * n:nl + 8 * n], np.uint32)
-        hi = np.frombuffer(raw[nl + 8 * n:nl + 12 * n], np.uint32)
-        hdr2 = dict(hdr, n_entries=65)
-        p = str(tmp_path / "crowd.qdb")
-        open(p, "wb").write(
-            (_json.dumps(hdr2) + "\n").encode() + big_addr.tobytes()
-            + np.tile(lo[:1], 65).tobytes() + np.tile(hi[:1], 65).tobytes())
-        with pytest.raises(ValueError, match="entries"):
-            db_format.read_db(p, to_device=False)
+    # >64 entries claiming one bucket: rewrite the file with 65 copies
+    # of entry 0 (all sharing one bucket address)
+    lo = np.frombuffer(raw[nl + 4 * n:nl + 8 * n], np.uint32)
+    hi = np.frombuffer(raw[nl + 8 * n:nl + 12 * n], np.uint32)
+    hdr2 = dict(hdr, n_entries=65)
+    p = str(tmp_path / "crowd.qdb")
+    open(p, "wb").write(
+        (_json.dumps(hdr2) + "\n").encode()
+        + np.tile(addr[:1], 65).tobytes()
+        + np.tile(lo[:1], 65).tobytes() + np.tile(hi[:1], 65).tobytes())
+    with pytest.raises(ValueError, match="entries"):
+        db_format.read_db(p, to_device=False)
