@@ -22,11 +22,14 @@ Three payload versions:
   Jellyfish layer uses (RectangularBinaryMatrix,
   src/mer_database.hpp:28).
 
-* version 1 (legacy wide): three uint32 arrays (keys_hi, keys_lo,
-  vals) of equal length `size` (ops/table.TableState). Still readable.
+* version 1 (legacy wide, rounds 1-3): three uint32 arrays (keys_hi,
+  keys_lo, vals) of equal length `size`. Still readable — converted
+  to the tile layout at load (the wide runtime stack was retired in
+  round 5).
 
-Dispatch helpers (`db_lookup_np`, `db_iterate`, `db_stats`) work on
-either, so the inspection CLIs are format-agnostic.
+The helpers (`db_lookup_np`, `db_iterate`, `db_stats`) and every
+consumer see only tile tables, so the inspection CLIs are
+format-agnostic.
 """
 
 from __future__ import annotations
@@ -40,8 +43,7 @@ import time
 import numpy as np
 import jax.numpy as jnp
 
-from ..ops import ctable, table
-from ..ops.table import TableMeta, TableState
+from ..ops import ctable
 from ..ops.ctable import TileMeta, TileState
 
 FORMAT = "binary/quorum_tpu_db"
@@ -109,26 +111,7 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
             f.write(json.dumps(header).encode() + b"\n")
             f.write(rows.tobytes())
         return
-    keys_hi = np.asarray(state.keys_hi, dtype=np.uint32)
-    keys_lo = np.asarray(state.keys_lo, dtype=np.uint32)
-    vals = np.asarray(state.vals, dtype=np.uint32)
-    header = {
-        "format": FORMAT,
-        "version": 1,
-        "key_len": 2 * meta.k,
-        "bits": meta.bits,
-        "size": meta.size,
-        "size_log2": meta.size_log2,
-        "max_reprobe": meta.max_reprobe,
-        "key_bytes": int(keys_hi.nbytes + keys_lo.nbytes),
-        "value_bytes": int(vals.nbytes),
-        **_header_common(cmdline),
-    }
-    with open(path, "wb") as f:
-        f.write(json.dumps(header).encode() + b"\n")
-        f.write(keys_hi.tobytes())
-        f.write(keys_lo.tobytes())
-        f.write(vals.tobytes())
+    raise TypeError(f"write_db expects a tile table, got {type(meta)}")
 
 
 def read_header(path: str) -> dict:
@@ -159,9 +142,9 @@ def read_header(path: str) -> dict:
 
 def read_db(path: str, to_device: bool = True,
             no_mmap: bool = False):
-    """Load a database file. Returns (state, meta, header) where state/
-    meta are (TileState, TileMeta) for version-2 files and (TableState,
-    TableMeta) for legacy version-1 files. With to_device the arrays
+    """Load a database file. Returns (state, meta, header) — always
+    (TileState, TileMeta); legacy version-1 (wide full-key) files are
+    converted to the tile layout at load. With to_device the arrays
     are jnp (HBM); else host numpy views.
 
     The reference mmaps by default with a --no-mmap escape hatch
@@ -244,25 +227,23 @@ def read_db(path: str, to_device: bool = True,
                         rb_log2=header["rb_log2"])
         state = TileState(jnp.asarray(mm) if to_device else mm)
         return state, meta, header
+    # legacy version-1 (wide full-key layout, rounds 1-3): decode the
+    # occupied entries and re-home them in a tile table — one loader
+    # serves every downstream consumer now that the wide runtime stack
+    # is retired (round 5)
     size = header["size"]
     nbytes = size * 4
     mm = plane(np.uint32, offset, (3 * size,))
-    keys_hi = mm[:size]
-    keys_lo = mm[size: 2 * size]
-    vals = mm[2 * size:]
+    keys_hi = np.asarray(mm[:size])
+    keys_lo = np.asarray(mm[size: 2 * size])
+    vals = np.asarray(mm[2 * size:])
     assert offset + 3 * nbytes <= os.path.getsize(path), "truncated database"
-    meta = TableMeta(
-        k=header["key_len"] // 2,
-        bits=header["bits"],
-        size_log2=header["size_log2"],
-        max_reprobe=header["max_reprobe"],
-    )
-    if to_device:
-        state = TableState(
-            jnp.asarray(keys_hi), jnp.asarray(keys_lo), jnp.asarray(vals)
-        )
-    else:
-        state = TableState(keys_hi, keys_lo, vals)
+    occ = np.nonzero(vals != 0)[0]
+    state, meta = ctable.tile_from_entries(
+        keys_hi[occ], keys_lo[occ], vals[occ],
+        header["key_len"] // 2, header["bits"])
+    if not to_device:
+        state = TileState(np.asarray(state.rows))
     return state, meta, header
 
 
@@ -272,25 +253,15 @@ def read_db(path: str, to_device: bool = True,
 
 
 def db_lookup_np(state, meta, khi, klo) -> int:
-    """Scalar host lookup on either format."""
-    if isinstance(meta, TileMeta):
-        return ctable.tile_lookup_np(np.asarray(state.rows), meta, khi, klo)
-    return table.lookup_np(state.keys_hi, state.keys_lo, state.vals,
-                           khi, klo, meta.max_reprobe)
+    """Scalar host lookup."""
+    return ctable.tile_lookup_np(np.asarray(state.rows), meta, khi, klo)
 
 
 def db_iterate(state, meta):
     """(khi, klo, val) numpy arrays of all occupied entries."""
-    if isinstance(meta, TileMeta):
-        return ctable.tile_iterate(state, meta)
-    vals = np.asarray(state.vals)
-    occ = np.nonzero(vals != 0)[0]
-    return (np.asarray(state.keys_hi)[occ], np.asarray(state.keys_lo)[occ],
-            vals[occ])
+    return ctable.tile_iterate(state, meta)
 
 
 def db_stats(state, meta):
-    """(n_occupied, distinct_hq_ge1, total_hq) on either format."""
-    if isinstance(meta, TileMeta):
-        return ctable.tile_stats(state, meta)
-    return table.table_stats(state, meta)
+    """(n_occupied, distinct_hq_ge1, total_hq)."""
+    return ctable.tile_stats(state, meta)

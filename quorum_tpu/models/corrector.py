@@ -46,7 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from ..io import packing
-from ..ops import ctable, mer, table
+from ..ops import ctable, mer
 from ..ops.poisson import poisson_term
 from .ec_config import (
     ECConfig,
@@ -193,9 +193,7 @@ def _db_lookup(state, tmeta, khi, klo, active=None):
 
         return tile_sharded.routed_lookup_local(state.rows, tmeta, khi,
                                                 klo, active)
-    if isinstance(tmeta, ctable.TileMeta):
-        return ctable.tile_lookup_impl(state, tmeta, khi, klo, active)
-    return table._lookup_impl(state, tmeta, khi, klo, active)
+    return ctable.tile_lookup_impl(state, tmeta, khi, klo, active)
 
 
 # Max rows per single lookup op in the TOP-LEVEL sweeps: a tile-row
@@ -331,7 +329,7 @@ class AnchorResult(NamedTuple):
     prev_count: jax.Array  # int32[B] get_val(anchor mer)
 
 
-def find_anchors(state: table.TableState, tmeta: table.TableMeta,
+def find_anchors(state: ctable.TileState, tmeta: ctable.TileMeta,
                  codes, lengths, cfg: ECConfig,
                  contam_state, contam_meta, has_contam: bool,
                  sweep: SweepResult | None = None) -> AnchorResult:
@@ -1010,8 +1008,12 @@ class BatchResult(NamedTuple):
 
 
 def _dummy_contam(k: int):
-    meta = table.TableMeta(k=k, bits=1, size_log2=4)
-    return table.make_table(meta), meta
+    """An empty 16-row tile table: every lookup misses (the
+    has_contam=False executables never read it, but jit needs a
+    concrete operand of the right structure)."""
+    meta = ctable.TileMeta(k=k, bits=1, rb_log2=4)
+    return ctable.TileState(jnp.zeros((meta.rows, ctable.TILE),
+                                      jnp.uint32)), meta
 
 
 def _rev_rows(x, lengths, uniform_len: int | None, fill):
@@ -1294,7 +1296,7 @@ def _event_planes(state, tmeta, sweep: SweepResult, codes32, quals32,
                        mfh2, mfl2, mrh2, mrl2)
 
 
-def correct_batch(state: table.TableState, tmeta: table.TableMeta,
+def correct_batch(state: ctable.TileState, tmeta: ctable.TileMeta,
                   codes, quals, lengths, cfg: ECConfig,
                   contam=None, ambig_cap: int | None = None,
                   event_driven: bool = True, pack_cap: int | None = None):
@@ -1356,7 +1358,7 @@ def _batch_prologue(lengths, b: int, cfg: ECConfig, contam,
     return uniform, cstate, cmeta, has_contam, ambig_cap
 
 
-def correct_batch_packed(state: table.TableState, tmeta: table.TableMeta,
+def correct_batch_packed(state: ctable.TileState, tmeta: ctable.TileMeta,
                          packed, cfg: ECConfig,
                          contam=None, ambig_cap: int | None = None,
                          event_driven: bool = True,

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..io import fastq, db_format, packing
-from ..ops import ctable, mer, table
+from ..ops import ctable, mer
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog

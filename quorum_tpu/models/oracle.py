@@ -34,7 +34,6 @@ from typing import Callable
 import numpy as np
 
 from ..ops import mer as merops
-from ..ops import table as tableops
 from ..ops.poisson import poisson_term_f32, poisson_term_np
 from .ec_config import (
     ECConfig,

@@ -1,1 +1,1 @@
-from . import mer, table, poisson  # noqa: F401
+from . import ctable, mer, poisson  # noqa: F401

@@ -1,1 +1,1 @@
-from . import sharded  # noqa: F401
+from . import tile_sharded  # noqa: F401

@@ -14,7 +14,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from quorum_tpu.ops import mer, table
+from quorum_tpu.ops import ctable, mer
 from quorum_tpu.models.oracle import DictDB, OracleCorrector
 from quorum_tpu.models.ec_config import ECConfig
 from quorum_tpu.models import corrector
@@ -24,9 +24,8 @@ BASES = "ACGT"
 
 
 def table_from_dict(d, k, size_log2=14):
-    """Device table + DictDB with exact (count, qual) per canonical mer."""
-    meta = table.TableMeta(k=k, bits=7, size_log2=size_log2)
-    state = table.make_table(meta)
+    """Device tile table + DictDB with exact (count, qual) per
+    canonical mer."""
     khis, klos, vals = [], [], []
     dd = {}
     for s, (cnt, q) in d.items():
@@ -37,16 +36,9 @@ def table_from_dict(d, k, size_log2=14):
         khis.append(chi)
         klos.append(clo)
         vals.append((cnt << 1) | q)
-    n = len(khis)
-    pad = max(16 - n, 0)
-    state, full = table.raw_insert(
-        state, meta,
-        jnp.asarray(np.array(khis + [0] * pad, np.uint32)),
-        jnp.asarray(np.array(klos + [0] * pad, np.uint32)),
-        jnp.asarray(np.array(vals + [0] * pad, np.uint32)),
-        jnp.asarray(np.array([True] * n + [False] * pad)),
-    )
-    assert not bool(full)
+    state, meta = ctable.tile_from_entries(
+        np.array(khis, np.uint32), np.array(klos, np.uint32),
+        np.array(vals, np.uint32), k, 7)
     return state, meta, DictDB(dd, k)
 
 
@@ -242,8 +234,7 @@ def test_mixed_lengths_and_mismatched_k():
     # contaminant set with wrong k must be rejected (cc:703-705)
     cdb = {}
     add_seq(cdb, rand_seq(rng, 30), 1, 1, k=K + 2)
-    cmeta_bad = table.TableMeta(k=K + 2, bits=7, size_log2=6)
-    cstate_bad = table.make_table(cmeta_bad)
+    cstate_bad, cmeta_bad = corrector._dummy_contam(K + 2)
     cfg = ECConfig(k=K, cutoff=8, poisson_dtype="float32")
     with pytest.raises(ValueError, match="mer length"):
         corrector.correct_batch(state, meta, np.zeros((4, 16), np.int8),

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from quorum_tpu.io import fastq, db_format
-from quorum_tpu.ops import mer, table
+from quorum_tpu.ops import mer
 from quorum_tpu.cli import create_database as cdb_cli
 
 
