@@ -53,6 +53,55 @@ def synth_reads(rng, genome, n_reads, read_len, err_rate=0.01):
     return codes, quals, starts, errs
 
 
+def synth_reads_ramped(rng, genome, n_reads, read_len):
+    """Illumina-like 3' quality decay: error probability ramps
+    0.3% -> ~12% along the read (cubic, tail-heavy) and quality chars
+    decay 70 -> ~33, crossing the stage-1 HQ threshold mid-read. This
+    is the regime where the window error budget (window=10, error=3)
+    and 3' truncation actually fire — the paper's datasets trim
+    6.75-31% of bases (bmc_article.tex:624-649); the flat-quality
+    generator above trims ~0.1%."""
+    starts = rng.integers(0, len(genome) - read_len, size=n_reads)
+    idx = starts[:, None] + np.arange(read_len)[None, :]
+    truth = genome[idx]
+    frac = (np.arange(read_len) / read_len)[None, :]
+    perr = 0.003 + 0.117 * frac ** 3
+    errs = rng.random(truth.shape) < perr
+    codes = np.where(errs, (truth + rng.integers(1, 4, size=truth.shape)) % 4,
+                     truth).astype(np.int8)
+    quals = (70 - 37.0 * frac ** 2).astype(np.uint8)
+    quals = np.broadcast_to(quals, codes.shape).copy()
+    return codes, quals, starts, errs
+
+
+ADAPTER = ("ACACTCTTTCCCTACACGACGCTCTTCCGATCT"
+           "GATCGGAAGAGCGGTTCAGCAGGAATGCCGAG")  # TruSeq stems, 65 bp
+
+
+def inject_contaminants(rng, codes, frac=0.04):
+    """Overwrite a random window of `frac` of the reads with adapter
+    sequence (library-prep read-through), so the contaminant k-mer
+    check has real work. Returns (codes, contaminated_mask)."""
+    from quorum_tpu.ops import mer
+    n, l = codes.shape
+    sel = rng.random(n) < frac
+    acodes = mer.seq_to_codes(ADAPTER)
+    w = min(len(acodes), l - 10)
+    for i in np.nonzero(sel)[0]:
+        off = rng.integers(0, l - w + 1)
+        codes[i, off:off + w] = acodes[:w]
+    return codes, sel
+
+
+def inject_homopolymers(rng, codes, frac=0.03, tail=40):
+    """Give `frac` of the reads a 3' poly-A run (a common artifact the
+    --homo-trim pass removes). Returns (codes, mask)."""
+    n, l = codes.shape
+    sel = rng.random(n) < frac
+    codes[sel, l - tail:] = 0  # A
+    return codes, sel
+
+
 _BASES = np.frombuffer(b"ACGT", np.uint8)
 
 
@@ -83,21 +132,28 @@ def parse_fasta(path):
     return out
 
 
-def accuracy_triple(recs, genome, starts, errs, codes):
+def accuracy_triple(recs, genome, starts, errs, codes, include=None):
     """The paper's metrics (bmc_article.tex:615-651): % of original
     errors remaining after trim+correction, % errors introduced (new
     mismatches vs truth on kept bases), % bases trimmed/discarded.
     Reads are substitution-only, so the corrected sequence is a
     contiguous slice of the read's coordinates; its offset is 0 for
-    untrimmed reads and found by best-match for trimmed ones."""
+    untrimmed reads and found by best-match for trimmed ones.
+    `include` (bool[n], optional) restricts the error metrics to those
+    reads (e.g. excluding reads whose truth is an injected adapter,
+    not genome)."""
     n, l = codes.shape
-    injected = int(errs.sum())
-    total_bases = n * l
+    if include is None:
+        include = np.ones(n, bool)
+    injected = int(errs[include].sum())
+    total_bases = int(include.sum()) * l
     remaining = introduced = kept_bases = 0
     code_of = np.full(256, -1, np.int8)
     for i, b in enumerate(b"ACGT"):
         code_of[b] = i
     for rid in range(n):
+        if not include[rid]:
+            continue
         seq = recs.get(rid)
         if seq is None:
             continue
@@ -123,7 +179,7 @@ def accuracy_triple(recs, genome, starts, errs, codes):
         "pct_errors_introduced": round(100.0 * introduced / injected, 4),
         "pct_bases_trimmed": round(100.0 * trimmed / total_bases, 4),
         "injected_errors": injected,
-        "reads_kept": len(recs),
+        "reads_kept": int(sum(1 for rid in recs if include[rid])),
     }
 
 
@@ -187,6 +243,80 @@ def main():
     recs = parse_fasta(f"{tmp}/bench_out.fa")
     assert len(recs) > 0.9 * n_reads, f"correction mostly failing ({len(recs)})"
     acc = accuracy_triple(recs, genome, starts, errs, codes)
+
+    # ---- secondary regimes (VERDICT r4 weak #5): quality-ramped
+    # tails (trimming fires), 10x coverage, and contaminant+homo-trim
+    # in one config. Each prints its own throughput + accuracy triple;
+    # the 40x flat headline stays last for metric continuity.
+    def run_regime(name, r_genome, codes_r, quals_r, starts_r, errs_r,
+                   ec_extra=(), include=None, size_r=None):
+        fqr = f"{tmp}/{name}.fastq"
+        write_fastq(fqr, codes_r, quals_r)
+        nb_r = codes_r.size
+        if size_r is None:
+            size_r = int((len(r_genome) + errs_r.sum() * K * 1.3) * 1.25
+                         ) + 500_000
+        dbr = f"{tmp}/{name}_db.qdb"
+        ho: dict = {}
+        t0 = time.perf_counter()
+        rc = cdb_cli.main(["-s", str(size_r), "-m", str(K), "-b", "7",
+                           "-q", "38", "-o", dbr,
+                           "--batch-size", str(BATCH), fqr], handoff=ho)
+        s1_r = time.perf_counter() - t0
+        assert rc == 0, f"{name}: create_database failed"
+        t0 = time.perf_counter()
+        rc = ec_cli.main(["-o", f"{tmp}/{name}_out",
+                          "--batch-size", str(BATCH),
+                          *ec_extra, dbr, fqr], db=ho.get("db"))
+        s2_r = time.perf_counter() - t0
+        assert rc == 0, f"{name}: error_correct failed"
+        recs_r = parse_fasta(f"{tmp}/{name}_out.fa")
+        acc_r = accuracy_triple(recs_r, r_genome, starts_r, errs_r,
+                                codes_r, include=include)
+        print(json.dumps({
+            "metric": f"regime_{name}",
+            "stage1_gb_h": round(nb_r / s1_r * 3600 / 1e9, 3),
+            "stage2_gb_h": round(nb_r / s2_r * 3600 / 1e9, 3),
+            "bases": nb_r,
+            "reads": len(codes_r),
+            **acc_r,
+        }))
+        return recs_r
+
+    rngr = np.random.default_rng(7)
+    # (1) ramped-quality tails, ~41x on a 300 kb genome
+    g_r = rngr.integers(0, 4, size=300_000, dtype=np.int8)
+    c_r, q_r, s_r, e_r = synth_reads_ramped(rngr, g_r, 5 * BATCH, READ_LEN)
+    run_regime("ramp40x", g_r, c_r, q_r, s_r, e_r)
+
+    # (2) 10x coverage on the headline genome (flat quality)
+    c_t, q_t, s_t, e_t = synth_reads(rngr, genome, 5 * BATCH, READ_LEN,
+                                     ERR_RATE)
+    run_regime("flat10x", genome, c_t, q_t, s_t, e_t)
+
+    # (3) contaminated + homopolymer reads, trim-contaminant +
+    # homo-trim enabled, against the built-in adapter set
+    from quorum_tpu.data import adapter_fasta
+    adapters = adapter_fasta(f"{tmp}/adapters.fa")
+    c_c, q_c, s_c, e_c = synth_reads(rngr, g_r, 2 * BATCH, READ_LEN,
+                                     ERR_RATE)
+    c_c, contam_mask = inject_contaminants(rngr, c_c)
+    c_c, homo_mask = inject_homopolymers(rngr, c_c)
+    keep = ~(contam_mask | homo_mask)
+    recs_c = run_regime(
+        "contam", g_r, c_c, q_c, s_c, e_c,
+        ec_extra=("--contaminant", adapters, "--trim-contaminant",
+                  "--homo-trim", "10"),
+        include=keep)
+    n_contam_kept = int(sum(1 for rid in recs_c
+                            if contam_mask[rid]
+                            and len(recs_c[rid]) > READ_LEN // 2))
+    print(json.dumps({
+        "metric": "contaminant_handling",
+        "reads_contaminated": int(contam_mask.sum()),
+        "contaminated_kept_over_half_length": n_contam_kept,
+        "reads_homopolymer": int(homo_mask.sum()),
+    }))
 
     # secondary: the reference has no published build-only number; the
     # ratio below still divides by the CORRECTION baseline

@@ -61,24 +61,35 @@ def _header_common(cmdline):
 
 
 def write_db(path: str, state, meta, cmdline: list[str] | None = None,
-             compact: bool = True) -> None:
+             compact: bool = True, n_entries: int | None = None) -> None:
+    """`n_entries` (optional) spares the occupancy-counting pass when
+    the caller already knows it (stage 1's tile_seal does)."""
     if isinstance(meta, TileMeta):
         if compact:
             # v3: occupied entries only (addr, lo, hi — 12 B each).
             # A ~30%-occupied table moves ~4-5x fewer bytes through
             # the tunnel's ~0.17 s/MB D2H than the raw row plane, and
             # the read side re-uploads the same compact arrays.
-            occ, _d, _t = ctable.tile_stats(state, meta)
-            n = int(occ)
+            if n_entries is None:
+                occ, _d, _t = ctable.tile_stats(state, meta)
+                n_entries = int(occ)
+            n = n_entries
             # cap is a STATIC jit arg: round up to a power of two so
             # the compaction executable cache-hits across runs instead
             # of recompiling per distinct occupancy
             cap = 1 << max(10, (max(1, n) - 1).bit_length())
-            addr, lo, hi, _n = ctable.tile_compact_device(state, meta,
-                                                          cap)
-            addr = np.asarray(addr)[:n]
-            lo = np.asarray(lo)[:n]
-            hi = np.asarray(hi)[:n]
+            addr_c, lo_c, hi_c, _n = ctable.tile_compact_device(
+                state, meta, cap)
+            # ONE D2H of exactly 12n bytes: device-slice to n (the
+            # cap-padded planes would transfer up to 2x the bytes) and
+            # fuse the three planes into a single little-endian u8
+            # buffer (the tunnel charges a big fixed cost per
+            # transfer)
+            buf = np.asarray(ctable.bytes_concat_device(
+                addr_c[:n], lo_c[:n], hi_c[:n]))
+            addr = buf[:4 * n].view(np.int32)
+            lo = buf[4 * n:8 * n].view(np.uint32)
+            hi = buf[8 * n:].view(np.uint32)
             header = {
                 "format": FORMAT,
                 "version": 3,

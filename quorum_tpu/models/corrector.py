@@ -1734,66 +1734,88 @@ def finish_batch(res: BatchResult, n: int, cfg: ECConfig,
     l = res.out.shape[1]
 
     if codes is not None:
-        # the buffer's leading geometry scalars replace a separate
-        # scalar D2H; the entry capacity guess self-tunes per shape
-        # and a rare overflow re-packs once with the exact size.
-        # `packed` is the same buffer already produced INSIDE the
-        # correction executable (correct_batch(pack_cap=...)) — one
-        # dispatch instead of two.
-        b = res.out.shape[0]
-        key = (b, maxe)
-        if packed is not None:
-            buf = np.asarray(packed)
-            cap_e = len(buf) - 2 - 3 * b
-        else:
-            cap_e = _LEAN_CAP_CACHE.get(key, 16384)
-            buf = np.asarray(_pack_finish_lean(res, cap_e))
-        maxn, total = int(buf[0]), int(buf[1])
-        if maxn > maxe:
-            raise RuntimeError(
-                f"log overflow: {maxn} entries > buffer {maxe}")
-        if total > cap_e:
-            cap_e = 4096
-            while cap_e < total:
-                cap_e *= 2
-            buf = np.asarray(_pack_finish_lean(res, cap_e))
-        if packed is None:
-            # monotone per shape: a shrinking guess would re-pack
-            # every other batch when totals straddle a pow2 boundary.
-            # (Not updated on the prepacked path — its cap is the
-            # caller's fixed choice, not a tuned guess.)
-            _LEAN_CAP_CACHE[key] = max(
-                cap_e, 4096, 1 << (max(1, total) - 1).bit_length())
-        buf = buf[2:]
-        h1, h2, h3 = buf[:b], buf[b:2 * b], buf[2 * b:3 * b]
-        flat = buf[3 * b:]
+        buf = fetch_finish(res, packed)
+        return finish_batch_host(buf, n, cfg, codes,
+                                 res.out.shape[0], l, maxe)
 
-        def s16(x):
-            return x.astype(np.uint16).view(np.int16).astype(np.int32)
+    # wide path continues below
+    return _finish_wide(res, n, cfg, maxe, l)
 
-        start, end = s16(h1 >> 16), s16(h1 & 0xFFFF)
-        status, f_n = s16(h2 >> 16), s16(h2 & 0xFFFF)
-        b_n = s16(h3 & 0xFFFF)
-        tot_n = f_n + b_n
-        offs_f = (np.cumsum(tot_n) - tot_n).astype(np.int64)
-        offs_b = offs_f + f_n
-        pos_flat = (s16(flat >> 16) - _POS_BIAS).astype(np.int32)
-        meta_flat = s16(flat & 0xFFFF).astype(np.int32)
-        # reconstruct the corrected sequence: input bases + logged subs
-        codes_np = np.asarray(codes)
-        seq_ascii = _BASE_U8[np.clip(codes_np[:, :l], 0, 3)].copy()
-        if total:
-            counts = tot_n.astype(np.int64)
-            ri = np.repeat(np.arange(b), counts)
-            m = meta_flat[:total]
-            p = pos_flat[:total]
-            is_sub = (m & 1) == 0
-            to = (m >> 4) & 7
-            sel = is_sub & (to < 4) & (p >= 0) & (p < l)
-            seq_ascii[ri[sel], p[sel]] = _BASE_U8[to[sel]]
-        return _finish_host(n, l, cfg, seq_ascii, start, end, status,
-                            f_n, b_n, offs_f, offs_b, pos_flat, meta_flat)
 
+def fetch_finish(res: BatchResult, packed=None) -> np.ndarray:
+    """MAIN-THREAD half of the lean finish: the single packed D2H (and
+    the rare exact-size re-pack dispatch on overflow — a device call,
+    which must stay on the tunnel's one thread; PERF_NOTES.md r4).
+    Returns the host buffer, ready for finish_batch_host on any
+    thread."""
+    b = res.out.shape[0]
+    maxe = res.fwd_log.pos.shape[1]
+    key = (b, maxe)
+    if packed is not None:
+        buf = np.asarray(packed)
+        cap_e = len(buf) - 2 - 3 * b
+    else:
+        cap_e = _LEAN_CAP_CACHE.get(key, 16384)
+        buf = np.asarray(_pack_finish_lean(res, cap_e))
+    total = int(buf[1])
+    if total > cap_e:
+        # the entry-capacity guess overflowed: re-pack once, exact
+        cap_e = 4096
+        while cap_e < total:
+            cap_e *= 2
+        buf = np.asarray(_pack_finish_lean(res, cap_e))
+    if packed is None:
+        # monotone per shape: a shrinking guess would re-pack every
+        # other batch when totals straddle a pow2 boundary. (Not
+        # updated on the prepacked path — its cap is the caller's
+        # fixed choice, not a tuned guess.)
+        _LEAN_CAP_CACHE[key] = max(
+            cap_e, 4096, 1 << (max(1, total) - 1).bit_length())
+    return buf
+
+
+def finish_batch_host(buf: np.ndarray, n: int, cfg: ECConfig, codes,
+                      b: int, l: int, maxe: int) -> list[ReadResult]:
+    """WORKER-SAFE half of the lean finish: pure numpy/str work on the
+    fetched buffer — no device interaction, so the stage-2 pipeline
+    renders batch i while the device corrects batch i+1."""
+    maxn, total = int(buf[0]), int(buf[1])
+    if maxn > maxe:
+        raise RuntimeError(
+            f"log overflow: {maxn} entries > buffer {maxe}")
+    buf = buf[2:]
+    h1, h2, h3 = buf[:b], buf[b:2 * b], buf[2 * b:3 * b]
+    flat = buf[3 * b:]
+
+    def s16(x):
+        return x.astype(np.uint16).view(np.int16).astype(np.int32)
+
+    start, end = s16(h1 >> 16), s16(h1 & 0xFFFF)
+    status, f_n = s16(h2 >> 16), s16(h2 & 0xFFFF)
+    b_n = s16(h3 & 0xFFFF)
+    tot_n = f_n + b_n
+    offs_f = (np.cumsum(tot_n) - tot_n).astype(np.int64)
+    offs_b = offs_f + f_n
+    pos_flat = (s16(flat >> 16) - _POS_BIAS).astype(np.int32)
+    meta_flat = s16(flat & 0xFFFF).astype(np.int32)
+    # reconstruct the corrected sequence: input bases + logged subs
+    codes_np = np.asarray(codes)
+    seq_ascii = _BASE_U8[np.clip(codes_np[:, :l], 0, 3)].copy()
+    if total:
+        counts = tot_n.astype(np.int64)
+        ri = np.repeat(np.arange(b), counts)
+        m = meta_flat[:total]
+        p = pos_flat[:total]
+        is_sub = (m & 1) == 0
+        to = (m >> 4) & 7
+        sel = is_sub & (to < 4) & (p >= 0) & (p < l)
+        seq_ascii[ri[sel], p[sel]] = _BASE_U8[to[sel]]
+    return _finish_host(n, l, cfg, seq_ascii, start, end, status,
+                        f_n, b_n, offs_f, offs_b, pos_flat, meta_flat)
+
+
+def _finish_wide(res: BatchResult, n: int, cfg: ECConfig, maxe: int,
+                 l: int) -> list[ReadResult]:
     # wide path: one tiny D2H decides the clip width, one packed D2H
     # moves the rest
     maxn = int(np.asarray(jnp.maximum(jnp.max(res.fwd_log.n),

@@ -96,8 +96,20 @@ def build_database(
                                         thresholds=(cfg.qual_thresh,))
                 pk.to_wire()  # warm the fused H2D buffer off-thread
                 yield b, pk
-        batches = prefetch(_pack(fastq.read_batches(
-            paths, cfg.batch_size, threads=cfg.threads)))
+        import jax as _jax
+        if _jax.process_count() > 1:
+            # the single-chip build is host-local state; running it
+            # per-host would write racing PARTIAL tables. Multi-host
+            # stage 1 = global mesh + parallel/tile_sharded.
+            # build_database_tile_sharded fed by
+            # parallel/multihost.read_batches_multihost.
+            raise RuntimeError(
+                "multi-host build requires the sharded pipeline "
+                "(parallel.tile_sharded.build_database_tile_sharded + "
+                "parallel.multihost), not the single-chip CLI")
+        src = fastq.read_batches(paths, cfg.batch_size,
+                                 threads=cfg.threads)
+        batches = prefetch(_pack(src))
     timer = StageTimer()
     with trace(cfg.profile):
         for batch, pk in batches:
@@ -129,14 +141,18 @@ def build_database(
                 else:
                     if full:
                         raise RuntimeError("Hash is full")
+    with timer.stage("seal"):
+        # ONE dispatch: dup check + finalize + stats fused (separate
+        # calls each walk the full build planes; measured seconds per
+        # pass at production table sizes)
+        state, dup, occ, _d, _t = ctable.tile_seal(bstate, meta)
+        occ = int(occ)
+        if bool(dup):  # pragma: no cover
+            raise RuntimeError(
+                "internal error: duplicate tag pair in a bucket (torn "
+                "tag write) — please report")
     timer.report(stats.bases)
-    if bool(ctable.tile_dup_check(bstate, meta)):  # pragma: no cover
-        raise RuntimeError(
-            "internal error: duplicate tag pair in a bucket (torn tag "
-            "write) — please report")
-    state = ctable.tile_finalize(bstate, meta)
-    occ, _, _ = ctable.tile_stats(state, meta)
-    stats.distinct = int(occ)
+    stats.distinct = occ
     vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
          stats.distinct, " distinct mers")
     return state, meta, stats
@@ -170,5 +186,6 @@ def create_database_main(
         quorum_db.write_ref_db(output, khi, klo, vals, meta.k, meta.bits,
                                cmdline=cmdline)
     else:
-        db_format.write_db(output, state, meta, cmdline)
+        db_format.write_db(output, state, meta, cmdline,
+                           n_entries=stats.distinct)
     return stats

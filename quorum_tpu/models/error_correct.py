@@ -36,7 +36,8 @@ from ..ops.poisson import compute_poisson_cutoff
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
-from .corrector import correct_batch_packed, finish_batch
+from .corrector import (correct_batch_packed, fetch_finish,
+                        finish_batch_host)
 from .ec_config import ECConfig
 
 
@@ -175,6 +176,15 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
             # quorum-driver replay: stage 1 already parsed AND packed
             # these reads (run_quorum); skip the second disk parse
             src = None
+        elif jax.process_count() > 1:
+            # per-host runs of the single-chip CLI would race on one
+            # output path; multi-host stage 2 = global mesh +
+            # tile_sharded.correct_step(_routed) with per-host output
+            # prefixes, fed by parallel/multihost
+            raise RuntimeError(
+                "multi-host correction requires the sharded pipeline "
+                "(parallel.tile_sharded.correct_step + "
+                "parallel.multihost), not the single-chip CLI")
         else:
             src = fastq.read_batches(sequences, opts.batch_size,
                                      threads=opts.threads)
@@ -192,48 +202,80 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                 for b in it:
                     yield b, pack_for_stage2(b, cfg)
             batches = prefetch(_pack(src))
-        with trace(opts.profile):
-            for batch, pk in batches:
-                with timer.stage("device"):
-                    # the lean finish buffer packs inside the same
-                    # executable (one dispatch per batch instead of
-                    # two). The cap is a DETERMINISTIC function of the
-                    # batch shape — a data-dependent cap would
-                    # recompile the whole corrector executable per
-                    # distinct value (measured: minutes, mid-run).
-                    # 4 entries/read covers ~1% error rates with 2x+
-                    # headroom; rarer batches overflow and re-pack
-                    # once in finish_batch.
-                    cap = 4 * batch.codes.shape[0]
-                    res, packed = correct_batch_packed(
-                        state, meta, pk, cfg, contam=contam,
-                        pack_cap=cap)
-                    jax.block_until_ready(packed)
-                with timer.stage("finish"):
-                    results = finish_batch(res, batch.n, cfg,
-                                           codes=batch.codes,
-                                           packed=packed)
-                with timer.stage("render"):
-                    fa_parts: list[str] = []
-                    log_parts: list[str] = []
-                    for hdr, r in zip(batch.headers, results):
-                        if r.ok:
-                            fa_parts.append(
-                                f">{hdr} {r.fwd_log} {r.bwd_log}\n"
-                                f"{r.seq}\n")
-                            stats.corrected += 1
-                            stats.bases_out += r.end - r.start
-                        else:
-                            log_parts.append(f"Skipped {hdr}: {r.error}\n")
-                            stats.skipped += 1
-                            if cfg.no_discard:
-                                fa_parts.append(f">{hdr}\nN\n")
+        # host finish+render pipeline: the D2H (fetch_finish) must stay
+        # on the MAIN thread (the tunnel degrades under concurrent
+        # device access, PERF_NOTES.md r4), but the numpy/str tail is
+        # pure host work — one worker renders batch i while the device
+        # corrects batch i+1 (~0.3-0.4 s/batch hidden). A single
+        # worker + FIFO drain preserves output record order.
+        import collections
+        import concurrent.futures as _cf
+
+        def _render(batch, buf, b, l, maxe):
+            results = finish_batch_host(buf, batch.n, cfg, batch.codes,
+                                        b, l, maxe)
+            fa_parts: list[str] = []
+            log_parts: list[str] = []
+            n_corr = n_skip = bases_out = 0
+            for hdr, r in zip(batch.headers, results):
+                if r.ok:
+                    fa_parts.append(
+                        f">{hdr} {r.fwd_log} {r.bwd_log}\n{r.seq}\n")
+                    n_corr += 1
+                    bases_out += r.end - r.start
+                else:
+                    log_parts.append(f"Skipped {hdr}: {r.error}\n")
+                    n_skip += 1
+                    if cfg.no_discard:
+                        fa_parts.append(f">{hdr}\nN\n")
+            return ("".join(fa_parts), "".join(log_parts), n_corr,
+                    n_skip, bases_out)
+
+        def _drain(fut):
+            with timer.stage("drain"):
+                fa, lg, n_corr, n_skip, bases_out = fut.result()
+            stats.corrected += n_corr
+            stats.skipped += n_skip
+            stats.bases_out += bases_out
+            writer.write(0, fa)
+            writer.write(1, lg)
+
+        pool = _cf.ThreadPoolExecutor(1)
+        pending: collections.deque = collections.deque()
+        try:
+            with trace(opts.profile):
+                for batch, pk in batches:
+                    with timer.stage("device"):
+                        # the lean finish buffer packs inside the same
+                        # executable (one dispatch per batch instead
+                        # of two). The cap is a DETERMINISTIC function
+                        # of the batch shape — a data-dependent cap
+                        # would recompile the whole corrector
+                        # executable per distinct value (measured:
+                        # minutes, mid-run). 4 entries/read covers ~1%
+                        # error rates with 2x+ headroom; rarer batches
+                        # overflow and re-pack once in fetch_finish.
+                        cap = 4 * batch.codes.shape[0]
+                        res, packed = correct_batch_packed(
+                            state, meta, pk, cfg, contam=contam,
+                            pack_cap=cap)
+                        jax.block_until_ready(packed)
+                    with timer.stage("fetch"):
+                        buf = fetch_finish(res, packed)
+                    b, l = res.out.shape
+                    maxe = res.fwd_log.pos.shape[1]
+                    while len(pending) >= 2:
+                        _drain(pending.popleft())
+                    pending.append(pool.submit(_render, batch, buf,
+                                               b, l, maxe))
                     stats.reads += batch.n
                     nb = int(batch.lengths[:batch.n].sum())
                     stats.bases_in += nb
                     timer.add_units("device", nb)
-                    writer.write(0, "".join(fa_parts))
-                    writer.write(1, "".join(log_parts))
+                while pending:
+                    _drain(pending.popleft())
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
     finally:
         try:
             writer.close()

@@ -746,7 +746,9 @@ def tile_from_build(bstate: CBuildState, meta: CTableMeta,
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def tile_stats(state: TileState, meta: TileMeta):
-    """(n_occupied, distinct_hq_ge1, total_hq) over the tile table."""
+    """(n_occupied, distinct_hq_ge1, total_hq) over the tile table.
+    Jitted: unjitted, each slice/reduce op dispatched separately over
+    the full row plane (~GBs) through the tunnel."""
     lo = state.rows[:, 0::2]
     count = lo & jnp.uint32(meta.max_val)
     occ = count != 0
@@ -1251,16 +1253,34 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
 def tile_dup_check(bstate: TBuildState, meta: TileMeta):
     """True iff any bucket holds two occupied slots with the same tag
     pair — impossible unless the two tag scatters ever disagreed on a
-    winner (see _tile_build_round). Checked once per build."""
+    winner (see _tile_build_round). Checked once per build (fused with
+    finalize+stats in tile_seal; this standalone entry serves tests)."""
+    return _dup_check_impl(bstate, meta)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def tile_seal(bstate: TBuildState, meta: TileMeta):
+    """End-of-build fusion: dup check + finalize + stats as ONE
+    dispatch (each separate call walks the full multi-GB build planes;
+    through the tunnel every extra dispatch also costs fixed ~25-90
+    ms). Returns (TileState, dup, n_occupied, distinct_hq, total_hq)."""
+    dup = _dup_check_impl(bstate, meta)
+    state = _finalize_impl(bstate, meta)
+    occ, distinct, total = tile_stats.__wrapped__(state, meta)
+    return state, dup, occ, distinct, total
+
+
+def _dup_check_impl(bstate: TBuildState, meta: TileMeta):
     tlo = bstate.tag[:, 0::2]
     thi = bstate.tag[:, 1::2]
     sh = (meta.rows, TSLOTS)
-    occ = (tlo != _EMPTY_TAG) &         ((bstate.hq.reshape(sh) | bstate.lq.reshape(sh)) != 0)
-    # sort by a 64-bit tag key within each bucket; duplicates adjacent
+    occ = (tlo != _EMPTY_TAG) & \
+        ((bstate.hq.reshape(sh) | bstate.lq.reshape(sh)) != 0)
     key_hi = jnp.where(occ, thi, jnp.uint32(0xFFFFFFFF))
     key_lo = jnp.where(occ, tlo, jnp.uint32(0xFFFFFFFF))
     shi, slo = jax.lax.sort((key_hi, key_lo), dimension=1, num_keys=2)
-    dup = (shi[:, 1:] == shi[:, :-1]) & (slo[:, 1:] == slo[:, :-1]) &         (shi[:, 1:] != jnp.uint32(0xFFFFFFFF))
+    dup = (shi[:, 1:] == shi[:, :-1]) & (slo[:, 1:] == slo[:, :-1]) & \
+        (shi[:, 1:] != jnp.uint32(0xFFFFFFFF))
     return jnp.any(dup)
 
 
@@ -1269,6 +1289,10 @@ def tile_finalize(bstate: TBuildState, meta: TileMeta) -> TileState:
     """Pack accumulators into the query layout in place: lo word =
     rlo | qual | count (count-at-best-quality closed form), phantom and
     empty slots -> 0."""
+    return _finalize_impl(bstate, meta)
+
+
+def _finalize_impl(bstate: TBuildState, meta: TileMeta) -> TileState:
     tlo = bstate.tag[:, 0::2]
     thi = bstate.tag[:, 1::2]
     sh = (meta.rows, TSLOTS)
@@ -1338,3 +1362,17 @@ def tile_grow_build(bstate: TBuildState, meta: TileMeta,
         if bool(left):  # pragma: no cover - halved load can't overflow
             raise RuntimeError("Hash is full")
     return new_state, new_meta
+
+
+def bytes_concat_device(*arrays):
+    """Concatenate 32-bit device arrays into one little-endian u8
+    buffer ON DEVICE, so a multi-plane D2H pays the tunnel's large
+    fixed per-transfer cost once and moves exactly the live bytes.
+    bitcast_convert_type to u8 yields each word's bytes in the minor
+    dimension in host (little-endian) order — pinned by
+    tests/test_create_database.py round trips."""
+    parts = [
+        jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+        for a in arrays
+    ]
+    return jnp.concatenate(parts)

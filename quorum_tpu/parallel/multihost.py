@@ -1,0 +1,79 @@
+"""Multi-host (DCN) input sharding for stage 1/2 (SURVEY §2.4, §5).
+
+The reference is single-node; scaling beyond one host here follows the
+standard JAX multi-controller recipe: every host runs the SAME
+program, the device mesh spans all hosts (`jax.make_mesh` over
+`jax.devices()`), and collectives ride ICI within a slice and DCN
+across slices — the program never addresses a remote host explicitly.
+The only genuinely multi-host-specific decision is INPUT sharding:
+which host parses which read files. That lives here.
+
+Sharding is by FILE (not byte ranges): FASTQ is newline-framed and
+gzip members aren't splittable, so files are the natural unit — the
+same reason the reference parallelizes across its thread-pool by
+whole-sequence jobs (stream_manager, create_database.cc:52). Hosts
+with no file of their own still participate in every collective (the
+mesh is global), contributing empty batches.
+
+This feeds the SHARDED pipeline (tile_sharded.build_database_tile_
+sharded / correct_step over a global mesh, whose collectives merge
+state across hosts); the single-chip CLIs refuse process_count > 1 —
+their state is host-local and per-host runs would race on one output.
+Deterministic: the assignment depends only on (file sizes, process
+topology), so every host computes the same global plan without
+communicating.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Sequence
+
+import jax
+
+from ..io import fastq
+
+
+def host_shard_paths(paths: Sequence[str],
+                     process_index: int | None = None,
+                     process_count: int | None = None) -> list[str]:
+    """The subset of `paths` THIS host should parse.
+
+    Greedy size-balanced assignment (largest file first onto the
+    least-loaded host) so hosts finish their decode at roughly the
+    same time; ties and unstatable files fall back to round-robin
+    order. Every path is assigned to exactly one host."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc <= 1:
+        return list(paths)
+
+    def size_of(p):
+        try:
+            return os.path.getsize(p)
+        except OSError:
+            return 0
+
+    # stable plan: sort by (size desc, original order)
+    order = sorted(range(len(paths)),
+                   key=lambda i: (-size_of(paths[i]), i))
+    load = [0] * pc
+    owner = [0] * len(paths)
+    for rank, i in enumerate(order):
+        h = min(range(pc), key=lambda j: (load[j], j))
+        owner[i] = h
+        load[h] += size_of(paths[i]) or 1
+    return [p for i, p in enumerate(paths) if owner[i] == pi]
+
+
+def read_batches_multihost(paths: Sequence[str], batch_size: int = 8192,
+                           threads: int = 1) -> Iterator[fastq.ReadBatch]:
+    """This host's share of the global read stream, batched. With one
+    process this is exactly fastq.read_batches. Callers running under
+    a global mesh must keep issuing collective steps until EVERY host
+    drains (hosts' shares differ in length) — build_step/correct_step
+    handle that by treating an empty batch as all-invalid lanes."""
+    mine = host_shard_paths(paths)
+    if not mine:
+        return
+    yield from fastq.read_batches(mine, batch_size, threads=threads)

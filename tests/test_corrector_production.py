@@ -49,15 +49,16 @@ def test_production_shape_parity_and_efficacy(production_batch):
                                   jnp.asarray(quals), lengths, cfg)
     dev = corrector.finish_batch(res, B, cfg)
 
-    # sampled bit-exact oracle parity (full-batch python would be slow)
+    # EXHAUSTIVE bit-exact oracle parity: every read in the batch
+    # (VERDICT r4 weak #7 — k=24/150 bp is where packing/layout bugs
+    # would live, and a sampled check could miss them)
     ikhi, iklo, ivals = ctable.tile_iterate(state, meta)
     d = {(int(h) << 32) | int(l): (int(v) >> 1, int(v) & 1)
          for h, l, v in zip(ikhi, iklo, ivals)}
     oc = OracleCorrector(DictDB(d, K), cfg)
-    rng = np.random.default_rng(1)
-    sample = rng.choice(B, size=60, replace=False)
-    for i in sample:
-        read = "".join(BASES[c] for c in codes[i])
+    seqs = np.frombuffer(b"ACGT", np.uint8)[np.clip(codes, 0, 3)]
+    for i in range(B):
+        read = seqs[i].tobytes().decode()
         qual = "".join(chr(int(q)) for q in quals[i])
         o = oc.correct(read, qual)
         dv = dev[i]
