@@ -223,21 +223,34 @@ def main():
     # and file output are all inside the timed windows.
     db = f"{tmp}/bench_db.qdb"
     handoff: dict = {}
-    t0 = time.perf_counter()
-    rc = cdb_cli.main(["-s", str(size), "-m", str(K), "-b", "7", "-q", "38",
-                       "-o", db, "--batch-size", str(BATCH), fq],
-                      handoff=handoff)
-    s1_dt = time.perf_counter() - t0
-    assert rc == 0, "create_database failed"
+
+    def timed_cli(fn, argv, what, **kw):
+        """Timed with one retry (transient tunnel-compile failures);
+        a retried run re-times from the retry so the recorded number
+        isn't polluted by the failed attempt."""
+        t0 = time.perf_counter()
+        rc = fn(argv, **kw)
+        if rc != 0:
+            print(f"# retrying {what} once (transient failure)",
+                  flush=True)
+            t0 = time.perf_counter()
+            rc = fn(argv, **kw)
+        assert rc == 0, f"{what} failed"
+        return time.perf_counter() - t0
+
+    s1_dt = timed_cli(cdb_cli.main,
+                      ["-s", str(size), "-m", str(K), "-b", "7",
+                       "-q", "38", "-o", db,
+                       "--batch-size", str(BATCH), fq],
+                      "create_database", handoff=handoff)
     s1 = bases / s1_dt * 3600 / 1e9
 
     ec_cli.main(["-o", f"{tmp}/warm_out", "--batch-size", str(BATCH),
                  db, wq], db=handoff.get("db"))
-    t0 = time.perf_counter()
-    rc = ec_cli.main(["-o", f"{tmp}/bench_out", "--batch-size", str(BATCH),
-                      db, fq], db=handoff.get("db"))
-    s2_dt = time.perf_counter() - t0
-    assert rc == 0, "error_correct_reads failed"
+    s2_dt = timed_cli(ec_cli.main,
+                      ["-o", f"{tmp}/bench_out",
+                       "--batch-size", str(BATCH), db, fq],
+                      "error_correct_reads", db=handoff.get("db"))
     s2 = bases / s2_dt * 3600 / 1e9
 
     recs = parse_fasta(f"{tmp}/bench_out.fa")
@@ -248,6 +261,18 @@ def main():
     # tails (trimming fires), 10x coverage, and contaminant+homo-trim
     # in one config. Each prints its own throughput + accuracy triple;
     # the 40x flat headline stays last for metric continuity.
+    def run_cli(fn, argv, what, **kw):
+        """One retry: the tunnel's remote_compile endpoint fails
+        transiently on long compiles (observed 'response body closed
+        before all bytes were read'); the second attempt reuses
+        whatever the cache kept."""
+        rc = fn(argv, **kw)
+        if rc != 0:
+            print(f"# retrying {what} once (transient failure)",
+                  flush=True)
+            rc = fn(argv, **kw)
+        assert rc == 0, f"{what} failed"
+
     def run_regime(name, r_genome, codes_r, quals_r, starts_r, errs_r,
                    ec_extra=(), include=None, size_r=None):
         fqr = f"{tmp}/{name}.fastq"
@@ -259,17 +284,18 @@ def main():
         dbr = f"{tmp}/{name}_db.qdb"
         ho: dict = {}
         t0 = time.perf_counter()
-        rc = cdb_cli.main(["-s", str(size_r), "-m", str(K), "-b", "7",
-                           "-q", "38", "-o", dbr,
-                           "--batch-size", str(BATCH), fqr], handoff=ho)
+        run_cli(cdb_cli.main,
+                ["-s", str(size_r), "-m", str(K), "-b", "7",
+                 "-q", "38", "-o", dbr,
+                 "--batch-size", str(BATCH), fqr],
+                f"{name}: create_database", handoff=ho)
         s1_r = time.perf_counter() - t0
-        assert rc == 0, f"{name}: create_database failed"
         t0 = time.perf_counter()
-        rc = ec_cli.main(["-o", f"{tmp}/{name}_out",
-                          "--batch-size", str(BATCH),
-                          *ec_extra, dbr, fqr], db=ho.get("db"))
+        run_cli(ec_cli.main,
+                ["-o", f"{tmp}/{name}_out", "--batch-size", str(BATCH),
+                 *ec_extra, dbr, fqr],
+                f"{name}: error_correct", db=ho.get("db"))
         s2_r = time.perf_counter() - t0
-        assert rc == 0, f"{name}: error_correct failed"
         recs_r = parse_fasta(f"{tmp}/{name}_out.fa")
         acc_r = accuracy_triple(recs_r, r_genome, starts_r, errs_r,
                                 codes_r, include=include)
@@ -283,16 +309,27 @@ def main():
         }))
         return recs_r
 
+    # regime failures must not lose the headline: each is best-effort
+    # (transient tunnel-compile failures have been observed even after
+    # the in-regime retry)
+    def try_regime(name, *a, **kw):
+        try:
+            return run_regime(name, *a, **kw)
+        except Exception as e:  # noqa: BLE001 — reported, not fatal
+            print(json.dumps({"metric": f"regime_{name}",
+                              "error": str(e)[:200]}))
+            return None
+
     rngr = np.random.default_rng(7)
     # (1) ramped-quality tails, ~41x on a 300 kb genome
     g_r = rngr.integers(0, 4, size=300_000, dtype=np.int8)
     c_r, q_r, s_r, e_r = synth_reads_ramped(rngr, g_r, 5 * BATCH, READ_LEN)
-    run_regime("ramp40x", g_r, c_r, q_r, s_r, e_r)
+    try_regime("ramp40x", g_r, c_r, q_r, s_r, e_r)
 
     # (2) 10x coverage on the headline genome (flat quality)
     c_t, q_t, s_t, e_t = synth_reads(rngr, genome, 5 * BATCH, READ_LEN,
                                      ERR_RATE)
-    run_regime("flat10x", genome, c_t, q_t, s_t, e_t)
+    try_regime("flat10x", genome, c_t, q_t, s_t, e_t)
 
     # (3) contaminated + homopolymer reads, trim-contaminant +
     # homo-trim enabled, against the built-in adapter set
@@ -303,20 +340,21 @@ def main():
     c_c, contam_mask = inject_contaminants(rngr, c_c)
     c_c, homo_mask = inject_homopolymers(rngr, c_c)
     keep = ~(contam_mask | homo_mask)
-    recs_c = run_regime(
+    recs_c = try_regime(
         "contam", g_r, c_c, q_c, s_c, e_c,
         ec_extra=("--contaminant", adapters, "--trim-contaminant",
                   "--homo-trim", "10"),
         include=keep)
-    n_contam_kept = int(sum(1 for rid in recs_c
-                            if contam_mask[rid]
-                            and len(recs_c[rid]) > READ_LEN // 2))
-    print(json.dumps({
-        "metric": "contaminant_handling",
-        "reads_contaminated": int(contam_mask.sum()),
-        "contaminated_kept_over_half_length": n_contam_kept,
-        "reads_homopolymer": int(homo_mask.sum()),
-    }))
+    if recs_c is not None:
+        n_contam_kept = int(sum(1 for rid in recs_c
+                                if contam_mask[rid]
+                                and len(recs_c[rid]) > READ_LEN // 2))
+        print(json.dumps({
+            "metric": "contaminant_handling",
+            "reads_contaminated": int(contam_mask.sum()),
+            "contaminated_kept_over_half_length": n_contam_kept,
+            "reads_homopolymer": int(homo_mask.sum()),
+        }))
 
     # secondary: the reference has no published build-only number; the
     # ratio below still divides by the CORRECTION baseline
