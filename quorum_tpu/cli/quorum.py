@@ -188,29 +188,40 @@ def main(argv=None) -> int:
                                  threads=threads)
 
         def _pack_and_keep(it):
+            import numpy as _np
             for b in it:
-                # the EC qual plane is only packed while the replay
-                # cache is live (paired mode / overflowed runs would
-                # never consume it)
-                ts = (t1, _EC_QUAL_CUTOFF) if cache_state["ok"] else (t1,)
-                pk = packing.pack_reads(b.codes, b.quals, b.lengths,
-                                        thresholds=ts)
-                # compact() keeps ONLY the fused wire buffer (the
-                # standalone planes duplicate its bytes), built here
-                # off the main thread; stage 2 never touches host
-                # quals either, so they drop from the cached copy too
-                item = (dataclasses.replace(b, quals=None), pk.compact())
+                # SEPARATE single-plane wires per stage: a combined
+                # two-plane wire would give the driver's executables
+                # different jit keys (the threshold tuple is static)
+                # than the standalone stage CLIs compile — measured
+                # as minutes of needless recompile per driver run.
+                pk1 = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                         thresholds=(t1,))
+                item = (dataclasses.replace(b, quals=None),
+                        pk1.compact())
                 if cache_state["ok"]:
-                    # count the retained headers too (~90 B of str +
-                    # list-slot overhead each), not just the arrays
+                    # the cached stage-2 wire shares pk1's code/N
+                    # planes and adds only the EC qual plane; stage 2
+                    # never touches host quals, so the cached batch
+                    # drops them. Count retained headers too (~90 B
+                    # of str + list-slot overhead each).
+                    pk2 = packing.PackedReads(
+                        pcodes=pk1.pcodes, nmask=pk1.nmask,
+                        hq={_EC_QUAL_CUTOFF: _np.packbits(
+                            _np.asarray(b.quals, _np.uint8)
+                            >= _EC_QUAL_CUTOFF,
+                            axis=1, bitorder="little")},
+                        lengths=pk1.lengths,
+                        length=pk1.length).compact()
+                    cached = (item[0], pk2)
                     cache_state["bytes"] += (
-                        b.codes.nbytes + pk.nbytes
+                        b.codes.nbytes + pk2.nbytes
                         + sum(len(h) + 90 for h in b.headers))
                     if cache_state["bytes"] > _replay_cap():
                         cache_state["ok"] = False
                         reads_cache.clear()
                     else:
-                        reads_cache.append(item)
+                        reads_cache.append(cached)
                 yield item
         return prefetch(_pack_and_keep(src))
 
