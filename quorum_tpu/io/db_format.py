@@ -6,14 +6,18 @@ JSON header followed by the raw table arrays
 (reference: database_header src/mer_database.hpp:43-63,
 hash_with_quality::write :115-126, reload via database_query :270-278).
 
-Three payload versions:
+Four payload versions:
 
-* version 3 (written by stage 1, round 4): entry-compact tile layout —
-  the occupied slots only, as (bucket address, lo word, hi word)
-  triplets. A ~30%-occupied table is ~4-5x smaller on disk AND moves
-  ~4-5x fewer bytes over the tunnel in both directions (the write's
-  D2H and the standalone reload's H2D each cost ~0.1-0.17 s/MB;
-  PERF_NOTES.md round 4).
+* version 4 (written by stage 1, round 5): leanest entry-compact
+  layout — per-row occupancy counts (u8[rows]) followed by the
+  occupied entries' lo words and only the LIVE bytes of their hi
+  words, in row-major entry order (the bucket address is implied by
+  the counts). 5 B/entry at the k=24 default (hi carries just
+  rem_high = 2k - rb_log2 - (31 - bits) bits) vs v3's 12 — the
+  write-path D2H is the dominant stage-1 cost on the tunnel.
+
+* version 3 (round 4): entry-compact (bucket address, lo word, hi
+  word) triplets, 12 B/entry. Still readable.
 
 * version 2: the raw tile-bucket layout — ONE little-endian uint32
   array of shape [rows, 128], memmap-able and query-ready
@@ -66,46 +70,42 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
     the caller already knows it (stage 1's tile_seal does)."""
     if isinstance(meta, TileMeta):
         if compact:
-            # v3: occupied entries only (addr, lo, hi — 12 B each).
-            # A ~30%-occupied table moves ~4-5x fewer bytes through
-            # the tunnel's ~0.17 s/MB D2H than the raw row plane, and
-            # the read side re-uploads the same compact arrays.
+            # v4: per-row occupancy counts (u8[rows]) + the occupied
+            # entries' lo words + only the LIVE bytes of their hi
+            # words, in row-major entry order (the bucket address is
+            # implied). 5 B/entry at the k=24 default vs v3's 12 —
+            # the write's D2H is the dominant stage-1 cost on the
+            # ~0.17 s/MB tunnel (PERF_NOTES.md round 5).
             if n_entries is None:
                 occ, _d, _t = ctable.tile_stats(state, meta)
                 n_entries = int(occ)
             n = n_entries
             # cap is a STATIC jit arg: round up to a power of two so
-            # the compaction executable cache-hits across runs instead
-            # of recompiling per distinct occupancy
+            # the export executable cache-hits across runs instead of
+            # recompiling per distinct occupancy
             cap = 1 << max(10, (max(1, n) - 1).bit_length())
-            addr_c, lo_c, hi_c, _n = ctable.tile_compact_device(
+            counts, lo_b, hi_pl, _n = ctable.tile_export_v4(
                 state, meta, cap)
-            # ONE D2H of exactly 12n bytes: device-slice to n (the
-            # cap-padded planes would transfer up to 2x the bytes) and
-            # fuse the three planes into a single little-endian u8
-            # buffer (the tunnel charges a big fixed cost per
-            # transfer)
-            buf = np.asarray(ctable.bytes_concat_device(
-                addr_c[:n], lo_c[:n], hi_c[:n]))
-            addr = buf[:4 * n].view(np.int32)
-            lo = buf[4 * n:8 * n].view(np.uint32)
-            hi = buf[8 * n:].view(np.uint32)
+            hi_bytes = hi_pl.shape[0]
+            # ONE fused D2H of exactly rows + (4+hi_bytes)*n bytes
+            buf = np.asarray(jnp.concatenate(
+                [counts, lo_b[:4 * n]]
+                + [hi_pl[j, :n] for j in range(hi_bytes)]))
             header = {
                 "format": FORMAT,
-                "version": 3,
+                "version": 4,
                 "key_len": 2 * meta.k,
                 "bits": meta.bits,
                 "rb_log2": meta.rb_log2,
                 "rows": meta.rows,
                 "n_entries": n,
-                "value_bytes": int(addr.nbytes + lo.nbytes + hi.nbytes),
+                "hi_bytes": hi_bytes,
+                "value_bytes": int(buf.nbytes),
                 **_header_common(cmdline),
             }
             with open(path, "wb") as f:
                 f.write(json.dumps(header).encode() + b"\n")
-                f.write(addr.tobytes())
-                f.write(lo.tobytes())
-                f.write(hi.tobytes())
+                f.write(buf.tobytes())
             return
         rows = np.asarray(state.rows, dtype=np.uint32)
         header = {
@@ -192,6 +192,46 @@ def read_db(path: str, to_device: bool = True,
         return np.memmap(path, dtype=dtype, mode="r", offset=off,
                          shape=shape)
 
+    if header.get("version", 1) == 4:
+        n = header["n_entries"]
+        meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],
+                        rb_log2=header["rb_log2"])
+        hi_bytes = header["hi_bytes"]
+        want_hb = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+        if hi_bytes != want_hb:
+            raise ValueError(
+                f"corrupt v4 database '{path}': hi_bytes {hi_bytes} != "
+                f"{want_hb} for this geometry")
+        rows_n = meta.rows
+        payload = plane(np.uint8, offset, (rows_n + (4 + hi_bytes) * n,))
+        counts = np.asarray(payload[:rows_n])
+        if n and counts.max() > ctable.TILE // 2:
+            raise ValueError(
+                f"corrupt v4 database '{path}': {int(counts.max())} "
+                f"entries in one bucket (capacity {ctable.TILE // 2})")
+        if int(counts.sum()) != n:
+            raise ValueError(
+                f"corrupt v4 database '{path}': row counts sum "
+                f"{int(counts.sum())} != n_entries {n}")
+        lo = np.ascontiguousarray(
+            payload[rows_n:rows_n + 4 * n]).view(np.uint32)
+        hi = np.zeros((n,), np.uint32)
+        for j in range(hi_bytes):
+            pl = payload[rows_n + 4 * n + j * n:
+                         rows_n + 4 * n + (j + 1) * n]
+            hi |= np.asarray(pl, np.uint32) << (8 * j)
+        # bucket address implied by row-major entry order
+        addr = np.repeat(np.arange(rows_n, dtype=np.int64),
+                         counts).astype(np.int32)
+        if to_device:
+            row, col = ctable.tile_compact_placement(addr)
+            state = ctable.tile_rows_device_from_compact(
+                jnp.asarray(row), jnp.asarray(col), jnp.asarray(lo),
+                jnp.asarray(hi), meta)
+        else:
+            rows = ctable.tile_rows_from_compact(addr, lo, hi, meta)
+            state = TileState(rows)
+        return state, meta, header
     if header.get("version", 1) == 3:
         n = header["n_entries"]
         meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],

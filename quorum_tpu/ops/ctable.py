@@ -1376,3 +1376,26 @@ def bytes_concat_device(*arrays):
         for a in arrays
     ]
     return jnp.concatenate(parts)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def tile_export_v4(state: TileState, meta: TileMeta, cap: int):
+    """Device-side export for the v4 on-disk format (io/db_format):
+    per-row occupancy counts (u8, <= TSLOTS by construction) plus the
+    compact entries' lo words and the LIVE bytes of their hi words —
+    the bucket address is implied by row-major entry order, and hi
+    carries only rem_high = rem_bits - rlo_bits bits (1 byte at the
+    k=24 default instead of 4). Returns (counts u8[rows],
+    lo_bytes u8[4*cap], hi_byte_planes u8[hi_bytes, cap], n)."""
+    lo = state.rows[:, 0::2]
+    hi = state.rows[:, 1::2]
+    occ = (lo & jnp.uint32(meta.max_val)) != 0
+    counts = jnp.sum(occ, axis=1, dtype=jnp.int32).astype(jnp.uint8)
+    addr, clo, chi, n = tile_compact_device.__wrapped__(state, meta, cap)
+    lo_b = jax.lax.bitcast_convert_type(clo, jnp.uint8).reshape(-1)
+    hi_bytes = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+    hi_pl = jnp.stack([((chi >> (8 * j)) & jnp.uint32(0xFF))
+                       .astype(jnp.uint8)
+                       for j in range(hi_bytes)]) if hi_bytes else \
+        jnp.zeros((0, cap), jnp.uint8)
+    return counts, lo_b, hi_pl, n

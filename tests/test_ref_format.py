@@ -213,13 +213,22 @@ def test_v3_db_rejects_corrupt_addr(tmp_path):
     rng = np.random.default_rng(19)
     khi, klo, vals = _rand_entries(rng, 30, k)
     state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
+    # hand-write the v3 layout (write_db emits v4 since round 5)
+    a4, l4, h4, _ = (np.asarray(x) for x in _ct.tile_compact_device(
+        state, meta, 64))
+    n = int(_ct.tile_stats(state, meta)[0])
+    hdr = {"format": db_format.FORMAT, "version": 3,
+           "key_len": 2 * k, "bits": 7, "rb_log2": meta.rb_log2,
+           "rows": meta.rows, "n_entries": n}
     path = str(tmp_path / "db.qdb")
-    db_format.write_db(path, state, meta)
+    with open(path, "wb") as f:
+        f.write((_json.dumps(hdr) + "\n").encode())
+        f.write(a4[:n].astype(np.int32).tobytes())
+        f.write(l4[:n].tobytes())
+        f.write(h4[:n].tobytes())
 
     raw = open(path, "rb").read()
     nl = raw.index(b"\n") + 1
-    hdr = _json.loads(raw[:nl])
-    n = hdr["n_entries"]
     addr = np.frombuffer(raw[nl:nl + 4 * n], np.int32).copy()
 
     def rewrite(new_addr, name):
@@ -248,3 +257,65 @@ def test_v3_db_rejects_corrupt_addr(tmp_path):
         + np.tile(lo[:1], 65).tobytes() + np.tile(hi[:1], 65).tobytes())
     with pytest.raises(ValueError, match="entries"):
         db_format.read_db(p, to_device=False)
+
+
+def test_v3_still_readable(tmp_path):
+    """v3 files (round 4) written by hand must load identically to the
+    v4 the same entries produce."""
+    import json as _json
+    import quorum_tpu.ops.ctable as _ct
+
+    k = 9
+    rng = np.random.default_rng(23)
+    khi, klo, vals = _rand_entries(rng, 50, k)
+    state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
+    p4 = str(tmp_path / "v4.qdb")
+    db_format.write_db(p4, state, meta)
+    s4, m4, h4 = db_format.read_db(p4, to_device=False)
+    assert h4["version"] == 4
+
+    # hand-write the same entries as v3
+    addr, lo, hi, _ = (np.asarray(x) for x in _ct.tile_compact_device(
+        state, meta, 64))
+    n = int(_ct.tile_stats(state, meta)[0])
+    hdr = {"format": db_format.FORMAT, "version": 3,
+           "key_len": 2 * k, "bits": 7, "rb_log2": meta.rb_log2,
+           "rows": meta.rows, "n_entries": n}
+    p3 = str(tmp_path / "v3.qdb")
+    with open(p3, "wb") as f:
+        f.write((_json.dumps(hdr) + "\n").encode())
+        f.write(addr[:n].astype(np.int32).tobytes())
+        f.write(lo[:n].tobytes())
+        f.write(hi[:n].tobytes())
+    s3, m3, _h3 = db_format.read_db(p3, to_device=False)
+    np.testing.assert_array_equal(np.asarray(s3.rows), np.asarray(s4.rows))
+
+
+def test_v4_rejects_corrupt_counts(tmp_path):
+    import json as _json
+    import quorum_tpu.ops.ctable as _ct
+
+    k = 9
+    rng = np.random.default_rng(29)
+    khi, klo, vals = _rand_entries(rng, 30, k)
+    state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
+    p = str(tmp_path / "v4.qdb")
+    db_format.write_db(p, state, meta)
+    raw = open(p, "rb").read()
+    nl = raw.index(b"\n") + 1
+    hdr = _json.loads(raw[:nl])
+    rows_n = hdr["rows"]
+    counts = bytearray(raw[nl:nl + rows_n])
+    # inflate one row count: sum mismatch must raise
+    i = next(i for i, c in enumerate(counts) if c > 0)
+    counts[i] += 1
+    open(str(tmp_path / "bad.qdb"), "wb").write(
+        raw[:nl] + bytes(counts) + raw[nl + rows_n:])
+    with pytest.raises(ValueError, match="row counts sum"):
+        db_format.read_db(str(tmp_path / "bad.qdb"), to_device=False)
+    # >capacity count
+    counts[i] = 80
+    open(str(tmp_path / "bad2.qdb"), "wb").write(
+        raw[:nl] + bytes(counts) + raw[nl + rows_n:])
+    with pytest.raises(ValueError, match="entries"):
+        db_format.read_db(str(tmp_path / "bad2.qdb"), to_device=False)
