@@ -22,6 +22,10 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
                tools, flag-compatible with the reference binaries.
   native/    — C++ host runtime (FASTQ parsing / encoding) bound via
                ctypes, with a pure-Python fallback.
+  data/      — built-in Illumina adapter contaminant set (the
+               reference's data/adapter.fa as a generator).
+  tools/     — (repo root) analysis utilities, e.g. the multi-chip
+               communication model.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"

@@ -120,3 +120,36 @@ def test_insert_parity():
     for a, b in zip(ctable.tile_iterate(s1, meta),
                     ctable.tile_iterate(s2, meta)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compact_and_wire_surface():
+    """compact() keeps only the wire + geometry; the packed entry
+    points' guards behave on both forms."""
+    rng = np.random.default_rng(21)
+    codes, quals, lengths = _random_reads(rng, b=32)
+    p = packing.pack_reads(codes, quals, lengths, thresholds=(38,))
+    w = p.to_wire()
+    c = p.compact()
+    assert c.pcodes is None and c.n_reads == 32
+    assert np.array_equal(c.to_wire(), w)
+    c.require_plane(38)
+    with pytest.raises(KeyError, match="lacks the qual>=99"):
+        c.require_plane(99)
+    # a compacted batch that somehow lost its wire must fail loudly
+    c2 = packing.PackedReads(pcodes=None, nmask=None, hq={38: None},
+                             lengths=p.lengths, length=p.length, _b=32)
+    with pytest.raises(ValueError, match="lost its planes"):
+        c2.to_wire()
+    # nbytes counts only live arrays
+    assert c.nbytes == w.nbytes + p.lengths.nbytes
+
+
+def test_multihost_refusal(monkeypatch):
+    """The single-chip CLIs refuse multi-process runs (their state is
+    host-local; parallel/multihost + tile_sharded is the path)."""
+    import jax
+    from quorum_tpu.models.create_database import BuildConfig, \
+        build_database
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(RuntimeError, match="multi-host build"):
+        build_database(["/nonexistent.fastq"], BuildConfig(k=9))
