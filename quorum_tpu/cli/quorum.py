@@ -50,7 +50,7 @@ from . import error_correct_reads as ec_cli
 from .merge_mate_pairs import merge_records
 from .split_mate_pairs import split_stream
 
-VERSION = "1.0.0"
+from .. import __version__ as VERSION
 
 
 def build_parser() -> argparse.ArgumentParser:
