@@ -50,7 +50,12 @@ from . import error_correct_reads as ec_cli
 from .merge_mate_pairs import merge_records
 from .split_mate_pairs import split_stream
 
-from .. import __version__ as VERSION
+from .. import __version__ as _PKG_VERSION
+
+# The reference quorum is 1.x; wrappers gate on `quorum --version`, so
+# the CLI reports a 1.x-compatible version with the package version as
+# the local segment (PEP 440).
+VERSION = f"1.1.1+tpu.{_PKG_VERSION}"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -147,6 +152,19 @@ def main(argv=None) -> int:
               "required.", file=sys.stderr)
         return 1
 
+    import jax
+    if jax.process_count() > 1:
+        # the driver is single-controller by design: its build state is
+        # host-local and both stages write one output path. Multi-host
+        # = global mesh + parallel.tile_sharded fed by
+        # parallel.multihost (the stage CLIs refuse too, but the
+        # driver must refuse BEFORE handing them its own batches,
+        # which would bypass their checks).
+        print("quorum: multi-host runs require the sharded pipeline "
+              "(parallel.tile_sharded + parallel.multihost); the "
+              "driver is single-controller", file=sys.stderr)
+        return 1
+
     min_q_char = args.min_q_char
     if min_q_char is None:
         try:
@@ -189,6 +207,7 @@ def main(argv=None) -> int:
 
         def _pack_and_keep(it):
             import numpy as _np
+            cap_bytes = _replay_cap()  # resolve once, not per batch
             for b in it:
                 # SEPARATE single-plane wires per stage: a combined
                 # two-plane wire would give the driver's executables
@@ -217,7 +236,7 @@ def main(argv=None) -> int:
                     cache_state["bytes"] += (
                         b.codes.nbytes + pk2.nbytes
                         + sum(len(h) + 90 for h in b.headers))
-                    if cache_state["bytes"] > _replay_cap():
+                    if cache_state["bytes"] > cap_bytes:
                         cache_state["ok"] = False
                         reads_cache.clear()
                     else:

@@ -38,7 +38,9 @@ ADAPTERS = (
 def adapter_records():
     """Yield (header, sequence) for the full error-tolerant set: each
     canonical adapter followed by all of its 1-substitution variants
-    (dedup'd across the whole set, originals kept first)."""
+    (dedup'd across the whole set, originals kept first). Headers are
+    unique (canonical "1".."7", variants "v0".."vN") so tools that
+    index fasta by name (faidx etc.) accept the file."""
     seen = set()
     for i, s in enumerate(ADAPTERS):
         if s not in seen:
@@ -54,22 +56,29 @@ def adapter_records():
                 if v in seen:
                     continue
                 seen.add(v)
-                yield str(n), v
+                yield f"v{n}", v
                 n += 1
 
 
 def adapter_fasta(path: str | None = None) -> str:
-    """Write (or reuse) the adapter fasta; returns its path. Default
-    location is the package cache dir."""
+    """Write (or reuse) the adapter fasta; returns its path. The
+    default cache location embeds a content digest of the expansion,
+    so a changed adapter set (or expansion rule) regenerates instead
+    of silently reusing a stale file."""
+    recs = list(adapter_records())
     if path is None:
+        import hashlib
+        digest = hashlib.sha256(
+            "".join(f">{h}\n{s}\n" for h, s in recs).encode()
+        ).hexdigest()[:10]
         cache = os.path.expanduser("~/.cache/quorum_tpu")
         os.makedirs(cache, exist_ok=True)
-        path = os.path.join(cache, "adapters.fa")
+        path = os.path.join(cache, f"adapters-{digest}.fa")
         if os.path.exists(path):
             return path
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        for hdr, seq in adapter_records():
+        for hdr, seq in recs:
             f.write(f">{hdr}\n{seq}\n")
     os.replace(tmp, path)
     return path

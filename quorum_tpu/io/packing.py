@@ -54,8 +54,11 @@ class PackedReads:
 
     @property
     def nbytes(self) -> int:
-        arrs = [self.pcodes, self.nmask, self.lengths, self._wire,
-                *self.hq.values()]
+        # lengths ride inside the wire once it exists — don't count
+        # them twice (the driver's replay-cache budget uses this)
+        arrs = [self.pcodes, self.nmask, self._wire, *self.hq.values()]
+        if self._wire is None:
+            arrs.append(self.lengths)
         return sum(a.nbytes for a in arrs if a is not None)
 
     def require_plane(self, threshold: int) -> None:

@@ -1364,20 +1364,6 @@ def tile_grow_build(bstate: TBuildState, meta: TileMeta,
     return new_state, new_meta
 
 
-def bytes_concat_device(*arrays):
-    """Concatenate 32-bit device arrays into one little-endian u8
-    buffer ON DEVICE, so a multi-plane D2H pays the tunnel's large
-    fixed per-transfer cost once and moves exactly the live bytes.
-    bitcast_convert_type to u8 yields each word's bytes in the minor
-    dimension in host (little-endian) order — pinned by
-    tests/test_create_database.py round trips."""
-    parts = [
-        jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
-        for a in arrays
-    ]
-    return jnp.concatenate(parts)
-
-
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def tile_export_v4(state: TileState, meta: TileMeta, cap: int):
     """Device-side export for the v4 on-disk format (io/db_format):

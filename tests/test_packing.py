@@ -140,8 +140,8 @@ def test_compact_and_wire_surface():
                              lengths=p.lengths, length=p.length, _b=32)
     with pytest.raises(ValueError, match="lost its planes"):
         c2.to_wire()
-    # nbytes counts only live arrays
-    assert c.nbytes == w.nbytes + p.lengths.nbytes
+    # nbytes counts only live arrays (lengths ride inside the wire)
+    assert c.nbytes == w.nbytes
 
 
 def test_multihost_refusal(monkeypatch):

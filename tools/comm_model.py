@@ -103,8 +103,6 @@ def main():
         [(jnp.asarray(codes), jnp.asarray(quals))], mesh, meta, 53)
 
     cfg = ECConfig(k=K, cutoff=2, poisson_dtype="float32")
-    rmeta = ts.RoutedTileMeta(k=K, bits=meta.bits, rb_log2=meta.rb_log2,
-                              n_shards=S)
 
     # Iteration counting: the routed loop's lockstep trip count is
     # pmax over shards of the local count, and every shard sees the
