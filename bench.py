@@ -22,11 +22,12 @@ persistent cache (utils/jaxcache) — what a steady-state user sees.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 
 import numpy as np
+
+from quorum_tpu.telemetry import metric_line
 
 BASELINE_GBASES_PER_HOUR = 48.0
 
@@ -299,14 +300,14 @@ def main():
         recs_r = parse_fasta(f"{tmp}/{name}_out.fa")
         acc_r = accuracy_triple(recs_r, r_genome, starts_r, errs_r,
                                 codes_r, include=include)
-        print(json.dumps({
-            "metric": f"regime_{name}",
-            "stage1_gb_h": round(nb_r / s1_r * 3600 / 1e9, 3),
-            "stage2_gb_h": round(nb_r / s2_r * 3600 / 1e9, 3),
-            "bases": nb_r,
-            "reads": len(codes_r),
+        print(metric_line(
+            f"regime_{name}",
+            stage1_gb_h=round(nb_r / s1_r * 3600 / 1e9, 3),
+            stage2_gb_h=round(nb_r / s2_r * 3600 / 1e9, 3),
+            bases=nb_r,
+            reads=len(codes_r),
             **acc_r,
-        }))
+        ))
         return recs_r
 
     # regime failures must not lose the headline: each is best-effort
@@ -316,8 +317,7 @@ def main():
         try:
             return run_regime(name, *a, **kw)
         except Exception as e:  # noqa: BLE001 — reported, not fatal
-            print(json.dumps({"metric": f"regime_{name}",
-                              "error": str(e)[:200]}))
+            print(metric_line(f"regime_{name}", error=str(e)[:200]))
             return None
 
     rngr = np.random.default_rng(7)
@@ -349,12 +349,12 @@ def main():
         n_contam_kept = int(sum(1 for rid in recs_c
                                 if contam_mask[rid]
                                 and len(recs_c[rid]) > READ_LEN // 2))
-        print(json.dumps({
-            "metric": "contaminant_handling",
-            "reads_contaminated": int(contam_mask.sum()),
-            "contaminated_kept_over_half_length": n_contam_kept,
-            "reads_homopolymer": int(homo_mask.sum()),
-        }))
+        print(metric_line(
+            "contaminant_handling",
+            reads_contaminated=int(contam_mask.sum()),
+            contaminated_kept_over_half_length=n_contam_kept,
+            reads_homopolymer=int(homo_mask.sum()),
+        ))
 
     # the quorum DRIVER end to end (parse-once replay + in-process
     # table handoff): the user-facing wall clock for raw reads ->
@@ -367,39 +367,38 @@ def main():
                               "--batch-size", str(BATCH), fq])
         drv_dt = time.perf_counter() - t0
         assert rc == 0, "driver failed"
-        print(json.dumps({
-            "metric": "driver_e2e_throughput",
-            "value": round(bases / drv_dt * 3600 / 1e9, 3),
-            "unit": "Gbases/hour",
-            "seconds": round(drv_dt, 1),
-            "bases": bases,
-        }))
+        print(metric_line(
+            "driver_e2e_throughput",
+            value=round(bases / drv_dt * 3600 / 1e9, 3),
+            unit="Gbases/hour",
+            seconds=round(drv_dt, 1),
+            bases=bases,
+        ))
     except Exception as e:  # noqa: BLE001 — reported, not fatal
-        print(json.dumps({"metric": "driver_e2e_throughput",
-                          "error": str(e)[:200]}))
+        print(metric_line("driver_e2e_throughput", error=str(e)[:200]))
 
     # secondary: the reference has no published build-only number; the
     # ratio below still divides by the CORRECTION baseline
-    print(json.dumps({
-        "metric": "stage1_db_build_throughput",
-        "value": round(s1, 3),
-        "unit": "Gbases/hour",
-        "vs_baseline": round(s1 / BASELINE_GBASES_PER_HOUR, 3),
-        "baseline_metric": "stage2_correction_throughput_48t",
-        "bases": bases,
-    }))
-    print(json.dumps({"metric": "accuracy", **acc}))
+    print(metric_line(
+        "stage1_db_build_throughput",
+        value=round(s1, 3),
+        unit="Gbases/hour",
+        vs_baseline=round(s1 / BASELINE_GBASES_PER_HOUR, 3),
+        baseline_metric="stage2_correction_throughput_48t",
+        bases=bases,
+    ))
+    print(metric_line("accuracy", **acc))
     # HEADLINE last (the driver records the final line): stage-2
     # correction, end to end through the CLI, vs the 48 Gb/h baseline
-    print(json.dumps({
-        "metric": "stage2_correction_throughput",
-        "value": round(s2, 3),
-        "unit": "Gbases/hour",
-        "vs_baseline": round(s2 / BASELINE_GBASES_PER_HOUR, 3),
-        "bases": bases,
+    print(metric_line(
+        "stage2_correction_throughput",
+        value=round(s2, 3),
+        unit="Gbases/hour",
+        vs_baseline=round(s2 / BASELINE_GBASES_PER_HOUR, 3),
+        bases=bases,
         **{f"acc_{k}": v for k, v in acc.items()
            if k.startswith("pct_")},
-    }))
+    ))
 
 
 if __name__ == "__main__":

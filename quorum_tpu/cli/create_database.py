@@ -39,6 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of the native format")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
+    p.add_argument("--metrics", metavar="path", default=None,
+                   help="Write a final metrics JSON (schema "
+                        "quorum-tpu-metrics/1) to this path")
+    p.add_argument("--metrics-interval", metavar="seconds", type=float,
+                   default=0.0,
+                   help="With --metrics: also write JSONL heartbeat "
+                        "events at this period (0 = off)")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("reads", nargs="+", help="Read files")
     return p
@@ -48,7 +55,8 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
-    vlog_mod.verbose = args.verbose
+    # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
+    vlog_mod.verbose = args.verbose or vlog_mod.verbose
     if args.min_qual_value is None and args.min_qual_char is None:
         print("Either a min-qual-value or min-qual-char must be provided.",
               file=sys.stderr)
@@ -79,14 +87,20 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         threads=args.threads,
         profile=args.profile,
     )
+    from ..telemetry import registry_for
+    reg = registry_for(args.metrics, args.metrics_interval)
     try:
         create_database_main(args.reads, args.output, cfg,
                              cmdline=list(sys.argv),
                              ref_format=args.ref_format,
-                             handoff=handoff, batches=batches)
+                             handoff=handoff, batches=batches,
+                             metrics=reg)
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
         return 1
+    if reg.enabled:
+        reg.set_meta(status="ok", output=args.output)
+        reg.write()
     return 0
 
 

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Reads per device batch")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
+    p.add_argument("--metrics", metavar="path", default=None,
+                   help="Write a final metrics JSON (schema "
+                        "quorum-tpu-metrics/1) to this path")
+    p.add_argument("--metrics-interval", metavar="seconds", type=float,
+                   default=0.0,
+                   help="With --metrics: also write JSONL heartbeat "
+                        "events at this period (0 = off)")
     p.add_argument("db", help="Mer database")
     p.add_argument("sequence", nargs="+", help="Input sequence")
     return p
@@ -74,7 +81,8 @@ def main(argv=None, db=None, prepacked=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
-    vlog_mod.verbose = args.verbose
+    # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
+    vlog_mod.verbose = args.verbose or vlog_mod.verbose
 
     if args.qual_cutoff_char is not None and args.qual_cutoff_value is not None:
         print("Switches -q and -Q are conflicting.", file=sys.stderr)
@@ -107,6 +115,8 @@ def main(argv=None, db=None, prepacked=None) -> int:
         threads=args.thread,
         no_mmap=args.no_mmap,
         profile=args.profile,
+        metrics=args.metrics,
+        metrics_interval=args.metrics_interval,
     )
     try:
         run_error_correct(

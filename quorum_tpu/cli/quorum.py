@@ -104,11 +104,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Trim homo-polymer on 3' end")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--profile", metavar="dir", default=None,
+                   help="Write jax.profiler traces (per-stage "
+                        "subdirectories of this directory)")
+    p.add_argument("--metrics", metavar="path", default=None,
+                   help="Write a run-manifest metrics JSON here plus "
+                        "per-stage files with .stage1/.stage2 suffixes")
+    p.add_argument("--metrics-interval", metavar="seconds", type=float,
+                   default=0.0,
+                   help="With --metrics: JSONL heartbeat period for "
+                        "the stages (0 = off)")
     p.add_argument("--debug", action="store_true",
                    help="Display debugging information")
     p.add_argument("--version", action="version", version=VERSION)
     p.add_argument("reads", nargs="*", help="Input fastq files")
     return p
+
+
+def _stage_path(base: str, tag: str) -> str:
+    """Per-stage metrics path: out.json -> out.stage1.json; a path
+    without a .json extension just gets the suffix appended."""
+    if base.endswith(".json"):
+        return f"{base[:-5]}.{tag}.json"
+    return f"{base}.{tag}"
 
 
 def detect_min_q_char(path: str, max_reads: int = 1000) -> int:
@@ -134,10 +152,20 @@ def detect_min_q_char(path: str, max_reads: int = 1000) -> int:
 
 
 def main(argv=None) -> int:
+    import time
+
+    from ..telemetry import registry_for, track_jax_compile_cache
     from ..utils.jaxcache import enable_cache
-    enable_cache()
+    cache_dir = enable_cache()
     args = build_parser().parse_args(argv)
-    vlog_mod.verbose = args.debug
+    # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
+    vlog_mod.verbose = args.debug or vlog_mod.verbose
+
+    # driver telemetry: the run manifest (resolved config, jax
+    # backend/devices, compile-cache hits) plus per-child timings;
+    # the listener must attach BEFORE the stages compile anything
+    reg = registry_for(args.metrics, args.metrics_interval)
+    track_jax_compile_cache(reg)
 
     if not re.match(r"^\d+[kMGT]?$", args.size):
         print(f"Invalid size '{args.size}'. It must be a number, maybe "
@@ -165,13 +193,43 @@ def main(argv=None) -> int:
               "driver is single-controller", file=sys.stderr)
         return 1
 
+    # per-stage observability paths (satellite: forward --metrics and
+    # --profile consistently to both children, suffixed per stage)
+    m1 = _stage_path(args.metrics, "stage1") if args.metrics else None
+    m2 = _stage_path(args.metrics, "stage2") if args.metrics else None
+    p1 = os.path.join(args.profile, "stage1") if args.profile else None
+    p2 = os.path.join(args.profile, "stage2") if args.profile else None
+    if reg.enabled:
+        devs = jax.devices()
+        reg.set_meta(
+            driver="quorum", version=VERSION,
+            config={k: "" if v is None else str(v)
+                    for k, v in vars(args).items()},
+            jax_backend=jax.default_backend(),
+            device_count=len(devs),
+            device_kinds=sorted({d.device_kind for d in devs}),
+            process_count=jax.process_count(),
+            compile_cache_dir=str(cache_dir),
+            metrics_stage1=m1, metrics_stage2=m2,
+        )
+
+    def finish(rc: int) -> int:
+        """Write the driver manifest on every exit past this point."""
+        if reg.enabled:
+            hits = reg.counter("jax_cache_hits").value
+            reqs = reg.counter("jax_cache_requests").value
+            reg.gauge("jax_cache_misses").set(max(0, reqs - hits))
+            reg.set_meta(status="ok" if rc == 0 else "error")
+            reg.write()
+        return rc
+
     min_q_char = args.min_q_char
     if min_q_char is None:
         try:
             min_q_char = detect_min_q_char(args.reads[0])
         except (RuntimeError, ValueError, OSError) as e:
             print(str(e), file=sys.stderr)
-            return 1
+            return finish(1)
     vlog("Using min quality char ", min_q_char, " (+", args.min_quality, ")")
 
     # CPU-count autodetect, like the reference driver's /proc/cpuinfo
@@ -186,6 +244,11 @@ def main(argv=None) -> int:
                 "-q", str(min_q_char + args.min_quality), "-b", "7",
                 "-t", str(threads),
                 "-o", db_file, "--batch-size", str(args.batch_size)]
+    if m1 is not None:
+        cdb_argv.extend(["--metrics", m1,
+                         "--metrics-interval", str(args.metrics_interval)])
+    if p1 is not None:
+        cdb_argv.extend(["--profile", p1])
     if args.debug:
         cdb_argv.append("-v")
         print("+ quorum_create_database " + " ".join(cdb_argv)
@@ -245,11 +308,16 @@ def main(argv=None) -> int:
         return prefetch(_pack_and_keep(src))
 
     handoff: dict = {}
+    t_s1 = time.perf_counter()
     if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff,
                     batches=_cached_batches()) != 0:
         print("Creating the mer database failed. Most likely the size "
               "passed to the -s switch is too small.", file=sys.stderr)
-        return 1
+        return finish(1)
+    if reg.enabled:
+        s1_s = round(time.perf_counter() - t_s1, 3)
+        reg.gauge("stage1_seconds").set(s1_s)
+        reg.event("stage_done", stage="create_database", seconds=s1_s)
     prepacked = reads_cache if cache_state["ok"] and reads_cache else None
 
     # Stage 2: error correction (quorum.in:162-231)
@@ -272,17 +340,30 @@ def main(argv=None) -> int:
         ec_common.append("--no-discard")
     if args.debug:
         ec_common.append("-v")
+    if m2 is not None:
+        ec_common.extend(["--metrics", m2,
+                          "--metrics-interval", str(args.metrics_interval)])
+    if p2 is not None:
+        ec_common.extend(["--profile", p2])
+
+    def record_stage2(t0: float) -> None:
+        if reg.enabled:
+            s2_s = round(time.perf_counter() - t0, 3)
+            reg.gauge("stage2_seconds").set(s2_s)
+            reg.event("stage_done", stage="error_correct", seconds=s2_s)
 
     if not args.paired_files:
         ec_argv = ec_common + ["-o", args.prefix, db_file] + list(args.reads)
         if args.debug:
             print("+ quorum_error_correct_reads " + " ".join(ec_argv),
                   file=sys.stderr)
+        t_s2 = time.perf_counter()
         if ec_cli.main(ec_argv, db=handoff.get("db"),
                        prepacked=prepacked) != 0:
             print("Error correction failed", file=sys.stderr)
-            return 1
-        return 0
+            return finish(1)
+        record_stage2(t_s2)
+        return finish(0)
 
     # Paired mode: merge | correct | split, in-process
     # (quorum.in:172-231). --no-discard is forced so every input read
@@ -293,7 +374,9 @@ def main(argv=None) -> int:
               f"{db_file} /dev/fd/0 | split_mate_pairs {args.prefix}",
               file=sys.stderr)
     opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
-                     batch_size=args.batch_size, threads=threads)
+                     batch_size=args.batch_size, threads=threads,
+                     profile=p2, metrics=m2,
+                     metrics_interval=args.metrics_interval)
     kwargs = dict(no_discard=True,
                   trim_contaminant=args.trim_contaminant)
     for key, val in (("min_count", args.min_count), ("skip", args.skip),
@@ -303,6 +386,7 @@ def main(argv=None) -> int:
                      ("homo_trim", args.homo_trim)):
         if val is not None:
             kwargs[key] = val
+    t_s2 = time.perf_counter()
     try:
         run_error_correct(db_file, [], None, opts,
                           records=merge_records(args.reads),
@@ -310,16 +394,17 @@ def main(argv=None) -> int:
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)
         print("Error correction failed", file=sys.stderr)
-        return 1
+        return finish(1)
+    record_stage2(t_s2)
     fa_path = args.prefix + ".fa"
     try:
         with open(fa_path, "r") as inp:
             split_stream(inp, args.prefix)
     except OSError as e:
         print(str(e), file=sys.stderr)
-        return 1
+        return finish(1)
     os.remove(fa_path)
-    return 0
+    return finish(0)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..io import fastq, db_format, packing
 from ..ops import ctable, mer
+from ..telemetry import NULL as NULL_METRICS
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -66,6 +67,7 @@ def build_database(
     paths: Sequence[str],
     cfg: BuildConfig,
     batches=None,
+    metrics=None,
 ):
     """Run the full stage-1 pipeline. Returns
     (TileState, TileMeta, stats) — the query-ready tile table.
@@ -75,14 +77,21 @@ def build_database(
     cfg.qual_thresh (the quorum driver uses this to share one
     parse+pack between both stages).
 
+    `metrics` (optional telemetry registry, --metrics on the CLI)
+    records reads/bases/batches/distinct-mer counters, hash geometry
+    and fill gauges, grow events, and the stage timer table.
+
     Raises RuntimeError("Hash is full") only if growth itself fails
     (allocation), preserving the reference's failure contract
     (create_database.cc:87, README.md:46-47).
     """
+    reg = metrics if metrics is not None else NULL_METRICS
     rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
     meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb)
     bstate = ctable.make_tile_build(meta)
     stats = BuildStats()
+    reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
+                 qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size)
 
     if batches is None:
         # host decode/encode/bit-packing overlaps device rounds (double
@@ -109,7 +118,8 @@ def build_database(
                 "parallel.multihost), not the single-chip CLI")
         src = fastq.read_batches(paths, cfg.batch_size,
                                  threads=cfg.threads)
-        batches = prefetch(_pack(src))
+        batches = prefetch(_pack(src),
+                           metrics=reg if reg.enabled else None)
     timer = StageTimer()
     with trace(cfg.profile):
         for batch, pk in batches:
@@ -118,6 +128,8 @@ def build_database(
             nb = int(batch.lengths.sum())
             stats.bases += nb
             timer.add_units("insert", nb)
+            reg.heartbeat(stage="create_database", reads=stats.reads,
+                          bases=stats.bases, batches=stats.batches)
             with timer.stage("insert"):
                 # ONE dispatch: extract + insert fused
                 bstate, full, (chi, clo, q, valid, placed) = \
@@ -131,8 +143,12 @@ def build_database(
                         break
                     vlog("Hash table full at ", meta.rows,
                          " buckets; doubling")
+                    rows_before = meta.rows
                     bstate, meta = ctable.tile_grow_build(bstate, meta)
                     stats.grows += 1
+                    reg.counter("hash_grows").inc()
+                    reg.event("hash_grow", rows_before=rows_before,
+                              rows_after=meta.rows)
                     bstate, full, placed = ctable.tile_insert_observations(
                         bstate, meta, chi, clo, q, pending
                     )
@@ -153,6 +169,16 @@ def build_database(
                 "tag write) — please report")
     timer.report(stats.bases)
     stats.distinct = occ
+    if reg.enabled:
+        reg.counter("reads").inc(stats.reads)
+        reg.counter("bases").inc(stats.bases)
+        reg.counter("batches").inc(stats.batches)
+        reg.counter("distinct_mers").inc(stats.distinct)
+        slots = meta.rows * ctable.TSLOTS
+        reg.gauge("hash_buckets").set(meta.rows)
+        reg.gauge("hash_slots").set(slots)
+        reg.gauge("hash_fill").set(round(stats.distinct / slots, 6))
+        reg.set_timer("stage1", timer.as_dict(stats.bases))
     vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
          stats.distinct, " distinct mers")
     return state, meta, stats
@@ -166,6 +192,7 @@ def create_database_main(
     ref_format: bool = False,
     handoff: dict | None = None,
     batches=None,
+    metrics=None,
 ) -> BuildStats:
     """With `handoff` (a dict), the built device-resident table is
     stashed as handoff["db"] = (state, meta) so an in-process stage-2
@@ -173,7 +200,8 @@ def create_database_main(
     full-size table costs ~0.1 s/MB — ~50 s for a 0.5 GB table — while
     the reference's equivalent, re-mmapping a page-cached file, is
     free; quorum.in:154-231 runs both stages over the same file)."""
-    state, meta, stats = build_database(paths, cfg, batches=batches)
+    state, meta, stats = build_database(paths, cfg, batches=batches,
+                                        metrics=metrics)
     if handoff is not None:
         handoff["db"] = (state, meta)
     if ref_format:

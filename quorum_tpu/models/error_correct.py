@@ -33,12 +33,23 @@ import jax
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
+from ..telemetry import registry_for
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
 from .corrector import (correct_batch_packed, fetch_finish,
                         finish_batch_host)
-from .ec_config import ECConfig
+from .ec_config import (ECConfig, ERROR_CONTAMINANT, ERROR_HOMOPOLYMER,
+                        ERROR_NO_STARTING_MER)
+
+# skip-reason -> counter slug (err_log.hpp semantics: the same reason
+# strings the .log channel prints, so metrics counters are exactly
+# recoverable from the .log output)
+REASON_SLUGS = {
+    ERROR_CONTAMINANT: "contaminant",
+    ERROR_NO_STARTING_MER: "no_anchor",
+    ERROR_HOMOPOLYMER: "homopolymer",
+}
 
 
 def pack_for_stage2(batch: fastq.ReadBatch, cfg: ECConfig):
@@ -75,6 +86,8 @@ class ECOptions:
     threads: int = 1  # -t: parallel host decode workers (multi-file)
     no_mmap: bool = False  # -M: slurp the DB instead of memmapping
     profile: str | None = None  # --profile DIR: jax.profiler trace
+    metrics: str | None = None  # --metrics PATH: final metrics JSON
+    metrics_interval: float = 0.0  # heartbeat period (s); 0 = no JSONL
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -130,6 +143,13 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     quorum driver replays stage 1's cache through stage 2, sparing the
     second full parse the reference gets for free from the page
     cache."""
+    # telemetry (--metrics): per-read outcome counters decoded from the
+    # rendered results, pipeline queue gauges, stage timers. NULL (all
+    # no-ops, reg.enabled False) when opts.metrics is unset, so the
+    # per-read hot path pays nothing.
+    reg = registry_for(opts.metrics, opts.metrics_interval)
+    reg.set_meta(stage="error_correct", batch_size=opts.batch_size,
+                 no_discard=bool(no_discard))
     vlog("Loading mer database")
     if db is not None:
         # in-process handoff from stage 1: the table is already device
@@ -166,7 +186,8 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     out = _open_out(opts.output, ".fa", sys.stdout, opts.gzip)
     log = _open_out(opts.output, ".log", sys.stderr, opts.gzip)
     stats = ECStats(cutoff=cutoff)
-    writer = AsyncWriter([out, log])
+    pipe_metrics = reg if reg.enabled else None
+    writer = AsyncWriter([out, log], metrics=pipe_metrics)
     timer = StageTimer()
     vlog("Correcting reads")
     try:
@@ -201,7 +222,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
             def _pack(it):
                 for b in it:
                     yield b, pack_for_stage2(b, cfg)
-            batches = prefetch(_pack(src))
+            batches = prefetch(_pack(src), metrics=pipe_metrics)
         # host finish+render pipeline: the D2H (fetch_finish) must stay
         # on the MAIN thread (the tunnel degrades under concurrent
         # device access, PERF_NOTES.md r4), but the numpy/str tail is
@@ -211,32 +232,61 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         import collections
         import concurrent.futures as _cf
 
+        count_outcomes = reg.enabled
+
         def _render(batch, buf, b, l, maxe):
             results = finish_batch_host(buf, batch.n, cfg, batch.codes,
                                         b, l, maxe)
             fa_parts: list[str] = []
             log_parts: list[str] = []
             n_corr = n_skip = bases_out = 0
+            # per-read outcome tallies (err_log.hpp semantics, decoded
+            # from the rendered entry strings so counters are exactly
+            # what the .fa/.log outputs record); skipped when metrics
+            # are off — the branch below never runs
+            outcome = ({"subs": 0, "t3": 0, "t5": 0, "hist": {},
+                        "skips": {}} if count_outcomes else None)
             for hdr, r in zip(batch.headers, results):
                 if r.ok:
                     fa_parts.append(
                         f">{hdr} {r.fwd_log} {r.bwd_log}\n{r.seq}\n")
                     n_corr += 1
                     bases_out += r.end - r.start
+                    if outcome is not None:
+                        ns = (r.fwd_log.count(":sub:")
+                              + r.bwd_log.count(":sub:"))
+                        outcome["subs"] += ns
+                        outcome["t3"] += r.fwd_log.count(":3_trunc")
+                        outcome["t5"] += r.bwd_log.count(":5_trunc")
+                        outcome["hist"][ns] = (
+                            outcome["hist"].get(ns, 0) + 1)
                 else:
                     log_parts.append(f"Skipped {hdr}: {r.error}\n")
                     n_skip += 1
+                    if outcome is not None:
+                        slug = REASON_SLUGS.get(r.error, "other")
+                        outcome["skips"][slug] = (
+                            outcome["skips"].get(slug, 0) + 1)
                     if cfg.no_discard:
                         fa_parts.append(f">{hdr}\nN\n")
             return ("".join(fa_parts), "".join(log_parts), n_corr,
-                    n_skip, bases_out)
+                    n_skip, bases_out, outcome)
 
         def _drain(fut):
             with timer.stage("drain"):
-                fa, lg, n_corr, n_skip, bases_out = fut.result()
+                fa, lg, n_corr, n_skip, bases_out, outcome = fut.result()
             stats.corrected += n_corr
             stats.skipped += n_skip
             stats.bases_out += bases_out
+            if outcome is not None:
+                reg.counter("substitutions").inc(outcome["subs"])
+                reg.counter("truncations_3p").inc(outcome["t3"])
+                reg.counter("truncations_5p").inc(outcome["t5"])
+                hist = reg.histogram("substitutions_per_read")
+                for v, n in outcome["hist"].items():
+                    hist.observe(v, n)
+                for slug, n in outcome["skips"].items():
+                    reg.counter(f"skipped_{slug}").inc(n)
             writer.write(0, fa)
             writer.write(1, lg)
 
@@ -272,6 +322,9 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                     nb = int(batch.lengths[:batch.n].sum())
                     stats.bases_in += nb
                     timer.add_units("device", nb)
+                    reg.heartbeat(stage="error_correct",
+                                  reads=stats.reads,
+                                  bases=stats.bases_in)
                 while pending:
                     _drain(pending.popleft())
         finally:
@@ -302,4 +355,14 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                 _finish(log)
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
+    if reg.enabled:
+        reg.counter("reads_in").inc(stats.reads)
+        reg.counter("reads_corrected").inc(stats.corrected)
+        reg.counter("reads_skipped").inc(stats.skipped)
+        reg.counter("bases_in").inc(stats.bases_in)
+        reg.counter("bases_out").inc(stats.bases_out)
+        reg.gauge("cutoff").set(stats.cutoff)
+        reg.set_timer("stage2", timer.as_dict(stats.bases_in))
+        reg.set_meta(status="ok")
+        reg.write()
     return stats

@@ -67,13 +67,33 @@ def host_shard_paths(paths: Sequence[str],
 
 
 def read_batches_multihost(paths: Sequence[str], batch_size: int = 8192,
-                           threads: int = 1) -> Iterator[fastq.ReadBatch]:
+                           threads: int = 1,
+                           metrics=None) -> Iterator[fastq.ReadBatch]:
     """This host's share of the global read stream, batched. With one
     process this is exactly fastq.read_batches. Callers running under
     a global mesh must keep issuing collective steps until EVERY host
     drains (hosts' shares differ in length) — build_step/correct_step
-    handle that by treating an empty batch as all-invalid lanes."""
+    handle that by treating an empty batch as all-invalid lanes.
+
+    `metrics` (optional telemetry registry) records THIS host's input
+    share (file count and bytes — the decode load-balance the greedy
+    assignment targets) plus per-host batch/read counters."""
     mine = host_shard_paths(paths)
+    if metrics is not None and metrics.enabled:
+        def size_of(p):
+            try:
+                return os.path.getsize(p)
+            except OSError:
+                return 0
+        metrics.gauge("host_input_files").set(len(mine))
+        metrics.gauge("host_input_bytes").set(
+            sum(size_of(p) for p in mine))
+        metrics.set_meta(host_process_index=jax.process_index(),
+                         host_input_paths=[str(p) for p in mine])
     if not mine:
         return
-    yield from fastq.read_batches(mine, batch_size, threads=threads)
+    for batch in fastq.read_batches(mine, batch_size, threads=threads):
+        if metrics is not None:
+            metrics.counter("host_batches").inc()
+            metrics.counter("host_reads").inc(batch.n)
+        yield batch

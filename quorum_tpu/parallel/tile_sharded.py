@@ -57,6 +57,7 @@ from ..models import corrector
 from ..models.create_database import extract_observations_impl
 from ..models.ec_config import ECConfig
 from ..ops import ctable
+from ..telemetry import NULL as NULL_METRICS
 
 AXIS = "shards"
 
@@ -394,14 +395,46 @@ def finalize(bstate: ctable.TBuildState, meta: TileShardedMeta,
                                             bstate.lq))
 
 
+def shard_occupancy(state: ctable.TileState,
+                    meta: TileShardedMeta) -> list[int]:
+    """Distinct-mer count per shard of a FINALIZED row-sharded table
+    (value-word layout: low half-word holds count in the bottom
+    `bits`). The per-shard spread is the load-balance number the
+    telemetry layer reports — leading-bit sharding under the Feistel
+    mix should keep it tight.
+
+    The reduction runs device-side (shard-local: the reduced axis
+    never crosses the row split) so only `n_shards` ints cross D2H —
+    the row plane itself is never materialized on the host, and on a
+    multi-host mesh the replicated output stays addressable."""
+
+    def occ(rows):
+        counts = rows[:, 0::2] & jnp.uint32(meta.max_val)
+        return (counts != 0).reshape(meta.n_shards, -1).sum(
+            axis=1, dtype=jnp.int32)
+
+    sharding = getattr(state.rows, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    kw = {} if mesh is None else {
+        "out_shardings": NamedSharding(mesh, P())}
+    return [int(n) for n in jax.device_get(jax.jit(occ, **kw)(state.rows))]
+
+
 def build_database_tile_sharded(batches, mesh: Mesh,
                                 meta: TileShardedMeta, qual_thresh: int,
-                                max_grows: int = 8):
+                                max_grows: int = 8, metrics=None):
     """Driver: insert every (codes, quals) batch with the exact-once
-    grow-retry contract. Returns (TileState sharded by rows, meta)."""
+    grow-retry contract. Returns (TileState sharded by rows, meta).
+
+    `metrics` (optional telemetry registry) records per-shard build
+    counters: batches/reads routed, grow and overflow-retry events,
+    and the final per-shard distinct-mer occupancy."""
+    reg = metrics if metrics is not None else NULL_METRICS
     bstate = make_build_state(meta, mesh)
     step = build_step(mesh, meta, qual_thresh)
     for codes, quals in batches:
+        reg.counter("shard_batches").inc()
+        reg.counter("shard_reads").inc(codes.shape[0])
         n = codes.shape[0] * codes.shape[1]
         pending = jnp.ones((n,), bool)
         grows = 0
@@ -425,16 +458,29 @@ def build_database_tile_sharded(batches, mesh: Mesh,
                     raise RuntimeError("Hash is full")
                 grows += 1
                 passes = 0
+                rb_before = meta.rb_log2
                 bstate, meta = grow(bstate, meta, mesh)
                 step = build_step(mesh, meta, qual_thresh)
+                reg.counter("shard_grows").inc()
+                reg.event("shard_grow", rb_log2_before=rb_before,
+                          rb_log2_after=meta.rb_log2)
             else:
                 # send-bucket overflow only — re-exchange the
                 # un-placed lanes at the same size (ADVICE r4: skew
                 # must not trigger doubling while table space remains)
                 passes += 1
+                reg.counter("shard_overflow_passes").inc()
                 if passes > level_budget:
                     raise RuntimeError("Hash is full")
-    return finalize(bstate, meta, mesh), meta
+    state = finalize(bstate, meta, mesh)
+    if reg.enabled:
+        per = shard_occupancy(state, meta)
+        reg.gauge("n_shards").set(meta.n_shards)
+        reg.gauge("shard_distinct_min").set(min(per))
+        reg.gauge("shard_distinct_max").set(max(per))
+        reg.counter("distinct_mers").inc(sum(per))
+        reg.set_meta(shard_distinct_mers=per)
+    return state, meta
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""Structured telemetry: metrics registry + JSONL run events.
+
+`registry_for(path, heartbeat_s)` is the entry point the CLIs use for
+their `--metrics PATH` option; it returns the no-op NULL singleton
+when no path is given, so instrumentation is zero-cost when disabled.
+See registry.py for the model and schema.py for the document format.
+"""
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       NULL, NullRegistry, registry_for,
+                       track_jax_compile_cache)
+from .schema import (SCHEMA_VERSION, check_file, metric_line,
+                     validate_bench_line, validate_events_line,
+                     validate_metrics)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
+    "NullRegistry", "registry_for", "track_jax_compile_cache",
+    "SCHEMA_VERSION", "check_file", "metric_line",
+    "validate_bench_line", "validate_events_line", "validate_metrics",
+]

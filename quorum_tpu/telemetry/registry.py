@@ -1,0 +1,354 @@
+"""Metrics registry: counters, gauges, histograms, and a JSONL sink.
+
+The machine-readable counterpart of vlog/StageTimer (ISSUE 1): every
+layer of the pipeline records what it did into ONE registry per run,
+which writes a final schema-versioned JSON document (schema.py) plus —
+when a heartbeat interval is configured — a JSONL event stream
+(run manifest, hash grows, period-limited progress lines with Gb/h
+so-far). The reference keeps this information in vlog timestamps and
+the per-read err_log; KMC 3 (PAPERS.md) exposes it as a queryable
+per-stage statistics artifact, which is the model followed here.
+
+Zero-cost when disabled: `registry_for(None)` returns the NULL
+singleton whose methods are all no-ops and whose `enabled` flag lets
+per-read hot paths skip metric derivation entirely. No dependencies
+beyond the standard library.
+
+Thread model: counters/gauges take a per-object lock (the pipeline
+updates them from the prefetch, writer, and render threads); the
+registry's name->metric maps and the event sink share one registry
+lock. All costs are per-batch or per-event, never per-base.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+
+from .schema import SCHEMA_VERSION
+
+
+def _scalar(v):
+    """Coerce a value to a JSON-safe scalar (numpy ints/floats pass
+    through their __int__/__float__)."""
+    if isinstance(v, bool) or v is None or isinstance(v, (int, float, str)):
+        return v
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            continue
+    return str(v)
+
+
+class Counter:
+    """Monotone integer count."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += int(n)
+
+
+class Gauge:
+    """Last-set (or max/accumulated) numeric value."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = _scalar(v)
+
+    def set_max(self, v) -> None:
+        v = _scalar(v)
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
+    def add(self, v) -> None:
+        with self._lock:
+            self.value += v
+
+
+class Histogram:
+    """Integer-valued histogram: exact per-value counts plus count/sum
+    (substitutions-per-read and friends take a handful of distinct
+    small values, so exact counts beat fixed buckets)."""
+
+    __slots__ = ("counts", "count", "sum", "_lock")
+
+    MAX_KEYS = 512
+
+    def __init__(self):
+        self.counts: dict[int, int] = {}
+        self.count = 0
+        self.sum = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value, n: int = 1) -> None:
+        value, n = int(value), int(n)
+        with self._lock:
+            self.count += n
+            self.sum += value * n
+            if value in self.counts or len(self.counts) < self.MAX_KEYS:
+                self.counts[value] = self.counts.get(value, 0) + n
+            else:  # pragma: no cover - cardinality guard
+                self.counts["overflow"] = (
+                    self.counts.get("overflow", 0) + n)
+
+
+class MetricsRegistry:
+    """One per instrumented run. `path` receives the final JSON via
+    `write()`; `heartbeat_s > 0` additionally opens `events_path`
+    (default: <path minus .json>.events.jsonl) and rate-limits
+    `heartbeat()` to that period."""
+
+    enabled = True
+
+    def __init__(self, path: str | None = None,
+                 heartbeat_s: float = 0.0,
+                 events_path: str | None = None):
+        self.path = path
+        self.heartbeat_s = float(heartbeat_s)
+        if events_path is None and path and self.heartbeat_s > 0:
+            base = path[:-5] if path.endswith(".json") else path
+            events_path = base + ".events.jsonl"
+        self.events_path = events_path
+        self.meta: dict = {}
+        self.timers: dict = {}
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+        self._events_f = None
+        self._t0 = time.perf_counter()
+        self._last_beat = -1e18
+
+    # -- metric accessors (get-or-create) --------------------------------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter()
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge()
+            return m
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            m = self._hists.get(name)
+            if m is None:
+                m = self._hists[name] = Histogram()
+            return m
+
+    def set_meta(self, **fields) -> None:
+        self.meta.update(fields)
+
+    def set_timer(self, name: str, timer_dict: dict) -> None:
+        """Attach a StageTimer.as_dict() under `timers`."""
+        self.timers[name] = timer_dict
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- JSONL event sink -------------------------------------------------
+    def event(self, kind: str, **fields) -> None:
+        """Append one event line; no-op unless an events path is
+        configured (heartbeat_s > 0 or explicit events_path)."""
+        if not self.events_path:
+            return
+        obj = {"event": kind, "t": round(self.elapsed(), 3)}
+        for k, v in fields.items():
+            obj[k] = _scalar(v)
+        line = json.dumps(obj) + "\n"
+        with self._lock:
+            if self._events_f is None:
+                self._events_f = open(self.events_path, "w")
+            self._events_f.write(line)
+            self._events_f.flush()
+
+    def heartbeat(self, **fields) -> None:
+        """Rate-limited progress event. A `bases` field gets derived
+        `gb_per_h` (so-far, since registry creation) for free."""
+        if not self.events_path or self.heartbeat_s <= 0:
+            return
+        now = time.perf_counter()
+        if now - self._last_beat < self.heartbeat_s:
+            return
+        self._last_beat = now
+        el = self.elapsed()
+        if "bases" in fields and el > 0:
+            fields["gb_per_h"] = round(
+                _scalar(fields["bases"]) / el * 3600.0 / 1e9, 4)
+        self.event("heartbeat", elapsed_s=round(el, 3), **fields)
+
+    # -- output -----------------------------------------------------------
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "schema": SCHEMA_VERSION,
+                "meta": dict(self.meta),
+                "counters": {k: c.value
+                             for k, c in sorted(self._counters.items())},
+                "gauges": {k: g.value
+                           for k, g in sorted(self._gauges.items())},
+                "histograms": {
+                    k: {"count": h.count, "sum": h.sum,
+                        "counts": {str(v): n
+                                   for v, n in sorted(
+                                       h.counts.items(),
+                                       key=lambda kv: str(kv[0]))}}
+                    for k, h in sorted(self._hists.items())},
+                "timers": dict(self.timers),
+            }
+
+    def write(self, path: str | None = None) -> str | None:
+        """Write the final metrics JSON (atomic replace) and close the
+        event sink. Returns the path written."""
+        path = path or self.path
+        if not path:
+            return None
+        doc = self.as_dict()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        with self._lock:
+            if self._events_f is not None:
+                self._events_f.close()
+                self._events_f = None
+        return path
+
+
+class NullRegistry:
+    """The disabled registry: every method is a no-op, `enabled` is
+    False so hot paths can skip metric derivation entirely."""
+
+    enabled = False
+    path = None
+    events_path = None
+
+    def counter(self, name):
+        return _NULL_COUNTER
+
+    def gauge(self, name):
+        return _NULL_GAUGE
+
+    def histogram(self, name):
+        return _NULL_HIST
+
+    def set_meta(self, **fields):
+        pass
+
+    def set_timer(self, name, timer_dict):
+        pass
+
+    def event(self, kind, **fields):
+        pass
+
+    def heartbeat(self, **fields):
+        pass
+
+    def elapsed(self):
+        return 0.0
+
+    def as_dict(self):
+        return {"schema": SCHEMA_VERSION, "meta": {}, "counters": {},
+                "gauges": {}, "histograms": {}, "timers": {}}
+
+    def write(self, path=None):
+        return None
+
+
+class _NullMetric:
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def set_max(self, v):
+        pass
+
+    def add(self, v):
+        pass
+
+    def observe(self, value, n=1):
+        pass
+
+
+_NULL_COUNTER = _NullMetric()
+_NULL_GAUGE = _NullMetric()
+_NULL_HIST = _NullMetric()
+
+NULL = NullRegistry()
+
+
+def registry_for(path: str | None,
+                 heartbeat_s: float = 0.0) -> MetricsRegistry | NullRegistry:
+    """The one constructor call sites use: a real registry when a
+    `--metrics PATH` was given, the no-op NULL singleton when not."""
+    if not path:
+        return NULL
+    return MetricsRegistry(path, heartbeat_s=heartbeat_s)
+
+
+# jax.monitoring offers register but no unregister, so exactly ONE
+# listener is ever installed; it fans out to whichever registries are
+# still alive (WeakSet: a finished run's registry just drops out, no
+# per-run leak in long-lived processes that call main() repeatedly).
+_cache_listener_installed = False
+_cache_listener_targets: weakref.WeakSet = weakref.WeakSet()
+
+
+def _cache_listener(event, *a, **kw):
+    if event == "/jax/compilation_cache/cache_hits":
+        name = "jax_cache_hits"
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        name = "jax_cache_requests"
+    else:
+        return
+    for reg in list(_cache_listener_targets):
+        reg.counter(name).inc()
+
+
+def track_jax_compile_cache(reg) -> None:
+    """Subscribe `reg` to the jax.monitoring compile-cache events,
+    feeding `jax_cache_hits` / `jax_cache_requests` counters (misses =
+    requests - hits; the driver derives a `jax_cache_misses` gauge at
+    write time). Best-effort: silently a no-op on jax versions without
+    monitoring or with different event names."""
+    global _cache_listener_installed
+    if not reg.enabled:
+        return
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 - jax absent / too old
+        return
+    if not _cache_listener_installed:
+        try:
+            monitoring.register_event_listener(_cache_listener)
+        except Exception:  # noqa: BLE001 - listener API drift
+            return
+        _cache_listener_installed = True
+    _cache_listener_targets.add(reg)

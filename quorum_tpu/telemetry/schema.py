@@ -1,0 +1,196 @@
+"""The metrics document schema (version `quorum-tpu-metrics/1`) and
+its validator — shared by `tools/metrics_check.py`, the tests, and
+bench.py's line emitter, so every machine-readable artifact the
+pipeline produces stays mutually comparable.
+
+Final metrics JSON (MetricsRegistry.as_dict):
+
+    {
+      "schema":     "quorum-tpu-metrics/1",
+      "meta":       {str: scalar | [scalar] | {str: scalar}},
+      "counters":   {str: int >= 0},
+      "gauges":     {str: number},
+      "histograms": {str: {"count": int, "sum": number,
+                           "counts": {str: int}}},
+      "timers":     {str: {"total_seconds": number,
+                           "stages": {str: {"seconds": number,
+                                            "calls": int,
+                                            "units": int}}}}
+    }
+
+Events JSONL (one JSON object per line): `event` (str) and `t`
+(seconds since registry creation, number) are required; all other
+values must be scalars. `heartbeat` events carry progress fields
+(reads/bases so far, derived `gb_per_h`).
+
+No dependency on jsonschema: the checks are hand-rolled and return a
+list of human-readable problem strings (empty = valid).
+"""
+
+from __future__ import annotations
+
+import json
+
+SCHEMA_VERSION = "quorum-tpu-metrics/1"
+
+_SCALAR = (str, int, float, bool, type(None))
+
+
+def _is_scalar(v) -> bool:
+    return isinstance(v, _SCALAR)
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_metrics(doc) -> list[str]:
+    """Validate a final metrics document. Returns problems (empty =
+    valid)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if doc.get("schema") != SCHEMA_VERSION:
+        errs.append(f"schema is {doc.get('schema')!r}, "
+                    f"expected {SCHEMA_VERSION!r}")
+    for key in ("meta", "counters", "gauges", "histograms", "timers"):
+        if not isinstance(doc.get(key), dict):
+            errs.append(f"missing or non-object section {key!r}")
+    unknown = set(doc) - {"schema", "meta", "counters", "gauges",
+                          "histograms", "timers"}
+    if unknown:
+        errs.append(f"unknown top-level keys {sorted(unknown)}")
+    if errs:
+        return errs
+
+    for k, v in doc["meta"].items():
+        ok = (_is_scalar(v)
+              or (isinstance(v, list) and all(_is_scalar(x) for x in v))
+              or (isinstance(v, dict)
+                  and all(_is_scalar(x) for x in v.values())))
+        if not ok:
+            errs.append(f"meta[{k!r}] is not scalar/list/flat-object")
+    for k, v in doc["counters"].items():
+        if not (isinstance(v, int) and not isinstance(v, bool) and v >= 0):
+            errs.append(f"counters[{k!r}] = {v!r} is not a non-negative int")
+    for k, v in doc["gauges"].items():
+        if not _is_number(v):
+            errs.append(f"gauges[{k!r}] = {v!r} is not a number")
+    for k, h in doc["histograms"].items():
+        if not isinstance(h, dict):
+            errs.append(f"histograms[{k!r}] is not an object")
+            continue
+        if not (isinstance(h.get("count"), int)
+                and _is_number(h.get("sum"))
+                and isinstance(h.get("counts"), dict)):
+            errs.append(f"histograms[{k!r}] needs count/sum/counts")
+            continue
+        total = 0
+        for bk, bn in h["counts"].items():
+            if not isinstance(bk, str) or not isinstance(bn, int):
+                errs.append(f"histograms[{k!r}].counts[{bk!r}] malformed")
+            else:
+                total += bn
+        if total != h["count"]:
+            errs.append(f"histograms[{k!r}]: counts sum {total} != "
+                        f"count {h['count']}")
+    for k, t in doc["timers"].items():
+        if not isinstance(t, dict) or not _is_number(
+                t.get("total_seconds")):
+            errs.append(f"timers[{k!r}] needs numeric total_seconds")
+            continue
+        stages = t.get("stages", {})
+        if not isinstance(stages, dict):
+            errs.append(f"timers[{k!r}].stages is not an object")
+            continue
+        for sk, sv in stages.items():
+            if not (isinstance(sv, dict) and _is_number(sv.get("seconds"))
+                    and isinstance(sv.get("calls"), int)):
+                errs.append(f"timers[{k!r}].stages[{sk!r}] malformed")
+    return errs
+
+
+def validate_events_line(obj) -> list[str]:
+    """Validate one parsed events-JSONL object."""
+    errs: list[str] = []
+    if not isinstance(obj, dict):
+        return ["event line is not a JSON object"]
+    if not isinstance(obj.get("event"), str) or not obj.get("event"):
+        errs.append("missing/empty 'event' field")
+    if not _is_number(obj.get("t")):
+        errs.append("missing/non-numeric 't' field")
+    for k, v in obj.items():
+        if not _is_scalar(v):
+            errs.append(f"event field {k!r} is not scalar")
+    return errs
+
+
+def validate_bench_line(obj) -> list[str]:
+    """Validate one parsed bench-style metric line (the `metric_line`
+    output format: `metric` (str) plus scalar fields)."""
+    errs: list[str] = []
+    if not isinstance(obj, dict):
+        return ["bench line is not a JSON object"]
+    if not isinstance(obj.get("metric"), str) or not obj.get("metric"):
+        errs.append("missing/empty 'metric' field")
+    for k, v in obj.items():
+        if not _is_scalar(v):
+            errs.append(f"bench field {k!r} is not scalar")
+    return errs
+
+
+def check_file(path: str) -> list[str]:
+    """Validate any metrics artifact by path, dispatching on content:
+    a whole-document metrics JSON (MetricsRegistry.write), an events
+    .jsonl stream, or a bench-style metric-line file (one
+    `{"metric": ...}` object per line, as bench.py emits)."""
+    errs: list[str] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [str(e)]
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if (isinstance(doc, dict)
+            and ("schema" in doc or "counters" in doc)
+            and "metric" not in doc and "event" not in doc):
+        return validate_metrics(doc)
+    # line-oriented: events JSONL and/or bench metric lines (a bench
+    # run interleaves both kinds through one stdout)
+    any_line = False
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        any_line = True
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            errs.append(f"line {i}: invalid JSON ({e})")
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            check = validate_bench_line
+        else:
+            check = validate_events_line
+        errs.extend(f"line {i}: {e}" for e in check(obj))
+    if not any_line:
+        errs.append("no metrics content found")
+    return errs
+
+
+def metric_line(metric: str, **fields) -> str:
+    """One bench-style JSON line (`{"metric": ..., ...}`) with the
+    field types checked — bench.py emits through this so BENCH_*.json
+    stays schema-consistent across rounds. Values must be scalars."""
+    if not metric or not isinstance(metric, str):
+        raise ValueError("metric name must be a non-empty string")
+    obj = {"metric": metric}
+    for k, v in fields.items():
+        if not _is_scalar(v):
+            raise ValueError(
+                f"metric_line field {k!r} is not a scalar: {type(v)}")
+        obj[k] = v
+    return json.dumps(obj)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, TypeVar
 
 T = TypeVar("T")
@@ -22,19 +23,34 @@ T = TypeVar("T")
 _STOP = object()
 
 
-def prefetch(it: Iterable[T], depth: int = 4) -> Iterator[T]:
+def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
+             name: str = "prefetch") -> Iterator[T]:
     """Run `it` in a background thread, buffering up to `depth` items.
-    Exceptions in the producer re-raise at the consumption point."""
+    Exceptions in the producer re-raise at the consumption point.
+
+    `metrics` (an enabled telemetry registry, or None) records
+    `<name>_queue_depth_max` (items buffered when the consumer asks —
+    depth-of-`depth` means the producer is keeping up) and
+    `<name>_producer_stall_seconds` (time the producer spent blocked
+    on a full queue, i.e. the consumer was the bottleneck)."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
+    depth_g = metrics.gauge(f"{name}_queue_depth_max") if metrics else None
+    stall_g = (metrics.gauge(f"{name}_producer_stall_seconds")
+               if metrics else None)
 
     def put(item) -> bool:
         # bounded put that gives up if the consumer abandoned us
+        t0 = time.perf_counter() if stall_g is not None else 0.0
+        blocked = False
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.2)
+                if stall_g is not None and blocked:
+                    stall_g.add(time.perf_counter() - t0)
                 return True
             except queue.Full:
+                blocked = True
                 continue
         return False
 
@@ -52,6 +68,8 @@ def prefetch(it: Iterable[T], depth: int = 4) -> Iterator[T]:
     t.start()
     try:
         while True:
+            if depth_g is not None:
+                depth_g.set_max(q.qsize())
             item = q.get()
             if item is _STOP:
                 break
@@ -72,13 +90,19 @@ class AsyncWriter:
     Streams are indexed by position; `write(i, text)` never blocks the
     caller unless `maxsize` records are already queued (backpressure,
     like the bounded jflib::pool). `close()` flushes and joins; a
-    writer-side exception re-raises there."""
+    writer-side exception re-raises there.
 
-    def __init__(self, streams, maxsize: int = 64):
+    `metrics` (an enabled telemetry registry, or None) records
+    `writer_queue_depth_max` — records queued when the caller writes;
+    maxsize means output I/O was the bottleneck."""
+
+    def __init__(self, streams, maxsize: int = 64, metrics=None):
         self.streams = list(streams)
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self.err: BaseException | None = None
         self._raised = False
+        self._depth_g = (metrics.gauge("writer_queue_depth_max")
+                         if metrics else None)
         self.t = threading.Thread(target=self._loop, daemon=True)
         self.t.start()
 
@@ -100,6 +124,8 @@ class AsyncWriter:
             self._raised = True
             raise self.err  # fail fast, not after gigabases into a dead pipe
         if text:
+            if self._depth_g is not None:
+                self._depth_g.set_max(self.q.qsize() + 1)
             self.q.put((i, text))
 
     def close(self) -> None:

@@ -39,9 +39,25 @@ def trace(profile_dir: str | None):
         return
     import jax
 
-    with jax.profiler.trace(profile_dir):
-        yield
-    vlog("Wrote profiler trace to ", profile_dir)
+    # Log the pointer even when the traced BODY raises — an
+    # interrupted profiled run is exactly when the user needs it (the
+    # profiler exit still dumps the trace during unwind) — but never
+    # when the profiler itself failed to start or to write, which
+    # would advertise a trace that does not exist.
+    body_exc = None
+    try:
+        with jax.profiler.trace(profile_dir):
+            try:
+                yield
+            except BaseException as e:
+                body_exc = e
+                raise
+    except BaseException as e:
+        if e is body_exc:
+            vlog("Wrote profiler trace to ", profile_dir)
+        raise
+    else:
+        vlog("Wrote profiler trace to ", profile_dir)
 
 
 class StageTimer:
@@ -70,17 +86,38 @@ class StageTimer:
     def add_units(self, name: str, n: int) -> None:
         self.units[name] = self.units.get(name, 0) + n
 
+    def as_dict(self, total_units: int = 0, unit: str = "bases") -> dict:
+        """The machine-readable stage table (telemetry `timers`
+        section; schema in telemetry/schema.py) — the same facts
+        `report` prints through vlog."""
+        total = time.perf_counter() - self._t0
+        d: dict = {
+            "total_seconds": round(total, 6),
+            "stages": {
+                name: {"seconds": round(self.seconds[name], 6),
+                       "calls": self.calls[name],
+                       "units": self.units.get(name, 0)}
+                for name in self.seconds
+            },
+        }
+        if total_units and total > 0:
+            d["total_units"] = total_units
+            d["unit"] = unit
+            d["units_per_hour"] = round(total_units / total * 3600, 3)
+        return d
+
     def report(self, total_units: int = 0, unit: str = "bases") -> None:
         """Print the stage table through vlog (visible with -v)."""
-        total = time.perf_counter() - self._t0
-        for name in self.seconds:
-            s = self.seconds[name]
+        d = self.as_dict(total_units, unit)
+        total = d["total_seconds"] or 1e-12
+        for name, st in d["stages"].items():
+            s = st["seconds"]
             line = (f"stage {name:<12} {s:8.3f}s "
-                    f"({100.0 * s / total:5.1f}%) x{self.calls[name]}")
-            if name in self.units and s > 0:
-                line += f"  {self.units[name] / s / 1e6:.2f} M{unit}/s"
+                    f"({100.0 * s / total:5.1f}%) x{st['calls']}")
+            if st["units"] and s > 0:
+                line += f"  {st['units'] / s / 1e6:.2f} M{unit}/s"
             vlog(line)
-        accounted = sum(self.seconds.values())
+        accounted = sum(st["seconds"] for st in d["stages"].values())
         vlog(f"stage {'(other)':<12} {total - accounted:8.3f}s "
              f"({100.0 * (total - accounted) / total:5.1f}%)")
         if total_units and total > 0:

@@ -2,14 +2,26 @@
 
 Same surface as the reference's vlog (src/verbose_log.hpp:26-63):
 "[YYYY/MM/DD HH:MM:SS] message" on stderr when enabled.
+
+Library callers (tests, notebooks) that never run a CLI parser can
+enable it with the QUORUM_TPU_VERBOSE environment variable (any value
+other than empty/0/false); the CLIs' --verbose/--debug flags OR into
+this, they do not override it off.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-verbose = False
+
+def _env_enabled() -> bool:
+    return os.environ.get("QUORUM_TPU_VERBOSE", "").strip().lower() not in (
+        "", "0", "false", "no")
+
+
+verbose = _env_enabled()
 
 
 def vlog(*parts) -> None:

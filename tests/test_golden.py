@@ -39,3 +39,83 @@ def test_golden_end_to_end(tmp_path):
     assert filecmp.cmp(out2 + ".log",
                        os.path.join(GOLDEN, "expected_auto.log"),
                        shallow=False)
+
+
+def test_golden_metrics_end_to_end(tmp_path):
+    """Acceptance (ISSUE 1): the golden pipeline run with --metrics
+    produces schema-valid metrics whose outcome counters exactly match
+    the counts recoverable from expected.fa/expected.log, while the
+    .fa/.log outputs stay byte-identical."""
+    import json
+    import subprocess
+    import sys
+
+    from quorum_tpu.models.error_correct import REASON_SLUGS
+    from quorum_tpu.telemetry import validate_metrics
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    db = str(tmp_path / "db.jf")
+    m1 = str(tmp_path / "stage1.json")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, "--metrics", m1, reads])
+    assert rc == 0
+    out = str(tmp_path / "corr")
+    m2 = str(tmp_path / "stage2.json")
+    rc = ec_cli.main(["-p", "4", db, reads, "-o", out, "--metrics", m2])
+    assert rc == 0
+
+    # byte parity unchanged with telemetry enabled
+    assert filecmp.cmp(out + ".fa", os.path.join(GOLDEN, "expected.fa"),
+                       shallow=False)
+    assert filecmp.cmp(out + ".log", os.path.join(GOLDEN, "expected.log"),
+                       shallow=False)
+
+    # schema-valid, through the actual validator tool
+    check = os.path.join(os.path.dirname(HERE), "tools",
+                         "metrics_check.py")
+    res = subprocess.run([sys.executable, check, m1, m2],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+    # ground truth recovered from the committed expected outputs
+    fastq_lines = open(reads).read().splitlines()
+    n_reads = len(fastq_lines) // 4
+    n_bases = sum(len(s) for s in fastq_lines[1::4])
+    fa = open(os.path.join(GOLDEN, "expected.fa")).read()
+    log = open(os.path.join(GOLDEN, "expected.log")).read()
+    corrected = fa.count(">")
+    skip_reasons = [ln.split(": ", 1)[1]
+                    for ln in log.splitlines()
+                    if ln.startswith("Skipped ")]
+
+    doc1 = json.load(open(m1))
+    assert validate_metrics(doc1) == []
+    assert doc1["meta"]["stage"] == "create_database"
+    assert doc1["counters"]["reads"] == n_reads
+    assert doc1["counters"]["bases"] == n_bases
+    assert doc1["counters"]["distinct_mers"] > 0
+    assert 0 < doc1["gauges"]["hash_fill"] < 1
+    assert "stage1" in doc1["timers"]
+
+    doc2 = json.load(open(m2))
+    assert validate_metrics(doc2) == []
+    assert doc2["meta"]["stage"] == "error_correct"
+    c = doc2["counters"]
+    assert c["reads_in"] == n_reads
+    assert c["reads_corrected"] == corrected
+    assert c["reads_skipped"] == len(skip_reasons)
+    assert corrected + len(skip_reasons) == n_reads
+    assert c["bases_in"] == n_bases
+    assert c["substitutions"] == fa.count(":sub:")
+    assert c.get("truncations_3p", 0) == fa.count(":3_trunc")
+    assert c.get("truncations_5p", 0) == fa.count(":5_trunc")
+    want_skips: dict = {}
+    for r in skip_reasons:
+        slug = REASON_SLUGS.get(r, "other")
+        want_skips[slug] = want_skips.get(slug, 0) + 1
+    for slug, n in want_skips.items():
+        assert c[f"skipped_{slug}"] == n, slug
+    h = doc2["histograms"]["substitutions_per_read"]
+    assert h["count"] == corrected
+    assert h["sum"] == c["substitutions"]
+    assert "stage2" in doc2["timers"]

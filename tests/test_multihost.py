@@ -51,3 +51,20 @@ def test_deterministic_across_hosts(tmp_path):
     a = [multihost.host_shard_paths(paths, pi, pc) for pi in range(pc)]
     b = [multihost.host_shard_paths(paths, pi, pc) for pi in range(pc)]
     assert a == b
+
+
+def test_read_batches_metrics(tmp_path):
+    """Telemetry wiring: this host's input share and batch/read
+    counters."""
+    from quorum_tpu.telemetry import MetricsRegistry
+
+    paths = _mk_files(tmp_path, [5, 5])
+    reg = MetricsRegistry()
+    batches = list(multihost.read_batches_multihost(paths, 4,
+                                                    metrics=reg))
+    doc = reg.as_dict()
+    assert doc["gauges"]["host_input_files"] == 2
+    assert doc["gauges"]["host_input_bytes"] > 0
+    assert doc["counters"]["host_reads"] == sum(b.n for b in batches) == 2
+    assert doc["counters"]["host_batches"] == len(batches)
+    assert doc["meta"]["host_input_paths"] == paths

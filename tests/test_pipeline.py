@@ -1,0 +1,159 @@
+"""Host pipeline error paths and telemetry gauges: producer exceptions
+re-raised at the consumer, AsyncWriter fail-fast and single-raise on
+close, and the queue-depth/stall instrumentation."""
+
+import io
+import time
+
+import pytest
+
+from quorum_tpu.telemetry import MetricsRegistry
+from quorum_tpu.utils.pipeline import AsyncWriter, prefetch
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+def test_prefetch_passes_items_in_order():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+
+
+def test_prefetch_producer_exception_reraises_at_consumer():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("producer blew up")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="producer blew up"):
+        next(it)
+
+
+def test_prefetch_immediate_producer_error():
+    def gen():
+        raise ValueError("dead on arrival")
+        yield  # pragma: no cover
+
+    with pytest.raises(ValueError, match="dead on arrival"):
+        list(prefetch(gen()))
+
+
+def test_prefetch_consumer_abandon_releases_producer():
+    state = {"produced": 0}
+
+    def gen():
+        for i in range(10_000):
+            state["produced"] += 1
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # generator close -> stop event -> producer unblocks
+    time.sleep(0.5)
+    assert state["produced"] < 10_000
+
+
+def test_prefetch_queue_depth_gauge():
+    reg = MetricsRegistry()
+    # producer instant, consumer slow: the queue should reach depth
+    list_out = []
+    for item in prefetch(iter(range(20)), depth=4, metrics=reg):
+        time.sleep(0.01)
+        list_out.append(item)
+    assert list_out == list(range(20))
+    depth = reg.gauge("prefetch_queue_depth_max").value
+    assert 1 <= depth <= 4
+
+
+def test_prefetch_producer_stall_gauge():
+    reg = MetricsRegistry()
+    # depth 1 + slow consumer: the producer must block on a full queue
+    for _ in prefetch(iter(range(5)), depth=1, metrics=reg):
+        time.sleep(0.25)
+    assert reg.gauge("prefetch_producer_stall_seconds").value > 0.0
+
+
+def test_prefetch_custom_name_prefixes_gauges():
+    reg = MetricsRegistry()
+    list(prefetch(iter(range(3)), metrics=reg, name="reader"))
+    assert "reader_queue_depth_max" in reg.as_dict()["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# AsyncWriter
+# ---------------------------------------------------------------------------
+
+class BrokenStream:
+    def __init__(self, fail_after=0):
+        self.n = 0
+        self.fail_after = fail_after
+
+    def write(self, text):
+        self.n += 1
+        if self.n > self.fail_after:
+            raise OSError("dead pipe")
+
+
+def test_async_writer_writes_and_closes():
+    a, b = io.StringIO(), io.StringIO()
+    w = AsyncWriter([a, b])
+    w.write(0, "x1")
+    w.write(1, "y1")
+    w.write(0, "x2")
+    w.close()
+    assert a.getvalue() == "x1x2"
+    assert b.getvalue() == "y1"
+
+
+def test_async_writer_fail_fast_on_write():
+    w = AsyncWriter([BrokenStream()])
+    w.write(0, "first")  # lands in the queue; the writer thread dies on it
+    deadline = time.time() + 5.0
+    while w.err is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert w.err is not None
+    with pytest.raises(OSError, match="dead pipe"):
+        w.write(0, "second")
+    # already raised at write: close() must not raise again
+    w.close()
+
+
+def test_async_writer_single_raise_on_close():
+    w = AsyncWriter([BrokenStream()])
+    w.write(0, "boom")
+    deadline = time.time() + 5.0
+    while w.err is None and time.time() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(OSError, match="dead pipe"):
+        w.close()
+
+
+def test_async_writer_empty_text_skipped():
+    a = io.StringIO()
+    w = AsyncWriter([a])
+    w.write(0, "")
+    w.write(0, "data")
+    w.close()
+    assert a.getvalue() == "data"
+
+
+def test_async_writer_queue_depth_gauge():
+    class SlowStream:
+        def __init__(self):
+            self.buf = []
+
+        def write(self, text):
+            time.sleep(0.02)
+            self.buf.append(text)
+
+    reg = MetricsRegistry()
+    s = SlowStream()
+    w = AsyncWriter([s], metrics=reg)
+    for i in range(10):
+        w.write(0, f"r{i}")
+    w.close()
+    assert s.buf == [f"r{i}" for i in range(10)]
+    assert reg.gauge("writer_queue_depth_max").value >= 1

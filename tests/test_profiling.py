@@ -58,3 +58,23 @@ def test_trace_writes_profile(tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert found, "profiler trace directory is empty"
+
+
+def test_trace_logs_dir_even_when_body_raises(tmp_path, capsys):
+    """An interrupted profiled run is exactly when the pointer to the
+    trace dir matters: the vlog must fire from the finally."""
+    d = str(tmp_path / "prof")
+    old = vlog_mod.verbose
+    vlog_mod.verbose = True
+    try:
+        try:
+            with trace(d):
+                import jax.numpy as jnp
+
+                _ = (jnp.zeros((4,)) + 1).sum()
+                raise RuntimeError("interrupted")
+        except RuntimeError:
+            pass
+    finally:
+        vlog_mod.verbose = old
+    assert "Wrote profiler trace" in capsys.readouterr().err

@@ -212,3 +212,47 @@ def test_driver_thread_plumbing_and_single_parse(tmp_path, monkeypatch):
     assert rc == 0
     want = str(os.cpu_count() or 1)
     assert seen["cdb"][seen["cdb"].index("-t") + 1] == want
+
+
+def test_stage_path_suffixing():
+    assert quorum_cli._stage_path("out.json", "stage1") == "out.stage1.json"
+    assert quorum_cli._stage_path("metrics", "stage2") == "metrics.stage2"
+
+
+def test_quorum_driver_metrics_forwarding(tmp_path, monkeypatch):
+    """Satellite (ISSUE 1): the driver forwards --metrics to both
+    children with per-stage suffixed paths and writes its own
+    run-manifest JSON with per-child timings."""
+    import json
+
+    from quorum_tpu.telemetry import validate_metrics
+
+    monkeypatch.chdir(tmp_path)
+    reads_path, reads, quals = make_dataset(tmp_path)
+    prefix = str(tmp_path / "qc")
+    mpath = str(tmp_path / "run.json")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "--batch-size", "64", "--metrics", mpath,
+                          reads_path])
+    assert rc == 0
+
+    drv = json.load(open(mpath))
+    assert validate_metrics(drv) == []
+    assert drv["meta"]["driver"] == "quorum"
+    assert drv["meta"]["status"] == "ok"
+    assert drv["meta"]["jax_backend"]
+    assert drv["meta"]["device_count"] >= 1
+    assert drv["gauges"]["stage1_seconds"] > 0
+    assert drv["gauges"]["stage2_seconds"] > 0
+
+    s1 = json.load(open(str(tmp_path / "run.stage1.json")))
+    s2 = json.load(open(str(tmp_path / "run.stage2.json")))
+    assert validate_metrics(s1) == []
+    assert validate_metrics(s2) == []
+    assert s1["meta"]["stage"] == "create_database"
+    assert s2["meta"]["stage"] == "error_correct"
+    # both stages saw the same reads
+    assert s1["counters"]["reads"] == s2["counters"]["reads_in"] \
+        == len(reads)
+    assert s2["counters"]["reads_corrected"] \
+        + s2["counters"]["reads_skipped"] == len(reads)

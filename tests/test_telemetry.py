@@ -1,0 +1,243 @@
+"""Telemetry subsystem: registry semantics, schema validation, the
+metrics_check tool's dispatch, and the vlog env-var fallback."""
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from quorum_tpu.telemetry import (NULL, MetricsRegistry, SCHEMA_VERSION,
+                                  check_file, metric_line, registry_for,
+                                  validate_bench_line,
+                                  validate_events_line, validate_metrics)
+from quorum_tpu.utils.profiling import StageTimer
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+METRICS_CHECK = os.path.join(REPO, "tools", "metrics_check.py")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_null_registry_is_free_and_inert(tmp_path):
+    reg = registry_for(None)
+    assert reg is NULL
+    assert not reg.enabled
+    # every surface is a no-op, nothing raises, nothing is written
+    reg.counter("c").inc(5)
+    reg.gauge("g").set(3)
+    reg.gauge("g").set_max(9)
+    reg.gauge("g").add(1.0)
+    reg.histogram("h").observe(2)
+    reg.set_meta(a=1)
+    reg.set_timer("t", {})
+    reg.event("e", x=1)
+    reg.heartbeat(bases=10)
+    assert reg.write(str(tmp_path / "never.json")) is None
+    assert not (tmp_path / "never.json").exists()
+    assert validate_metrics(reg.as_dict()) == []
+
+
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.counter("reads").inc()
+    reg.counter("reads").inc(4)
+    reg.gauge("fill").set(0.25)
+    reg.gauge("depth").set_max(2)
+    reg.gauge("depth").set_max(1)  # lower: ignored
+    reg.gauge("stall").add(0.5)
+    reg.gauge("stall").add(0.25)
+    reg.histogram("subs").observe(0, 10)
+    reg.histogram("subs").observe(3, 2)
+    doc = reg.as_dict()
+    assert doc["schema"] == SCHEMA_VERSION
+    assert doc["counters"]["reads"] == 5
+    assert doc["gauges"]["fill"] == 0.25
+    assert doc["gauges"]["depth"] == 2
+    assert doc["gauges"]["stall"] == 0.75
+    h = doc["histograms"]["subs"]
+    assert h == {"count": 12, "sum": 6, "counts": {"0": 10, "3": 2}}
+    assert validate_metrics(doc) == []
+
+
+def test_registry_threaded_counts_are_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("n")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == 8000
+
+
+def test_registry_write_and_events(tmp_path):
+    p = str(tmp_path / "m.json")
+    reg = registry_for(p, heartbeat_s=0.001)
+    assert reg.enabled
+    reg.set_meta(stage="test", k=13)
+    reg.counter("reads").inc(7)
+    reg.event("hash_grow", rows_before=8, rows_after=16)
+    reg.heartbeat(reads=7, bases=1000)
+    t = StageTimer()
+    with t.stage("insert"):
+        pass
+    t.add_units("insert", 1000)
+    reg.set_timer("stage1", t.as_dict(1000))
+    assert reg.write() == p
+    doc = json.load(open(p))
+    assert validate_metrics(doc) == []
+    assert doc["meta"]["stage"] == "test"
+    assert doc["counters"]["reads"] == 7
+    assert doc["timers"]["stage1"]["stages"]["insert"]["units"] == 1000
+    # the events stream sits next to the json and validates too
+    ev = p[:-5] + ".events.jsonl"
+    assert os.path.exists(ev)
+    assert check_file(ev) == []
+    lines = [json.loads(x) for x in open(ev) if x.strip()]
+    kinds = [x["event"] for x in lines]
+    assert "hash_grow" in kinds and "heartbeat" in kinds
+    hb = next(x for x in lines if x["event"] == "heartbeat")
+    assert "gb_per_h" in hb  # derived from the bases field
+
+
+def test_heartbeat_rate_limited(tmp_path):
+    p = str(tmp_path / "m.json")
+    reg = registry_for(p, heartbeat_s=1000.0)
+    for i in range(50):
+        reg.heartbeat(reads=i)
+    reg.write()
+    ev = p[:-5] + ".events.jsonl"
+    lines = [x for x in open(ev) if x.strip()]
+    assert len(lines) == 1  # only the first beat within the period
+
+
+def test_no_events_without_interval(tmp_path):
+    p = str(tmp_path / "m.json")
+    reg = registry_for(p)  # heartbeat_s = 0
+    reg.heartbeat(reads=1)
+    reg.event("e")
+    reg.write()
+    assert not os.path.exists(p[:-5] + ".events.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+def test_validate_metrics_rejects_malformed():
+    assert validate_metrics([]) != []
+    assert validate_metrics({"schema": "nope"}) != []
+    base = MetricsRegistry().as_dict()
+    bad = dict(base, counters={"c": -1})
+    assert any("non-negative" in e for e in validate_metrics(bad))
+    bad = dict(base, gauges={"g": "high"})
+    assert any("not a number" in e for e in validate_metrics(bad))
+    bad = dict(base, histograms={"h": {"count": 3, "sum": 1,
+                                       "counts": {"0": 1}}})
+    assert any("counts sum" in e for e in validate_metrics(bad))
+    bad = dict(base, extra={})
+    assert any("unknown top-level" in e for e in validate_metrics(bad))
+
+
+def test_validate_events_and_bench_lines():
+    assert validate_events_line({"event": "x", "t": 0.1, "n": 3}) == []
+    assert validate_events_line({"t": 0.1}) != []
+    assert validate_events_line({"event": "x", "t": 0.1,
+                                 "bad": [1, 2]}) != []
+    assert validate_bench_line(json.loads(
+        metric_line("accuracy", pct=1.5, unit="Gb/h"))) == []
+    assert validate_bench_line({"value": 2}) != []
+    with pytest.raises(ValueError):
+        metric_line("m", bad=[1, 2, 3])
+    with pytest.raises(ValueError):
+        metric_line("")
+
+
+def test_check_file_dispatches_on_content(tmp_path):
+    # bench-style metric lines in a .json file (BENCH_*.json shape)
+    bench = tmp_path / "bench.json"
+    bench.write_text(metric_line("a", value=1) + "\n"
+                     + "# comment\n"
+                     + metric_line("b", value=2) + "\n")
+    assert check_file(str(bench)) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"value": 1}\n{"metric": "x", "v": [1]}\n')
+    errs = check_file(str(bad))
+    assert any(e.startswith("line 1:") for e in errs)
+    assert any(e.startswith("line 2:") and "not scalar" in e
+               for e in errs)
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert check_file(str(empty)) != []
+    assert check_file(str(tmp_path / "missing.json")) != []
+
+
+def test_metrics_check_tool_cli(tmp_path):
+    p = str(tmp_path / "m.json")
+    reg = registry_for(p)
+    reg.counter("c").inc()
+    reg.write()
+    res = subprocess.run([sys.executable, METRICS_CHECK, p],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert "OK" in res.stdout
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "wrong", "meta": {}, "counters": {}, '
+                   '"gauges": {}, "histograms": {}, "timers": {}}')
+    res = subprocess.run([sys.executable, METRICS_CHECK, p, str(bad)],
+                         capture_output=True, text=True)
+    assert res.returncode == 1
+    assert "schema" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# StageTimer.as_dict (the registry feed) and vlog env fallback
+# ---------------------------------------------------------------------------
+
+def test_stage_timer_as_dict_matches_report_facts():
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("a"):
+        pass
+    t.add_units("a", 2000)
+    d = t.as_dict(2000)
+    assert d["stages"]["a"]["calls"] == 2
+    assert d["stages"]["a"]["units"] == 2000
+    assert d["total_seconds"] >= d["stages"]["a"]["seconds"] >= 0
+    assert d["total_units"] == 2000
+    assert d["units_per_hour"] > 0
+    # attaches cleanly to the schema
+    reg = MetricsRegistry()
+    reg.set_timer("s", d)
+    assert validate_metrics(reg.as_dict()) == []
+
+
+def test_vlog_env_var_fallback(monkeypatch):
+    from quorum_tpu.utils import vlog as vlog_mod
+    old = vlog_mod.verbose
+    try:
+        monkeypatch.setenv("QUORUM_TPU_VERBOSE", "1")
+        importlib.reload(vlog_mod)
+        assert vlog_mod.verbose is True
+        monkeypatch.setenv("QUORUM_TPU_VERBOSE", "0")
+        importlib.reload(vlog_mod)
+        assert vlog_mod.verbose is False
+        monkeypatch.delenv("QUORUM_TPU_VERBOSE")
+        importlib.reload(vlog_mod)
+        assert vlog_mod.verbose is False
+    finally:
+        importlib.reload(vlog_mod)
+        vlog_mod.verbose = old

@@ -168,3 +168,35 @@ def test_routed_correct_tile():
                                      jnp.asarray(lengths), cfg)
     _batch_result_equal(res, single)
     assert int(np.sum(np.asarray(res.status) == corrector.OK)) > 0
+
+
+def test_build_metrics_counters():
+    """Telemetry wiring of the sharded build: batch/read/grow counters
+    and the final per-shard occupancy matching the table content."""
+    from quorum_tpu.telemetry import MetricsRegistry, validate_metrics
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable in this environment")
+    n_shards = 2
+    rng = np.random.default_rng(3)
+    codes, quals = _reads(rng, 32, genome_size=1500)
+    mesh = ts.make_mesh(n_shards, conftest.cpu_devices(n_shards))
+    meta = ts.TileShardedMeta(k=K, bits=7, rb_log2=4, n_shards=n_shards)
+    reg = MetricsRegistry()
+    state, meta = ts.build_database_tile_sharded(
+        [(jnp.asarray(codes), jnp.asarray(quals))], mesh, meta, 53,
+        metrics=reg)
+    doc = reg.as_dict()
+    assert validate_metrics(doc) == []
+    c, g = doc["counters"], doc["gauges"]
+    assert c["shard_batches"] == 1
+    assert c["shard_reads"] == 32
+    assert c["shard_grows"] >= 1  # rb_log2=4 is undersized on purpose
+    gstate, gmeta = ts.gather_table(state, meta)
+    n_distinct = len(_entry_map(gstate, gmeta))
+    assert c["distinct_mers"] == n_distinct
+    per = doc["meta"]["shard_distinct_mers"]
+    assert len(per) == n_shards and sum(per) == n_distinct
+    assert g["n_shards"] == n_shards
+    assert g["shard_distinct_min"] <= g["shard_distinct_max"]
+    assert per == ts.shard_occupancy(state, meta)

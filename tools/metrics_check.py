@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Validate quorum-tpu metrics artifacts against the telemetry schema
+(quorum_tpu/telemetry/schema.py, version quorum-tpu-metrics/1).
+
+Usage: python tools/metrics_check.py FILE [FILE ...]
+
+Accepts any of the three artifact kinds the pipeline produces and
+dispatches on content, not extension:
+
+  * final metrics JSON documents (`--metrics PATH` on the CLIs,
+    MetricsRegistry.write)
+  * JSONL event streams (`--metrics-interval` heartbeats, hash-grow
+    and stage-done events)
+  * bench-style metric-line files (one {"metric": ...} object per
+    line, as bench.py emits — so CI can gate BENCH_*.json output)
+
+Prints one line per problem and exits 1 if any file fails, 0 if all
+are valid. Used by tests/test_telemetry.py on a golden-pipeline dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from quorum_tpu.telemetry import check_file  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Validate metrics JSON / events JSONL / bench "
+                    "metric-line files against quorum-tpu-metrics/1")
+    p.add_argument("files", nargs="+", metavar="FILE")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Suppress per-file OK lines")
+    args = p.parse_args(argv)
+
+    bad = 0
+    for path in args.files:
+        problems = check_file(path)
+        if problems:
+            bad += 1
+            for msg in problems:
+                print(f"{path}: {msg}", file=sys.stderr)
+        elif not args.quiet:
+            print(f"{path}: OK")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
