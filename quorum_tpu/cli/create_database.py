@@ -10,6 +10,7 @@ import sys
 from ..models.create_database import BuildConfig, create_database_main
 from ..utils import vlog as vlog_mod
 from ..utils.sizes import parse_size
+from .observability import add_observability_args
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -46,6 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=0.0,
                    help="With --metrics: also write JSONL heartbeat "
                         "events at this period (0 = off)")
+    add_observability_args(p)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("reads", nargs="+", help="Read files")
     return p
@@ -87,21 +89,43 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         threads=args.threads,
         profile=args.profile,
     )
-    from ..telemetry import registry_for
-    reg = registry_for(args.metrics, args.metrics_interval)
+    from ..telemetry import registry_for, tracer_for
+    from ..telemetry import export as export_mod
+    from ..utils.vlog import vlog
+    reg = registry_for(args.metrics, args.metrics_interval,
+                       force=(args.metrics_port is not None
+                              or bool(args.metrics_textfile)
+                              or args.metrics_live))
+    tracer = tracer_for(args.trace_spans)
+    server = None
+    rc = 1  # flipped to 0 only on success: any exception leaves 1
     try:
+        # endpoint/textfile start INSIDE the umbrella: a busy port
+        # must still land the error document below
+        server = export_mod.start_exposition(
+            reg, args.metrics_port, args.metrics_textfile,
+            period=args.metrics_interval)
         create_database_main(args.reads, args.output, cfg,
                              cmdline=list(sys.argv),
                              ref_format=args.ref_format,
                              handoff=handoff, batches=batches,
-                             metrics=reg)
+                             metrics=reg, tracer=tracer)
+        rc = 0
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
-        return 1
-    if reg.enabled:
-        reg.set_meta(status="ok", output=args.output)
-        reg.write()
-    return 0
+    finally:
+        # a failed run (hash-full, or anything uncaught) must still
+        # land its metrics document with status=error — monitoring
+        # needs a run that FAILED, not one that stopped reporting
+        tracer.close()
+        if reg.enabled:
+            reg.set_meta(status="ok" if rc == 0 else "error")
+            if rc == 0:
+                reg.set_meta(output=args.output)
+            reg.write()
+        if server is not None:
+            server.close()
+    return rc
 
 
 if __name__ == "__main__":

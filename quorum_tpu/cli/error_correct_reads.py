@@ -11,6 +11,7 @@ import sys
 from ..models.ec_config import ECConfig  # noqa: F401 (re-export for users)
 from ..models.error_correct import ECOptions, run_error_correct
 from ..utils import vlog as vlog_mod
+from .observability import add_observability_args
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -72,6 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=0.0,
                    help="With --metrics: also write JSONL heartbeat "
                         "events at this period (0 = off)")
+    add_observability_args(p)
     p.add_argument("db", help="Mer database")
     p.add_argument("sequence", nargs="+", help="Input sequence")
     return p
@@ -117,6 +119,10 @@ def main(argv=None, db=None, prepacked=None) -> int:
         profile=args.profile,
         metrics=args.metrics,
         metrics_interval=args.metrics_interval,
+        metrics_port=args.metrics_port,
+        metrics_textfile=args.metrics_textfile,
+        metrics_force=args.metrics_live,
+        trace_spans=args.trace_spans,
     )
     try:
         run_error_correct(

@@ -1,0 +1,43 @@
+"""Shared live-observability CLI flags (ISSUE 2).
+
+All three main CLIs expose the same four flags; one helper keeps the
+surfaces (and their help text) from drifting apart. `--metrics` /
+`--metrics-interval` stay per-CLI — their help genuinely differs
+(the driver suffixes per-stage paths).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_observability_args(p: argparse.ArgumentParser,
+                           driver: bool = False) -> None:
+    """The live-exposition + span-tracing flag block. `driver=True`
+    switches to the quorum driver's wording (one endpoint for all
+    stages, per-stage span suffixes) and drops `--metrics-live`,
+    which only the driver itself forwards to its children."""
+    p.add_argument("--metrics-port", metavar="port", type=int,
+                   default=None,
+                   help="Serve live Prometheus /metrics (+ /healthz) "
+                        "on this port during the run; 0 = ephemeral"
+                        + (". One endpoint carries the driver and "
+                           "both stages under stage=... labels"
+                           if driver else ""))
+    p.add_argument("--metrics-textfile", metavar="path", default=None,
+                   help="Atomically refresh a Prometheus textfile "
+                        "here on each heartbeat"
+                        + (" (shared by the driver and both stages)"
+                           if driver else ""))
+    p.add_argument("--trace-spans", metavar="path", default=None,
+                   help="Write hierarchical span JSONL here (plus a "
+                        "Chrome trace_event twin, .trace.json)"
+                        + (", suffixed .stage1/.stage2 per stage"
+                           if driver else ""))
+    if not driver:
+        p.add_argument("--metrics-live", action="store_true",
+                       help="Force a live metrics registry even with "
+                            "no output path, so a parent process's "
+                            "exposition endpoint sees this stage "
+                            "(the quorum driver forwards this with "
+                            "--metrics-port)")

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Sequence
 
 import numpy as np
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 from ..io import fastq, db_format, packing
 from ..ops import ctable, mer
 from ..telemetry import NULL as NULL_METRICS
+from ..telemetry import NULL_TRACER
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -68,6 +70,7 @@ def build_database(
     cfg: BuildConfig,
     batches=None,
     metrics=None,
+    tracer=None,
 ):
     """Run the full stage-1 pipeline. Returns
     (TileState, TileMeta, stats) — the query-ready tile table.
@@ -79,13 +82,17 @@ def build_database(
 
     `metrics` (optional telemetry registry, --metrics on the CLI)
     records reads/bases/batches/distinct-mer counters, hash geometry
-    and fill gauges, grow events, and the stage timer table.
+    and fill gauges, grow events, per-batch dispatch/wait histograms,
+    and the stage timer table. `tracer` (optional span tracer,
+    --trace-spans) records per-batch hierarchical spans with the
+    device steps StepTraceAnnotation-tagged.
 
     Raises RuntimeError("Hash is full") only if growth itself fails
     (allocation), preserving the reference's failure contract
     (create_database.cc:87, README.md:46-47).
     """
     reg = metrics if metrics is not None else NULL_METRICS
+    tracer = tracer if tracer is not None else NULL_TRACER
     rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
     meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb)
     bstate = ctable.make_tile_build(meta)
@@ -119,22 +126,44 @@ def build_database(
         src = fastq.read_batches(paths, cfg.batch_size,
                                  threads=cfg.threads)
         batches = prefetch(_pack(src),
-                           metrics=reg if reg.enabled else None)
+                           metrics=reg if reg.enabled else None,
+                           tracer=tracer)
     timer = StageTimer()
     with trace(cfg.profile):
         for batch, pk in batches:
+            step_i = stats.batches
             stats.batches += 1
             stats.reads += batch.n
             nb = int(batch.lengths.sum())
             stats.bases += nb
-            timer.add_units("insert", nb)
+            timer.add_units("insert_wait", nb)
             reg.heartbeat(stage="create_database", reads=stats.reads,
                           bases=stats.bases, batches=stats.batches)
-            with timer.stage("insert"):
-                # ONE dispatch: extract + insert fused
-                bstate, full, (chi, clo, q, valid, placed) = \
-                    ctable.tile_insert_reads_packed(
-                        bstate, meta, pk, cfg.qual_thresh)
+            with tracer.span("stage1_batch", step=step_i,
+                             reads=batch.n):
+                # per-batch device-time attribution: dispatch (handing
+                # XLA the fused extract+insert program) split from the
+                # wait for the device result (`bool(full)` is the sync
+                # point — full comes out of the same executable as the
+                # table planes), under a StepTraceAnnotation so the
+                # split lines up with the XLA timeline under --profile
+                t0 = time.perf_counter()
+                with tracer.step("stage1_insert", step_i,
+                                 reads=batch.n):
+                    # ONE dispatch: extract + insert fused
+                    bstate, full, (chi, clo, q, valid, placed) = \
+                        ctable.tile_insert_reads_packed(
+                            bstate, meta, pk, cfg.qual_thresh)
+                    t1 = time.perf_counter()
+                    full = bool(full)
+                    t2 = time.perf_counter()
+                timer.add_time("insert_dispatch", t1 - t0)
+                timer.add_time("insert_wait", t2 - t1)
+                if reg.enabled:
+                    reg.histogram("insert_dispatch_us").observe(
+                        int((t1 - t0) * 1e6))
+                    reg.histogram("insert_wait_us").observe(
+                        int((t2 - t1) * 1e6))
                 if full:
                     pending = jnp.logical_and(valid,
                                               jnp.logical_not(placed))
@@ -144,20 +173,24 @@ def build_database(
                     vlog("Hash table full at ", meta.rows,
                          " buckets; doubling")
                     rows_before = meta.rows
-                    bstate, meta = ctable.tile_grow_build(bstate, meta)
-                    stats.grows += 1
-                    reg.counter("hash_grows").inc()
-                    reg.event("hash_grow", rows_before=rows_before,
-                              rows_after=meta.rows)
-                    bstate, full, placed = ctable.tile_insert_observations(
-                        bstate, meta, chi, clo, q, pending
-                    )
-                    pending = jnp.logical_and(pending,
-                                              jnp.logical_not(placed))
+                    with timer.stage("grow"), tracer.span(
+                            "hash_grow", rows_before=rows_before):
+                        bstate, meta = ctable.tile_grow_build(bstate,
+                                                              meta)
+                        stats.grows += 1
+                        reg.counter("hash_grows").inc()
+                        reg.event("hash_grow", rows_before=rows_before,
+                                  rows_after=meta.rows)
+                        bstate, full, placed = \
+                            ctable.tile_insert_observations(
+                                bstate, meta, chi, clo, q, pending)
+                        full = bool(full)
+                        pending = jnp.logical_and(
+                            pending, jnp.logical_not(placed))
                 else:
                     if full:
                         raise RuntimeError("Hash is full")
-    with timer.stage("seal"):
+    with timer.stage("seal"), tracer.span("seal"):
         # ONE dispatch: dup check + finalize + stats fused (separate
         # calls each walk the full build planes; measured seconds per
         # pass at production table sizes)
@@ -193,6 +226,7 @@ def create_database_main(
     handoff: dict | None = None,
     batches=None,
     metrics=None,
+    tracer=None,
 ) -> BuildStats:
     """With `handoff` (a dict), the built device-resident table is
     stashed as handoff["db"] = (state, meta) so an in-process stage-2
@@ -201,7 +235,7 @@ def create_database_main(
     the reference's equivalent, re-mmapping a page-cached file, is
     free; quorum.in:154-231 runs both stages over the same file)."""
     state, meta, stats = build_database(paths, cfg, batches=batches,
-                                        metrics=metrics)
+                                        metrics=metrics, tracer=tracer)
     if handoff is not None:
         handoff["db"] = (state, meta)
     if ref_format:

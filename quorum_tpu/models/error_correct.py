@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import gzip as gzip_mod
 import sys
+import time
 from typing import Sequence
 
 import jax
@@ -33,7 +34,8 @@ import jax
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
-from ..telemetry import registry_for
+from ..telemetry import registry_for, tracer_for
+from ..telemetry import export as export_mod
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -88,6 +90,11 @@ class ECOptions:
     profile: str | None = None  # --profile DIR: jax.profiler trace
     metrics: str | None = None  # --metrics PATH: final metrics JSON
     metrics_interval: float = 0.0  # heartbeat period (s); 0 = no JSONL
+    metrics_port: int | None = None  # --metrics-port: live /metrics
+    metrics_textfile: str | None = None  # --metrics-textfile PATH
+    metrics_force: bool = False  # --metrics-live: real registry for a
+    # parent-owned exposition endpoint (quorum driver --metrics-port)
+    trace_spans: str | None = None  # --trace-spans PATH: span JSONL
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -146,10 +153,56 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     # telemetry (--metrics): per-read outcome counters decoded from the
     # rendered results, pipeline queue gauges, stage timers. NULL (all
     # no-ops, reg.enabled False) when opts.metrics is unset, so the
-    # per-read hot path pays nothing.
-    reg = registry_for(opts.metrics, opts.metrics_interval)
+    # per-read hot path pays nothing. Live exposition
+    # (--metrics-port/--metrics-textfile) forces a real registry even
+    # without a final-JSON path; --trace-spans adds the hierarchical
+    # span tracer (JSONL + Chrome trace, TraceAnnotation mirror).
+    reg = registry_for(opts.metrics, opts.metrics_interval,
+                       force=(opts.metrics_port is not None
+                              or bool(opts.metrics_textfile)
+                              or opts.metrics_force))
     reg.set_meta(stage="error_correct", batch_size=opts.batch_size,
                  no_discard=bool(no_discard))
+    tracer = tracer_for(opts.trace_spans)
+    server = None
+    try:
+        # endpoint/textfile start INSIDE the umbrella: a busy port
+        # must still land the error document below
+        server = export_mod.start_exposition(
+            reg, opts.metrics_port, opts.metrics_textfile,
+            period=opts.metrics_interval)
+        return _run_ec(db_path, sequences, cfg_in, opts, reg, tracer,
+                       qual_cutoff=qual_cutoff, skip=skip, good=good,
+                       anchor_count=anchor_count, min_count=min_count,
+                       window=window, error=error, homo_trim=homo_trim,
+                       trim_contaminant=trim_contaminant,
+                       no_discard=no_discard, records=records, db=db,
+                       prepacked=prepacked)
+    except BaseException:
+        # a failed run must still land its metrics document (the
+        # success path writes status=ok at the end of _run_ec)
+        if reg.enabled:
+            reg.set_meta(status="error")
+            reg.write()
+        raise
+    finally:
+        # span + endpoint teardown on EVERY exit: the Chrome trace of
+        # an interrupted run is exactly when it's needed, and the
+        # port must free for the next stage/run
+        tracer.close()
+        if server is not None:
+            server.close()
+
+
+def _run_ec(db_path: str, sequences: Sequence[str],
+            cfg_in: ECConfig | None, opts: ECOptions, reg, tracer,
+            *, qual_cutoff: int, skip: int, good: int,
+            anchor_count: int, min_count: int,
+            window: int, error: int,
+            homo_trim: int | None,
+            trim_contaminant: bool,
+            no_discard: bool,
+            records, db, prepacked) -> ECStats:
     vlog("Loading mer database")
     if db is not None:
         # in-process handoff from stage 1: the table is already device
@@ -222,7 +275,8 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
             def _pack(it):
                 for b in it:
                     yield b, pack_for_stage2(b, cfg)
-            batches = prefetch(_pack(src), metrics=pipe_metrics)
+            batches = prefetch(_pack(src), metrics=pipe_metrics,
+                               tracer=tracer)
         # host finish+render pipeline: the D2H (fetch_finish) must stay
         # on the MAIN thread (the tunnel degrades under concurrent
         # device access, PERF_NOTES.md r4), but the numpy/str tail is
@@ -235,6 +289,10 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
         count_outcomes = reg.enabled
 
         def _render(batch, buf, b, l, maxe):
+            with tracer.span("render", reads=batch.n):
+                return _render_inner(batch, buf, b, l, maxe)
+
+        def _render_inner(batch, buf, b, l, maxe):
             results = finish_batch_host(buf, batch.n, cfg, batch.codes,
                                         b, l, maxe)
             fa_parts: list[str] = []
@@ -292,39 +350,60 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
 
         pool = _cf.ThreadPoolExecutor(1)
         pending: collections.deque = collections.deque()
+        step_i = 0
         try:
             with trace(opts.profile):
                 for batch, pk in batches:
-                    with timer.stage("device"):
-                        # the lean finish buffer packs inside the same
-                        # executable (one dispatch per batch instead
-                        # of two). The cap is a DETERMINISTIC function
-                        # of the batch shape — a data-dependent cap
-                        # would recompile the whole corrector
-                        # executable per distinct value (measured:
-                        # minutes, mid-run). 4 entries/read covers ~1%
-                        # error rates with 2x+ headroom; rarer batches
-                        # overflow and re-pack once in fetch_finish.
-                        cap = 4 * batch.codes.shape[0]
-                        res, packed = correct_batch_packed(
-                            state, meta, pk, cfg, contam=contam,
-                            pack_cap=cap)
-                        jax.block_until_ready(packed)
-                    with timer.stage("fetch"):
-                        buf = fetch_finish(res, packed)
-                    b, l = res.out.shape
-                    maxe = res.fwd_log.pos.shape[1]
-                    while len(pending) >= 2:
-                        _drain(pending.popleft())
-                    pending.append(pool.submit(_render, batch, buf,
-                                               b, l, maxe))
-                    stats.reads += batch.n
-                    nb = int(batch.lengths[:batch.n].sum())
-                    stats.bases_in += nb
-                    timer.add_units("device", nb)
-                    reg.heartbeat(stage="error_correct",
-                                  reads=stats.reads,
-                                  bases=stats.bases_in)
+                    with tracer.span("stage2_batch", step=step_i,
+                                     reads=batch.n):
+                        # per-batch device-time attribution: dispatch
+                        # (handing XLA the program; host-side queueing)
+                        # measured separately from block_until_ready wait
+                        # (device compute + transfer), under a
+                        # StepTraceAnnotation so the split is also visible
+                        # against the XLA timeline under --profile
+                        t0 = time.perf_counter()
+                        with tracer.step("stage2_device", step_i,
+                                         reads=batch.n):
+                            # the lean finish buffer packs inside the same
+                            # executable (one dispatch per batch instead
+                            # of two). The cap is a DETERMINISTIC function
+                            # of the batch shape — a data-dependent cap
+                            # would recompile the whole corrector
+                            # executable per distinct value (measured:
+                            # minutes, mid-run). 4 entries/read covers ~1%
+                            # error rates with 2x+ headroom; rarer batches
+                            # overflow and re-pack once in fetch_finish.
+                            cap = 4 * batch.codes.shape[0]
+                            res, packed = correct_batch_packed(
+                                state, meta, pk, cfg, contam=contam,
+                                pack_cap=cap)
+                            t1 = time.perf_counter()
+                            jax.block_until_ready(packed)
+                            t2 = time.perf_counter()
+                        timer.add_time("device_dispatch", t1 - t0)
+                        timer.add_time("device_wait", t2 - t1)
+                        if count_outcomes:
+                            reg.histogram("device_dispatch_us").observe(
+                                int((t1 - t0) * 1e6))
+                            reg.histogram("device_wait_us").observe(
+                                int((t2 - t1) * 1e6))
+                        with timer.stage("fetch"), tracer.span("fetch"):
+                            buf = fetch_finish(res, packed)
+                        b, l = res.out.shape
+                        maxe = res.fwd_log.pos.shape[1]
+                        while len(pending) >= 2:
+                            _drain(pending.popleft())
+                        pending.append(pool.submit(_render, batch, buf,
+                                                   b, l, maxe))
+                        stats.reads += batch.n
+                        nb = int(batch.lengths[:batch.n].sum())
+                        stats.bases_in += nb
+                        timer.add_units("device_wait", nb)
+                        reg.heartbeat(stage="error_correct",
+                                      reads=stats.reads,
+                                      bases=stats.bases_in)
+                        step_i += 1
                 while pending:
                     _drain(pending.popleft())
         finally:

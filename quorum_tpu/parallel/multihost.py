@@ -26,12 +26,16 @@ communicating.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Iterator, Sequence
 
 import jax
+import numpy as np
 
 from ..io import fastq
+from ..telemetry.registry import atomic_write
+from ..telemetry.schema import SCHEMA_VERSION
 
 
 def host_shard_paths(paths: Sequence[str],
@@ -97,3 +101,101 @@ def read_batches_multihost(paths: Sequence[str], batch_size: int = 8192,
             metrics.counter("host_batches").inc()
             metrics.counter("host_reads").inc(batch.n)
         yield batch
+
+
+# ---------------------------------------------------------------------------
+# Multi-host metrics aggregation (ISSUE 2 tentpole)
+# ---------------------------------------------------------------------------
+# PR 1 left every host writing its own metrics document; the KMC-3
+# queryable-stats model (PAPERS.md, arxiv 1701.08022) is ONE artifact
+# per job. The reduce below allgathers every host's document (JSON
+# over a padded uint8 plane — the only collective the payload needs)
+# and merges: counters sum, histograms merge exactly, timer stages
+# sum with the job's total_seconds = slowest host, gauges keep the
+# per-host max (queue depths and fill levels are high-water marks;
+# the per-host values stay exact under `hosts`). Process 0 writes the
+# merged document; every host RETURNS it (the collective is
+# symmetric), so callers needing the totals don't re-read the file.
+
+def merge_host_docs(docs: Sequence[dict]) -> dict:
+    """Pure merge of per-host metrics documents (MetricsRegistry.
+    as_dict shapes) into one aggregated document with the per-host
+    shards preserved under `hosts`. Top-level counters are exact sums
+    of the shards — the acceptance invariant pinned by
+    tests/test_multihost.py."""
+    docs = list(docs)
+    merged: dict = {
+        "schema": SCHEMA_VERSION,
+        "meta": dict(docs[0].get("meta", {})) if docs else {},
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "timers": {},
+        "hosts": {str(i): d for i, d in enumerate(docs)},
+    }
+    merged["meta"]["aggregated_hosts"] = len(docs)
+    # host-specific meta makes no sense merged; the shards keep it
+    for k in ("host_process_index", "host_input_paths"):
+        merged["meta"].pop(k, None)
+    for d in docs:
+        for k, v in d.get("counters", {}).items():
+            merged["counters"][k] = merged["counters"].get(k, 0) + v
+        for k, v in d.get("gauges", {}).items():
+            cur = merged["gauges"].get(k)
+            merged["gauges"][k] = v if cur is None else max(cur, v)
+        for k, h in d.get("histograms", {}).items():
+            m = merged["histograms"].setdefault(
+                k, {"count": 0, "sum": 0, "counts": {}})
+            m["count"] += h.get("count", 0)
+            m["sum"] += h.get("sum", 0)
+            for b, n in h.get("counts", {}).items():
+                m["counts"][b] = m["counts"].get(b, 0) + n
+        for k, t in d.get("timers", {}).items():
+            m = merged["timers"].setdefault(
+                k, {"total_seconds": 0.0, "stages": {}})
+            m["total_seconds"] = max(m["total_seconds"],
+                                     t.get("total_seconds", 0.0))
+            for sk, sv in t.get("stages", {}).items():
+                ms = m["stages"].setdefault(
+                    sk, {"seconds": 0.0, "calls": 0, "units": 0})
+                ms["seconds"] = round(
+                    ms["seconds"] + sv.get("seconds", 0.0), 6)
+                ms["calls"] += sv.get("calls", 0)
+                ms["units"] += sv.get("units", 0)
+    return merged
+
+
+def _allgather_bytes(payload: bytes) -> list[bytes]:
+    """Every host's payload, in process-index order, via two
+    process_allgathers (lengths, then a max-length-padded uint8
+    plane). Single-process: the identity."""
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    n = np.asarray([len(payload)], np.int32)
+    lens = np.asarray(
+        multihost_utils.process_allgather(n)).reshape(-1)
+    cap = int(lens.max())
+    buf = np.zeros((cap,), np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    planes = np.asarray(
+        multihost_utils.process_allgather(buf)).reshape(len(lens), cap)
+    return [planes[i, : lens[i]].tobytes() for i in range(len(lens))]
+
+
+def aggregate_metrics(reg, path: str | None = None,
+                      process_index: int | None = None) -> dict:
+    """Collective reduce of every host's registry into ONE aggregated
+    metrics document (allgather + merge_host_docs). All hosts must
+    call this (it is a collective); all hosts get the merged document
+    back, and exactly process 0 writes it to `path` (atomic replace)
+    — one artifact per multi-host job, per-host shards under
+    `hosts`."""
+    pi = jax.process_index() if process_index is None else process_index
+    docs = [json.loads(b.decode()) for b in
+            _allgather_bytes(json.dumps(reg.as_dict()).encode())]
+    merged = merge_host_docs(docs)
+    if path and pi == 0:
+        atomic_write(path, json.dumps(merged, indent=1) + "\n")
+    return merged

@@ -422,16 +422,26 @@ def shard_occupancy(state: ctable.TileState,
 
 def build_database_tile_sharded(batches, mesh: Mesh,
                                 meta: TileShardedMeta, qual_thresh: int,
-                                max_grows: int = 8, metrics=None):
+                                max_grows: int = 8, metrics=None,
+                                tracer=None):
     """Driver: insert every (codes, quals) batch with the exact-once
     grow-retry contract. Returns (TileState sharded by rows, meta).
 
     `metrics` (optional telemetry registry) records per-shard build
     counters: batches/reads routed, grow and overflow-retry events,
-    and the final per-shard distinct-mer occupancy."""
+    per-step dispatch/wait histograms, and the final per-shard
+    distinct-mer occupancy. `tracer` (optional span tracer) records a
+    StepTraceAnnotation-tagged span per collective step so sharded
+    device time is attributable under --profile."""
+    import time
+
+    from ..telemetry.spans import NULL_TRACER
+
     reg = metrics if metrics is not None else NULL_METRICS
+    tracer = tracer if tracer is not None else NULL_TRACER
     bstate = make_build_state(meta, mesh)
     step = build_step(mesh, meta, qual_thresh)
+    step_i = 0
     for codes, quals in batches:
         reg.counter("shard_batches").inc()
         reg.counter("shard_reads").inc(codes.shape[0])
@@ -447,12 +457,27 @@ def build_database_tile_sharded(batches, mesh: Mesh,
         level_budget = 2 * meta.n_shards + 8
         passes = 0
         while True:
-            bstate, full, over, placed = step(bstate, codes, quals,
-                                              pending)
-            if not (bool(full) or bool(over)):
+            # per-step device-time attribution: dispatch (tracing +
+            # enqueue of the shard_mapped step) split from the wait
+            # for the collective result (`bool(full)` syncs — full is
+            # an output of the same executable as the table planes)
+            t0 = time.perf_counter()
+            with tracer.step("shard_build_step", step_i):
+                bstate, full, over, placed = step(bstate, codes, quals,
+                                                  pending)
+                t1 = time.perf_counter()
+                full_b, over_b = bool(full), bool(over)
+                t2 = time.perf_counter()
+            step_i += 1
+            if reg.enabled:
+                reg.histogram("shard_step_dispatch_us").observe(
+                    int((t1 - t0) * 1e6))
+                reg.histogram("shard_step_wait_us").observe(
+                    int((t2 - t1) * 1e6))
+            if not (full_b or over_b):
                 break
             pending = jnp.logical_and(pending, jnp.logical_not(placed))
-            if bool(full):
+            if full_b:
                 # genuine table pressure -> grow (exact-once retry)
                 if grows >= max_grows:
                     raise RuntimeError("Hash is full")

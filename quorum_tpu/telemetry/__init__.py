@@ -1,21 +1,28 @@
-"""Structured telemetry: metrics registry + JSONL run events.
+"""Structured telemetry: metrics registry, JSONL run events, span
+tracing, and live Prometheus exposition.
 
 `registry_for(path, heartbeat_s)` is the entry point the CLIs use for
 their `--metrics PATH` option; it returns the no-op NULL singleton
 when no path is given, so instrumentation is zero-cost when disabled.
-See registry.py for the model and schema.py for the document format.
+`tracer_for(path)` is the same contract for `--trace-spans`
+(spans.py); export.py drives `--metrics-port`/`--metrics-textfile`.
+See registry.py for the model and schema.py for the document formats.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        NULL, NullRegistry, registry_for,
                        track_jax_compile_cache)
 from .schema import (SCHEMA_VERSION, check_file, metric_line,
-                     validate_bench_line, validate_events_line,
-                     validate_metrics)
+                     validate_bench_line, validate_chrome_trace,
+                     validate_events_line, validate_metrics,
+                     validate_span_line)
+from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
     "NullRegistry", "registry_for", "track_jax_compile_cache",
     "SCHEMA_VERSION", "check_file", "metric_line",
-    "validate_bench_line", "validate_events_line", "validate_metrics",
+    "validate_bench_line", "validate_chrome_trace",
+    "validate_events_line", "validate_metrics", "validate_span_line",
+    "NULL_TRACER", "NullTracer", "SpanTracer", "tracer_for",
 ]

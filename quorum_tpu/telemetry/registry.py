@@ -31,6 +31,17 @@ import weakref
 from .schema import SCHEMA_VERSION
 
 
+def atomic_write(path: str, text: str) -> None:
+    """The one atomic-replace idiom every telemetry artifact uses
+    (final JSON, Chrome trace, Prometheus textfile, multi-host
+    aggregate): write a sibling tmp, then os.replace — a reader at
+    `path` can never observe a torn file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
 def _scalar(v):
     """Coerce a value to a JSON-safe scalar (numpy ints/floats pass
     through their __int__/__float__)."""
@@ -113,7 +124,10 @@ class MetricsRegistry:
     """One per instrumented run. `path` receives the final JSON via
     `write()`; `heartbeat_s > 0` additionally opens `events_path`
     (default: <path minus .json>.events.jsonl) and rate-limits
-    `heartbeat()` to that period."""
+    `heartbeat()` to that period. An EXPLICIT `events_path` is honored
+    even when `path` is None (a heartbeat-only run writes no final
+    JSON but still streams events); with `heartbeat_s <= 0` an
+    explicit events path heartbeats unlimited (every call emits)."""
 
     enabled = True
 
@@ -135,6 +149,7 @@ class MetricsRegistry:
         self._events_f = None
         self._t0 = time.perf_counter()
         self._last_beat = -1e18
+        self._exporters: list = []
 
     # -- metric accessors (get-or-create) --------------------------------
     def counter(self, name: str) -> Counter:
@@ -184,13 +199,33 @@ class MetricsRegistry:
             self._events_f.write(line)
             self._events_f.flush()
 
+    def add_exporter(self, fn) -> None:
+        """Register a live exporter: `fn(reg, final=False)` is called
+        on every `heartbeat()` (exporters self-rate-limit) and once
+        with `final=True` from `write()` (the Prometheus textfile
+        writer attaches here, telemetry/export.py)."""
+        with self._lock:
+            self._exporters.append(fn)
+
+    def _notify_exporters(self, final: bool = False) -> None:
+        for fn in list(self._exporters):
+            try:
+                fn(self, final=final)
+            except Exception:  # noqa: BLE001 - exposition never kills runs
+                pass
+
     def heartbeat(self, **fields) -> None:
         """Rate-limited progress event. A `bases` field gets derived
-        `gb_per_h` (so-far, since registry creation) for free."""
-        if not self.events_path or self.heartbeat_s <= 0:
+        `gb_per_h` (so-far, since registry creation) for free. Every
+        record carries a monotonic `elapsed_s`. Live exporters are
+        notified on EVERY call (they rate-limit themselves), so the
+        textfile/endpoint stay fresh even when JSONL events are
+        off."""
+        self._notify_exporters()
+        if not self.events_path:
             return
         now = time.perf_counter()
-        if now - self._last_beat < self.heartbeat_s:
+        if self.heartbeat_s > 0 and now - self._last_beat < self.heartbeat_s:
             return
         self._last_beat = now
         el = self.elapsed()
@@ -220,22 +255,21 @@ class MetricsRegistry:
             }
 
     def write(self, path: str | None = None) -> str | None:
-        """Write the final metrics JSON (atomic replace) and close the
-        event sink. Returns the path written."""
+        """Write the final metrics JSON (atomic replace), give live
+        exporters their final refresh, and close the event sink.
+        Returns the path written (None for an exposition-only
+        registry, which still flushes exporters and events)."""
+        self._notify_exporters(final=True)
         path = path or self.path
-        if not path:
-            return None
-        doc = self.as_dict()
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        doc = None
+        if path:
+            doc = self.as_dict()
+            atomic_write(path, json.dumps(doc, indent=1) + "\n")
         with self._lock:
             if self._events_f is not None:
                 self._events_f.close()
                 self._events_f = None
-        return path
+        return path if doc is not None else None
 
 
 class NullRegistry:
@@ -259,6 +293,9 @@ class NullRegistry:
         pass
 
     def set_timer(self, name, timer_dict):
+        pass
+
+    def add_exporter(self, fn):
         pass
 
     def event(self, kind, **fields):
@@ -305,12 +342,24 @@ NULL = NullRegistry()
 
 
 def registry_for(path: str | None,
-                 heartbeat_s: float = 0.0) -> MetricsRegistry | NullRegistry:
+                 heartbeat_s: float = 0.0,
+                 events_path: str | None = None,
+                 force: bool = False) -> MetricsRegistry | NullRegistry:
     """The one constructor call sites use: a real registry when a
-    `--metrics PATH` was given, the no-op NULL singleton when not."""
-    if not path:
+    `--metrics PATH` (or an explicit `events_path`) was given, the
+    no-op NULL singleton when not. `force=True` returns a real
+    registry even with no output path — the live-exposition case
+    (`--metrics-port`/`--metrics-textfile` without `--metrics`), where
+    counters must accumulate for scraping but no final JSON lands.
+    Enabled registries self-register with the live exposition layer
+    (telemetry/export.py) so `/metrics` sees every stage in-process."""
+    if not path and not events_path and not force:
         return NULL
-    return MetricsRegistry(path, heartbeat_s=heartbeat_s)
+    reg = MetricsRegistry(path, heartbeat_s=heartbeat_s,
+                          events_path=events_path)
+    from .export import register_live
+    register_live(reg)
+    return reg
 
 
 # jax.monitoring offers register but no unregister, so exactly ONE

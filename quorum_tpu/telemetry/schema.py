@@ -21,7 +21,18 @@ Final metrics JSON (MetricsRegistry.as_dict):
 Events JSONL (one JSON object per line): `event` (str) and `t`
 (seconds since registry creation, number) are required; all other
 values must be scalars. `heartbeat` events carry progress fields
-(reads/bases so far, derived `gb_per_h`).
+(reads/bases so far, a monotonic `elapsed_s`, derived `gb_per_h`).
+
+A multi-host aggregated document (parallel/multihost.
+aggregate_metrics) additionally carries a `hosts` section: one
+complete per-host metrics document per process index, with the
+top-level counters equal to the per-host sums.
+
+Span JSONL (telemetry/spans.py, one object per line): `span` (str),
+`id` (int), `ts`/`dur` (seconds, numbers) required; `parent` is an
+int or null, `tid` an int; all other values scalars. The Chrome-trace
+twin (`{"traceEvents": [...]}`, "X" complete events) is validated by
+`validate_chrome_trace`.
 
 No dependency on jsonschema: the checks are hand-rolled and return a
 list of human-readable problem strings (empty = valid).
@@ -44,9 +55,10 @@ def _is_number(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
-def validate_metrics(doc) -> list[str]:
-    """Validate a final metrics document. Returns problems (empty =
-    valid)."""
+def validate_metrics(doc, _nested: bool = False) -> list[str]:
+    """Validate a final metrics document (optionally carrying a
+    multi-host `hosts` section of per-host shard documents). Returns
+    problems (empty = valid)."""
     errs: list[str] = []
     if not isinstance(doc, dict):
         return ["document is not a JSON object"]
@@ -56,12 +68,22 @@ def validate_metrics(doc) -> list[str]:
     for key in ("meta", "counters", "gauges", "histograms", "timers"):
         if not isinstance(doc.get(key), dict):
             errs.append(f"missing or non-object section {key!r}")
-    unknown = set(doc) - {"schema", "meta", "counters", "gauges",
-                          "histograms", "timers"}
+    allowed = {"schema", "meta", "counters", "gauges",
+               "histograms", "timers"}
+    if not _nested:
+        allowed.add("hosts")
+    unknown = set(doc) - allowed
     if unknown:
         errs.append(f"unknown top-level keys {sorted(unknown)}")
     if errs:
         return errs
+    if not _nested and "hosts" in doc:
+        if not isinstance(doc["hosts"], dict):
+            errs.append("hosts is not an object")
+        else:
+            for hk, hdoc in doc["hosts"].items():
+                errs.extend(f"hosts[{hk!r}]: {e}" for e in
+                            validate_metrics(hdoc, _nested=True))
 
     for k, v in doc["meta"].items():
         ok = (_is_scalar(v)
@@ -125,6 +147,57 @@ def validate_events_line(obj) -> list[str]:
     return errs
 
 
+def validate_span_line(obj) -> list[str]:
+    """Validate one parsed span-JSONL object (telemetry/spans.py)."""
+    errs: list[str] = []
+    if not isinstance(obj, dict):
+        return ["span line is not a JSON object"]
+    if not isinstance(obj.get("span"), str) or not obj.get("span"):
+        errs.append("missing/empty 'span' field")
+    if not isinstance(obj.get("id"), int) or isinstance(obj.get("id"), bool):
+        errs.append("missing/non-int 'id' field")
+    if not (obj.get("parent") is None or isinstance(obj.get("parent"), int)):
+        errs.append("'parent' must be an int or null")
+    if not isinstance(obj.get("tid"), int):
+        errs.append("missing/non-int 'tid' field")
+    for k in ("ts", "dur"):
+        if not _is_number(obj.get(k)):
+            errs.append(f"missing/non-numeric {k!r} field")
+        elif obj[k] < 0:
+            errs.append(f"{k!r} is negative")
+    for k, v in obj.items():
+        if not _is_scalar(v):
+            errs.append(f"span field {k!r} is not scalar")
+    return errs
+
+
+def validate_chrome_trace(doc) -> list[str]:
+    """Validate a Chrome trace_event document (the loadable-in-
+    Perfetto twin of the span JSONL): {"traceEvents": [...]} of "X"
+    complete events with numeric µs ts/dur and pid/tid."""
+    errs: list[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        return ["not a Chrome trace object (no traceEvents list)"]
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict):
+            errs.append(f"traceEvents[{i}] is not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errs.append(f"traceEvents[{i}]: missing name")
+        if ev.get("ph") not in ("X", "B", "E", "i", "M"):
+            errs.append(f"traceEvents[{i}]: unsupported ph "
+                        f"{ev.get('ph')!r}")
+        if not _is_number(ev.get("ts")):
+            errs.append(f"traceEvents[{i}]: missing/non-numeric ts")
+        if ev.get("ph") == "X" and not _is_number(ev.get("dur")):
+            errs.append(f"traceEvents[{i}]: X event without dur")
+        for k in ("pid", "tid"):
+            if not isinstance(ev.get(k), int):
+                errs.append(f"traceEvents[{i}]: missing/non-int {k}")
+    return errs
+
+
 def validate_bench_line(obj) -> list[str]:
     """Validate one parsed bench-style metric line (the `metric_line`
     output format: `metric` (str) plus scalar fields)."""
@@ -141,9 +214,10 @@ def validate_bench_line(obj) -> list[str]:
 
 def check_file(path: str) -> list[str]:
     """Validate any metrics artifact by path, dispatching on content:
-    a whole-document metrics JSON (MetricsRegistry.write), an events
-    .jsonl stream, or a bench-style metric-line file (one
-    `{"metric": ...}` object per line, as bench.py emits)."""
+    a whole-document metrics JSON (MetricsRegistry.write), a Chrome
+    trace (SpanTracer.write_chrome_trace), an events or span .jsonl
+    stream, or a bench-style metric-line file (one `{"metric": ...}`
+    object per line, as bench.py emits)."""
     errs: list[str] = []
     try:
         with open(path) as f:
@@ -158,8 +232,10 @@ def check_file(path: str) -> list[str]:
             and ("schema" in doc or "counters" in doc)
             and "metric" not in doc and "event" not in doc):
         return validate_metrics(doc)
-    # line-oriented: events JSONL and/or bench metric lines (a bench
-    # run interleaves both kinds through one stdout)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return validate_chrome_trace(doc)
+    # line-oriented: events JSONL, span JSONL, and/or bench metric
+    # lines (a bench run interleaves kinds through one stdout)
     any_line = False
     for i, line in enumerate(text.splitlines(), 1):
         line = line.strip()
@@ -173,6 +249,8 @@ def check_file(path: str) -> list[str]:
             continue
         if isinstance(obj, dict) and "metric" in obj:
             check = validate_bench_line
+        elif isinstance(obj, dict) and "span" in obj:
+            check = validate_span_line
         else:
             check = validate_events_line
         errs.extend(f"line {i}: {e}" for e in check(obj))

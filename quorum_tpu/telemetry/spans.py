@@ -1,0 +1,253 @@
+"""Hierarchical span tracing: the live/deep half of the telemetry
+subsystem (ISSUE 2 tentpole).
+
+`with tracer.span("correct_batch", reads=n):` records start, duration,
+parent (per-thread stack) and scalar attributes for one region of host
+work. Each span is mirrored into `jax.profiler.TraceAnnotation` (and
+`tracer.step(...)` into `StepTraceAnnotation`) so that under
+`--profile` the host spans line up with the XLA device timeline in
+TensorBoard/Perfetto — the host-side counterpart of the GPU-counter
+per-phase breakdowns Gerbil reports (PAPERS.md, arxiv 1607.06618).
+
+Two artifacts per run, from one `--trace-spans PATH` flag:
+
+* `PATH` — span JSONL, one object per line, streamed as spans close
+  (schema: `validate_span_line` in schema.py); survives crashes.
+* chrome trace (`PATH` with `.jsonl` swapped for `.trace.json`) — the
+  same spans in Chrome `trace_event` format (`{"traceEvents": [...]}`,
+  "X" complete events, microsecond timestamps), written at `close()`;
+  loads directly in Perfetto / `chrome://tracing`.
+
+Zero-cost when disabled: `tracer_for(None)` returns the NULL singleton
+whose `span`/`step` are re-entrant no-op context managers and whose
+`enabled` flag lets hot paths skip attribute derivation.
+
+Thread model: the parent stack is thread-local (the prefetch, render
+and writer threads each get their own lineage); the JSONL sink and the
+retained-span list share one lock. Costs are per-span (per-batch at
+the call sites), never per-base.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+
+from .registry import _scalar, atomic_write
+
+
+def chrome_trace_path(path: str) -> str:
+    """The Chrome trace twin of a span-JSONL path: `.jsonl` (or
+    `.json`) swapped for `.trace.json`, else appended."""
+    for ext in (".jsonl", ".json"):
+        if path.endswith(ext):
+            return path[: -len(ext)] + ".trace.json"
+    return path + ".trace.json"
+
+
+@contextlib.contextmanager
+def _annotation(kind: str, name: str, step=None):
+    """Best-effort jax.profiler annotation context: TraceAnnotation for
+    plain spans, StepTraceAnnotation for device steps. A no-op when jax
+    (or the annotation API) is unavailable — the tracer's own record
+    never depends on it."""
+    ctx = None
+    try:
+        from jax import profiler as _prof
+        if kind == "step":
+            ctx = _prof.StepTraceAnnotation(name, step_num=step)
+        else:
+            ctx = _prof.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - jax absent / API drift
+        ctx = None
+    if ctx is None:
+        yield
+        return
+    with ctx:
+        yield
+
+
+class SpanTracer:
+    """One per instrumented run (`--trace-spans PATH`)."""
+
+    enabled = True
+
+    # retained-span cap for the Chrome export: the JSONL stream is
+    # unbounded (it goes to disk as spans close); the in-memory list
+    # backing close()'s trace_event dump is not. Past the cap the
+    # Chrome trace is truncated (and says so in its metadata) while
+    # the JSONL keeps every span.
+    MAX_RETAINED = 100_000
+
+    def __init__(self, path: str | None, chrome_path: str | None = None):
+        self.path = path
+        self.chrome_path = chrome_path or (
+            chrome_trace_path(path) if path else None)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._f = None
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._spans: list[dict] = []
+        self._dropped = 0
+        self._tids: dict[int, int] = {}
+        self._closed = False
+
+    # -- internals --------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _tid(self) -> int:
+        """Small stable per-thread id (Chrome tid / JSONL `tid`)."""
+        ident = threading.get_ident()
+        with self._lock:
+            t = self._tids.get(ident)
+            if t is None:
+                t = self._tids[ident] = len(self._tids)
+            return t
+
+    def _record(self, name: str, sid: int, parent: int | None,
+                ts: float, dur: float, attrs: dict) -> None:
+        obj = {"span": name, "id": sid, "parent": parent,
+               "tid": self._tid(),
+               "ts": round(ts, 6), "dur": round(dur, 6)}
+        for k, v in attrs.items():
+            obj[k] = _scalar(v)
+        line = json.dumps(obj) + "\n"
+        with self._lock:
+            if self._closed:
+                # a straggler (producer/render thread) outliving
+                # close(): reopening the JSONL in "w" here would
+                # truncate every streamed span — drop it instead
+                self._dropped += 1
+                return
+            if len(self._spans) < self.MAX_RETAINED:
+                self._spans.append(obj)
+            else:
+                self._dropped += 1
+            if self.path:
+                if self._f is None:
+                    self._f = open(self.path, "w")
+                self._f.write(line)
+                self._f.flush()
+
+    @contextlib.contextmanager
+    def _span(self, kind: str, name: str, step, attrs: dict):
+        stack = self._stack()
+        sid = next(self._ids)
+        parent = stack[-1] if stack else None
+        stack.append(sid)
+        if step is not None:
+            attrs = dict(attrs, step=step)
+        t0 = time.perf_counter()
+        try:
+            with _annotation(kind, name, step):
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            self._record(name, sid, parent, t0 - self._t0, dur, attrs)
+
+    # -- public surface ---------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Record a host region; nests via the per-thread stack and
+        mirrors into jax.profiler.TraceAnnotation."""
+        return self._span("span", name, None, attrs)
+
+    def step(self, name: str, step: int, **attrs):
+        """Record a device-dispatch region tagged with a step number;
+        mirrors into jax.profiler.StepTraceAnnotation so per-batch
+        device time is attributable in the XLA trace."""
+        return self._span("step", name, int(step), attrs)
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def as_chrome_trace(self) -> dict:
+        """The retained spans as a Chrome trace_event document
+        (Perfetto / chrome://tracing 'X' complete events, µs units)."""
+        pid = os.getpid()
+        with self._lock:
+            events = [
+                {"name": s["span"], "ph": "X", "pid": pid,
+                 "tid": s["tid"],
+                 "ts": round(s["ts"] * 1e6, 3),
+                 "dur": round(s["dur"] * 1e6, 3),
+                 "args": {k: v for k, v in s.items()
+                          if k not in ("span", "ts", "dur", "tid")}}
+                for s in self._spans
+            ]
+            dropped = self._dropped
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            doc["metadata"] = {"dropped_spans": dropped}
+        return doc
+
+    def write_chrome_trace(self, path: str | None = None) -> str | None:
+        """Write the Chrome trace JSON (atomic replace). Returns the
+        path written."""
+        path = path or self.chrome_path
+        if not path:
+            return None
+        atomic_write(path, json.dumps(self.as_chrome_trace()) + "\n")
+        return path
+
+    def close(self) -> None:
+        """Flush + close the JSONL sink and write the Chrome trace.
+        Idempotent (the CLIs call it from finally blocks)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+        self.write_chrome_trace()
+
+
+class NullTracer:
+    """The disabled tracer: every surface is a no-op."""
+
+    enabled = False
+    path = None
+    chrome_path = None
+
+    @contextlib.contextmanager
+    def _noop(self):
+        yield
+
+    def span(self, name, **attrs):
+        return self._noop()
+
+    def step(self, name, step, **attrs):
+        return self._noop()
+
+    def elapsed(self):
+        return 0.0
+
+    def as_chrome_trace(self):
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path=None):
+        return None
+
+    def close(self):
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+def tracer_for(path: str | None) -> SpanTracer | NullTracer:
+    """The one constructor call sites use: a real tracer when a
+    `--trace-spans PATH` was given, the no-op singleton when not."""
+    if not path:
+        return NULL_TRACER
+    return SpanTracer(path)

@@ -86,6 +86,14 @@ class StageTimer:
     def add_units(self, name: str, n: int) -> None:
         self.units[name] = self.units.get(name, 0) + n
 
+    def add_time(self, name: str, dt: float, calls: int = 1) -> None:
+        """Accumulate an externally-measured duration (the per-batch
+        dispatch/wait split measures both halves with one clock pair
+        and attributes them here, rather than nesting two `stage`
+        contexts and paying two extra clock reads)."""
+        self.seconds[name] = self.seconds.get(name, 0.0) + dt
+        self.calls[name] = self.calls.get(name, 0) + calls
+
     def as_dict(self, total_units: int = 0, unit: str = "bases") -> dict:
         """The machine-readable stage table (telemetry `timers`
         section; schema in telemetry/schema.py) — the same facts
@@ -107,19 +115,25 @@ class StageTimer:
         return d
 
     def report(self, total_units: int = 0, unit: str = "bases") -> None:
-        """Print the stage table through vlog (visible with -v)."""
+        """Print the stage table through vlog (visible with -v). A
+        zero total (a no-work run) prints explicit 0.0% rows rather
+        than dividing by a tiny sentinel."""
         d = self.as_dict(total_units, unit)
-        total = d["total_seconds"] or 1e-12
+        total = d["total_seconds"]
+
+        def pct(s: float) -> float:
+            return 100.0 * s / total if total > 0 else 0.0
+
         for name, st in d["stages"].items():
             s = st["seconds"]
             line = (f"stage {name:<12} {s:8.3f}s "
-                    f"({100.0 * s / total:5.1f}%) x{st['calls']}")
+                    f"({pct(s):5.1f}%) x{st['calls']}")
             if st["units"] and s > 0:
                 line += f"  {st['units'] / s / 1e6:.2f} M{unit}/s"
             vlog(line)
         accounted = sum(st["seconds"] for st in d["stages"].values())
         vlog(f"stage {'(other)':<12} {total - accounted:8.3f}s "
-             f"({100.0 * (total - accounted) / total:5.1f}%)")
+             f"({pct(total - accounted):5.1f}%)")
         if total_units and total > 0:
             vlog(f"total {total:.3f}s, "
                  f"{total_units / total * 3600 / 1e9:.3f} G{unit}/hour "

@@ -119,3 +119,74 @@ def test_golden_metrics_end_to_end(tmp_path):
     assert h["count"] == corrected
     assert h["sum"] == c["substitutions"]
     assert "stage2" in doc2["timers"]
+
+
+def test_golden_observability_gate(tmp_path):
+    """CI gate (ISSUE 2 satellite): the golden pipeline run with
+    --metrics + --metrics-textfile + --trace-spans must produce
+    artifacts that metrics_check passes — the JSON/JSONL/trace kinds
+    in default mode and the Prometheus textfile under --prom — while
+    the corrected outputs stay byte-identical."""
+    import json
+    import subprocess
+    import sys
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    db = str(tmp_path / "db.jf")
+    m1 = str(tmp_path / "stage1.json")
+    tf = str(tmp_path / "live.prom")
+    sp1 = str(tmp_path / "spans1.jsonl")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, "--metrics", m1,
+                       "--metrics-interval", "0.001",
+                       "--metrics-textfile", tf,
+                       "--trace-spans", sp1, reads])
+    assert rc == 0
+    out = str(tmp_path / "corr")
+    m2 = str(tmp_path / "stage2.json")
+    sp2 = str(tmp_path / "spans2.jsonl")
+    rc = ec_cli.main(["-p", "4", db, reads, "-o", out,
+                      "--metrics", m2, "--metrics-textfile", tf,
+                      "--trace-spans", sp2])
+    assert rc == 0
+
+    # byte parity unchanged with the full observability surface on
+    assert filecmp.cmp(out + ".fa", os.path.join(GOLDEN, "expected.fa"),
+                       shallow=False)
+    assert filecmp.cmp(out + ".log", os.path.join(GOLDEN, "expected.log"),
+                       shallow=False)
+
+    check = os.path.join(os.path.dirname(HERE), "tools",
+                         "metrics_check.py")
+    artifacts = [m1, m2, sp1, sp2,
+                 str(tmp_path / "stage1.events.jsonl"),
+                 str(tmp_path / "spans1.trace.json"),
+                 str(tmp_path / "spans2.trace.json")]
+    for a in artifacts:
+        assert os.path.exists(a), a
+    res = subprocess.run([sys.executable, check] + artifacts,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = subprocess.run([sys.executable, check, "--prom", tf],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    # the split timers finally separate dispatch from device wait
+    doc2 = json.load(open(m2))
+    st = doc2["timers"]["stage2"]["stages"]
+    assert "device_dispatch" in st and "device_wait" in st
+    assert doc2["histograms"]["device_dispatch_us"]["count"] \
+        == doc2["histograms"]["device_wait_us"]["count"] > 0
+    doc1 = json.load(open(m1))
+    s1 = doc1["timers"]["stage1"]["stages"]
+    assert "insert_dispatch" in s1 and "insert_wait" in s1
+
+    # trace_summary runs over the artifacts and prints the
+    # host/device/wait attribution table
+    summ = os.path.join(os.path.dirname(HERE), "tools",
+                        "trace_summary.py")
+    res = subprocess.run([sys.executable, summ, sp2, m2],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert "device wait" in res.stdout
+    assert "stage2_batch" in res.stdout

@@ -68,3 +68,109 @@ def test_read_batches_metrics(tmp_path):
     assert doc["counters"]["host_reads"] == sum(b.n for b in batches) == 2
     assert doc["counters"]["host_batches"] == len(batches)
     assert doc["meta"]["host_input_paths"] == paths
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 2: multi-host metrics aggregation (one document per job)
+# ---------------------------------------------------------------------------
+
+def _host_reg(reads, stall, subs_counts, stage_s, pi):
+    from quorum_tpu.telemetry import MetricsRegistry
+    from quorum_tpu.utils.profiling import StageTimer
+
+    reg = MetricsRegistry()
+    reg.set_meta(stage="create_database", host_process_index=pi,
+                 host_input_paths=[f"h{pi}.fastq"])
+    reg.counter("host_reads").inc(reads)
+    reg.counter("host_batches").inc(1)
+    reg.gauge("prefetch_queue_depth_max").set_max(stall)
+    for v, n in subs_counts.items():
+        reg.histogram("insert_wait_ms").observe(v, n)
+    t = StageTimer()
+    t.add_time("insert_wait", stage_s)
+    reg.set_timer("stage1", t.as_dict())
+    return reg
+
+
+def test_merge_host_docs_counters_sum():
+    from quorum_tpu.parallel.multihost import merge_host_docs
+    from quorum_tpu.telemetry import validate_metrics
+
+    d0 = _host_reg(100, 3, {0: 5, 2: 1}, 1.0, 0).as_dict()
+    d1 = _host_reg(40, 4, {0: 2, 7: 2}, 2.5, 1).as_dict()
+    merged = merge_host_docs([d0, d1])
+    assert validate_metrics(merged) == []
+    # the acceptance invariant: top-level counters == sum of shards
+    assert merged["counters"]["host_reads"] == 140
+    assert merged["counters"]["host_batches"] == 2
+    assert merged["hosts"]["0"]["counters"]["host_reads"] == 100
+    assert merged["hosts"]["1"]["counters"]["host_reads"] == 40
+    # gauges keep the per-host high-water mark
+    assert merged["gauges"]["prefetch_queue_depth_max"] == 4
+    # histograms merge exactly
+    h = merged["histograms"]["insert_wait_ms"]
+    assert h["count"] == 10
+    assert h["counts"] == {"0": 7, "2": 1, "7": 2}
+    assert h["sum"] == d0["histograms"]["insert_wait_ms"]["sum"] \
+        + d1["histograms"]["insert_wait_ms"]["sum"]
+    # timers: stages sum, job total = slowest host
+    st = merged["timers"]["stage1"]
+    assert st["stages"]["insert_wait"]["seconds"] == 3.5
+    assert st["total_seconds"] == max(
+        d["timers"]["stage1"]["total_seconds"] for d in (d0, d1))
+    # per-host meta stays in the shards, not the merged top level
+    assert "host_process_index" not in merged["meta"]
+    assert merged["meta"]["aggregated_hosts"] == 2
+    assert merged["hosts"]["1"]["meta"]["host_process_index"] == 1
+
+
+def test_aggregate_metrics_two_hosts_one_document(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 2): a 2-host run produces exactly ONE
+    aggregated document, written by process 0, whose counters equal
+    the sum of the per-host shards."""
+    import json
+
+    from quorum_tpu.telemetry import validate_metrics
+
+    regs = [_host_reg(100, 3, {0: 5}, 1.0, 0),
+            _host_reg(40, 4, {1: 2}, 2.0, 1)]
+    # simulate the collective: every host contributes its own payload
+    payloads = [json.dumps(r.as_dict()).encode() for r in regs]
+    monkeypatch.setattr(multihost, "_allgather_bytes",
+                        lambda payload: list(payloads))
+
+    outs = []
+    for pi, reg in enumerate(regs):
+        path = str(tmp_path / f"agg_pi{pi}" / "metrics.json")
+        (tmp_path / f"agg_pi{pi}").mkdir()
+        outs.append(multihost.aggregate_metrics(reg, path,
+                                                process_index=pi))
+    # every host gets the same merged document back...
+    assert outs[0] == outs[1]
+    # ...but exactly one file lands (process 0's)
+    assert (tmp_path / "agg_pi0" / "metrics.json").exists()
+    assert not (tmp_path / "agg_pi1" / "metrics.json").exists()
+    doc = json.load(open(tmp_path / "agg_pi0" / "metrics.json"))
+    assert validate_metrics(doc) == []
+    assert doc["counters"]["host_reads"] == sum(
+        doc["hosts"][h]["counters"]["host_reads"] for h in doc["hosts"])
+    assert doc["counters"]["host_reads"] == 140
+    assert doc["meta"]["aggregated_hosts"] == 2
+
+
+def test_aggregate_metrics_single_process_identity(tmp_path):
+    """Under one process the collective is the identity and the
+    document still writes (the degenerate 1-host job)."""
+    import json
+
+    from quorum_tpu.telemetry import validate_metrics
+
+    reg = _host_reg(7, 1, {0: 1}, 0.5, 0)
+    path = str(tmp_path / "agg.json")
+    merged = multihost.aggregate_metrics(reg, path)
+    assert (tmp_path / "agg.json").exists()
+    assert json.load(open(path)) == merged
+    assert validate_metrics(merged) == []
+    assert merged["counters"]["host_reads"] == 7
+    assert merged["meta"]["aggregated_hosts"] == 1
+    assert merged["hosts"]["0"]["counters"]["host_reads"] == 7

@@ -78,3 +78,42 @@ def test_trace_logs_dir_even_when_body_raises(tmp_path, capsys):
     finally:
         vlog_mod.verbose = old
     assert "Wrote profiler trace" in capsys.readouterr().err
+
+
+def test_stage_timer_zero_total_reports_zero_percent(capsys, monkeypatch):
+    """Satellite (ISSUE 2): a no-work run prints explicit 0.0% rows,
+    not sentinel-divided garbage percentages."""
+    from quorum_tpu.utils import profiling as prof_mod
+
+    now = [10.0]
+    monkeypatch.setattr(prof_mod.time, "perf_counter", lambda: now[0])
+    t = StageTimer()  # _t0 = 10.0; the clock never advances
+    with t.stage("a"):
+        pass
+    old = vlog_mod.verbose
+    vlog_mod.verbose = True
+    try:
+        t.report(total_units=0)
+    finally:
+        vlog_mod.verbose = old
+    err = capsys.readouterr().err
+    assert "stage a" in err
+    assert "(  0.0%)" in err
+    assert "nan" not in err and "inf" not in err
+    # and the dict form stays schema-clean with a zero total
+    d = t.as_dict()
+    assert d["total_seconds"] == 0.0
+    assert "units_per_hour" not in d
+
+
+def test_stage_timer_add_time_accumulates():
+    """add_time attributes externally-measured durations (the
+    dispatch/wait split) without extra clock reads."""
+    t = StageTimer()
+    t.add_time("device_dispatch", 0.25)
+    t.add_time("device_dispatch", 0.5, calls=2)
+    t.add_time("device_wait", 1.0)
+    assert t.seconds["device_dispatch"] == 0.75
+    assert t.calls["device_dispatch"] == 3
+    d = t.as_dict()
+    assert d["stages"]["device_wait"]["seconds"] == 1.0

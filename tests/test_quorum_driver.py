@@ -256,3 +256,132 @@ def test_quorum_driver_metrics_forwarding(tmp_path, monkeypatch):
         == len(reads)
     assert s2["counters"]["reads_corrected"] \
         + s2["counters"]["reads_skipped"] == len(reads)
+
+
+def test_quorum_driver_live_observability(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 2): a driver run with --metrics-port serves a
+    Prometheus-parseable /metrics DURING the run (the server closes
+    when the run finishes, so every successful scrape below is by
+    construction mid-pipeline), --metrics-textfile lints clean, and
+    --trace-spans produces span JSONL whose Chrome twin loads as valid
+    trace_event JSON."""
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    from quorum_tpu.telemetry import (export, validate_chrome_trace,
+                                      validate_span_line)
+
+    monkeypatch.chdir(tmp_path)
+    reads_path, reads, quals = make_dataset(tmp_path)
+    prefix = str(tmp_path / "qc")
+    tf = str(tmp_path / "live.prom")
+    sp = str(tmp_path / "spans.jsonl")
+
+    scrapes: list[str] = []
+    done = threading.Event()
+
+    def scraper():
+        # wait for the ephemeral port, then scrape until the run ends
+        while not done.is_set():
+            srv = export.current_server()
+            if srv is None:
+                time.sleep(0.005)
+                continue
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    scrapes.append(r.read().decode())
+            except OSError:
+                pass  # server may close between check and request
+            time.sleep(0.01)
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    try:
+        rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                              "--batch-size", "64",
+                              "--metrics-port", "0",
+                              "--metrics-textfile", tf,
+                              "--trace-spans", sp,
+                              reads_path])
+    finally:
+        done.set()
+        t.join()
+    assert rc == 0
+    assert os.path.exists(prefix + ".fa")
+
+    # mid-run scrapes happened and are Prometheus-parseable
+    assert scrapes, "no successful mid-run scrape"
+    for text in scrapes:
+        assert export.lint_prometheus_text(text) == []
+    # by the end of the run a stage counter must have shown up
+    assert any("quorum_tpu_" in s and 'stage="' in s for s in scrapes)
+    # the server is down after the run (closed by the driver)
+    assert export.current_server() is None
+
+    # textfile: present, linting clean via the rename target
+    assert export.lint_prometheus_text(open(tf).read()) == []
+    assert not os.path.exists(tf + ".tmp")
+
+    # spans: per-stage JSONL + Chrome twins, all schema-valid
+    for tag, names in (("stage1", {"stage1_batch", "stage1_insert"}),
+                       ("stage2", {"stage2_batch", "stage2_device"})):
+        spath = str(tmp_path / f"spans.{tag}.jsonl")
+        assert os.path.exists(spath), spath
+        lines = [json.loads(x) for x in open(spath) if x.strip()]
+        assert lines
+        assert all(validate_span_line(o) == [] for o in lines)
+        got = {o["span"] for o in lines}
+        assert names <= got, (tag, got)
+        chrome = str(tmp_path / f"spans.{tag}.trace.json")
+        doc = json.load(open(chrome))
+        assert validate_chrome_trace(doc) == []
+        assert {e["name"] for e in doc["traceEvents"]} >= names
+        # nesting: each device step is a child of its batch span
+        by_id = {o["id"]: o for o in lines}
+        steps = [o for o in lines if o["span"].endswith(
+            ("_insert", "_device"))]
+        assert steps
+        for s in steps:
+            assert by_id[s["parent"]]["span"] == f"{tag}_batch"
+            assert "step" in s
+
+    # the driver's own span file covers the shared read/pack producer
+    dpath = str(tmp_path / "spans.driver.jsonl")
+    assert os.path.exists(dpath)
+    dlines = [json.loads(x) for x in open(dpath) if x.strip()]
+    assert all(validate_span_line(o) == [] for o in dlines)
+    assert any(o["span"] == "reads_producer_produce" for o in dlines)
+    assert json.load(open(str(tmp_path / "spans.driver.trace.json")))
+
+
+def test_quorum_driver_uncaught_error_frees_port_and_stamps_manifest(
+        tmp_path, monkeypatch):
+    """An exception the stage CLIs don't catch must still close the
+    --metrics-port server and write the driver manifest with
+    status=error."""
+    import gc
+    import json
+
+    from quorum_tpu.cli import quorum as qmod
+    from quorum_tpu.telemetry import export
+
+    monkeypatch.chdir(tmp_path)
+    reads_path, _, _ = make_dataset(tmp_path)
+    mpath = str(tmp_path / "run.json")
+
+    def boom(*a, **kw):
+        raise OSError("stage 1 exploded")
+
+    monkeypatch.setattr(qmod.cdb_cli, "main", boom)
+    with pytest.raises(OSError, match="stage 1 exploded"):
+        quorum_cli.main(["-s", "64k", "-k", str(K),
+                         "-p", str(tmp_path / "qc"),
+                         "--metrics", mpath, "--metrics-port", "0",
+                         reads_path])
+    gc.collect()
+    assert export.current_server() is None  # port freed
+    drv = json.load(open(mpath))
+    assert drv["meta"]["status"] == "error"

@@ -241,3 +241,428 @@ def test_vlog_env_var_fallback(monkeypatch):
     finally:
         importlib.reload(vlog_mod)
         vlog_mod.verbose = old
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 2: heartbeat clock semantics, explicit events_path, live
+# exposition (Prometheus text, textfile atomicity, HTTP endpoint),
+# span tracer, and the --prom lint mode
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_rate_limit_mocked_clock(tmp_path, monkeypatch):
+    """Satellite: with the clock mocked, exactly one event lands per
+    interval regardless of how many heartbeat() calls arrive."""
+    from quorum_tpu.telemetry import registry as reg_mod
+
+    now = [100.0]
+    monkeypatch.setattr(reg_mod.time, "perf_counter", lambda: now[0])
+    p = str(tmp_path / "m.json")
+    reg = registry_for(p, heartbeat_s=5.0)
+    for i in range(20):  # t = 100.0 .. 101.9: one interval
+        now[0] = 100.0 + i * 0.1
+        reg.heartbeat(reads=i)
+    now[0] = 105.5  # second interval opens
+    for i in range(20):
+        reg.heartbeat(reads=100 + i)
+    reg.write()
+    ev = p[:-5] + ".events.jsonl"
+    lines = [json.loads(x) for x in open(ev) if x.strip()]
+    assert len(lines) == 2  # at most one per interval
+    assert [x["reads"] for x in lines] == [0, 100]
+    # every heartbeat record carries a monotonic elapsed_s
+    assert [x["elapsed_s"] for x in lines] == [0.0, 5.5]
+
+
+def test_explicit_events_path_without_final_json(tmp_path):
+    """Satellite: an explicit events_path streams heartbeats even when
+    no final-JSON path is configured (they used to be dropped)."""
+    ev = str(tmp_path / "beats.jsonl")
+    reg = registry_for(None, events_path=ev)
+    assert reg.enabled
+    reg.heartbeat(reads=1)
+    reg.heartbeat(reads=2)  # heartbeat_s=0 + explicit path: unlimited
+    assert reg.write() is None  # no final JSON...
+    assert not any(f.suffix == ".json" for f in tmp_path.iterdir())
+    lines = [json.loads(x) for x in open(ev) if x.strip()]
+    assert [x["reads"] for x in lines] == [1, 2]
+    assert all("elapsed_s" in x for x in lines)
+    assert check_file(ev) == []
+
+
+def test_prometheus_render_and_lint():
+    from quorum_tpu.telemetry import export
+
+    reg = MetricsRegistry()
+    reg.set_meta(stage="stage_x")
+    reg.counter("reads").inc(7)
+    reg.gauge("fill").set(0.25)
+    reg.histogram("subs").observe(0, 3)
+    reg.histogram("subs").observe(2, 2)
+    text = export.prometheus_text({"stage_x": reg.as_dict()},
+                                  {"stage_x": 1.5})
+    assert export.lint_prometheus_text(text) == []
+    assert 'quorum_tpu_reads_total{stage="stage_x"} 7' in text
+    assert 'quorum_tpu_fill{stage="stage_x"} 0.25' in text
+    # exact counts -> cumulative le buckets
+    assert 'quorum_tpu_subs_bucket{stage="stage_x",le="0"} 3' in text
+    assert 'quorum_tpu_subs_bucket{stage="stage_x",le="2"} 5' in text
+    assert 'quorum_tpu_subs_bucket{stage="stage_x",le="+Inf"} 5' in text
+    assert 'quorum_tpu_subs_sum{stage="stage_x"} 4' in text
+    assert 'quorum_tpu_elapsed_seconds{stage="stage_x"} 1.5' in text
+    # TYPE headers appear exactly once per metric
+    assert text.count("# TYPE quorum_tpu_subs histogram") == 1
+
+
+def test_prometheus_lint_catches_malformations():
+    from quorum_tpu.telemetry.export import lint_prometheus_text
+
+    assert lint_prometheus_text("") != []  # no samples
+    assert any("not a valid sample" in e for e in
+               lint_prometheus_text("this is not prometheus\n"))
+    assert any("missing _total" in e for e in lint_prometheus_text(
+        "# TYPE foo counter\nfoo 3\n"))
+    bad_buckets = ("# TYPE h histogram\n"
+                   'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n')
+    assert any("not cumulative" in e for e in
+               lint_prometheus_text(bad_buckets))
+
+
+def test_textfile_atomic_under_concurrent_reads(tmp_path):
+    """Satellite: a reader at the rename target never observes a
+    half-written textfile, no matter how the writes interleave."""
+    from quorum_tpu.telemetry import export
+
+    reg = MetricsRegistry()
+    reg.set_meta(stage="atomic")
+    for i in range(200):  # a body big enough to make torn writes real
+        reg.counter(f"c{i:03d}").inc(i)
+    export.register_live(reg)
+    path = str(tmp_path / "metrics.prom")
+    export.write_textfile(path)
+    stop = threading.Event()
+    torn: list[str] = []
+
+    def reader():
+        while not stop.is_set():
+            text = open(path).read()
+            errs = export.lint_prometheus_text(text)
+            if errs:
+                torn.extend(errs)
+                return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for _ in range(300):
+            reg.counter("c000").inc()
+            export.write_textfile(path)
+    finally:
+        stop.set()
+        t.join()
+    assert torn == []
+    assert not os.path.exists(path + ".tmp")  # tmp never lingers
+
+
+def test_attach_textfile_rate_limit_and_final(tmp_path, monkeypatch):
+    """attach_textfile refreshes at most once per period on heartbeats
+    but always on the final write()."""
+    from quorum_tpu.telemetry import export, registry as reg_mod
+
+    now = [50.0]
+    monkeypatch.setattr(reg_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(export.time, "perf_counter", lambda: now[0])
+    path = str(tmp_path / "m.prom")
+    writes = []
+    real_write = export.write_textfile
+    monkeypatch.setattr(export, "write_textfile",
+                        lambda p, text=None: writes.append(p)
+                        or real_write(p, text))
+    reg = registry_for(None, force=True)
+    reg.set_meta(stage="tf")
+    reg.counter("c").inc()
+    export.attach_textfile(reg, path, period=10.0)
+    for i in range(5):
+        now[0] = 50.0 + i  # all within one period
+        reg.heartbeat(reads=i)
+    assert len(writes) == 1
+    reg.write()  # final=True bypasses the period
+    assert len(writes) == 2
+    assert export.lint_prometheus_text(open(path).read()) == []
+
+
+def test_live_http_endpoint_serves_metrics_and_healthz():
+    import urllib.request
+
+    from quorum_tpu.telemetry import export
+
+    reg = MetricsRegistry()
+    reg.set_meta(stage="live")
+    reg.counter("scraped").inc(3)
+    export.register_live(reg)
+    srv = export.serve(0)  # ephemeral port
+    try:
+        assert export.current_server() is srv
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics") as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert export.lint_prometheus_text(text) == []
+        assert 'quorum_tpu_scraped_total{stage="live"} 3' in text
+        with urllib.request.urlopen(base + "/healthz") as r:
+            hz = json.loads(r.read().decode())
+        assert hz["status"] == "ok"
+        assert hz["registries"] >= 1
+        try:
+            urllib.request.urlopen(base + "/nope")
+            assert False, "404 expected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.close()
+
+
+def test_span_tracer_jsonl_and_chrome_trace(tmp_path):
+    from quorum_tpu.telemetry import (NULL_TRACER, tracer_for,
+                                      validate_chrome_trace,
+                                      validate_span_line)
+
+    assert tracer_for(None) is NULL_TRACER
+    assert not NULL_TRACER.enabled
+    with NULL_TRACER.span("x"), NULL_TRACER.step("y", 0):
+        pass
+
+    p = str(tmp_path / "spans.jsonl")
+    tr = tracer_for(p)
+    assert tr.enabled
+    with tr.span("outer", reads=128):
+        with tr.span("inner"):
+            pass
+        with tr.step("device", 0, reads=128):
+            pass
+
+    def other_thread():
+        with tr.span("threaded"):
+            pass
+
+    t = threading.Thread(target=other_thread)
+    t.start()
+    t.join()
+    tr.close()
+    tr.close()  # idempotent
+
+    lines = [json.loads(x) for x in open(p) if x.strip()]
+    assert all(validate_span_line(o) == [] for o in lines)
+    by_name = {o["span"]: o for o in lines}
+    assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+    assert by_name["device"]["parent"] == by_name["outer"]["id"]
+    assert by_name["device"]["step"] == 0
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["reads"] == 128
+    # the other thread starts its own lineage on its own tid
+    assert by_name["threaded"]["parent"] is None
+    assert by_name["threaded"]["tid"] != by_name["outer"]["tid"]
+    # children close before the parent: JSONL is close-ordered
+    assert [o["span"] for o in lines].index("inner") \
+        < [o["span"] for o in lines].index("outer")
+    assert check_file(p) == []
+
+    chrome = p[:-6] + ".trace.json"  # .jsonl -> .trace.json
+    doc = json.load(open(chrome))
+    assert validate_chrome_trace(doc) == []
+    assert {e["name"] for e in doc["traceEvents"]} \
+        == {"outer", "inner", "device", "threaded"}
+    ev = {e["name"]: e for e in doc["traceEvents"]}
+    assert ev["outer"]["args"]["reads"] == 128
+    assert ev["inner"]["ts"] >= ev["outer"]["ts"]
+    assert check_file(chrome) == []
+
+
+def test_metrics_check_prom_mode(tmp_path):
+    from quorum_tpu.telemetry import export
+
+    reg = MetricsRegistry()
+    reg.set_meta(stage="s")
+    reg.counter("c").inc()
+    good = tmp_path / "good.prom"
+    good.write_text(export.prometheus_text({"s": reg.as_dict()}))
+    bad = tmp_path / "bad.prom"
+    bad.write_text("definitely not prometheus\n")
+    res = subprocess.run([sys.executable, METRICS_CHECK, "--prom",
+                          str(good)], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    res = subprocess.run([sys.executable, METRICS_CHECK, "--prom",
+                          str(good), str(bad)],
+                         capture_output=True, text=True)
+    assert res.returncode == 1
+    assert "sample" in res.stderr
+
+
+def test_lint_reports_non_numeric_le():
+    from quorum_tpu.telemetry.export import lint_prometheus_text
+
+    errs = lint_prometheus_text('# TYPE h histogram\n'
+                                'h_bucket{le="abc"} 1\n')
+    assert any("le=" in e for e in errs)  # reported, not a crash
+
+
+def test_span_after_close_does_not_truncate_jsonl(tmp_path):
+    """A straggler thread's span closing after tracer.close() must
+    not reopen (and truncate) the streamed JSONL."""
+    from quorum_tpu.telemetry import tracer_for
+
+    p = str(tmp_path / "s.jsonl")
+    tr = tracer_for(p)
+    with tr.span("kept"):
+        pass
+    tr.close()
+    with tr.span("late"):  # e.g. a render-pool task outliving the run
+        pass
+    lines = [json.loads(x) for x in open(p) if x.strip()]
+    assert [o["span"] for o in lines] == ["kept"]
+
+
+def test_http_server_close_is_idempotent():
+    from quorum_tpu.telemetry import export
+
+    srv = export.serve(0)
+    srv.close()
+    srv.close()  # second close: no-op, no error
+
+
+def test_finished_registry_series_survive_in_live_rendering(tmp_path):
+    """A stage registry freed after its run must keep its FINAL series
+    in the shared exposition (driver endpoint/textfile carries stage1
+    after stage1 returns)."""
+    import gc
+
+    from quorum_tpu.telemetry import export
+
+    reg = registry_for(str(tmp_path / "s1.json"))
+    reg.set_meta(stage="finished_stage")
+    reg.counter("reads").inc(42)
+    reg.write()
+    del reg
+    gc.collect()
+    text = export.render_live()
+    assert 'quorum_tpu_reads_total{stage="finished_stage"} 42' in text
+    # a NEW live registry with the same label supersedes the snapshot
+    reg2 = registry_for(None, force=True)
+    reg2.set_meta(stage="finished_stage")
+    reg2.counter("reads").inc(7)
+    text = export.render_live()
+    assert 'quorum_tpu_reads_total{stage="finished_stage"} 7' in text
+    assert '} 42' not in text
+
+
+def test_stage_cli_error_still_writes_metrics(tmp_path, monkeypatch):
+    """A failed stage run (hash-full RuntimeError) must land its
+    metrics document with status=error, not just stop reporting."""
+    from quorum_tpu.cli import create_database as cdb_cli
+
+    def boom(*a, **kw):
+        raise RuntimeError("Hash is full")
+
+    monkeypatch.setattr(cdb_cli, "create_database_main", boom)
+    reads = tmp_path / "r.fastq"
+    reads.write_text("@r\nACGT\n+\nIIII\n")
+    m = str(tmp_path / "m.json")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", str(tmp_path / "db"), "--metrics", m,
+                       str(reads)])
+    assert rc == 1
+    doc = json.load(open(m))
+    assert doc["meta"]["status"] == "error"
+    assert validate_metrics(doc) == []
+
+
+def test_serve_resets_retained_finals():
+    """A new endpoint must not report a previous job's counters."""
+    from quorum_tpu.telemetry import export
+
+    reg = registry_for(None, force=True)
+    reg.set_meta(stage="job_a")
+    reg.counter("stale").inc(9)
+    reg.write()
+    del reg
+    assert 'stage="job_a"' in export.render_live()
+    srv = export.serve(0)
+    try:
+        assert 'stage="job_a"' not in export.render_live()
+    finally:
+        srv.close()
+
+
+def test_metrics_live_flag_forces_stage_registry(tmp_path):
+    """--metrics-live (forwarded by the driver with --metrics-port)
+    gives a stage a real registry with no output path, so the
+    parent-owned endpoint sees its counters."""
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.telemetry import export
+
+    export.reset_exposition()
+    golden = os.path.join(HERE, "golden", "reads.fastq")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", str(tmp_path / "db.jf"), "--metrics-live",
+                       golden])
+    assert rc == 0
+    text = export.render_live()
+    assert 'quorum_tpu_reads_total{stage="create_database"}' in text
+    # no metrics file was written (no --metrics path)
+    assert not (tmp_path / "db.jf.json").exists()
+    assert list(tmp_path.glob("*.json")) == []
+
+
+def test_attach_textfile_new_target_drops_stale_finals(tmp_path):
+    """Attaching a textfile path this process never wrote marks a new
+    job: a previous job's retained finals must not leak into it.
+    Re-attaching a known path (driver stages sharing one file) keeps
+    them."""
+    from quorum_tpu.telemetry import export
+
+    export.reset_exposition()
+    old = registry_for(None, force=True)
+    old.set_meta(stage="old_job")
+    old.counter("stale").inc(5)
+    old.write()
+    del old
+    assert 'stage="old_job"' in export.render_live()
+
+    new = registry_for(None, force=True)
+    new.set_meta(stage="new_job")
+    export.attach_textfile(new, str(tmp_path / "b.prom"))
+    assert 'stage="old_job"' not in export.render_live()
+    # same-path re-attach retains finals written since
+    new.counter("c").inc()
+    new.write()
+    del new
+    later = registry_for(None, force=True)
+    later.set_meta(stage="later_stage")
+    export.attach_textfile(later, str(tmp_path / "b.prom"))
+    assert 'stage="new_job"' in export.render_live()
+
+
+def test_busy_metrics_port_still_lands_error_document(tmp_path):
+    """A busy --metrics-port raises before the pipeline starts; the
+    run must still write its metrics document with status=error."""
+    import socket
+
+    from quorum_tpu.cli import create_database as cdb_cli
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    port = s.getsockname()[1]
+    try:
+        reads = tmp_path / "r.fastq"
+        reads.write_text("@r\nACGT\n+\nIIII\n")
+        m = str(tmp_path / "m.json")
+        with pytest.raises(OSError):
+            cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7",
+                          "-q", "38", "-o", str(tmp_path / "db"),
+                          "--metrics", m, "--metrics-port", str(port),
+                          str(reads)])
+        doc = json.load(open(m))
+        assert doc["meta"]["status"] == "error"
+    finally:
+        s.close()

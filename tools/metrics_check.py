@@ -3,19 +3,28 @@
 (quorum_tpu/telemetry/schema.py, version quorum-tpu-metrics/1).
 
 Usage: python tools/metrics_check.py FILE [FILE ...]
+       python tools/metrics_check.py --prom TEXTFILE [...]
 
-Accepts any of the three artifact kinds the pipeline produces and
-dispatches on content, not extension:
+Default mode accepts any of the artifact kinds the pipeline produces
+and dispatches on content, not extension:
 
   * final metrics JSON documents (`--metrics PATH` on the CLIs,
-    MetricsRegistry.write)
+    MetricsRegistry.write), including multi-host aggregated documents
+    with a `hosts` section (parallel/multihost.aggregate_metrics)
   * JSONL event streams (`--metrics-interval` heartbeats, hash-grow
     and stage-done events)
+  * span JSONL streams (`--trace-spans`, telemetry/spans.py) and
+    their Chrome trace_event twins (`*.trace.json`)
   * bench-style metric-line files (one {"metric": ...} object per
     line, as bench.py emits — so CI can gate BENCH_*.json output)
 
+`--prom` switches to linting Prometheus text exposition output
+(`--metrics-textfile` files or a saved `/metrics` scrape) through the
+shared linter in telemetry/export.py.
+
 Prints one line per problem and exits 1 if any file fails, 0 if all
-are valid. Used by tests/test_telemetry.py on a golden-pipeline dump.
+are valid. Used by tests/test_telemetry.py and tests/test_golden.py
+on golden-pipeline dumps.
 """
 
 from __future__ import annotations
@@ -28,20 +37,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from quorum_tpu.telemetry import check_file  # noqa: E402
+from quorum_tpu.telemetry.export import lint_prometheus_text  # noqa: E402
+
+
+def _check_prom(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [str(e)]
+    return lint_prometheus_text(text)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        description="Validate metrics JSON / events JSONL / bench "
-                    "metric-line files against quorum-tpu-metrics/1")
+        description="Validate metrics JSON / events JSONL / span JSONL "
+                    "/ Chrome trace / bench metric-line files against "
+                    "quorum-tpu-metrics/1, or Prometheus textfiles "
+                    "with --prom")
     p.add_argument("files", nargs="+", metavar="FILE")
+    p.add_argument("--prom", action="store_true",
+                   help="Lint FILEs as Prometheus text exposition "
+                        "format (--metrics-textfile output)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="Suppress per-file OK lines")
     args = p.parse_args(argv)
 
+    check = _check_prom if args.prom else check_file
     bad = 0
     for path in args.files:
-        problems = check_file(path)
+        problems = check(path)
         if problems:
             bad += 1
             for msg in problems:

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Offline span/metrics join: where did the time go, without
+TensorBoard (ISSUE 2 satellite).
+
+Usage: python tools/trace_summary.py SPANS.jsonl [METRICS.json ...]
+
+Reads a `--trace-spans` JSONL stream (telemetry/spans.py) and any
+number of `--metrics` JSON documents, and prints:
+
+  * the per-span aggregate (calls, total, mean, share of wall time),
+    with parent/child nesting preserved in the ordering;
+  * each metrics document's StageTimer table (the same facts
+    `-v` prints through vlog, recovered from the artifact);
+  * a host / device-dispatch / device-wait attribution summary that
+    joins the split timer stages and `*_dispatch_us`/`*_wait_us`
+    histograms — the per-batch device-time breakdown the trace
+    records, folded to one table per run.
+
+This is the quick look a BENCH run's time budget needs; for the
+timeline view load the `.trace.json` twin in Perfetto or
+`chrome://tracing`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_spans(path: str) -> list[dict]:
+    spans = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "span" in obj:
+                spans.append(obj)
+    return spans
+
+
+def span_table(spans: list[dict]) -> tuple[list[tuple], float]:
+    """Aggregate by (name, depth): [(name, depth, calls, total_s,
+    mean_ms, pct_wall)], wall = latest end - earliest start."""
+    if not spans:
+        return [], 0.0
+    by_id = {s["id"]: s for s in spans}
+
+    def depth(s):
+        d = 0
+        seen = set()
+        while s.get("parent") is not None and s["id"] not in seen:
+            seen.add(s["id"])
+            parent = by_id.get(s["parent"])
+            if parent is None:
+                break
+            d += 1
+            s = parent
+        return d
+
+    wall = (max(s["ts"] + s["dur"] for s in spans)
+            - min(s["ts"] for s in spans))
+    agg: dict[tuple, list] = {}
+    order: list[tuple] = []
+    for s in sorted(spans, key=lambda x: x["ts"]):
+        key = (s["span"], depth(s))
+        if key not in agg:
+            agg[key] = [0, 0.0]
+            order.append(key)
+        agg[key][0] += 1
+        agg[key][1] += s["dur"]
+    rows = []
+    for name, d in order:
+        calls, total = agg[(name, d)]
+        rows.append((name, d, calls, total,
+                     total / calls * 1000.0,
+                     100.0 * total / wall if wall > 0 else 0.0))
+    return rows, wall
+
+
+def _bucket(name: str) -> str:
+    if name.endswith(("_dispatch", "_dispatch_ms", "_dispatch_us")):
+        return "device dispatch"
+    if name.endswith(("_wait", "_wait_ms", "_wait_us")):
+        return "device wait"
+    return "host"
+
+
+def attribution(doc: dict) -> dict[str, float]:
+    """host/device-dispatch/device-wait seconds from a metrics
+    document's split timer stages."""
+    out = {"host": 0.0, "device dispatch": 0.0, "device wait": 0.0}
+    for t in doc.get("timers", {}).values():
+        for name, st in t.get("stages", {}).items():
+            out[_bucket(name)] += st.get("seconds", 0.0)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Summarize span JSONL + metrics JSON into per-"
+                    "stage host/device/wait tables")
+    p.add_argument("spans", metavar="SPANS.jsonl",
+                   help="Span JSONL from --trace-spans")
+    p.add_argument("metrics", nargs="*", metavar="METRICS.json",
+                   help="Metrics documents from --metrics")
+    args = p.parse_args(argv)
+
+    try:
+        spans = load_spans(args.spans)
+    except (OSError, ValueError) as e:
+        print(f"{args.spans}: {e}", file=sys.stderr)
+        return 1
+    rows, wall = span_table(spans)
+    print(f"== spans: {args.spans} ({len(spans)} spans, "
+          f"wall {wall:.3f} s) ==")
+    print(f"{'span':<28} {'calls':>6} {'total_s':>9} {'mean_ms':>9} "
+          f"{'%wall':>6}")
+    for name, d, calls, total, mean_ms, pct in rows:
+        label = "  " * d + name
+        print(f"{label:<28} {calls:>6} {total:>9.3f} {mean_ms:>9.2f} "
+              f"{pct:>6.1f}")
+
+    for mpath in args.metrics:
+        try:
+            doc = json.load(open(mpath))
+        except (OSError, ValueError) as e:
+            print(f"{mpath}: {e}", file=sys.stderr)
+            return 1
+        for tname, t in doc.get("timers", {}).items():
+            total = t.get("total_seconds", 0.0)
+            print(f"\n== timers: {mpath} [{tname}] "
+                  f"(total {total:.3f} s) ==")
+            print(f"{'stage':<20} {'calls':>6} {'seconds':>9} "
+                  f"{'%total':>7}  class")
+            for sname, st in t.get("stages", {}).items():
+                s = st.get("seconds", 0.0)
+                pct = 100.0 * s / total if total > 0 else 0.0
+                print(f"{sname:<20} {st.get('calls', 0):>6} "
+                      f"{s:>9.3f} {pct:>7.1f}  {_bucket(sname)}")
+        att = attribution(doc)
+        total_att = sum(att.values())
+        print(f"\n== attribution: {mpath} ==")
+        for k in ("host", "device dispatch", "device wait"):
+            pct = 100.0 * att[k] / total_att if total_att > 0 else 0.0
+            print(f"{k:<18} {att[k]:>9.3f} s {pct:>6.1f}%")
+        for hname, h in sorted(doc.get("histograms", {}).items()):
+            if not hname.endswith(("_dispatch_ms", "_wait_ms",
+                                   "_dispatch_us", "_wait_us")):
+                continue
+            div = 1e3 if hname.endswith("_us") else 1.0
+            n = h.get("count", 0)
+            mean = h.get("sum", 0) / div / n if n else 0.0
+            print(f"  {hname}: n={n} mean={mean:.2f} ms "
+                  f"sum={h.get('sum', 0) / div / 1000.0:.3f} s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
