@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate (ISSUE 3 satellite): the repo's tier-1 pytest pass,
+# then a golden serve run (tools/serve_smoke.py: parity with the
+# committed expected.fa, warm no-recompile, graceful drain) whose
+# artifacts are gated through tools/metrics_check.py — the final serve
+# metrics document (including the serve request/batch metric names)
+# and the Prometheus /metrics scrape (--prom lint).
+#
+# Usage: ci/tier1.sh [pytest args...]
+# Env:   SKIP_SERVE_SMOKE=1  skips the serve gate (pytest only).
+set -o pipefail
+set -u
+
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 pytest =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee /tmp/_t1.log
+pytest_rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+if [ "$pytest_rc" -ne 0 ]; then
+    # keep going: the serve gate must report even when pytest is red
+    # (known-failing seed tests), and the final exit carries the
+    # failure either way
+    echo "ci/tier1.sh: tier-1 pytest FAILED (rc=$pytest_rc)" >&2
+fi
+
+serve_rc=0
+if [ "${SKIP_SERVE_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: serve smoke skipped (SKIP_SERVE_SMOKE=1)"
+else
+    echo "== golden serve run =="
+    SMOKE_DIR=$(mktemp -d /tmp/serve_smoke.XXXXXX)
+    trap 'rm -rf "$SMOKE_DIR"' EXIT
+    # share the pytest run's host-local compile cache (tests/conftest
+    # pins it): the default ~/.cache dir can hold executables AOT'd
+    # with a tunnel machine's features (SIGILL risk, conftest.py),
+    # and a warm cache makes the cold serve request fast
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/serve_smoke.py \
+        --out-dir "$SMOKE_DIR" || serve_rc=$?
+    if [ "$serve_rc" -eq 0 ]; then
+        echo "== metrics_check gates =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$SMOKE_DIR/serve_metrics.json" || serve_rc=1
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py --prom \
+            "$SMOKE_DIR/serve_scrape.prom" || serve_rc=1
+    fi
+    if [ "$serve_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: serve gate FAILED (rc=$serve_rc)" >&2
+    fi
+fi
+
+if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
+if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
+echo "ci/tier1.sh: ALL GREEN"

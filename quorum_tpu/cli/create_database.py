@@ -89,42 +89,28 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         threads=args.threads,
         profile=args.profile,
     )
-    from ..telemetry import registry_for, tracer_for
-    from ..telemetry import export as export_mod
-    from ..utils.vlog import vlog
-    reg = registry_for(args.metrics, args.metrics_interval,
-                       force=(args.metrics_port is not None
-                              or bool(args.metrics_textfile)
-                              or args.metrics_live))
-    tracer = tracer_for(args.trace_spans)
-    server = None
+    from .observability import observability
     rc = 1  # flipped to 0 only on success: any exception leaves 1
-    try:
-        # endpoint/textfile start INSIDE the umbrella: a busy port
-        # must still land the error document below
-        server = export_mod.start_exposition(
-            reg, args.metrics_port, args.metrics_textfile,
-            period=args.metrics_interval)
-        create_database_main(args.reads, args.output, cfg,
-                             cmdline=list(sys.argv),
-                             ref_format=args.ref_format,
-                             handoff=handoff, batches=batches,
-                             metrics=reg, tracer=tracer)
-        rc = 0
-    except RuntimeError as e:
-        print(str(e), file=sys.stderr)
-    finally:
-        # a failed run (hash-full, or anything uncaught) must still
-        # land its metrics document with status=error — monitoring
-        # needs a run that FAILED, not one that stopped reporting
-        tracer.close()
-        if reg.enabled:
-            reg.set_meta(status="ok" if rc == 0 else "error")
-            if rc == 0:
-                reg.set_meta(output=args.output)
-            reg.write()
-        if server is not None:
-            server.close()
+    # a failed run (hash-full, busy --metrics-port, or anything
+    # uncaught) must still land its metrics document with
+    # status=error — monitoring needs a run that FAILED, not one that
+    # stopped reporting. The observability() teardown guarantees it.
+    with observability(args.metrics, args.metrics_interval,
+                       port=args.metrics_port,
+                       textfile=args.metrics_textfile,
+                       live=args.metrics_live,
+                       trace_spans=args.trace_spans) as obs:
+        try:
+            create_database_main(args.reads, args.output, cfg,
+                                 cmdline=list(sys.argv),
+                                 ref_format=args.ref_format,
+                                 handoff=handoff, batches=batches,
+                                 metrics=obs.registry, tracer=obs.tracer)
+            rc = 0
+            obs.registry.set_meta(output=args.output)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            obs.status = "error"
     return rc
 
 

@@ -1,15 +1,23 @@
 """histo_mer_database — count histogram split by quality bit, capped at
 1000 (reference: src/histo_mer_database.cc:8-28; identical output:
 "<count> <n_lowqual> <n_highqual>" for each non-empty bin). The primary
-DB-equivalence check — one bincount reduce over the value array."""
+DB-equivalence check — one bincount reduce over the value array.
+
+Telemetry (ISSUE 3 satellite): same observability surface as the main
+CLIs — `--metrics` records a `distinct_mers` counter and
+`max_count` / `nonempty_bins` gauges; stdout stays
+reference-identical.
+"""
 
 from __future__ import annotations
 
+import argparse
 import sys
 
 import numpy as np
 
 from ..io import db_format
+from .observability import add_observability_args, observability
 
 HLEN = 1001
 
@@ -24,23 +32,51 @@ def histo(vals: np.ndarray) -> np.ndarray:
     return out
 
 
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="histo_mer_database",
+        description="Histogram of mer counts split by the quality bit.",
+    )
+    add_observability_args(p, metrics=True)
+    p.add_argument("db", help="Mer database")
+    return p
+
+
 def main(argv=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1:
-        print(f"Usage: histo_mer_database db", file=sys.stderr)
-        return 1
-    try:
-        state, meta, _ = db_format.read_db(argv[0], to_device=False)
-    except (RuntimeError, ValueError, OSError) as e:
-        print(str(e), file=sys.stderr)
-        return 1
-    _, _, vals = db_format.db_iterate(state, meta)
-    out = histo(vals)
-    for i in range(HLEN):
-        if out[i, 0] or out[i, 1]:
-            print(f"{i} {out[i, 0]} {out[i, 1]}")
+    args = build_parser().parse_args(argv)
+    with observability(args.metrics, args.metrics_interval,
+                       port=args.metrics_port,
+                       textfile=args.metrics_textfile,
+                       live=args.metrics_live,
+                       trace_spans=args.trace_spans,
+                       stage="histo_mer_database") as obs:
+        reg, tracer = obs.registry, obs.tracer
+        try:
+            with tracer.span("load_db"):
+                state, meta, _ = db_format.read_db(args.db,
+                                                   to_device=False)
+        except (RuntimeError, ValueError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            obs.status = "error"
+            return 1
+        reg.set_meta(db=args.db, k=meta.k)
+        with tracer.span("histogram"):
+            _, _, vals = db_format.db_iterate(state, meta)
+            out = histo(vals)
+        nonempty = 0
+        for i in range(HLEN):
+            if out[i, 0] or out[i, 1]:
+                print(f"{i} {out[i, 0]} {out[i, 1]}")
+                nonempty += 1
+        if reg.enabled:
+            total = int(out.sum())
+            reg.counter("distinct_mers").inc(total)
+            reg.gauge("nonempty_bins").set(nonempty)
+            occupied = np.nonzero(out.sum(axis=1))[0]
+            reg.gauge("max_count").set(
+                int(occupied.max()) if occupied.size else 0)
     return 0
 
 

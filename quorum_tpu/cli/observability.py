@@ -1,22 +1,49 @@
-"""Shared live-observability CLI flags (ISSUE 2).
+"""Shared live-observability CLI surface (ISSUE 2) and the one
+startup/teardown shape every entry point runs it through (ISSUE 3).
 
-All three main CLIs expose the same four flags; one helper keeps the
+All main CLIs expose the same four flags; one helper keeps the
 surfaces (and their help text) from drifting apart. `--metrics` /
 `--metrics-interval` stay per-CLI — their help genuinely differs
 (the driver suffixes per-stage paths).
+
+`observability()` is the context manager behind those flags: it
+builds the registry and span tracer, starts the live exposition
+(endpoint/textfile) INSIDE the error umbrella (a busy port must still
+land the error document), and on exit guarantees — in order — that
+the span file closes, the final metrics document lands with a status
+stamp, and the endpoint port frees. Before it existed the quorum
+driver, both stage CLIs, and run_error_correct each carried their own
+slightly different copy of that teardown (the explicit ROADMAP gap);
+`quorum-serve` is the fourth consumer.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 
 
 def add_observability_args(p: argparse.ArgumentParser,
-                           driver: bool = False) -> None:
+                           driver: bool = False,
+                           metrics: bool = False) -> None:
     """The live-exposition + span-tracing flag block. `driver=True`
     switches to the quorum driver's wording (one endpoint for all
     stages, per-stage span suffixes) and drops `--metrics-live`,
-    which only the driver itself forwards to its children."""
+    which only the driver itself forwards to its children.
+    `metrics=True` also owns the `--metrics`/`--metrics-interval`
+    pair with the generic help text — the three main CLIs keep their
+    own copies because their help genuinely differs (the driver
+    suffixes per-stage paths); the simpler CLIs (query/histo/serve)
+    share this one."""
+    if metrics:
+        p.add_argument("--metrics", metavar="path", default=None,
+                       help="Write a final metrics JSON (schema "
+                            "quorum-tpu-metrics/1) to this path")
+        p.add_argument("--metrics-interval", metavar="seconds",
+                       type=float, default=0.0,
+                       help="With --metrics: also write JSONL "
+                            "heartbeat events at this period "
+                            "(0 = off)")
     p.add_argument("--metrics-port", metavar="port", type=int,
                    default=None,
                    help="Serve live Prometheus /metrics (+ /healthz) "
@@ -41,3 +68,92 @@ def add_observability_args(p: argparse.ArgumentParser,
                             "exposition endpoint sees this stage "
                             "(the quorum driver forwards this with "
                             "--metrics-port)")
+
+
+class ObservabilitySession:
+    """What `observability()` yields: the registry and tracer, plus
+    the knobs a run uses to steer the final document.
+
+    * `status` — the stamp written on a CLEAN exit ("ok" by default);
+      entry points that report failure through a return code instead
+      of an exception set it to "error" before leaving the block. An
+      exception always stamps "error", whatever `status` says.
+    * `at_exit(fn)` — `fn(registry)` runs right before the final
+      write on EVERY exit path (success or error); the quorum driver
+      derives its compile-cache-miss gauge here so a crashed run
+      still reports it.
+    """
+
+    def __init__(self, registry, tracer):
+        self.registry = registry
+        self.tracer = tracer
+        self.server = None  # exposition endpoint, once started
+        self.status: str | None = None
+        self._at_exit: list = []
+
+    def at_exit(self, fn) -> None:
+        self._at_exit.append(fn)
+
+    def _finalize(self, ok: bool) -> None:
+        reg = self.registry
+        if not reg.enabled:
+            return
+        for fn in self._at_exit:
+            try:
+                fn(reg)
+            except Exception:  # noqa: BLE001 - exit hooks never mask exits
+                pass
+        if not ok:
+            reg.set_meta(status="error")
+            reg.write()
+        elif reg.meta.get("status") is None:
+            # a run that already stamped + wrote (run_error_correct's
+            # success path) is left alone — no second write
+            reg.set_meta(status=self.status or "ok")
+            reg.write()
+
+
+@contextlib.contextmanager
+def observability(metrics: str | None = None, interval: float = 0.0,
+                  port: int | None = None, textfile: str | None = None,
+                  live: bool = False, trace_spans: str | None = None,
+                  **meta):
+    """The one observability lifecycle (ISSUE 3 satellite): registry +
+    tracer up front, exposition started inside the umbrella, and a
+    teardown that runs on every exit — span close, status-stamped
+    final write (skipped when the body already wrote), endpoint
+    close. `meta` seeds `registry.set_meta` (stage=..., etc.).
+
+    Typical shape::
+
+        with observability(args.metrics, args.metrics_interval,
+                           port=args.metrics_port, ...) as obs:
+            rc = run(obs.registry, obs.tracer)
+            if rc != 0:
+                obs.status = "error"
+    """
+    from ..telemetry import registry_for, tracer_for
+    from ..telemetry import export as export_mod
+
+    reg = registry_for(metrics, interval,
+                       force=(port is not None or bool(textfile) or live))
+    if meta:
+        reg.set_meta(**meta)
+    tracer = tracer_for(trace_spans)
+    obs = ObservabilitySession(reg, tracer)
+    try:
+        try:
+            obs.server = export_mod.start_exposition(
+                reg, port, textfile, period=interval)
+            yield obs
+        except BaseException:
+            obs._finalize(ok=False)
+            raise
+        obs._finalize(ok=True)
+    finally:
+        # span + endpoint teardown on EVERY exit: the Chrome trace of
+        # an interrupted run is exactly when it's needed, and the
+        # port must free for the next stage/run
+        tracer.close()
+        if obs.server is not None:
+            obs.server.close()

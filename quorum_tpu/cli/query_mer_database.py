@@ -1,37 +1,72 @@
 """query_mer_database — print count+quality for given mers
-(reference: src/query_mer_database.cc:7-24; same output format)."""
+(reference: src/query_mer_database.cc:7-24; same output format).
+
+Telemetry (ISSUE 3 satellite): the same observability surface as the
+main CLIs — `--metrics` writes a final JSON with per-query counters
+(`mers_queried`/`mers_found`/`mers_bad_length`), and the
+`--metrics-port`/`--metrics-textfile`/`--trace-spans` block works
+identically. Stdout stays reference-identical.
+"""
 
 from __future__ import annotations
 
+import argparse
 import sys
 
 from ..io import db_format
 from ..ops import mer
+from .observability import add_observability_args, observability
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="query_mer_database",
+        description="Print count and quality flag for the given mers.",
+    )
+    add_observability_args(p, metrics=True)
+    p.add_argument("db", help="Mer database")
+    p.add_argument("mers", nargs="+", metavar="mer",
+                   help="Mers to look up")
+    return p
 
 
 def main(argv=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) < 2:
-        print(f"Usage: query_mer_database db mer ...", file=sys.stderr)
-        return 1
-    try:
-        state, meta, _ = db_format.read_db(argv[0], to_device=False)
-    except (RuntimeError, ValueError, OSError) as e:
-        print(str(e), file=sys.stderr)
-        return 1
-    k = meta.k
-    print(k)
-    for s in argv[1:]:
-        if len(s) != k:
-            print(f"{s}: wrong length (k={k})", file=sys.stderr)
-            continue
-        hi, lo = mer.pack_kmer(s)
-        chi, clo = mer.canonical_py(hi, lo, k)
-        v = db_format.db_lookup_np(state, meta, chi, clo)
-        canon = mer.unpack_kmer(chi, clo, k)
-        print(f"{s}:{canon} val:{v >> 1} qual:{v & 1}")
+    args = build_parser().parse_args(argv)
+    with observability(args.metrics, args.metrics_interval,
+                       port=args.metrics_port,
+                       textfile=args.metrics_textfile,
+                       live=args.metrics_live,
+                       trace_spans=args.trace_spans,
+                       stage="query_mer_database") as obs:
+        reg, tracer = obs.registry, obs.tracer
+        try:
+            with tracer.span("load_db"):
+                state, meta, _ = db_format.read_db(args.db,
+                                                   to_device=False)
+        except (RuntimeError, ValueError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            obs.status = "error"
+            return 1
+        k = meta.k
+        reg.set_meta(db=args.db, k=k)
+        print(k)
+        for s in args.mers:
+            if len(s) != k:
+                print(f"{s}: wrong length (k={k})", file=sys.stderr)
+                reg.counter("mers_bad_length").inc()
+                continue
+            with tracer.span("query"):
+                hi, lo = mer.pack_kmer(s)
+                chi, clo = mer.canonical_py(hi, lo, k)
+                v = db_format.db_lookup_np(state, meta, chi, clo)
+                canon = mer.unpack_kmer(chi, clo, k)
+            print(f"{s}:{canon} val:{v >> 1} qual:{v & 1}")
+            reg.counter("mers_queried").inc()
+            if int(v) >> 1 > 0:
+                reg.counter("mers_found").inc()
+            reg.heartbeat(stage="query_mer_database")
     return 0
 
 

@@ -156,11 +156,9 @@ def detect_min_q_char(path: str, max_reads: int = 1000) -> int:
 
 
 def main(argv=None) -> int:
-    import time
-
-    from ..telemetry import (registry_for, tracer_for,
-                             track_jax_compile_cache)
+    from ..telemetry import track_jax_compile_cache
     from ..utils.jaxcache import enable_cache
+    from .observability import observability
     cache_dir = enable_cache()
     args = build_parser().parse_args(argv)
     # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
@@ -173,300 +171,291 @@ def main(argv=None) -> int:
     # real registry even without --metrics; the in-process stage
     # registries self-register with the same live set, so one
     # endpoint/textfile carries driver + stage1 + stage2 under
-    # stage=... labels.
-    from ..telemetry import export as export_mod
-    reg = registry_for(args.metrics, args.metrics_interval,
-                       force=(args.metrics_port is not None
-                              or bool(args.metrics_textfile)))
-    track_jax_compile_cache(reg)
-    server = None
-    # the driver's own span file covers work done in the DRIVER
+    # stage=... labels. observability() keeps everything from the
+    # live-endpoint start on under one umbrella: an UNCAUGHT
+    # exception (the stage CLIs only catch RuntimeError; a busy
+    # --metrics-port raises OSError here) still frees the /metrics
+    # port and stamps the manifest status=error before propagating.
+    # The driver's own span file covers work done in the DRIVER
     # process (the shared read/pack producer) — the stages'
-    # in-device loops land in the forwarded .stage1/.stage2 files
-    driver_tracer = tracer_for(
-        _stage_path(args.trace_spans, "driver")
-        if args.trace_spans else None)
+    # in-device loops land in the forwarded .stage1/.stage2 files.
+    with observability(args.metrics, args.metrics_interval,
+                       port=args.metrics_port,
+                       textfile=args.metrics_textfile,
+                       trace_spans=(_stage_path(args.trace_spans, "driver")
+                                    if args.trace_spans else None)) as obs:
+        reg = obs.registry
+        track_jax_compile_cache(reg)
 
-    def finish(rc: int) -> int:
-        """Write the driver manifest and stop the live endpoint on
-        every exit past this point."""
-        if reg.enabled:
-            hits = reg.counter("jax_cache_hits").value
-            reqs = reg.counter("jax_cache_requests").value
-            reg.gauge("jax_cache_misses").set(max(0, reqs - hits))
-            reg.set_meta(status="ok" if rc == 0 else "error")
-            reg.write()
-        driver_tracer.close()
-        if server is not None:
-            server.close()
-        return rc
+        def _cache_gauges(reg_) -> None:
+            hits = reg_.counter("jax_cache_hits").value
+            reqs = reg_.counter("jax_cache_requests").value
+            reg_.gauge("jax_cache_misses").set(max(0, reqs - hits))
 
-    # everything from the live-endpoint start on runs under one
-    # umbrella: an UNCAUGHT exception (the stage CLIs only
-    # catch RuntimeError; a busy --metrics-port raises OSError here)
-    # must still free the /metrics port and stamp the manifest
-    # status=error before propagating
-    try:
-        server = export_mod.start_exposition(
-            reg, args.metrics_port, args.metrics_textfile,
-            period=args.metrics_interval)
-        if not re.match(r"^\d+[kMGT]?$", args.size):
-            print(f"Invalid size '{args.size}'. It must be a number, maybe "
-                  "followed by a suffix (like k, M, G for thousand, million "
-                  "and billion).", file=sys.stderr)
-            return finish(1)
-        if not args.reads:
-            print("No sequence files. See quorum --help.", file=sys.stderr)
-            return finish(1)
-        if args.paired_files and len(args.reads) % 2 != 0:
-            print("With --paired-files an even number of input files is "
-                  "required.", file=sys.stderr)
-            return finish(1)
+        obs.at_exit(_cache_gauges)
+        rc = _main_inner(args, reg, obs.tracer, cache_dir)
+        if rc != 0:
+            obs.status = "error"
+    return rc
 
-        import jax
-        if jax.process_count() > 1:
-            # the driver is single-controller by design: its build state is
-            # host-local and both stages write one output path. Multi-host
-            # = global mesh + parallel.tile_sharded fed by
-            # parallel.multihost (the stage CLIs refuse too, but the
-            # driver must refuse BEFORE handing them its own batches,
-            # which would bypass their checks).
-            print("quorum: multi-host runs require the sharded pipeline "
-                  "(parallel.tile_sharded + parallel.multihost); the "
-                  "driver is single-controller", file=sys.stderr)
-            return finish(1)
 
-        # per-stage observability paths (forward --metrics, --profile and
-        # --trace-spans consistently to both children, suffixed per
-        # stage; --metrics-textfile is shared — each stage's heartbeats
-        # atomically re-render the ONE file from all live registries)
-        m1 = _stage_path(args.metrics, "stage1") if args.metrics else None
-        m2 = _stage_path(args.metrics, "stage2") if args.metrics else None
-        p1 = os.path.join(args.profile, "stage1") if args.profile else None
-        p2 = os.path.join(args.profile, "stage2") if args.profile else None
-        ts1 = (_stage_path(args.trace_spans, "stage1")
-               if args.trace_spans else None)
-        ts2 = (_stage_path(args.trace_spans, "stage2")
-               if args.trace_spans else None)
-        if reg.enabled:
-            devs = jax.devices()
-            reg.set_meta(
-                driver="quorum", version=VERSION,
-                config={k: "" if v is None else str(v)
-                        for k, v in vars(args).items()},
-                jax_backend=jax.default_backend(),
-                device_count=len(devs),
-                device_kinds=sorted({d.device_kind for d in devs}),
-                process_count=jax.process_count(),
-                compile_cache_dir=str(cache_dir),
-                metrics_stage1=m1, metrics_stage2=m2,
-            )
+def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
+    import time
 
-        min_q_char = args.min_q_char
-        if min_q_char is None:
-            try:
-                min_q_char = detect_min_q_char(args.reads[0])
-            except (RuntimeError, ValueError, OSError) as e:
-                print(str(e), file=sys.stderr)
-                return finish(1)
-        vlog("Using min quality char ", min_q_char, " (+", args.min_quality, ")")
+    if not re.match(r"^\d+[kMGT]?$", args.size):
+        print(f"Invalid size '{args.size}'. It must be a number, maybe "
+              "followed by a suffix (like k, M, G for thousand, million "
+              "and billion).", file=sys.stderr)
+        return 1
+    if not args.reads:
+        print("No sequence files. See quorum --help.", file=sys.stderr)
+        return 1
+    if args.paired_files and len(args.reads) % 2 != 0:
+        print("With --paired-files an even number of input files is "
+              "required.", file=sys.stderr)
+        return 1
 
-        # CPU-count autodetect, like the reference driver's /proc/cpuinfo
-        # scan (quorum.in:110-120); forwarded to both stages' host decode
-        threads = args.threads if args.threads else (os.cpu_count() or 1)
-        vlog("Using ", threads, " threads for host decode")
+    import jax
+    if jax.process_count() > 1:
+        # the driver is single-controller by design: its build state is
+        # host-local and both stages write one output path. Multi-host
+        # = global mesh + parallel.tile_sharded fed by
+        # parallel.multihost (the stage CLIs refuse too, but the
+        # driver must refuse BEFORE handing them its own batches,
+        # which would bypass their checks).
+        print("quorum: multi-host runs require the sharded pipeline "
+              "(parallel.tile_sharded + parallel.multihost); the "
+              "driver is single-controller", file=sys.stderr)
+        return 1
 
-        # Stage 1: quorum_create_database -s SIZE -m K -q char+qual -t N
-        # -b 7 (quorum.in:154-160)
-        db_file = args.prefix + "_mer_database.jf"
-        cdb_argv = ["-s", args.size, "-m", str(args.kmer_len),
-                    "-q", str(min_q_char + args.min_quality), "-b", "7",
-                    "-t", str(threads),
-                    "-o", db_file, "--batch-size", str(args.batch_size)]
-        if m1 is not None:
-            cdb_argv.extend(["--metrics", m1,
-                             "--metrics-interval", str(args.metrics_interval)])
-        if p1 is not None:
-            cdb_argv.extend(["--profile", p1])
-        if ts1 is not None:
-            cdb_argv.extend(["--trace-spans", ts1])
-        if args.metrics_textfile:
-            cdb_argv.extend(["--metrics-textfile", args.metrics_textfile])
-        if args.metrics_port is not None:
-            # the driver owns the endpoint; the stage must still run a
-            # real registry so its counters appear on it
-            cdb_argv.append("--metrics-live")
-        if args.debug:
-            cdb_argv.append("-v")
-            print("+ quorum_create_database " + " ".join(cdb_argv)
-                  + " " + " ".join(args.reads), file=sys.stderr)
+    # per-stage observability paths (forward --metrics, --profile and
+    # --trace-spans consistently to both children, suffixed per
+    # stage; --metrics-textfile is shared — each stage's heartbeats
+    # atomically re-render the ONE file from all live registries)
+    m1 = _stage_path(args.metrics, "stage1") if args.metrics else None
+    m2 = _stage_path(args.metrics, "stage2") if args.metrics else None
+    p1 = os.path.join(args.profile, "stage1") if args.profile else None
+    p2 = os.path.join(args.profile, "stage2") if args.profile else None
+    ts1 = (_stage_path(args.trace_spans, "stage1")
+           if args.trace_spans else None)
+    ts2 = (_stage_path(args.trace_spans, "stage2")
+           if args.trace_spans else None)
+    if reg.enabled:
+        devs = jax.devices()
+        reg.set_meta(
+            driver="quorum", version=VERSION,
+            config={k: "" if v is None else str(v)
+                    for k, v in vars(args).items()},
+            jax_backend=jax.default_backend(),
+            device_count=len(devs),
+            device_kinds=sorted({d.device_kind for d in devs}),
+            process_count=jax.process_count(),
+            compile_cache_dir=str(cache_dir),
+            metrics_stage1=m1, metrics_stage2=m2,
+        )
 
-        # Parse + pack the reads ONCE for both stages (unpaired mode):
-        # stage 1 consumes this generator; every yielded (batch, packed)
-        # pair is retained (packed with both stages' quality thresholds)
-        # and replayed into stage 2, sparing the second disk parse + H2D
-        # re-pack that the two-process reference gets from the page cache.
-        reads_cache: list = []
-        cache_state = {"bytes": 0, "ok": not args.paired_files}
-
-        def _cached_batches():
-            from ..utils.pipeline import prefetch
-            t1 = min_q_char + args.min_quality
-            src = fastq.read_batches(args.reads, args.batch_size,
-                                     threads=threads)
-
-            def _pack_and_keep(it):
-                import numpy as _np
-                cap_bytes = _replay_cap()  # resolve once, not per batch
-                for b in it:
-                    # SEPARATE single-plane wires per stage: a combined
-                    # two-plane wire would give the driver's executables
-                    # different jit keys (the threshold tuple is static)
-                    # than the standalone stage CLIs compile — measured
-                    # as minutes of needless recompile per driver run.
-                    pk1 = packing.pack_reads(b.codes, b.quals, b.lengths,
-                                             thresholds=(t1,))
-                    item = (dataclasses.replace(b, quals=None),
-                            pk1.compact())
-                    if cache_state["ok"]:
-                        # the cached stage-2 wire shares pk1's code/N
-                        # planes and adds only the EC qual plane; stage 2
-                        # never touches host quals, so the cached batch
-                        # drops them. Count retained headers too (~90 B
-                        # of str + list-slot overhead each).
-                        pk2 = packing.PackedReads(
-                            pcodes=pk1.pcodes, nmask=pk1.nmask,
-                            hq={_EC_QUAL_CUTOFF: _np.packbits(
-                                _np.asarray(b.quals, _np.uint8)
-                                >= _EC_QUAL_CUTOFF,
-                                axis=1, bitorder="little")},
-                            lengths=pk1.lengths,
-                            length=pk1.length).compact()
-                        cached = (item[0], pk2)
-                        cache_state["bytes"] += (
-                            b.codes.nbytes + pk2.nbytes
-                            + sum(len(h) + 90 for h in b.headers))
-                        if cache_state["bytes"] > cap_bytes:
-                            cache_state["ok"] = False
-                            reads_cache.clear()
-                        else:
-                            reads_cache.append(cached)
-                    yield item
-            return prefetch(_pack_and_keep(src),
-                            metrics=reg if reg.enabled else None,
-                            name="reads_producer",
-                            tracer=driver_tracer)
-
-        handoff: dict = {}
-        t_s1 = time.perf_counter()
-        if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff,
-                        batches=_cached_batches()) != 0:
-            print("Creating the mer database failed. Most likely the size "
-                  "passed to the -s switch is too small.", file=sys.stderr)
-            return finish(1)
-        if reg.enabled:
-            s1_s = round(time.perf_counter() - t_s1, 3)
-            reg.gauge("stage1_seconds").set(s1_s)
-            reg.event("stage_done", stage="create_database", seconds=s1_s)
-        prepacked = reads_cache if cache_state["ok"] and reads_cache else None
-
-        # Stage 2: error correction (quorum.in:162-231)
-        ec_common = ["--batch-size", str(args.batch_size),
-                     "-t", str(threads)]
-        for flag, val in (("--min-count", args.min_count),
-                          ("--skip", args.skip),
-                          ("--good", args.anchor),
-                          ("--anchor-count", args.anchor_count),
-                          ("--window", args.window),
-                          ("--error", args.error),
-                          ("--homo-trim", args.homo_trim),
-                          ("--contaminant", args.contaminant)):
-            if val is not None:
-                ec_common.extend([flag, str(val)])
-        if args.trim_contaminant:
-            ec_common.append("--trim-contaminant")
-        no_discard = args.no_discard or args.paired_files
-        if no_discard:
-            ec_common.append("--no-discard")
-        if args.debug:
-            ec_common.append("-v")
-        if m2 is not None:
-            ec_common.extend(["--metrics", m2,
-                              "--metrics-interval", str(args.metrics_interval)])
-        if p2 is not None:
-            ec_common.extend(["--profile", p2])
-        if ts2 is not None:
-            ec_common.extend(["--trace-spans", ts2])
-        if args.metrics_textfile:
-            ec_common.extend(["--metrics-textfile", args.metrics_textfile])
-        if args.metrics_port is not None:
-            ec_common.append("--metrics-live")
-
-        def record_stage2(t0: float) -> None:
-            if reg.enabled:
-                s2_s = round(time.perf_counter() - t0, 3)
-                reg.gauge("stage2_seconds").set(s2_s)
-                reg.event("stage_done", stage="error_correct", seconds=s2_s)
-
-        if not args.paired_files:
-            ec_argv = ec_common + ["-o", args.prefix, db_file] + list(args.reads)
-            if args.debug:
-                print("+ quorum_error_correct_reads " + " ".join(ec_argv),
-                      file=sys.stderr)
-            t_s2 = time.perf_counter()
-            if ec_cli.main(ec_argv, db=handoff.get("db"),
-                           prepacked=prepacked) != 0:
-                print("Error correction failed", file=sys.stderr)
-                return finish(1)
-            record_stage2(t_s2)
-            return finish(0)
-
-        # Paired mode: merge | correct | split, in-process
-        # (quorum.in:172-231). --no-discard is forced so every input read
-        # yields exactly one output record and pairing survives the split.
-        if args.debug:
-            print(f"+ merge_mate_pairs {' '.join(args.reads)} | "
-                  f"quorum_error_correct_reads {' '.join(ec_common)} "
-                  f"{db_file} /dev/fd/0 | split_mate_pairs {args.prefix}",
-                  file=sys.stderr)
-        opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
-                         batch_size=args.batch_size, threads=threads,
-                         profile=p2, metrics=m2,
-                         metrics_interval=args.metrics_interval,
-                         metrics_textfile=args.metrics_textfile,
-                         metrics_force=args.metrics_port is not None,
-                         trace_spans=ts2)
-        kwargs = dict(no_discard=True,
-                      trim_contaminant=args.trim_contaminant)
-        for key, val in (("min_count", args.min_count), ("skip", args.skip),
-                         ("good", args.anchor),
-                         ("anchor_count", args.anchor_count),
-                         ("window", args.window), ("error", args.error),
-                         ("homo_trim", args.homo_trim)):
-            if val is not None:
-                kwargs[key] = val
-        t_s2 = time.perf_counter()
+    min_q_char = args.min_q_char
+    if min_q_char is None:
         try:
-            run_error_correct(db_file, [], None, opts,
-                              records=merge_records(args.reads),
-                              db=handoff.get("db"), **kwargs)
+            min_q_char = detect_min_q_char(args.reads[0])
         except (RuntimeError, ValueError, OSError) as e:
             print(str(e), file=sys.stderr)
+            return 1
+    vlog("Using min quality char ", min_q_char, " (+", args.min_quality, ")")
+
+    # CPU-count autodetect, like the reference driver's /proc/cpuinfo
+    # scan (quorum.in:110-120); forwarded to both stages' host decode
+    threads = args.threads if args.threads else (os.cpu_count() or 1)
+    vlog("Using ", threads, " threads for host decode")
+
+    # Stage 1: quorum_create_database -s SIZE -m K -q char+qual -t N
+    # -b 7 (quorum.in:154-160)
+    db_file = args.prefix + "_mer_database.jf"
+    cdb_argv = ["-s", args.size, "-m", str(args.kmer_len),
+                "-q", str(min_q_char + args.min_quality), "-b", "7",
+                "-t", str(threads),
+                "-o", db_file, "--batch-size", str(args.batch_size)]
+    if m1 is not None:
+        cdb_argv.extend(["--metrics", m1,
+                         "--metrics-interval", str(args.metrics_interval)])
+    if p1 is not None:
+        cdb_argv.extend(["--profile", p1])
+    if ts1 is not None:
+        cdb_argv.extend(["--trace-spans", ts1])
+    if args.metrics_textfile:
+        cdb_argv.extend(["--metrics-textfile", args.metrics_textfile])
+    if args.metrics_port is not None:
+        # the driver owns the endpoint; the stage must still run a
+        # real registry so its counters appear on it
+        cdb_argv.append("--metrics-live")
+    if args.debug:
+        cdb_argv.append("-v")
+        print("+ quorum_create_database " + " ".join(cdb_argv)
+              + " " + " ".join(args.reads), file=sys.stderr)
+
+    # Parse + pack the reads ONCE for both stages (unpaired mode):
+    # stage 1 consumes this generator; every yielded (batch, packed)
+    # pair is retained (packed with both stages' quality thresholds)
+    # and replayed into stage 2, sparing the second disk parse + H2D
+    # re-pack that the two-process reference gets from the page cache.
+    reads_cache: list = []
+    cache_state = {"bytes": 0, "ok": not args.paired_files}
+
+    def _cached_batches():
+        from ..utils.pipeline import prefetch
+        t1 = min_q_char + args.min_quality
+        src = fastq.read_batches(args.reads, args.batch_size,
+                                 threads=threads)
+
+        def _pack_and_keep(it):
+            import numpy as _np
+            cap_bytes = _replay_cap()  # resolve once, not per batch
+            for b in it:
+                # SEPARATE single-plane wires per stage: a combined
+                # two-plane wire would give the driver's executables
+                # different jit keys (the threshold tuple is static)
+                # than the standalone stage CLIs compile — measured
+                # as minutes of needless recompile per driver run.
+                pk1 = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                         thresholds=(t1,))
+                item = (dataclasses.replace(b, quals=None),
+                        pk1.compact())
+                if cache_state["ok"]:
+                    # the cached stage-2 wire shares pk1's code/N
+                    # planes and adds only the EC qual plane; stage 2
+                    # never touches host quals, so the cached batch
+                    # drops them. Count retained headers too (~90 B
+                    # of str + list-slot overhead each).
+                    pk2 = packing.PackedReads(
+                        pcodes=pk1.pcodes, nmask=pk1.nmask,
+                        hq={_EC_QUAL_CUTOFF: _np.packbits(
+                            _np.asarray(b.quals, _np.uint8)
+                            >= _EC_QUAL_CUTOFF,
+                            axis=1, bitorder="little")},
+                        lengths=pk1.lengths,
+                        length=pk1.length).compact()
+                    cached = (item[0], pk2)
+                    cache_state["bytes"] += (
+                        b.codes.nbytes + pk2.nbytes
+                        + sum(len(h) + 90 for h in b.headers))
+                    if cache_state["bytes"] > cap_bytes:
+                        cache_state["ok"] = False
+                        reads_cache.clear()
+                    else:
+                        reads_cache.append(cached)
+                yield item
+        return prefetch(_pack_and_keep(src),
+                        metrics=reg if reg.enabled else None,
+                        name="reads_producer",
+                        tracer=driver_tracer)
+
+    handoff: dict = {}
+    t_s1 = time.perf_counter()
+    if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff,
+                    batches=_cached_batches()) != 0:
+        print("Creating the mer database failed. Most likely the size "
+              "passed to the -s switch is too small.", file=sys.stderr)
+        return 1
+    if reg.enabled:
+        s1_s = round(time.perf_counter() - t_s1, 3)
+        reg.gauge("stage1_seconds").set(s1_s)
+        reg.event("stage_done", stage="create_database", seconds=s1_s)
+    prepacked = reads_cache if cache_state["ok"] and reads_cache else None
+
+    # Stage 2: error correction (quorum.in:162-231)
+    ec_common = ["--batch-size", str(args.batch_size),
+                 "-t", str(threads)]
+    for flag, val in (("--min-count", args.min_count),
+                      ("--skip", args.skip),
+                      ("--good", args.anchor),
+                      ("--anchor-count", args.anchor_count),
+                      ("--window", args.window),
+                      ("--error", args.error),
+                      ("--homo-trim", args.homo_trim),
+                      ("--contaminant", args.contaminant)):
+        if val is not None:
+            ec_common.extend([flag, str(val)])
+    if args.trim_contaminant:
+        ec_common.append("--trim-contaminant")
+    no_discard = args.no_discard or args.paired_files
+    if no_discard:
+        ec_common.append("--no-discard")
+    if args.debug:
+        ec_common.append("-v")
+    if m2 is not None:
+        ec_common.extend(["--metrics", m2,
+                          "--metrics-interval", str(args.metrics_interval)])
+    if p2 is not None:
+        ec_common.extend(["--profile", p2])
+    if ts2 is not None:
+        ec_common.extend(["--trace-spans", ts2])
+    if args.metrics_textfile:
+        ec_common.extend(["--metrics-textfile", args.metrics_textfile])
+    if args.metrics_port is not None:
+        ec_common.append("--metrics-live")
+
+    def record_stage2(t0: float) -> None:
+        if reg.enabled:
+            s2_s = round(time.perf_counter() - t0, 3)
+            reg.gauge("stage2_seconds").set(s2_s)
+            reg.event("stage_done", stage="error_correct", seconds=s2_s)
+
+    if not args.paired_files:
+        ec_argv = ec_common + ["-o", args.prefix, db_file] + list(args.reads)
+        if args.debug:
+            print("+ quorum_error_correct_reads " + " ".join(ec_argv),
+                  file=sys.stderr)
+        t_s2 = time.perf_counter()
+        if ec_cli.main(ec_argv, db=handoff.get("db"),
+                       prepacked=prepacked) != 0:
             print("Error correction failed", file=sys.stderr)
-            return finish(1)
+            return 1
         record_stage2(t_s2)
-        fa_path = args.prefix + ".fa"
-        try:
-            with open(fa_path, "r") as inp:
-                split_stream(inp, args.prefix)
-        except OSError as e:
-            print(str(e), file=sys.stderr)
-            return finish(1)
-        os.remove(fa_path)
-        return finish(0)
-    except BaseException:
-        finish(1)
-        raise
+        return 0
+
+    # Paired mode: merge | correct | split, in-process
+    # (quorum.in:172-231). --no-discard is forced so every input read
+    # yields exactly one output record and pairing survives the split.
+    if args.debug:
+        print(f"+ merge_mate_pairs {' '.join(args.reads)} | "
+              f"quorum_error_correct_reads {' '.join(ec_common)} "
+              f"{db_file} /dev/fd/0 | split_mate_pairs {args.prefix}",
+              file=sys.stderr)
+    opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
+                     batch_size=args.batch_size, threads=threads,
+                     profile=p2, metrics=m2,
+                     metrics_interval=args.metrics_interval,
+                     metrics_textfile=args.metrics_textfile,
+                     metrics_force=args.metrics_port is not None,
+                     trace_spans=ts2)
+    kwargs = dict(no_discard=True,
+                  trim_contaminant=args.trim_contaminant)
+    for key, val in (("min_count", args.min_count), ("skip", args.skip),
+                     ("good", args.anchor),
+                     ("anchor_count", args.anchor_count),
+                     ("window", args.window), ("error", args.error),
+                     ("homo_trim", args.homo_trim)):
+        if val is not None:
+            kwargs[key] = val
+    t_s2 = time.perf_counter()
+    try:
+        run_error_correct(db_file, [], None, opts,
+                          records=merge_records(args.reads),
+                          db=handoff.get("db"), **kwargs)
+    except (RuntimeError, ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        print("Error correction failed", file=sys.stderr)
+        return 1
+    record_stage2(t_s2)
+    fa_path = args.prefix + ".fa"
+    try:
+        with open(fa_path, "r") as inp:
+            split_stream(inp, args.prefix)
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    os.remove(fa_path)
+    return 0
 
 
 if __name__ == "__main__":

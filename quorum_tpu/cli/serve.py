@@ -1,0 +1,205 @@
+"""quorum-serve — the persistent correction service (ISSUE 3).
+
+Loads a stage-1 mer database once, warms the corrector, and serves
+`POST /correct` with dynamic batching until drained (SIGTERM or
+`POST /quiesce`). The correction flags mirror
+`quorum_error_correct_reads` so a serve deployment and an offline run
+of the same flags produce byte-identical corrections; the final
+metrics document lands through the same observability() lifecycle as
+every other CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from ..utils import vlog as vlog_mod
+from ..utils.vlog import vlog
+from .observability import add_observability_args, observability
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="quorum-serve",
+        description="Serve quorum error correction over HTTP: POST "
+                    "FASTQ text to /correct, scrape /metrics, drain "
+                    "with SIGTERM or POST /quiesce.",
+    )
+    # correction surface (quorum_error_correct_reads parity)
+    p.add_argument("-m", "--min-count", type=int, default=1,
+                   help='Minimum count for a k-mer to be considered "good"')
+    p.add_argument("-s", "--skip", type=int, default=1,
+                   help="Number of bases to skip for start k-mer")
+    p.add_argument("-g", "--good", type=int, default=2,
+                   help="Number of good k-mer in a row for anchor")
+    p.add_argument("-a", "--anchor-count", type=int, default=3,
+                   help="Minimum count for an anchor k-mer")
+    p.add_argument("-w", "--window", type=int, default=10,
+                   help="Size of window")
+    p.add_argument("-e", "--error", type=int, default=3,
+                   help="Maximum number of error in a window")
+    p.add_argument("--contaminant", metavar="path",
+                   help="Contaminant sequences (fasta/fastq) or k-mer "
+                        "database")
+    p.add_argument("--trim-contaminant", action="store_true",
+                   help="Trim reads containing contaminated k-mers "
+                        "instead of discarding")
+    p.add_argument("--homo-trim", type=int, default=None,
+                   help="Trim homo-polymer run at the 3' end")
+    p.add_argument("-M", "--no-mmap", action="store_true",
+                   help="Do not memory map the input mer database")
+    p.add_argument("--apriori-error-rate", type=float, default=0.01,
+                   help="Probability of a base being an error")
+    p.add_argument("--poisson-threshold", type=float, default=1e-6,
+                   help="Error probability threshold in Poisson test")
+    p.add_argument("-p", "--cutoff", type=int, default=None,
+                   help="Poisson cutoff when there are multiple choices")
+    p.add_argument("-q", "--qual-cutoff-value", type=int, default=None,
+                   help="Any base above with quality equal or greater is "
+                        "untouched when there are multiple choices")
+    p.add_argument("-Q", "--qual-cutoff-char", default=None,
+                   help="Any base above with quality equal or greater is "
+                        "untouched when there are multiple choices")
+    p.add_argument("-d", "--no-discard", action="store_true",
+                   help="Do not discard reads, output a single N")
+    p.add_argument("-v", "--verbose", action="store_true", help="Be verbose")
+    # serving surface
+    p.add_argument("--host", default="127.0.0.1",
+                   help="Bind address (default loopback; 0.0.0.0 to "
+                        "serve off-machine)")
+    p.add_argument("--port", type=int, default=8100,
+                   help="Listen port (default 8100; 0 = ephemeral)")
+    p.add_argument("--max-batch", type=int, default=1024,
+                   help="Reads per device batch; also the padded row "
+                        "capacity every batch compiles at (default 1024)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="How long the dispatcher waits to coalesce "
+                        "more requests into a batch (default 5)")
+    p.add_argument("--queue-requests", type=int, default=64,
+                   help="Bounded request-queue capacity; a full queue "
+                        "answers 429 + Retry-After (default 64)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="Default per-request deadline (overridable "
+                        "per request); expired requests answer 504")
+    p.add_argument("--drain-grace-s", type=float, default=30.0,
+                   help="Max seconds a drain waits for in-flight "
+                        "batches (default 30)")
+    p.add_argument("--warmup-lengths", metavar="L1,L2,...", default=None,
+                   help="Comma-separated read lengths to pre-compile "
+                        "before listening (one device step per "
+                        "length bucket)")
+    # observability (same surface as the other CLIs; --metrics
+    # writes the final document on drain)
+    add_observability_args(p, metrics=True)
+    p.add_argument("db", help="Mer database")
+    return p
+
+
+def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
+    args = build_parser().parse_args(argv)
+    # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
+    vlog_mod.verbose = args.verbose or vlog_mod.verbose
+
+    if args.qual_cutoff_char is not None and args.qual_cutoff_value is not None:
+        print("Switches -q and -Q are conflicting.", file=sys.stderr)
+        return 1
+    if args.qual_cutoff_char is not None and (
+            len(args.qual_cutoff_char) != 1
+            or ord(args.qual_cutoff_char) > 127):
+        print("The qual-cutoff-char must be one ASCII character.",
+              file=sys.stderr)
+        return 1
+    if args.qual_cutoff_value is not None and not (
+            0 <= args.qual_cutoff_value <= 127):
+        print("The qual-cutoff-value must be in the range 0-127.",
+              file=sys.stderr)
+        return 1
+    qual_cutoff = (
+        ord(args.qual_cutoff_char) if args.qual_cutoff_char is not None
+        else args.qual_cutoff_value if args.qual_cutoff_value is not None
+        else 127  # numeric_limits<char>::max()
+    )
+    warmup_lengths: list[int] = []
+    if args.warmup_lengths:
+        try:
+            warmup_lengths = [int(x) for x in
+                              args.warmup_lengths.split(",") if x]
+        except ValueError:
+            print(f"Bad --warmup-lengths {args.warmup_lengths!r}",
+                  file=sys.stderr)
+            return 1
+
+    # the service is its own /metrics endpoint, so the registry must
+    # be live even without --metrics (live=True); --metrics-port
+    # additionally starts the standalone exposition endpoint the
+    # other CLIs use, for scrapers that must not share the serving
+    # port's queue
+    with observability(args.metrics, args.metrics_interval,
+                       port=args.metrics_port,
+                       textfile=args.metrics_textfile,
+                       live=True, trace_spans=args.trace_spans,
+                       stage="serve") as obs:
+        try:
+            rc = _serve(args, qual_cutoff, warmup_lengths, obs)
+        except (RuntimeError, ValueError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            obs.status = "error"
+            return 1
+        if rc != 0:
+            obs.status = "error"
+        return rc
+
+
+def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
+    from ..serve import CorrectionEngine, CorrectionServer, DynamicBatcher
+
+    reg = obs.registry
+    engine = CorrectionEngine(
+        args.db, cutoff=args.cutoff, qual_cutoff=qual_cutoff,
+        skip=args.skip, good=args.good, anchor_count=args.anchor_count,
+        min_count=args.min_count, window=args.window, error=args.error,
+        homo_trim=args.homo_trim, trim_contaminant=args.trim_contaminant,
+        no_discard=args.no_discard, contaminant=args.contaminant,
+        apriori_error_rate=args.apriori_error_rate,
+        poisson_threshold=args.poisson_threshold, no_mmap=args.no_mmap,
+        rows=args.max_batch, registry=reg, tracer=obs.tracer)
+    if warmup_lengths:
+        vlog("Warming ", len(warmup_lengths), " length buckets")
+        engine.warmup(warmup_lengths)
+    batcher = DynamicBatcher(engine, max_batch=args.max_batch,
+                             max_wait_ms=args.max_wait_ms,
+                             queue_requests=args.queue_requests,
+                             registry=reg)
+    server = CorrectionServer(batcher, host=args.host, port=args.port,
+                              deadline_ms=args.deadline_ms, registry=reg,
+                              drain_grace_s=args.drain_grace_s)
+
+    def _sigterm(_signum, _frame):
+        vlog("SIGTERM: draining")
+        server.initiate_drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (in-process embedding/tests)
+    print(f"quorum-serve: listening on {args.host}:{server.port} "
+          f"(max-batch {args.max_batch}, queue {args.queue_requests})",
+          file=sys.stderr)
+    reg.heartbeat(stage="serve", port=server.port)
+    try:
+        server.serve_until_drained()
+    except BaseException:
+        # an unexpected failure must still free the port; the
+        # observability teardown stamps the error document
+        server.close()
+        raise
+    vlog("Drained; writing final metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,6 +193,8 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
     import queue
     import threading
 
+    from ..utils.pipeline import put_or_stop as _put_or_stop
+
     qs = [queue.Queue(maxsize=4) for _ in paths]
     stop = threading.Event()
     # workers CLAIM file indices in order (not one pre-pinned file
@@ -203,16 +205,11 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
     claim_lock = threading.Lock()
 
     def put_or_stop(i, item) -> bool:
-        """Stop-aware bounded put; False if the consumer went away
-        (an unbounded put here would strand the worker forever on a
-        full queue after the generator is abandoned)."""
-        while not stop.is_set():
-            try:
-                qs[i].put(item, timeout=0.2)
-                return True
-            except queue.Full:
-                continue
-        return False
+        """Stop-aware bounded put (the shared pipeline helper); False
+        if the consumer went away — an unbounded put here would
+        strand the worker forever on a full queue after the generator
+        is abandoned."""
+        return _put_or_stop(qs[i], item, stop)
 
     def worker():
         while not stop.is_set():

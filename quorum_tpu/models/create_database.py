@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from ..io import fastq, db_format, packing
 from ..ops import ctable, mer
 from ..telemetry import NULL as NULL_METRICS
-from ..telemetry import NULL_TRACER
+from ..telemetry import NULL_TRACER, observe_dispatch_wait
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -157,13 +157,8 @@ def build_database(
                     t1 = time.perf_counter()
                     full = bool(full)
                     t2 = time.perf_counter()
-                timer.add_time("insert_dispatch", t1 - t0)
-                timer.add_time("insert_wait", t2 - t1)
-                if reg.enabled:
-                    reg.histogram("insert_dispatch_us").observe(
-                        int((t1 - t0) * 1e6))
-                    reg.histogram("insert_wait_us").observe(
-                        int((t2 - t1) * 1e6))
+                observe_dispatch_wait(reg, "insert", t0, t1, t2,
+                                      timer=timer)
                 if full:
                     pending = jnp.logical_and(valid,
                                               jnp.logical_not(placed))

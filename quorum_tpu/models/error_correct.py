@@ -34,8 +34,7 @@ import jax
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
-from ..telemetry import registry_for, tracer_for
-from ..telemetry import export as export_mod
+from ..telemetry import observe_dispatch_wait
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -52,6 +51,52 @@ REASON_SLUGS = {
     ERROR_NO_STARTING_MER: "no_anchor",
     ERROR_HOMOPOLYMER: "homopolymer",
 }
+
+
+def render_result(hdr: str, r, cfg: ECConfig,
+                  outcome: dict | None = None) -> tuple[str, str]:
+    """One read's exact output surfaces: the `.fa` text and `.log`
+    text the reference writes for result `r` (error_correct_reads.cc
+    :246-341; empty strings where the read contributes nothing to a
+    channel). THE single rendering — the offline CLI loop and the
+    serve engine both go through here, which is what makes
+    `POST /correct` byte-identical to `quorum_error_correct_reads` by
+    construction. `outcome`, when given, accumulates the per-read
+    outcome tallies (err_log.hpp semantics) that feed the telemetry
+    counters: keys subs/t3/t5/hist/skips, as built by
+    `new_outcome()`."""
+    if r.ok:
+        if outcome is not None:
+            ns = r.fwd_log.count(":sub:") + r.bwd_log.count(":sub:")
+            outcome["subs"] += ns
+            outcome["t3"] += r.fwd_log.count(":3_trunc")
+            outcome["t5"] += r.bwd_log.count(":5_trunc")
+            outcome["hist"][ns] = outcome["hist"].get(ns, 0) + 1
+        return f">{hdr} {r.fwd_log} {r.bwd_log}\n{r.seq}\n", ""
+    if outcome is not None:
+        slug = REASON_SLUGS.get(r.error, "other")
+        outcome["skips"][slug] = outcome["skips"].get(slug, 0) + 1
+    fa = f">{hdr}\nN\n" if cfg.no_discard else ""
+    return fa, f"Skipped {hdr}: {r.error}\n"
+
+
+def new_outcome() -> dict:
+    """A fresh per-read outcome tally for `render_result`."""
+    return {"subs": 0, "t3": 0, "t5": 0, "hist": {}, "skips": {}}
+
+
+def record_outcome(reg, outcome: dict) -> None:
+    """Feed one outcome tally into the registry's counters — shared
+    by the offline drain loop and the serve engine so both report the
+    same metric names."""
+    reg.counter("substitutions").inc(outcome["subs"])
+    reg.counter("truncations_3p").inc(outcome["t3"])
+    reg.counter("truncations_5p").inc(outcome["t5"])
+    hist = reg.histogram("substitutions_per_read")
+    for v, n in outcome["hist"].items():
+        hist.observe(v, n)
+    for slug, n in outcome["skips"].items():
+        reg.counter(f"skipped_{slug}").inc(n)
 
 
 def pack_for_stage2(batch: fastq.ReadBatch, cfg: ECConfig):
@@ -157,41 +202,28 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     # (--metrics-port/--metrics-textfile) forces a real registry even
     # without a final-JSON path; --trace-spans adds the hierarchical
     # span tracer (JSONL + Chrome trace, TraceAnnotation mirror).
-    reg = registry_for(opts.metrics, opts.metrics_interval,
-                       force=(opts.metrics_port is not None
-                              or bool(opts.metrics_textfile)
-                              or opts.metrics_force))
-    reg.set_meta(stage="error_correct", batch_size=opts.batch_size,
-                 no_discard=bool(no_discard))
-    tracer = tracer_for(opts.trace_spans)
-    server = None
-    try:
-        # endpoint/textfile start INSIDE the umbrella: a busy port
-        # must still land the error document below
-        server = export_mod.start_exposition(
-            reg, opts.metrics_port, opts.metrics_textfile,
-            period=opts.metrics_interval)
-        return _run_ec(db_path, sequences, cfg_in, opts, reg, tracer,
+    # observability() owns the whole lifecycle: exposition starts
+    # inside its umbrella (a busy port still lands the error
+    # document), a failed run stamps status=error + writes, and the
+    # span file / endpoint close on every exit. The success path
+    # writes status=ok itself at the end of _run_ec, which the
+    # teardown detects and leaves alone.
+    from ..cli.observability import observability
+    with observability(opts.metrics, opts.metrics_interval,
+                       port=opts.metrics_port,
+                       textfile=opts.metrics_textfile,
+                       live=opts.metrics_force,
+                       trace_spans=opts.trace_spans,
+                       stage="error_correct", batch_size=opts.batch_size,
+                       no_discard=bool(no_discard)) as obs:
+        return _run_ec(db_path, sequences, cfg_in, opts, obs.registry,
+                       obs.tracer,
                        qual_cutoff=qual_cutoff, skip=skip, good=good,
                        anchor_count=anchor_count, min_count=min_count,
                        window=window, error=error, homo_trim=homo_trim,
                        trim_contaminant=trim_contaminant,
                        no_discard=no_discard, records=records, db=db,
                        prepacked=prepacked)
-    except BaseException:
-        # a failed run must still land its metrics document (the
-        # success path writes status=ok at the end of _run_ec)
-        if reg.enabled:
-            reg.set_meta(status="error")
-            reg.write()
-        raise
-    finally:
-        # span + endpoint teardown on EVERY exit: the Chrome trace of
-        # an interrupted run is exactly when it's needed, and the
-        # port must free for the next stage/run
-        tracer.close()
-        if server is not None:
-            server.close()
 
 
 def _run_ec(db_path: str, sequences: Sequence[str],
@@ -301,32 +333,19 @@ def _run_ec(db_path: str, sequences: Sequence[str],
             # per-read outcome tallies (err_log.hpp semantics, decoded
             # from the rendered entry strings so counters are exactly
             # what the .fa/.log outputs record); skipped when metrics
-            # are off — the branch below never runs
-            outcome = ({"subs": 0, "t3": 0, "t5": 0, "hist": {},
-                        "skips": {}} if count_outcomes else None)
+            # are off — render_result never sees an outcome dict
+            outcome = new_outcome() if count_outcomes else None
             for hdr, r in zip(batch.headers, results):
+                fa, lg = render_result(hdr, r, cfg, outcome)
                 if r.ok:
-                    fa_parts.append(
-                        f">{hdr} {r.fwd_log} {r.bwd_log}\n{r.seq}\n")
                     n_corr += 1
                     bases_out += r.end - r.start
-                    if outcome is not None:
-                        ns = (r.fwd_log.count(":sub:")
-                              + r.bwd_log.count(":sub:"))
-                        outcome["subs"] += ns
-                        outcome["t3"] += r.fwd_log.count(":3_trunc")
-                        outcome["t5"] += r.bwd_log.count(":5_trunc")
-                        outcome["hist"][ns] = (
-                            outcome["hist"].get(ns, 0) + 1)
                 else:
-                    log_parts.append(f"Skipped {hdr}: {r.error}\n")
                     n_skip += 1
-                    if outcome is not None:
-                        slug = REASON_SLUGS.get(r.error, "other")
-                        outcome["skips"][slug] = (
-                            outcome["skips"].get(slug, 0) + 1)
-                    if cfg.no_discard:
-                        fa_parts.append(f">{hdr}\nN\n")
+                if fa:
+                    fa_parts.append(fa)
+                if lg:
+                    log_parts.append(lg)
             return ("".join(fa_parts), "".join(log_parts), n_corr,
                     n_skip, bases_out, outcome)
 
@@ -337,14 +356,7 @@ def _run_ec(db_path: str, sequences: Sequence[str],
             stats.skipped += n_skip
             stats.bases_out += bases_out
             if outcome is not None:
-                reg.counter("substitutions").inc(outcome["subs"])
-                reg.counter("truncations_3p").inc(outcome["t3"])
-                reg.counter("truncations_5p").inc(outcome["t5"])
-                hist = reg.histogram("substitutions_per_read")
-                for v, n in outcome["hist"].items():
-                    hist.observe(v, n)
-                for slug, n in outcome["skips"].items():
-                    reg.counter(f"skipped_{slug}").inc(n)
+                record_outcome(reg, outcome)
             writer.write(0, fa)
             writer.write(1, lg)
 
@@ -381,13 +393,8 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                             t1 = time.perf_counter()
                             jax.block_until_ready(packed)
                             t2 = time.perf_counter()
-                        timer.add_time("device_dispatch", t1 - t0)
-                        timer.add_time("device_wait", t2 - t1)
-                        if count_outcomes:
-                            reg.histogram("device_dispatch_us").observe(
-                                int((t1 - t0) * 1e6))
-                            reg.histogram("device_wait_us").observe(
-                                int((t2 - t1) * 1e6))
+                        observe_dispatch_wait(reg, "device", t0, t1, t2,
+                                              timer=timer)
                         with timer.stage("fetch"), tracer.span("fetch"):
                             buf = fetch_finish(res, packed)
                         b, l = res.out.shape

@@ -58,6 +58,7 @@ from ..models.create_database import extract_observations_impl
 from ..models.ec_config import ECConfig
 from ..ops import ctable
 from ..telemetry import NULL as NULL_METRICS
+from ..telemetry import observe_dispatch_wait
 
 AXIS = "shards"
 
@@ -469,11 +470,7 @@ def build_database_tile_sharded(batches, mesh: Mesh,
                 full_b, over_b = bool(full), bool(over)
                 t2 = time.perf_counter()
             step_i += 1
-            if reg.enabled:
-                reg.histogram("shard_step_dispatch_us").observe(
-                    int((t1 - t0) * 1e6))
-                reg.histogram("shard_step_wait_us").observe(
-                    int((t2 - t1) * 1e6))
+            observe_dispatch_wait(reg, "shard_step", t0, t1, t2)
             if not (full_b or over_b):
                 break
             pending = jnp.logical_and(pending, jnp.logical_not(placed))

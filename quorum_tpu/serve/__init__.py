@@ -1,0 +1,36 @@
+"""Persistent correction service (ISSUE 3 tentpole).
+
+The offline CLIs pay the full cost of every invocation: reload the
+mer database, re-JIT the corrector, exit. `quorum_tpu.serve` is the
+inference-style alternative — a warm process that loads the database
+and compiled programs ONCE and then batches many small requests onto
+the device (the same shape as KMC 3's client/server mode and the GPU
+k-mer counters in PAPERS.md):
+
+* `engine.py`  — CorrectionEngine: a loaded DB + the stage-2
+  corrector, compiled once per read-length bucket and reused across
+  requests.
+* `batcher.py` — DynamicBatcher: a bounded request queue feeding a
+  dispatcher thread that coalesces waiting requests up to
+  `--max-batch` reads or `--max-wait-ms`, runs one device step, and
+  demuxes per-request results back through futures.
+* `server.py`  — the stdlib-HTTP front end: `POST /correct` (FASTQ
+  in, corrected FASTA out, byte-identical to the offline CLI),
+  `/healthz`, the live `/metrics` exposition on the same registry,
+  admission control (full queue -> 429 + Retry-After), per-request
+  deadlines, and graceful drain on SIGTERM / `POST /quiesce`.
+* `client.py`  — a minimal stdlib client plus the
+  `quorum-serve-bench` closed-loop load generator.
+
+The console entry point is `quorum-serve` (cli/serve.py).
+"""
+
+from .batcher import (DeadlineExceeded, DynamicBatcher, Draining,
+                      QueueFull)
+from .engine import CorrectionEngine
+from .server import CorrectionServer
+
+__all__ = [
+    "CorrectionEngine", "DynamicBatcher", "CorrectionServer",
+    "QueueFull", "Draining", "DeadlineExceeded",
+]

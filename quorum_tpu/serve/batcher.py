@@ -1,0 +1,232 @@
+"""Dynamic batching: a bounded request queue feeding one dispatcher
+thread that coalesces small requests into full device batches.
+
+The device is efficient at `--max-batch` reads per step and terrible
+at one; the batcher closes that gap the way inference servers do.
+`submit()` enqueues a request (a list of FASTQ records + a Future)
+under admission control — a full queue raises `QueueFull`, which the
+HTTP front end maps to 429 + Retry-After, so overload sheds at the
+door instead of growing an unbounded backlog (the bounded
+jflib::pool discipline of the reference, applied to requests). The
+dispatcher pops the queue, waits up to `max_wait_ms` for more work to
+coalesce (first-request arrival starts the clock), drops requests
+whose deadline already passed, packs up to `max_batch` reads into one
+engine step, and demuxes each request's slice of the results back
+through its Future.
+
+Telemetry mirrors the host pipeline's vocabulary: a `queue_depth`
+high-water gauge (set_max), a `queue_wait_us` histogram
+(admission -> dispatch), `batch_reads` + the dispatch/wait split from
+the engine, and request outcome counters
+(`requests_accepted/_rejected_queue_full/_deadline_exceeded/_failed`
+/`_completed`).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+from ..telemetry import NULL
+
+
+class QueueFull(Exception):
+    """Admission refused: the request queue is at capacity. The HTTP
+    layer maps this to 429 with `retry_after` seconds."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("request queue full")
+        self.retry_after = retry_after
+
+
+class Draining(Exception):
+    """Admission refused: the server is quiescing (503)."""
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before its batch dispatched
+    (504)."""
+
+
+class _Request:
+    __slots__ = ("records", "future", "t_enq", "deadline")
+
+    def __init__(self, records, future, deadline):
+        self.records = records
+        self.future = future
+        self.t_enq = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter, or None
+
+
+class DynamicBatcher:
+    """One dispatcher thread over a bounded deque of requests.
+
+    `max_batch` is also the engine's fixed row capacity; requests
+    larger than `max_batch` reads are corrected across several device
+    steps within one dispatch (their Future still resolves once, with
+    the full result). `queue_requests` bounds ADMITTED requests not
+    yet dispatched — in-flight device work doesn't count against it.
+    """
+
+    def __init__(self, engine, max_batch: int | None = None,
+                 max_wait_ms: float = 5.0, queue_requests: int = 64,
+                 registry=NULL):
+        self.engine = engine
+        self.max_batch = int(max_batch or engine.rows)
+        if self.max_batch > engine.rows:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds engine rows "
+                f"{engine.rows}")
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
+        self.queue_requests = int(queue_requests)
+        self.registry = registry
+        self._q: collections.deque[_Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._draining = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="quorum-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission --------------------------------------------------------
+    def submit(self, records, deadline_s: float | None = None) -> Future:
+        """Enqueue one request (list of (header, seq, qual) records).
+        Returns a Future resolving to the per-read (fa, log) list.
+        Raises QueueFull (429) or Draining (503) at admission; an
+        expired deadline resolves the Future with DeadlineExceeded."""
+        fut: Future = Future()
+        deadline = (time.perf_counter() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Request(list(records), fut, deadline)
+        reg = self.registry
+        with self._lock:
+            if self._draining:
+                reg.counter("requests_rejected_draining").inc()
+                raise Draining()
+            if len(self._q) >= self.queue_requests:
+                reg.counter("requests_rejected_queue_full").inc()
+                raise QueueFull(retry_after=self._retry_after_locked())
+            reg.counter("requests_accepted").inc()
+            if req.records:
+                self._q.append(req)
+                reg.gauge("queue_depth").set_max(len(self._q))
+                self._work.notify()
+        if not req.records:
+            # nothing to correct: resolve immediately (never
+            # enqueued), but AFTER admission control so an empty
+            # probe still honors drain and backpressure; completed
+            # here so accepted == completed + failed + deadline holds
+            reg.counter("requests_completed").inc()
+            fut.set_result([])
+        return fut
+
+    def _retry_after_locked(self) -> float:
+        """Suggested Retry-After: one full queue's worth of batches at
+        the coalescing wait, floored at 1 s. Deliberately coarse — the
+        point is a hint that backs clients off, not a promise."""
+        batches = max(1, self.queue_requests)
+        return max(1.0, round(batches * self.max_wait_s, 1))
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    # -- drain / shutdown -------------------------------------------------
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admitting, flush everything already admitted, stop the
+        dispatcher. Idempotent. Returns True if the dispatcher thread
+        exited within `timeout`."""
+        with self._lock:
+            self._draining = True
+            self._work.notify_all()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # -- dispatch ---------------------------------------------------------
+    def _take_locked(self) -> list[_Request]:
+        """Pop admitted requests up to max_batch reads. Always pops at
+        least one request (an oversize request dispatches alone and is
+        chunked across device steps)."""
+        taken: list[_Request] = []
+        reads = 0
+        while self._q:
+            nxt = len(self._q[0].records)
+            if taken and reads + nxt > self.max_batch:
+                break
+            req = self._q.popleft()
+            taken.append(req)
+            reads += nxt
+        return taken
+
+    def _dispatch_loop(self) -> None:
+        reg = self.registry
+        while True:
+            with self._work:
+                while not self._q and not self._draining:
+                    self._work.wait(timeout=0.1)
+                if not self._q:
+                    if self._draining:
+                        self._closed = True
+                        return
+                    continue
+                # coalescing window: the FIRST waiter's arrival starts
+                # the clock; stop early once a full batch is waiting
+                if self.max_wait_s > 0:
+                    first = self._q[0]
+                    give_up = first.t_enq + self.max_wait_s
+                    while (not self._draining
+                           and sum(len(r.records) for r in self._q)
+                           < self.max_batch):
+                        left = give_up - time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._work.wait(timeout=left)
+                        if not self._q:
+                            break
+                    if not self._q:
+                        continue
+                taken = self._take_locked()
+            self._run_batch(taken, reg)
+
+    def _run_batch(self, taken: list[_Request], reg) -> None:
+        now = time.perf_counter()
+        live: list[_Request] = []
+        for req in taken:
+            if req.deadline is not None and now > req.deadline:
+                reg.counter("requests_deadline_exceeded").inc()
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(DeadlineExceeded())
+            else:
+                if reg.enabled:
+                    reg.histogram("queue_wait_us").observe(
+                        int((now - req.t_enq) * 1e6))
+                live.append(req)
+        if not live:
+            return
+        flat: list = []
+        slices: list[tuple[_Request, int, int]] = []
+        for req in live:
+            slices.append((req, len(flat), len(flat) + len(req.records)))
+            flat.extend(req.records)
+        try:
+            results: list = []
+            for off in range(0, len(flat), self.max_batch):
+                results.extend(
+                    self.engine.step(flat[off:off + self.max_batch]))
+        except BaseException as e:  # noqa: BLE001 - delivered per request
+            reg.counter("requests_failed").inc(len(live))
+            for req, _s, _e in slices:
+                if not req.future.set_running_or_notify_cancel():
+                    continue
+                req.future.set_exception(e)
+            return
+        reg.counter("requests_completed").inc(len(live))
+        for req, s, e in slices:
+            if not req.future.set_running_or_notify_cancel():
+                continue  # abandoned by a timed-out waiter
+            req.future.set_result(results[s:e])

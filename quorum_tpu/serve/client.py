@@ -1,0 +1,243 @@
+"""Minimal stdlib client for the correction service, plus the
+`quorum-serve-bench` closed-loop load generator.
+
+`ServeClient` speaks the tiny HTTP surface of serve/server.py with
+http.client only — no dependencies — so tests, tooling, and the bench
+share one implementation of the protocol (headers, deadline
+forwarding, 429/503 Retry-After handling).
+
+The bench is closed-loop: `--concurrency` workers each post
+`--reads-per-request` reads and wait for the answer before posting
+again, the standard shape for measuring a service's latency/throughput
+trade-off under admission control. Results print as the repo's
+bench-style metric lines (telemetry.metric_line), so
+`tools/metrics_check.py` can gate a bench run's output like any other
+artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One /correct exchange. `status` is the HTTP code; `fa`/`log`
+    are the corrected-FASTA and skip-log texts (empty unless 200)."""
+
+    status: int
+    fa: str = ""
+    log: str = ""
+    reads: int = 0
+    corrected: int = 0
+    skipped: int = 0
+    retry_after_s: float = 0.0
+    error: str = ""
+
+
+class ServeClient:
+    """One server, many sequential requests (per instance; use one
+    instance per thread — http.client connections are not
+    thread-safe)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8100,
+                 timeout: float = 600.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 headers: dict | None = None):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp, data
+        finally:
+            conn.close()
+
+    def correct(self, fastq_text: str | bytes,
+                deadline_ms: float | None = None,
+                want_log: bool = False) -> ServeResult:
+        """POST /correct. Returns a ServeResult whatever the status —
+        callers branch on `.status` (200/429/503/504/...)."""
+        body = (fastq_text.encode()
+                if isinstance(fastq_text, str) else fastq_text)
+        path = "/correct" + ("?log=1" if want_log else "")
+        headers = {"Content-Type": "text/plain"}
+        if deadline_ms is not None:
+            headers["X-Quorum-Deadline-Ms"] = str(deadline_ms)
+        resp, data = self._request("POST", path, body, headers)
+        if resp.status != 200:
+            retry = float(resp.headers.get("Retry-After", 0) or 0)
+            err = ""
+            try:
+                err = json.loads(data.decode() or "{}").get("error", "")
+            except ValueError:
+                pass
+            return ServeResult(status=resp.status, retry_after_s=retry,
+                               error=err)
+        if want_log:
+            doc = json.loads(data.decode())
+            return ServeResult(status=200, fa=doc["fa"], log=doc["log"],
+                               reads=doc["reads"],
+                               corrected=doc["corrected"],
+                               skipped=doc["skipped"])
+        return ServeResult(
+            status=200, fa=data.decode(),
+            reads=int(resp.headers.get("X-Quorum-Reads", 0)),
+            corrected=int(resp.headers.get("X-Quorum-Corrected", 0)),
+            skipped=int(resp.headers.get("X-Quorum-Skipped", 0)))
+
+    def healthz(self) -> dict:
+        resp, data = self._request("GET", "/healthz")
+        if resp.status != 200:
+            raise RuntimeError(f"/healthz -> {resp.status}")
+        return json.loads(data.decode())
+
+    def metrics_text(self) -> str:
+        resp, data = self._request("GET", "/metrics")
+        if resp.status != 200:
+            raise RuntimeError(f"/metrics -> {resp.status}")
+        return data.decode()
+
+    def quiesce(self) -> dict:
+        resp, data = self._request("POST", "/quiesce")
+        if resp.status != 200:
+            raise RuntimeError(f"/quiesce -> {resp.status}")
+        return json.loads(data.decode())
+
+
+# ---------------------------------------------------------------------------
+# quorum-serve-bench
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def bench_main(argv=None) -> int:
+    """Closed-loop load generation against a running quorum-serve."""
+    import argparse
+    import sys
+
+    from ..io import fastq as fastq_mod
+    from ..telemetry import metric_line
+
+    p = argparse.ArgumentParser(
+        prog="quorum-serve-bench",
+        description="Closed-loop load generator for quorum-serve: N "
+                    "workers post FASTQ slices and wait for each "
+                    "answer; prints latency/throughput metric lines.")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("-c", "--concurrency", type=int, default=4,
+                   help="Closed-loop workers (default 4)")
+    p.add_argument("-n", "--requests", type=int, default=64,
+                   help="Total requests to send (default 64)")
+    p.add_argument("-r", "--reads-per-request", type=int, default=16,
+                   help="Reads per request body (default 16)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="Per-request deadline forwarded to the server")
+    p.add_argument("--retry-429", action="store_true",
+                   help="Honor Retry-After and retry rejected "
+                        "requests instead of counting and moving on")
+    p.add_argument("sequence", help="FASTQ/FASTA file to draw reads from")
+    args = p.parse_args(argv)
+
+    # pre-render request bodies: round-robin the file's records into
+    # --reads-per-request payloads (wrapping if the file is short)
+    records = list(fastq_mod.iter_records([args.sequence]))
+    if not records:
+        print("no reads in input", file=sys.stderr)
+        return 1
+    bodies: list[bytes] = []
+    rr = 0
+    for _ in range(args.requests):
+        parts = []
+        for _ in range(args.reads_per_request):
+            hdr, seq, qual = records[rr % len(records)]
+            rr += 1
+            if qual:
+                parts.append(f"@{hdr}\n{seq.decode()}\n+\n"
+                             f"{qual.decode()}\n")
+            else:
+                parts.append(f">{hdr}\n{seq.decode()}\n")
+        bodies.append("".join(parts).encode())
+
+    next_i = [0]
+    lock = threading.Lock()
+    lat: list[float] = []
+    outcomes = {200: 0, 429: 0, 503: 0, 504: 0}
+    reads_done = [0]
+    errors = [0]
+
+    def worker():
+        client = ServeClient(args.host, args.port)
+        while True:
+            with lock:
+                i = next_i[0]
+                if i >= len(bodies):
+                    return
+                next_i[0] += 1
+            body = bodies[i]
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    res = client.correct(body,
+                                         deadline_ms=args.deadline_ms)
+                except OSError:
+                    with lock:
+                        errors[0] += 1
+                    break
+                dt = time.perf_counter() - t0
+                with lock:
+                    outcomes[res.status] = outcomes.get(res.status, 0) + 1
+                    if res.status == 200:
+                        lat.append(dt)
+                        reads_done[0] += res.reads
+                if res.status == 429 and args.retry_429:
+                    time.sleep(max(0.05, res.retry_after_s))
+                    continue
+                break
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, args.concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    lat.sort()
+    print(metric_line(
+        "serve_bench", requests=args.requests,
+        concurrency=args.concurrency,
+        reads_per_request=args.reads_per_request,
+        wall_s=round(wall, 4),
+        ok=outcomes.get(200, 0), rejected=outcomes.get(429, 0),
+        draining=outcomes.get(503, 0), deadline=outcomes.get(504, 0),
+        transport_errors=errors[0],
+        reads=reads_done[0],
+        reads_per_s=round(reads_done[0] / wall, 2) if wall > 0 else 0,
+        requests_per_s=(round(len(lat) / wall, 2) if wall > 0 else 0),
+        latency_p50_ms=round(_percentile(lat, 50) * 1e3, 3),
+        latency_p90_ms=round(_percentile(lat, 90) * 1e3, 3),
+        latency_p99_ms=round(_percentile(lat, 99) * 1e3, 3)))
+    return 0 if outcomes.get(200, 0) > 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(bench_main())

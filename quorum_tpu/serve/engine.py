@@ -1,0 +1,206 @@
+"""The warm correction engine: one loaded database + the stage-2
+corrector, reused across requests.
+
+Where the offline path (models/error_correct.run_error_correct) loads
+the DB, resolves the Poisson cutoff, JITs the corrector, streams one
+file, and exits, the engine does the load/resolve ONCE at construction
+and then exposes `step(records)` — correct one batch of reads and
+return each read's exact offline output text. Byte parity with
+`quorum_error_correct_reads` is structural: the device path is the
+same `correct_batch_packed` -> `fetch_finish` -> `finish_batch_host`
+chain and the rendering is the same `render_result` the offline drain
+loop uses.
+
+Compilation discipline: every step pads its rows up to the fixed
+`rows` capacity (the batcher's `--max-batch`) and its columns to the
+read-length buckets the offline pipeline already uses
+(io/fastq.LENGTH_BUCKETS), so the engine compiles at most one
+executable per distinct length bucket it ever sees — the
+`engine_compiles` counter is the acceptance signal that a warm server
+answers a second request without recompilation. `warmup()` pays those
+compiles before the first request arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+from ..io import contaminant as contaminant_mod
+from ..io import db_format, fastq
+from ..models.corrector import (correct_batch_packed, fetch_finish,
+                                finish_batch_host)
+from ..models.ec_config import ECConfig
+from ..models.error_correct import (ECOptions, new_outcome,
+                                    pack_for_stage2, record_outcome,
+                                    render_result, resolve_cutoff)
+from ..telemetry import NULL, NULL_TRACER, observe_dispatch_wait
+from ..utils.vlog import vlog
+
+
+class CorrectionEngine:
+    """A warm, reusable stage-2 corrector.
+
+    `rows` is the fixed device-batch row capacity: every step is
+    padded to exactly `rows` reads so row count never forces a
+    recompile (padding rows are length-0 and cost only masked lanes).
+    Reads longer than the largest length bucket get a one-off shape —
+    allowed, but each distinct oversize length compiles its own
+    executable (the offline pipeline behaves the same).
+
+    Thread model: `step` serializes device access with a lock (the
+    tunnel degrades under concurrent device use, PERF_NOTES.md); the
+    host-side render afterwards runs outside it. One dispatcher
+    thread calling `step` is the intended shape (serve/batcher.py).
+    """
+
+    def __init__(self, db_path: str, *, cutoff: int | None = None,
+                 qual_cutoff: int = 127, skip: int = 1, good: int = 2,
+                 anchor_count: int = 3, min_count: int = 1,
+                 window: int = 10, error: int = 3,
+                 homo_trim: int | None = None,
+                 trim_contaminant: bool = False,
+                 no_discard: bool = False,
+                 contaminant: str | None = None,
+                 apriori_error_rate: float = 0.01,
+                 poisson_threshold: float = 1e-6,
+                 no_mmap: bool = False, rows: int = 1024,
+                 registry=NULL, tracer=NULL_TRACER):
+        if rows < 1:
+            raise ValueError("rows must be >= 1")
+        self.rows = int(rows)
+        self.registry = registry
+        self.tracer = tracer
+        opts = ECOptions(cutoff=cutoff,
+                         apriori_error_rate=apriori_error_rate,
+                         poisson_threshold=poisson_threshold,
+                         no_mmap=no_mmap)
+        vlog("Loading mer database")
+        self.state, self.meta, _header = db_format.read_db(
+            db_path, to_device=True, no_mmap=no_mmap)
+        cutoff = resolve_cutoff(self.state, self.meta, opts)
+        vlog("Using cutoff of ", cutoff)
+        if cutoff == 0 and opts.cutoff is None:
+            raise RuntimeError(
+                "Cutoff computation failed. Pass it explicitly with "
+                "-p switch.")
+        self.cfg = ECConfig(
+            k=self.meta.k, skip=skip, good=good,
+            anchor_count=anchor_count, min_count=min_count,
+            cutoff=cutoff, qual_cutoff=qual_cutoff, window=window,
+            error=error, homo_trim=homo_trim,
+            trim_contaminant=trim_contaminant, no_discard=no_discard,
+            collision_prob=apriori_error_rate / 3.0,
+            poisson_threshold=poisson_threshold,
+        )
+        self.contam = None
+        if contaminant is not None:
+            vlog("Loading contaminant sequences")
+            self.contam = contaminant_mod.load_contaminant(
+                contaminant, self.cfg.k)
+        self._lock = threading.Lock()
+        self._shapes: set[tuple[int, int]] = set()
+        registry.gauge("cutoff").set(cutoff)
+        registry.set_meta(db=db_path, rows=self.rows, cutoff=cutoff)
+
+    # -- device step ------------------------------------------------------
+    def step(self, records, _warmup: bool = False) -> list[tuple[str, str]]:
+        """Correct `records` — a list of (header, seq_bytes,
+        qual_bytes) tuples, at most `self.rows` long — and return one
+        (fa_text, log_text) pair per record, in order, exactly as the
+        offline CLI would write them. Updates the engine's telemetry
+        (outcome counters, dispatch/wait split, compile count).
+        `_warmup` steps count only `engine_compiles` — synthetic
+        warmup reads must not pollute the read/skip counters or the
+        latency histograms real traffic is judged by."""
+        if len(records) > self.rows:
+            raise ValueError(
+                f"batch of {len(records)} exceeds engine rows "
+                f"{self.rows}")
+        if not records:
+            return []
+        reg = NULL if _warmup else self.registry
+        batch = fastq._make_batch(list(records), self.rows)
+        pk = pack_for_stage2(batch, self.cfg)
+        shape = (batch.codes.shape[0], batch.codes.shape[1])
+        with self._lock:
+            if shape not in self._shapes:
+                # first time this (rows, bucket) shape reaches the
+                # device: the jit cache compiles a fresh executable.
+                # A warm server's steady state never grows this.
+                # Counted on the REAL registry even during warmup —
+                # warmup exists to move compiles before traffic, and
+                # the counter must show them.
+                self._shapes.add(shape)
+                self.registry.counter("engine_compiles").inc()
+                vlog("Engine compiling shape ", shape)
+            t0 = time.perf_counter()
+            with self.tracer.span("serve_device", reads=batch.n):
+                cap = 4 * batch.codes.shape[0]
+                res, packed = correct_batch_packed(
+                    self.state, self.meta, pk, self.cfg,
+                    contam=self.contam, pack_cap=cap)
+                t1 = time.perf_counter()
+                jax.block_until_ready(packed)
+                t2 = time.perf_counter()
+            with self.tracer.span("serve_fetch"):
+                buf = fetch_finish(res, packed)
+        # the same *_dispatch_us/*_wait_us split the offline device
+        # loops record, so one dashboard reads both
+        observe_dispatch_wait(reg, "serve", t0, t1, t2)
+        b, l = res.out.shape
+        maxe = res.fwd_log.pos.shape[1]
+        with self.tracer.span("serve_render", reads=batch.n):
+            results = finish_batch_host(buf, batch.n, self.cfg,
+                                        batch.codes, b, l, maxe)
+            outcome = new_outcome() if reg.enabled else None
+            out: list[tuple[str, str]] = []
+            n_corr = 0
+            for hdr, r in zip(batch.headers, results):
+                out.append(render_result(hdr, r, self.cfg, outcome))
+                if r.ok:
+                    n_corr += 1
+        if reg.enabled:
+            record_outcome(reg, outcome)
+            reg.counter("reads_in").inc(batch.n)
+            reg.counter("reads_corrected").inc(n_corr)
+            reg.counter("reads_skipped").inc(batch.n - n_corr)
+            reg.counter("bases_in").inc(int(batch.lengths[:batch.n].sum()))
+            reg.counter("batches").inc()
+            reg.histogram("batch_reads").observe(batch.n)
+            # per-batch heartbeat: heartbeats drive the textfile
+            # exporter and (with --metrics-interval) the JSONL event
+            # stream — without this a serving process would refresh
+            # its textfile only at startup and drain
+            reg.heartbeat(stage="serve",
+                          reads=reg.counter("reads_in").value,
+                          bases=reg.counter("bases_in").value)
+        return out
+
+    # -- warmup -----------------------------------------------------------
+    def warmup(self, lengths=(None,)) -> int:
+        """Pay the compile cost for the length buckets of `lengths`
+        (read lengths, not buckets; None entries are skipped) before
+        serving. Returns the number of device steps run. With the
+        default single-None argument this is a no-op — the serve CLI
+        passes `--warmup-lengths`."""
+        n = 0
+        for ln in lengths:
+            if ln is None:
+                continue
+            ln = int(ln)
+            if ln <= 0:
+                raise ValueError("warmup length must be positive")
+            seq = b"A" * ln
+            qual = b"~" * ln
+            self.step([("warmup", seq, qual)], _warmup=True)
+            n += 1
+        return n
+
+    @property
+    def compiles(self) -> int:
+        """Distinct device shapes compiled so far (mirrors the
+        `engine_compiles` counter even when telemetry is off)."""
+        return len(self._shapes)

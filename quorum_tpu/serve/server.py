@@ -1,0 +1,313 @@
+"""The stdlib-HTTP front end of the correction service.
+
+Same transport pattern as telemetry/export.py (ThreadingHTTPServer on
+daemon threads, no dependencies), with the service semantics on top:
+
+* ``POST /correct`` — body is FASTQ (or FASTA) text; the response is
+  the corrected FASTA text, byte-identical to what
+  ``quorum_error_correct_reads`` writes for the same reads, with the
+  per-read counts in ``X-Quorum-Reads`` / ``X-Quorum-Corrected`` /
+  ``X-Quorum-Skipped`` headers. ``?log=1`` switches to a JSON body
+  ``{"fa":..., "log":..., "reads":..., "corrected":..., "skipped":...}``
+  carrying the ``.log`` channel too. A per-request deadline comes
+  from ``?deadline_ms=`` or the ``X-Quorum-Deadline-Ms`` header
+  (default: the server's ``deadline_ms``).
+* 429 + ``Retry-After`` when the batcher's bounded queue is full
+  (admission control), 503 while draining, 504 past the deadline,
+  400 on malformed FASTQ.
+* ``GET /healthz`` — liveness JSON (status ok/draining, queue depth,
+  uptime, totals).
+* ``GET /metrics`` — the live Prometheus exposition, mounted on the
+  same registry set as every other quorum endpoint
+  (telemetry/export.render_live), so the serve counters and any
+  in-process stage registries share one scrape.
+* ``POST /quiesce`` — graceful drain: stop admitting, flush in-flight
+  batches, then release ``serve_until_drained()`` so the CLI writes
+  the final metrics document and exits. SIGTERM takes the same path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from ..io import fastq
+from ..telemetry import NULL
+from ..telemetry import export as export_mod
+from ..utils.vlog import vlog
+from .batcher import DeadlineExceeded, Draining, QueueFull
+
+# a request body bigger than this is refused with 413 before parsing
+# (an unbounded read would let one client exhaust host memory)
+MAX_BODY_BYTES = 256 * 1024 * 1024
+
+
+def parse_fastq_text(body: bytes) -> list[tuple[str, bytes, bytes]]:
+    """Parse a request body as FASTQ/FASTA records via the same
+    reader the offline pipeline uses (io/fastq._iter_one), so the
+    service accepts exactly the inputs the CLI accepts."""
+    import io as _io
+    return list(fastq._iter_one(_io.BytesIO(body), "<request>"))
+
+
+class CorrectionServer:
+    """HTTP front end over a DynamicBatcher.
+
+    `serve_until_drained()` blocks the calling thread until a drain
+    completes (via `/quiesce`, SIGTERM -> `initiate_drain()`, or
+    `close()`), which is when the caller should write final artifacts
+    and exit — the CLI runs it under the observability() context
+    manager so the final metrics document lands on every exit path.
+    """
+
+    def __init__(self, batcher, host: str = "127.0.0.1", port: int = 0,
+                 deadline_ms: float | None = None, registry=NULL,
+                 drain_grace_s: float = 30.0):
+        import http.server
+
+        self.batcher = batcher
+        self.registry = registry
+        self.deadline_ms = deadline_ms
+        self.drain_grace_s = drain_grace_s
+        self._t0 = time.perf_counter()
+        self._drained = threading.Event()
+        self._drain_started = threading.Event()
+        self._requests = 0
+        self._req_lock = threading.Lock()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                route = self.path.split("?")[0]
+                if route == "/metrics":
+                    body = export_mod.render_live().encode()
+                    self._reply(200, body,
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif route == "/healthz":
+                    self._reply_json(200, outer.health())
+                else:
+                    self._reply_json(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                route, _, query = self.path.partition("?")
+                if route == "/correct":
+                    outer._handle_correct(self, query)
+                elif route == "/quiesce":
+                    vlog("Quiesce requested over HTTP")
+                    outer.initiate_drain()
+                    self._reply_json(200, {"status": "draining"})
+                else:
+                    self._reply_json(404, {"error": "not found"})
+
+            # -- plumbing --------------------------------------------
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       extra: dict | None = None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                if self.close_connection:
+                    # replies sent WITHOUT reading the request body
+                    # (413, bad Content-Length) must kill the
+                    # keep-alive connection — the unread bytes would
+                    # be parsed as the next request line otherwise
+                    self.send_header("Connection", "close")
+                for k, v in (extra or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away; nothing to salvage
+
+            def _reply_json(self, code: int, obj: dict,
+                            extra: dict | None = None):
+                self._reply(code, (json.dumps(obj) + "\n").encode(),
+                            "application/json", extra)
+
+            def log_message(self, *a):  # requests are per-batch noise
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="quorum-serve-http", daemon=True)
+        self._thread.start()
+        registry.set_meta(serve_port=self.port)
+        vlog("quorum-serve listening on ", host, ":", self.port)
+
+    # -- request handling -------------------------------------------------
+    def _handle_correct(self, handler, query: str) -> None:
+        reg = self.registry
+        params = _parse_query(query)
+        if handler.headers.get("Transfer-Encoding"):
+            # we only read Content-Length bodies; silently treating a
+            # chunked body as empty would answer 200-empty and leave
+            # the chunk bytes to desync the keep-alive connection
+            handler.close_connection = True  # body left unread
+            handler._reply_json(411, {"error": "Content-Length required"})
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+        except ValueError:
+            handler.close_connection = True  # body left unread
+            handler._reply_json(400, {"error": "bad Content-Length"})
+            return
+        if length > MAX_BODY_BYTES:
+            handler.close_connection = True  # body left unread
+            handler._reply_json(413, {"error": "request body too large"})
+            return
+        body = handler.rfile.read(length)
+        deadline_ms = self.deadline_ms
+        hdr_deadline = (params.get("deadline_ms")
+                        or handler.headers.get("X-Quorum-Deadline-Ms"))
+        if hdr_deadline is not None:
+            try:
+                deadline_ms = float(hdr_deadline)
+            except ValueError:
+                handler._reply_json(400, {"error": "bad deadline_ms"})
+                return
+        try:
+            records = parse_fastq_text(body)
+        except (ValueError, UnicodeDecodeError) as e:
+            reg.counter("requests_bad_input").inc()
+            handler._reply_json(400, {"error": str(e)})
+            return
+        t0 = time.perf_counter()
+        try:
+            fut = self.batcher.submit(
+                records,
+                deadline_s=(deadline_ms / 1000.0
+                            if deadline_ms is not None else None))
+        except QueueFull as e:
+            handler._reply_json(
+                429, {"error": "queue full",
+                      "retry_after_s": e.retry_after},
+                extra={"Retry-After": max(1, int(round(e.retry_after)))})
+            return
+        except Draining:
+            handler._reply_json(503, {"error": "draining"},
+                                extra={"Retry-After": 1})
+            return
+        # the wall timeout backstops the batcher's deadline handling:
+        # a request admitted but stuck behind a wedged device step
+        # still gets its 504 (and its late result is discarded)
+        wall = (deadline_ms / 1000.0 + 1.0
+                if deadline_ms is not None else None)
+        try:
+            results = fut.result(timeout=wall)
+        except DeadlineExceeded:
+            handler._reply_json(504, {"error": "deadline exceeded"})
+            return
+        except FutureTimeout:
+            fut.cancel()
+            reg.counter("requests_late").inc()
+            handler._reply_json(504, {"error": "deadline exceeded"})
+            return
+        except BaseException as e:  # noqa: BLE001 - surfaced as 500
+            handler._reply_json(500, {"error": str(e)})
+            return
+        with self._req_lock:
+            self._requests += 1
+        if reg.enabled:
+            reg.histogram("request_us").observe(
+                int((time.perf_counter() - t0) * 1e6))
+            reg.histogram("request_reads").observe(len(records))
+        fa = "".join(r[0] for r in results)
+        log = "".join(r[1] for r in results)
+        corrected = sum(1 for r in results if r[0] and not r[1])
+        skipped = sum(1 for r in results if r[1])
+        counts = {"X-Quorum-Reads": len(records),
+                  "X-Quorum-Corrected": corrected,
+                  "X-Quorum-Skipped": skipped}
+        if _flag(params, "log"):
+            handler._reply_json(200, {
+                "fa": fa, "log": log, "reads": len(records),
+                "corrected": corrected, "skipped": skipped}, extra=counts)
+        else:
+            handler._reply(200, fa.encode(), "text/plain; charset=utf-8",
+                           extra=counts)
+
+    # -- health / lifecycle -----------------------------------------------
+    def health(self) -> dict:
+        with self._req_lock:
+            served = self._requests
+        return {
+            "status": ("draining" if self._drain_started.is_set()
+                       else "ok"),
+            "uptime_s": round(time.perf_counter() - self._t0, 3),
+            "queue_depth": self.batcher.depth,
+            "requests_served": served,
+            "engine_compiles": self.batcher.engine.compiles,
+            "port": self.port,
+        }
+
+    def initiate_drain(self) -> None:
+        """Begin graceful drain (idempotent, safe from signal
+        handlers and HTTP threads): stop admitting, then flush the
+        admitted backlog on a helper thread so the caller — possibly
+        an HTTP handler replying to /quiesce — never blocks on it."""
+        if self._drain_started.is_set():
+            return
+        self._drain_started.set()
+
+        def _drain():
+            # the meta stamp records what ACTUALLY happened: False
+            # means the grace period expired with work unflushed — a
+            # lossy shutdown must not read as a clean one downstream
+            ok = self.batcher.drain(timeout=self.drain_grace_s)
+            self.registry.set_meta(drained=bool(ok))
+            self._drained.set()
+
+        threading.Thread(target=_drain, name="quorum-serve-drain",
+                         daemon=True).start()
+
+    def serve_until_drained(self) -> None:
+        """Block until a drain completes, then stop the HTTP listener.
+        KeyboardInterrupt also initiates a drain (first ^C graceful)."""
+        try:
+            while not self._drained.wait(timeout=0.2):
+                pass
+        except KeyboardInterrupt:
+            vlog("Interrupt: draining")
+            self.initiate_drain()
+            self._drained.wait(timeout=self.drain_grace_s + 5)
+        self.close()
+
+    def close(self) -> None:
+        """Tear the listener down (idempotent). Does NOT write
+        metrics — that's the observability() teardown's job."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        self.initiate_drain()
+        self._drained.wait(timeout=self.drain_grace_s + 5)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def _parse_query(query: str) -> dict:
+    """`a=x&b` -> {"a": "x", "b": ""} — a bare key keeps an EMPTY
+    value (falsy), so `?deadline_ms` without a number falls through
+    to the header/default instead of becoming a 1 ms deadline.
+    parse_qsl also percent-decodes, so `log=%31` means `log=1`."""
+    import urllib.parse
+    return dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+
+
+def _flag(params: dict, key: str) -> bool:
+    """Boolean query flag: present and not an explicit off-value
+    (`?log=1` and bare `?log` are on; `?log=0` is off)."""
+    v = params.get(key)
+    if v is None:
+        return False
+    return v.lower() not in ("0", "false", "no")

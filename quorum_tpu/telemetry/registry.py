@@ -362,6 +362,27 @@ def registry_for(path: str | None,
     return reg
 
 
+def observe_dispatch_wait(reg, prefix: str, t0: float, t1: float,
+                          t2: float, timer=None) -> None:
+    """The per-batch device-time attribution every device loop
+    records (ISSUE 2), in one place instead of a copy per loop:
+    dispatch (t0->t1, handing XLA the program — host-side queueing)
+    lands as `<prefix>_dispatch_us`, the block-until-ready wait
+    (t1->t2, device compute + transfer) as `<prefix>_wait_us`.
+    Microsecond histograms so sub-ms dispatches keep signal. `timer`
+    (a StageTimer, or None) additionally gets `<prefix>_dispatch` /
+    `<prefix>_wait` stages for the timers table. Call sites: stage-1
+    insert (`insert`), stage-2 correct (`device`), sharded build
+    (`shard_step`), and the serve engine (`serve`)."""
+    if timer is not None:
+        timer.add_time(f"{prefix}_dispatch", t1 - t0)
+        timer.add_time(f"{prefix}_wait", t2 - t1)
+    if getattr(reg, "enabled", False):
+        reg.histogram(f"{prefix}_dispatch_us").observe(
+            int((t1 - t0) * 1e6))
+        reg.histogram(f"{prefix}_wait_us").observe(int((t2 - t1) * 1e6))
+
+
 # jax.monitoring offers register but no unregister, so exactly ONE
 # listener is ever installed; it fans out to whichever registries are
 # still alive (WeakSet: a finished run's registry just drops out, no

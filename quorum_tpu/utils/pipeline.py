@@ -23,6 +23,33 @@ T = TypeVar("T")
 _STOP = object()
 
 
+def put_or_stop(q: "queue.Queue", item, stop: threading.Event,
+                timeout: float = 0.2, stall_gauge=None) -> bool:
+    """THE stop-aware bounded put every producer thread in the repo
+    uses (previously copied in `prefetch`, `fastq.read_batches`, and
+    implicitly wanted by anything feeding a bounded queue): block on
+    a full queue, but give up once `stop` is set — an unbounded
+    `q.put` would strand the producer forever after its consumer
+    abandons the generator. Returns False if stopped.
+
+    `stall_gauge` (a telemetry Gauge, or None) accumulates the time
+    spent blocked on a full queue — only when at least one put
+    attempt actually found the queue full, so an always-keeping-up
+    producer reports exactly 0."""
+    t0 = time.perf_counter() if stall_gauge is not None else 0.0
+    blocked = False
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            if blocked and stall_gauge is not None:
+                stall_gauge.add(time.perf_counter() - t0)
+            return True
+        except queue.Full:
+            blocked = True
+            continue
+    return False
+
+
 def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
              name: str = "prefetch", tracer=None) -> Iterator[T]:
     """Run `it` in a background thread, buffering up to `depth` items.
@@ -57,18 +84,7 @@ def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
 
     def put(item) -> bool:
         # bounded put that gives up if the consumer abandoned us
-        t0 = time.perf_counter() if stall_g is not None else 0.0
-        blocked = False
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                if stall_g is not None and blocked:
-                    stall_g.add(time.perf_counter() - t0)
-                return True
-            except queue.Full:
-                blocked = True
-                continue
-        return False
+        return put_or_stop(q, item, stop, stall_gauge=stall_g)
 
     def loop():
         try:

@@ -1,0 +1,479 @@
+"""The persistent correction service (ISSUE 3): serve parity with the
+offline CLI, warm-path no-recompile, admission control, deadlines, and
+graceful drain.
+
+The parity tests run a REAL engine over the committed golden fixture:
+the server's `POST /correct` response must be byte-identical to what
+`quorum_error_correct_reads` writes for the same reads (both go
+through models/error_correct.render_result, so a drift here means the
+serving path broke batching/demux, not rendering). The
+backpressure/deadline/drain tests use a gated fake engine so they are
+fast and deterministic.
+"""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.cli import serve as serve_cli
+from quorum_tpu.serve import (CorrectionEngine, CorrectionServer,
+                              DeadlineExceeded, DynamicBatcher,
+                              QueueFull)
+from quorum_tpu.serve.client import ServeClient, bench_main
+from quorum_tpu.telemetry import registry_for, validate_metrics
+
+HERE = os.path.dirname(__file__)
+GOLDEN = os.path.join(HERE, "golden")
+READS = os.path.join(GOLDEN, "reads.fastq")
+
+
+# ---------------------------------------------------------------------------
+# real-engine stack over the golden fixture (module-scoped: one compile)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def golden_db(tmp_path_factory):
+    db = str(tmp_path_factory.mktemp("serve_db") / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, READS])
+    assert rc == 0
+    return db
+
+
+@pytest.fixture(scope="module")
+def offline(golden_db, tmp_path_factory):
+    """The offline CLI's output at -p 4 (matches tests/golden)."""
+    out = str(tmp_path_factory.mktemp("serve_off") / "off")
+    rc = ec_cli.main(["-p", "4", golden_db, READS, "-o", out])
+    assert rc == 0
+    with open(out + ".fa") as f:
+        fa = f.read()
+    with open(out + ".log") as f:
+        log = f.read()
+    return fa, log
+
+
+@pytest.fixture(scope="module")
+def warm_stack(golden_db):
+    reg = registry_for(None, force=True)
+    reg.set_meta(stage="serve")
+    engine = CorrectionEngine(golden_db, cutoff=4, rows=64, registry=reg)
+    batcher = DynamicBatcher(engine, max_batch=64, max_wait_ms=2,
+                             queue_requests=8, registry=reg)
+    server = CorrectionServer(batcher, port=0, registry=reg)
+    yield reg, engine, server
+    server.close()
+
+
+def test_serve_parity_and_warm_no_recompile(warm_stack, offline):
+    """Acceptance: a warm server answers a second POST /correct
+    without recompilation and byte-identical to the offline CLI."""
+    reg, engine, server = warm_stack
+    off_fa, off_log = offline
+    client = ServeClient(port=server.port)
+    body = open(READS).read()
+
+    r1 = client.correct(body, want_log=True)
+    assert r1.status == 200
+    assert r1.fa == off_fa          # byte parity, .fa channel
+    assert r1.log == off_log        # byte parity, .log channel
+    assert r1.reads == 242 and r1.skipped >= 1
+    compiles_after_first = reg.counter("engine_compiles").value
+    assert compiles_after_first >= 1
+
+    t0 = time.perf_counter()
+    r2 = client.correct(body, want_log=True)
+    warm_s = time.perf_counter() - t0
+    assert r2.status == 200
+    assert r2.fa == off_fa and r2.log == off_log
+    # THE acceptance signal: no new executable for the warm request
+    assert reg.counter("engine_compiles").value == compiles_after_first
+    assert warm_s < 30  # cold path is minutes on CPU; warm is sub-second
+
+    health = client.healthz()
+    assert health["status"] == "ok"
+    assert health["engine_compiles"] == compiles_after_first
+
+    # /metrics on the serving port carries the serve series
+    text = client.metrics_text()
+    for name in ("quorum_tpu_requests_accepted_total",
+                 "quorum_tpu_reads_corrected_total",
+                 "quorum_tpu_batch_reads", "quorum_tpu_engine_compiles"):
+        assert name in text, f"{name} missing from /metrics"
+
+
+def test_serve_multi_request_demux(warm_stack, offline):
+    """Several small requests concatenate to the offline output —
+    the batcher coalesces them but each Future gets exactly its own
+    slice back."""
+    _reg, _engine, server = warm_stack
+    off_fa, off_log = offline
+    client = ServeClient(port=server.port)
+    with open(READS) as f:
+        lines = f.read().splitlines(keepends=True)
+    recs = ["".join(lines[i:i + 4]) for i in range(0, len(lines), 4)]
+    # 242 reads in 5 uneven requests (the last is tiny)
+    chunks = [recs[0:50], recs[50:120], recs[120:190], recs[190:240],
+              recs[240:]]
+    fa_parts, log_parts = [], []
+    for chunk in chunks:
+        r = client.correct("".join(chunk), want_log=True)
+        assert r.status == 200
+        fa_parts.append(r.fa)
+        log_parts.append(r.log)
+    assert "".join(fa_parts) == off_fa
+    assert "".join(log_parts) == off_log
+
+
+def test_serve_empty_and_bad_input(warm_stack):
+    _reg, _engine, server = warm_stack
+    client = ServeClient(port=server.port)
+    r = client.correct("")
+    assert r.status == 200 and r.fa == "" and r.reads == 0
+    r = client.correct("@h\nACGT\n+\nzzz\n")  # qual/seq length mismatch
+    assert r.status == 400
+
+
+# ---------------------------------------------------------------------------
+# backpressure / deadline / drain (gated fake engine: fast + exact)
+# ---------------------------------------------------------------------------
+
+class FakeEngine:
+    """Engine-shaped stub: echoes each read as a one-line .fa record,
+    optionally blocking on an Event so tests control dispatch."""
+
+    def __init__(self, gate=None, rows=1024, **_kw):
+        self.gate = gate
+        self.rows = rows
+        self.stepped = 0
+        self.entered = threading.Event()  # a step actually began
+
+    @property
+    def compiles(self):
+        return 0
+
+    def step(self, records):
+        self.entered.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30), "test gate never opened"
+        self.stepped += 1
+        return [(f">{h}\n{s.decode()}\n", "") for h, s, _q in records]
+
+
+def _drain_to_depth(batcher, depth=0, timeout=5.0):
+    t0 = time.perf_counter()
+    while batcher.depth > depth:
+        if time.perf_counter() - t0 > timeout:
+            raise AssertionError(f"queue stuck at {batcher.depth}")
+        time.sleep(0.005)
+
+
+def test_batcher_429_on_full_queue():
+    gate = threading.Event()
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(gate), max_batch=4, max_wait_ms=0,
+                         queue_requests=1, registry=reg)
+    recs = [("r", b"ACGT", b"IIII")]
+    fa = bat.submit(recs)          # popped by the dispatcher, blocks
+    _drain_to_depth(bat, 0)        # ensure A left the queue
+    fb = bat.submit(recs)          # fills the queue
+    with pytest.raises(QueueFull) as ei:
+        bat.submit(recs)           # bounced at the door
+    assert ei.value.retry_after > 0
+    assert reg.counter("requests_rejected_queue_full").value == 1
+    gate.set()
+    assert fa.result(timeout=10)[0][0].startswith(">r")
+    assert fb.result(timeout=10)[0][0].startswith(">r")
+    assert bat.drain(timeout=5)
+
+
+def test_batcher_deadline_exceeded():
+    gate = threading.Event()
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(gate), max_batch=4, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    recs = [("r", b"ACGT", b"IIII")]
+    fa = bat.submit(recs)                      # blocks in the engine
+    _drain_to_depth(bat, 0)
+    fb = bat.submit(recs, deadline_s=0.01)     # will expire while queued
+    time.sleep(0.05)
+    gate.set()
+    assert fa.result(timeout=10)
+    with pytest.raises(DeadlineExceeded):
+        fb.result(timeout=10)
+    assert reg.counter("requests_deadline_exceeded").value == 1
+    assert bat.drain(timeout=5)
+
+
+def test_server_http_429_504_and_drain(tmp_path):
+    """The HTTP mappings: 429 + Retry-After on a full queue, 504 past
+    the deadline, 503 while draining — and the final metrics document
+    lands through the observability teardown on drain."""
+    from quorum_tpu.cli.observability import observability
+
+    gate = threading.Event()
+    metrics_path = str(tmp_path / "serve.json")
+    with observability(metrics_path, stage="serve") as obs:
+        reg = obs.registry
+        eng = FakeEngine(gate)
+        bat = DynamicBatcher(eng, max_batch=4,
+                             max_wait_ms=0, queue_requests=1,
+                             registry=reg)
+        srv = CorrectionServer(bat, port=0, registry=reg,
+                               drain_grace_s=5.0)
+        client = ServeClient(port=srv.port)
+        body = "@r\nACGT\n+\nIIII\n"
+
+        # occupy the engine: t1's request dispatches and blocks.
+        # `entered` (not queue depth) is the occupancy signal — depth
+        # 0 is also the state BEFORE t1's request arrives over HTTP.
+        t1 = threading.Thread(
+            target=lambda: client.correct(body), daemon=True)
+        t1.start()
+        assert eng.entered.wait(5), "t1's request never dispatched"
+        _drain_to_depth(bat, 0)
+
+        # deadline: the engine is gated, so this queued request's
+        # 10 ms deadline expires (the handler's wall-timeout backstop
+        # answers 504; its queue slot frees when the gate opens)
+        r = ServeClient(port=srv.port).correct(body, deadline_ms=10)
+        assert r.status == 504
+
+        # the expired request still occupies the 1-slot queue until
+        # the dispatcher gets to it -> the next request bounces
+        r = ServeClient(port=srv.port).correct(body)
+        assert r.status == 429
+        assert r.retry_after_s >= 1
+
+        gate.set()
+        t1.join(timeout=10)
+        _drain_to_depth(bat, 0)
+
+        # drain via /quiesce: stops admission, flushes, unblocks
+        # serve_until_drained
+        assert client.quiesce()["status"] == "draining"
+        deadline = time.perf_counter() + 5
+        while True:  # admission shuts asynchronously after /quiesce
+            r = ServeClient(port=srv.port).correct(body)
+            if r.status == 503:
+                break
+            assert time.perf_counter() < deadline, r.status
+            time.sleep(0.02)
+        srv.serve_until_drained()
+        srv.close()
+
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    assert validate_metrics(doc) == []
+    assert doc["meta"]["status"] == "ok"
+    assert doc["meta"]["drained"] is True
+    assert doc["counters"]["requests_accepted"] >= 2
+    assert doc["counters"]["requests_rejected_queue_full"] >= 1
+    assert doc["counters"]["requests_deadline_exceeded"] >= 1
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_serve_cli_end_to_end_with_fake_engine(tmp_path, monkeypatch):
+    """The quorum-serve CLI surface: flag plumbing, in-thread serving,
+    HTTP quiesce, rc 0, and a schema-valid final metrics document with
+    the serve metric names (the same set ci/tier1.sh gates on)."""
+    import quorum_tpu.serve as serve_pkg
+
+    monkeypatch.setattr(serve_pkg, "CorrectionEngine",
+                        lambda db, **kw: FakeEngine(
+                            rows=kw.get("rows", 1024)))
+    port = _free_port()
+    metrics_path = str(tmp_path / "serve.json")
+    rc_box = {}
+
+    def run():
+        rc_box["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-wait-ms", "0",
+             "--max-batch", "8", "--metrics", metrics_path,
+             "ignored.jf"])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    client = ServeClient(port=port)
+    deadline = time.perf_counter() + 10
+    while True:
+        try:
+            assert client.healthz()["status"] == "ok"
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                raise AssertionError("server never came up")
+            time.sleep(0.05)
+    r = client.correct("@a\nAC\n+\nII\n@b\nGT\n+\nII\n")
+    assert r.status == 200 and r.reads == 2
+    client.quiesce()
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert rc_box["rc"] == 0
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    assert validate_metrics(doc) == []
+    assert doc["meta"]["stage"] == "serve"
+    assert doc["meta"]["status"] == "ok"
+    for c in ("requests_accepted", "requests_completed"):
+        assert doc["counters"].get(c, 0) >= 1, c
+    for h in ("queue_wait_us", "request_us", "request_reads"):
+        assert h in doc["histograms"], h
+
+
+def test_serve_sigterm_drains_and_writes_metrics(tmp_path):
+    """Acceptance: a REAL SIGTERM (subprocess, signal handler on the
+    main thread) drains cleanly — exit 0 and a final metrics document
+    with status=ok. The engine is stubbed in the child so the test
+    exercises the signal/drain path, not compilation."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    port = _free_port()
+    metrics_path = str(tmp_path / "serve.json")
+    child_src = f"""
+import sys
+sys.path.insert(0, {repr(os.path.dirname(HERE))!s})
+import quorum_tpu.serve as serve_pkg
+
+class FE:
+    def __init__(self, rows=1024):
+        self.rows = rows
+    compiles = 0
+    def step(self, records):
+        return [(">%s\\n%s\\n" % (h, s.decode()), "")
+                for h, s, _q in records]
+
+serve_pkg.CorrectionEngine = lambda db, **kw: FE(kw.get("rows", 1024))
+from quorum_tpu.cli import serve as serve_cli
+sys.exit(serve_cli.main(["--port", "{port}", "--max-wait-ms", "0",
+                         "--metrics", {repr(metrics_path)!s},
+                         "ignored.jf"]))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([_sys.executable, "-c", child_src], env=env,
+                            stderr=subprocess.PIPE)
+    try:
+        client = ServeClient(port=port)
+        deadline = time.perf_counter() + 60
+        while True:
+            try:
+                client.healthz()
+                break
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "child died: "
+                        + proc.stderr.read().decode(errors="replace"))
+                assert time.perf_counter() < deadline, "never came up"
+                time.sleep(0.1)
+        r = client.correct("@a\nACGT\n+\nIIII\n")
+        assert r.status == 200 and r.reads == 1
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, proc.stderr.read().decode(errors="replace")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    assert validate_metrics(doc) == []
+    assert doc["meta"]["status"] == "ok"
+    assert doc["meta"]["drained"] is True
+    assert doc["counters"]["requests_completed"] >= 1
+
+
+def test_serve_bench_closed_loop(capsys):
+    """quorum-serve-bench against a fake-engine server: closed loop
+    completes, prints one schema-valid bench metric line."""
+    from quorum_tpu.telemetry import validate_bench_line
+
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=32, max_wait_ms=1,
+                         queue_requests=16, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        rc = bench_main(["--port", str(srv.port), "-c", "3", "-n", "9",
+                         "-r", "4", READS])
+    finally:
+        srv.close()
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    obj = json.loads(line)
+    assert validate_bench_line(obj) == []
+    assert obj["ok"] == 9 and obj["reads"] == 36
+    assert obj["latency_p50_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the observability() context manager (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+def test_observability_error_stamp(tmp_path):
+    from quorum_tpu.cli.observability import observability
+
+    path = str(tmp_path / "m.json")
+    with pytest.raises(RuntimeError):
+        with observability(path, stage="boom"):
+            raise RuntimeError("kaboom")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["meta"]["status"] == "error"
+    assert doc["meta"]["stage"] == "boom"
+
+
+def test_observability_rc_status_and_at_exit(tmp_path):
+    from quorum_tpu.cli.observability import observability
+
+    path = str(tmp_path / "m.json")
+    with observability(path) as obs:
+        obs.registry.counter("things").inc(3)
+        obs.at_exit(lambda reg: reg.gauge("derived").set(7))
+        obs.status = "error"   # rc-style failure without an exception
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["meta"]["status"] == "error"
+    assert doc["counters"]["things"] == 3
+    assert doc["gauges"]["derived"] == 7
+
+
+def test_observability_respects_body_write(tmp_path):
+    """A body that already stamped status=ok and wrote (the
+    run_error_correct success path) is left alone — no second
+    write clobbers post-write mutations."""
+    from quorum_tpu.cli.observability import observability
+
+    path = str(tmp_path / "m.json")
+    with observability(path) as obs:
+        obs.registry.counter("n").inc()
+        obs.registry.set_meta(status="ok")
+        obs.registry.write()
+        obs.registry.counter("n").inc()  # after the write: must NOT land
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["counters"]["n"] == 1
+
+
+def test_observability_null_when_disabled():
+    from quorum_tpu.cli.observability import observability
+
+    with observability() as obs:
+        assert not obs.registry.enabled
+        assert not getattr(obs.tracer, "enabled", False)
+        assert obs.server is None

@@ -16,7 +16,13 @@ and dispatches on content, not extension:
   * span JSONL streams (`--trace-spans`, telemetry/spans.py) and
     their Chrome trace_event twins (`*.trace.json`)
   * bench-style metric-line files (one {"metric": ...} object per
-    line, as bench.py emits — so CI can gate BENCH_*.json output)
+    line, as bench.py and quorum-serve-bench emit — so CI can gate
+    BENCH_*.json output)
+
+A final document whose `meta.stage` is "serve" (quorum-serve's
+`--metrics` output) is additionally required to carry the serve
+request/batch metric names (SERVE_REQUIRED_*), so a golden serve run
+in CI fails loudly if the serving telemetry regresses.
 
 `--prom` switches to linting Prometheus text exposition output
 (`--metrics-textfile` files or a saved `/metrics` scrape) through the
@@ -38,6 +44,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from quorum_tpu.telemetry import check_file  # noqa: E402
 from quorum_tpu.telemetry.export import lint_prometheus_text  # noqa: E402
+
+# The serve request/batch metric surface (quorum_tpu/serve/): a final
+# metrics document stamped `meta.stage == "serve"` must carry these,
+# or the serving telemetry regressed — ci/tier1.sh gates a golden
+# serve run through this check. Counters appear once the first
+# request is admitted; the histograms once the first batch dispatches.
+SERVE_REQUIRED_COUNTERS = (
+    "requests_accepted",
+    "requests_completed",
+    "reads_in",
+    "reads_corrected",
+    "batches",
+    "engine_compiles",
+)
+SERVE_REQUIRED_HISTOGRAMS = (
+    "batch_reads",
+    "queue_wait_us",
+    "request_us",
+    "request_reads",
+    "serve_dispatch_us",
+    "serve_wait_us",
+)
+
+
+def _check_serve_names(doc: dict) -> list[str]:
+    errs = []
+    for name in SERVE_REQUIRED_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"serve document missing counter {name!r}")
+    for name in SERVE_REQUIRED_HISTOGRAMS:
+        if name not in doc.get("histograms", {}):
+            errs.append(f"serve document missing histogram {name!r}")
+    return errs
+
+
+def _check_with_serve_names(path: str) -> list[str]:
+    """check_file, plus the serve-name requirements when the artifact
+    is a serve final document (dispatch on meta.stage, like the rest
+    of the content dispatch)."""
+    problems = check_file(path)
+    try:
+        import json
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return problems
+    if (isinstance(doc, dict)
+            and doc.get("meta", {}).get("stage") == "serve"):
+        problems = problems + _check_serve_names(doc)
+    return problems
 
 
 def _check_prom(path: str) -> list[str]:
@@ -63,7 +119,7 @@ def main(argv=None) -> int:
                    help="Suppress per-file OK lines")
     args = p.parse_args(argv)
 
-    check = _check_prom if args.prom else check_file
+    check = _check_prom if args.prom else _check_with_serve_names
     bad = 0
     for path in args.files:
         problems = check(path)

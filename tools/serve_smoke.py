@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Golden serve run for CI (ci/tier1.sh): build the mer database from
+the committed golden reads, start quorum-serve in-process, POST the
+golden reads twice, and verify the acceptance properties of ISSUE 3:
+
+  * the response is byte-identical to tests/golden/expected.fa (the
+    offline CLI's output at -p 4),
+  * the second (warm) request recompiles nothing
+    (`engine_compiles` stays flat),
+  * a graceful drain (POST /quiesce) writes the final metrics
+    document and a Prometheus scrape of the serving port's /metrics.
+
+Artifacts land in --out-dir (default: a temp dir):
+  serve_metrics.json  — the final serve document
+                        (`metrics_check.py` gates it, including the
+                        serve metric names)
+  serve_scrape.prom   — a /metrics scrape taken mid-run
+                        (`metrics_check.py --prom` gates it)
+
+Exit 0 = all checks passed. Run by ci/tier1.sh after the tier-1
+pytest pass; usable by hand for a quick serving sanity check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden serve run: parity, warm no-recompile, "
+                    "drain-with-metrics (ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where serve_metrics.json / serve_scrape.prom "
+                        "land (default: a temp dir)")
+    p.add_argument("--rows", type=int, default=64,
+                   help="Engine batch rows (default 64: fast CPU "
+                        "compile; production uses 1024+)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="serve_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import serve as serve_cli
+    from quorum_tpu.serve.client import ServeClient
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    expected_fa = os.path.join(GOLDEN, "expected.fa")
+    db = os.path.join(out_dir, "db.jf")
+    metrics_path = os.path.join(out_dir, "serve_metrics.json")
+    scrape_path = os.path.join(out_dir, "serve_scrape.prom")
+
+    print(f"[serve_smoke] building golden database -> {db}")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, reads])
+    if rc != 0:
+        print("[serve_smoke] FAIL: database build", file=sys.stderr)
+        return 1
+
+    # run the real quorum-serve CLI on an ephemeral-ish port in a
+    # thread; drain over HTTP when done so its final metrics land
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc_box = {}
+
+    def run_server():
+        rc_box["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-batch", str(args.rows),
+             "--max-wait-ms", "2", "-p", "4",
+             "--metrics", metrics_path, db])
+
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    client = ServeClient(port=port, timeout=900.0)
+    deadline = time.perf_counter() + 30
+    while True:
+        try:
+            client.healthz()
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                print("[serve_smoke] FAIL: server never came up",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+
+    with open(reads) as f:
+        body = f.read()
+    with open(expected_fa) as f:
+        want_fa = f.read()
+
+    print("[serve_smoke] cold request (compiles the length bucket)")
+    t0 = time.perf_counter()
+    r1 = client.correct(body)
+    cold_s = time.perf_counter() - t0
+    if r1.status != 200 or r1.fa != want_fa:
+        print(f"[serve_smoke] FAIL: cold request status={r1.status} "
+              f"parity={'ok' if r1.fa == want_fa else 'DRIFT'}",
+              file=sys.stderr)
+        return 1
+    compiles1 = client.healthz()["engine_compiles"]
+
+    print("[serve_smoke] warm request")
+    t0 = time.perf_counter()
+    r2 = client.correct(body)
+    warm_s = time.perf_counter() - t0
+    compiles2 = client.healthz()["engine_compiles"]
+    if r2.status != 200 or r2.fa != want_fa:
+        print("[serve_smoke] FAIL: warm request parity",
+              file=sys.stderr)
+        return 1
+    if compiles2 != compiles1:
+        print(f"[serve_smoke] FAIL: warm request recompiled "
+              f"({compiles1} -> {compiles2})", file=sys.stderr)
+        return 1
+
+    with open(scrape_path, "w") as f:
+        f.write(client.metrics_text())
+    print(f"[serve_smoke] scraped /metrics -> {scrape_path}")
+
+    print("[serve_smoke] draining via /quiesce")
+    client.quiesce()
+    t.join(timeout=60)
+    if t.is_alive() or rc_box.get("rc") != 0:
+        print(f"[serve_smoke] FAIL: drain (alive={t.is_alive()} "
+              f"rc={rc_box.get('rc')})", file=sys.stderr)
+        return 1
+    if not os.path.exists(metrics_path):
+        print("[serve_smoke] FAIL: no final metrics document",
+              file=sys.stderr)
+        return 1
+    print(f"[serve_smoke] OK: parity x2, cold {cold_s:.1f}s, warm "
+          f"{warm_s:.2f}s, compiles flat at {compiles2}, final "
+          f"metrics -> {metrics_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
