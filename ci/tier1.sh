@@ -4,10 +4,16 @@
 # committed expected.fa, warm no-recompile, graceful drain) whose
 # artifacts are gated through tools/metrics_check.py — the final serve
 # metrics document (including the serve request/batch metric names)
-# and the Prometheus /metrics scrape (--prom lint).
+# and the Prometheus /metrics scrape (--prom lint) — and a golden
+# kill-resume run (ISSUE 4, tools/resume_smoke.py: stage 2 hard-killed
+# mid-run by a fault plan, resumed with --resume, byte-diffed against
+# tests/golden/expected.fa; its resume metrics document is gated
+# through metrics_check too, which requires the checkpoint/resume
+# counter names).
 #
 # Usage: ci/tier1.sh [pytest args...]
-# Env:   SKIP_SERVE_SMOKE=1  skips the serve gate (pytest only).
+# Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
+#        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
 set -o pipefail
 set -u
 
@@ -56,6 +62,29 @@ else
     fi
 fi
 
+resume_rc=0
+if [ "${SKIP_RESUME_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: kill-resume smoke skipped (SKIP_RESUME_SMOKE=1)"
+else
+    echo "== golden kill-resume run =="
+    RESUME_DIR=$(mktemp -d /tmp/resume_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "$RESUME_DIR"' EXIT
+    # same shared compile cache as the pytest pass (see serve note)
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/resume_smoke.py \
+        --out-dir "$RESUME_DIR" || resume_rc=$?
+    if [ "$resume_rc" -eq 0 ]; then
+        echo "== metrics_check gate (resume) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$RESUME_DIR/resume_metrics.json" || resume_rc=1
+    fi
+    if [ "$resume_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: kill-resume gate FAILED (rc=$resume_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
+if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

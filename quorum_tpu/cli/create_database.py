@@ -7,7 +7,9 @@ from __future__ import annotations
 import argparse
 import sys
 
+from ..io.fastq import BadReadPolicy
 from ..models.create_database import BuildConfig, create_database_main
+from ..utils import faults
 from ..utils import vlog as vlog_mod
 from ..utils.sizes import parse_size
 from .observability import add_observability_args
@@ -48,6 +50,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="With --metrics: also write JSONL heartbeat "
                         "events at this period (0 = off)")
     add_observability_args(p)
+    # fault tolerance (ISSUE 4)
+    p.add_argument("--checkpoint-dir", metavar="dir", default=None,
+                   help="Write atomic snapshots of the counting table "
+                        "(plus the input batch cursor) here; a killed "
+                        "run restarted with --resume continues from "
+                        "the last one")
+    p.add_argument("--checkpoint-every", metavar="batches", type=int,
+                   default=64,
+                   help="Batches between snapshots (default 64; each "
+                        "snapshot syncs the device)")
+    p.add_argument("--resume", action="store_true",
+                   help="Continue from the last valid checkpoint in "
+                        "--checkpoint-dir (fresh start if none)")
+    p.add_argument("--on-bad-read",
+                   choices=BadReadPolicy.MODES, default="abort",
+                   help="Malformed-record policy: abort the run "
+                        "(default), skip and count, or quarantine to "
+                        "<output>.quarantine.fastq")
+    faults.add_fault_args(p)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("reads", nargs="+", help="Read files")
     return p
@@ -79,6 +100,7 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
     if args.mer < 1 or args.mer > 31:
         print("Mer length must be between 1 and 31", file=sys.stderr)
         return 1
+    faults.setup(args.fault_plan)
     cfg = BuildConfig(
         k=args.mer,
         bits=args.bits,
@@ -88,6 +110,12 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         batch_size=args.batch_size,
         threads=args.threads,
         profile=args.profile,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+        on_bad_read=args.on_bad_read,
+        quarantine_path=(args.output + ".quarantine.fastq"
+                         if args.on_bad_read == "quarantine" else None),
     )
     from .observability import observability
     rc = 1  # flipped to 0 only on success: any exception leaves 1
@@ -108,7 +136,15 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
                                  metrics=obs.registry, tracer=obs.tracer)
             rc = 0
             obs.registry.set_meta(output=args.output)
-        except RuntimeError as e:
+        except (RuntimeError, OSError, ValueError) as e:
+            # RuntimeError: hash-full / checkpoint mismatch; OSError:
+            # real (or injected) IO failures. A CheckpointError is
+            # deterministic — rc 3 tells the driver's retry loop not
+            # to back off and re-run a doomed attempt
+            from ..io.checkpoint import (CheckpointError,
+                                         NON_RETRYABLE_RC)
+            if isinstance(e, CheckpointError):
+                rc = NON_RETRYABLE_RC
             print(str(e), file=sys.stderr)
             obs.status = "error"
     return rc

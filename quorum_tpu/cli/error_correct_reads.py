@@ -8,8 +8,10 @@ from __future__ import annotations
 import argparse
 import sys
 
+from ..io.fastq import BadReadPolicy
 from ..models.ec_config import ECConfig  # noqa: F401 (re-export for users)
 from ..models.error_correct import ECOptions, run_error_correct
+from ..utils import faults
 from ..utils import vlog as vlog_mod
 from .observability import add_observability_args
 
@@ -74,6 +76,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="With --metrics: also write JSONL heartbeat "
                         "events at this period (0 = off)")
     add_observability_args(p)
+    # fault tolerance (ISSUE 4)
+    p.add_argument("--checkpoint-every", metavar="batches", type=int,
+                   default=0,
+                   help="Journal completed batches every N batches: "
+                        "output streams to <prefix>.fa/.log.partial "
+                        "and a kill -> --resume run is byte-identical "
+                        "to an uninterrupted one (needs -o, no "
+                        "--gzip; 0 = off)")
+    p.add_argument("--resume", action="store_true",
+                   help="Skip reads already journaled by an "
+                        "interrupted --checkpoint-every run, then "
+                        "finalize atomically (fresh start if no "
+                        "journal)")
+    p.add_argument("--on-bad-read",
+                   choices=BadReadPolicy.MODES, default="abort",
+                   help="Malformed-record policy: abort the run "
+                        "(default), skip and count, or quarantine to "
+                        "<prefix>.quarantine.fastq")
+    faults.add_fault_args(p)
     p.add_argument("db", help="Mer database")
     p.add_argument("sequence", nargs="+", help="Input sequence")
     return p
@@ -106,6 +127,7 @@ def main(argv=None, db=None, prepacked=None) -> int:
         else 127  # numeric_limits<char>::max()
     )
 
+    faults.setup(args.fault_plan)
     opts = ECOptions(
         output=args.output,
         gzip=args.gzip,
@@ -123,6 +145,9 @@ def main(argv=None, db=None, prepacked=None) -> int:
         metrics_textfile=args.metrics_textfile,
         metrics_force=args.metrics_live,
         trace_spans=args.trace_spans,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+        on_bad_read=args.on_bad_read,
     )
     try:
         run_error_correct(
@@ -135,7 +160,10 @@ def main(argv=None, db=None, prepacked=None) -> int:
         )
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)
-        return 1
+        from ..io.checkpoint import CheckpointError, NON_RETRYABLE_RC
+        # deterministic refusal (journal/config mismatch): rc 3 so
+        # the driver's retry loop fails fast instead of backing off
+        return NON_RETRYABLE_RC if isinstance(e, CheckpointError) else 1
     return 0
 
 

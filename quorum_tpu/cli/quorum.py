@@ -16,10 +16,13 @@ import argparse
 import os
 import re
 import sys
+import time
 
 import dataclasses
 
+from ..io import checkpoint as ckpt_mod
 from ..io import fastq, packing
+from ..utils import faults
 from ..models.error_correct import ECOptions, run_error_correct
 
 # EC's default quality cutoff when the driver passes no -q/-Q to it —
@@ -57,6 +60,67 @@ from .. import __version__ as _PKG_VERSION
 # the CLI reports a 1.x-compatible version with the package version as
 # the local segment (PEP 440).
 VERSION = f"1.1.1+tpu.{_PKG_VERSION}"
+
+# Retry backoff ceiling: exponential growth stops doubling here — a
+# flapping device should not push the next attempt out by hours.
+_RETRY_BACKOFF_CAP_MS = 30_000.0
+
+# module-level so tests mock the clock without touching time.sleep
+# globally (chaos tests assert the exact backoff sequence)
+_sleep = time.sleep
+
+
+def _run_stage_with_retries(reg, stage: str, attempt_fn, retries: int,
+                            backoff_ms: float, cursor_fn=None) -> int:
+    """Run one pipeline stage under the driver's retry policy: on
+    failure (nonzero rc OR an exception of the stages' failure
+    shapes), wait with capped exponential backoff and try again, up
+    to `retries` extra attempts. Every attempt is recorded — the
+    manifest carries `<stage>_attempts`, the registry counts
+    `stage_retries_total`, and each retry emits a `stage_retry` event
+    (cause, attempt number, resumed-from cursor via `cursor_fn`).
+    `attempt_fn(attempt)` returns the stage's rc; retried attempts are
+    expected to pass --resume so the stage continues from its
+    checkpoint instead of restarting."""
+    attempt = 0
+    while True:
+        cause = None
+        try:
+            rc = attempt_fn(attempt)
+            if rc != 0:
+                cause = f"exit status {rc}"
+        except ckpt_mod.CheckpointError as e:
+            # deterministic refusal (config mismatch, corrupt
+            # artifact): retrying with backoff just re-raises it —
+            # surface immediately
+            rc = ckpt_mod.NON_RETRYABLE_RC
+            cause = f"{type(e).__name__}: {e}"
+        except (RuntimeError, ValueError, OSError) as e:
+            rc = 1
+            cause = f"{type(e).__name__}: {e}"
+        if reg.enabled:
+            reg.set_meta(**{f"{stage}_attempts": attempt + 1})
+        if rc == 0:
+            return 0
+        if rc == ckpt_mod.NON_RETRYABLE_RC or attempt >= retries:
+            if cause:
+                print(f"quorum: {stage} failed: {cause}",
+                      file=sys.stderr)
+            return rc
+        delay_ms = min(backoff_ms * (2 ** attempt),
+                       _RETRY_BACKOFF_CAP_MS)
+        cursor = cursor_fn() if cursor_fn is not None else None
+        reg.counter("stage_retries_total").inc()
+        reg.event("stage_retry", stage=stage, attempt=attempt + 1,
+                  cause=cause, backoff_ms=delay_ms, resumed_from=cursor)
+        print(f"quorum: {stage} failed ({cause}); retrying in "
+              f"{delay_ms / 1000.0:.1f}s (attempt {attempt + 2} of "
+              f"{retries + 1}"
+              + (f", resuming from batch {cursor}" if cursor is not None
+                 else "") + ")", file=sys.stderr)
+        if delay_ms > 0:
+            _sleep(delay_ms / 1000.0)
+        attempt += 1
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -116,6 +180,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="With --metrics: JSONL heartbeat period for "
                         "the stages (0 = off)")
     add_observability_args(p, driver=True)
+    # fault tolerance (ISSUE 4)
+    p.add_argument("--checkpoint-dir", metavar="dir", default=None,
+                   help="Enable crash-safe checkpoints: stage-1 table "
+                        "snapshots land here; stage 2 journals beside "
+                        "its output. A killed run restarted with "
+                        "--resume continues instead of recounting")
+    p.add_argument("--checkpoint-every", metavar="batches", type=int,
+                   default=64,
+                   help="Batches between stage checkpoints "
+                        "(default 64)")
+    p.add_argument("--resume", action="store_true",
+                   help="Continue an interrupted run: a finished "
+                        "stage-1 database is reused, otherwise each "
+                        "stage resumes from its last checkpoint")
+    p.add_argument("--stage-retries", metavar="n", type=int, default=0,
+                   help="Retry a failed stage up to n times with "
+                        "capped exponential backoff, resuming from "
+                        "its checkpoint (default 0 = fail fast)")
+    p.add_argument("--retry-backoff-ms", metavar="ms", type=float,
+                   default=500.0,
+                   help="Base retry backoff; doubles per attempt, "
+                        "capped at 30s (default 500)")
+    p.add_argument("--on-bad-read",
+                   choices=fastq.BadReadPolicy.MODES, default="abort",
+                   help="Malformed-record policy: abort (default), "
+                        "skip and count, or quarantine to "
+                        "<prefix>.quarantine.fastq")
+    faults.add_fault_args(p)
     p.add_argument("--debug", action="store_true",
                    help="Display debugging information")
     p.add_argument("--version", action="version", version=VERSION)
@@ -163,6 +255,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
     vlog_mod.verbose = args.debug or vlog_mod.verbose
+    # one in-process plan covers the driver AND both stages (their
+    # mains run in this process); subprocess children would pick it up
+    # from the QUORUM_FAULT_PLAN env var instead
+    faults.setup(args.fault_plan)
 
     # driver telemetry: the run manifest (resolved config, jax
     # backend/devices, compile-cache hits) plus per-child timings;
@@ -200,8 +296,6 @@ def main(argv=None) -> int:
 
 
 def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
-    import time
-
     if not re.match(r"^\d+[kMGT]?$", args.size):
         print(f"Invalid size '{args.size}'. It must be a number, maybe "
               "followed by a suffix (like k, M, G for thousand, million "
@@ -275,6 +369,15 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                 "-q", str(min_q_char + args.min_quality), "-b", "7",
                 "-t", str(threads),
                 "-o", db_file, "--batch-size", str(args.batch_size)]
+    if args.checkpoint_dir:
+        cdb_argv.extend(["--checkpoint-dir", args.checkpoint_dir,
+                         "--checkpoint-every",
+                         str(args.checkpoint_every)])
+    if args.on_bad_read != "abort":
+        # matters for the stage's own read path (it normally consumes
+        # the driver's shared producer, which applies the policy
+        # itself below)
+        cdb_argv.extend(["--on-bad-read", args.on_bad_read])
     if m1 is not None:
         cdb_argv.extend(["--metrics", m1,
                          "--metrics-interval", str(args.metrics_interval)])
@@ -304,8 +407,18 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     def _cached_batches():
         from ..utils.pipeline import prefetch
         t1 = min_q_char + args.min_quality
+        policy = None
+        if args.on_bad_read != "abort":
+            # the driver parses ONCE for both stages, so the bad-read
+            # policy lives on ITS reader; the quarantine lands beside
+            # the corrected output
+            policy = fastq.BadReadPolicy(
+                args.on_bad_read, args.prefix + ".quarantine.fastq",
+                reg if reg.enabled else None)
+            reg.counter("bad_reads_total")
+            reg.set_meta(on_bad_read=args.on_bad_read)
         src = fastq.read_batches(args.reads, args.batch_size,
-                                 threads=threads)
+                                 threads=threads, policy=policy)
 
         def _pack_and_keep(it):
             import numpy as _np
@@ -350,16 +463,74 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                         tracer=driver_tracer)
 
     handoff: dict = {}
-    t_s1 = time.perf_counter()
-    if cdb_cli.main(cdb_argv + list(args.reads), handoff=handoff,
-                    batches=_cached_batches()) != 0:
-        print("Creating the mer database failed. Most likely the size "
-              "passed to the -s switch is too small.", file=sys.stderr)
-        return 1
     if reg.enabled:
-        s1_s = round(time.perf_counter() - t_s1, 3)
-        reg.gauge("stage1_seconds").set(s1_s)
-        reg.event("stage_done", stage="create_database", seconds=s1_s)
+        reg.counter("stage_retries_total")  # lands even at 0
+
+    def _stage1_cursor():
+        if not args.checkpoint_dir:
+            return None
+        return ckpt_mod.Stage1Checkpoint(args.checkpoint_dir).cursor()
+
+    def _stage1_attempt(attempt: int) -> int:
+        # every attempt gets a FRESH shared producer and replay cache
+        # (a failed attempt consumed part of the previous generator)
+        handoff.clear()
+        reads_cache.clear()
+        cache_state["bytes"] = 0
+        cache_state["ok"] = not args.paired_files
+        argv = list(cdb_argv)
+        if args.checkpoint_dir and (args.resume or attempt > 0):
+            argv.append("--resume")
+        return cdb_cli.main(argv + list(args.reads), handoff=handoff,
+                            batches=_cached_batches())
+
+    def _stage1_db_reusable() -> bool:
+        """The reuse bar: a readable database header whose geometry
+        matches THIS run's flags. write_db is atomic (tmp-then-
+        rename) so a torn file shouldn't exist, but a foreign file,
+        or a database built at a different k, must trigger a rebuild,
+        not feed stage 2 the wrong table. (The header doesn't record
+        the input set — resuming over changed inputs is the
+        operator's assertion, as with any --resume.)"""
+        from ..io import db_format as _dbf
+        try:
+            h = _dbf.read_header(db_file)
+        except (OSError, ValueError):
+            return False
+        if (h.get("key_len") != 2 * args.kmer_len
+                or h.get("bits") != 7):
+            print(f"quorum: --resume found {db_file} built with "
+                  f"k={h.get('key_len', 0) // 2}/bits={h.get('bits')}"
+                  f" (this run: k={args.kmer_len}/bits=7); rebuilding",
+                  file=sys.stderr)
+            return False
+        return True
+
+    # driver --resume with stage 1 already durable (its database file
+    # exists and validates, and no partial checkpoint is pending):
+    # reuse it instead of recounting — the point of resuming. Stage 2
+    # then reloads the table and re-reads the inputs from disk.
+    if (args.resume and os.path.exists(db_file)
+            and _stage1_cursor() is None and _stage1_db_reusable()):
+        vlog("Resume: reusing existing mer database ", db_file)
+        reg.event("stage_skipped", stage="create_database",
+                  reason="resume: database exists")
+        reg.set_meta(stage1_resumed_db=db_file)
+    else:
+        t_s1 = time.perf_counter()
+        if _run_stage_with_retries(reg, "create_database",
+                                   _stage1_attempt, args.stage_retries,
+                                   args.retry_backoff_ms,
+                                   cursor_fn=_stage1_cursor) != 0:
+            print("Creating the mer database failed. Most likely the "
+                  "size passed to the -s switch is too small.",
+                  file=sys.stderr)
+            return 1
+        if reg.enabled:
+            s1_s = round(time.perf_counter() - t_s1, 3)
+            reg.gauge("stage1_seconds").set(s1_s)
+            reg.event("stage_done", stage="create_database",
+                      seconds=s1_s)
     prepacked = reads_cache if cache_state["ok"] and reads_cache else None
 
     # Stage 2: error correction (quorum.in:162-231)
@@ -377,6 +548,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             ec_common.extend([flag, str(val)])
     if args.trim_contaminant:
         ec_common.append("--trim-contaminant")
+    if args.checkpoint_dir:
+        ec_common.extend(["--checkpoint-every",
+                          str(args.checkpoint_every)])
+    if args.on_bad_read != "abort":
+        ec_common.extend(["--on-bad-read", args.on_bad_read])
     no_discard = args.no_discard or args.paired_files
     if no_discard:
         ec_common.append("--no-discard")
@@ -400,14 +576,33 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             reg.gauge("stage2_seconds").set(s2_s)
             reg.event("stage_done", stage="error_correct", seconds=s2_s)
 
+    def _stage2_cursor():
+        if not args.checkpoint_dir:
+            return None
+        return ckpt_mod.Stage2Journal(args.prefix).batches_done()
+
+    def _stage2_resume(attempt: int) -> bool:
+        return bool(args.checkpoint_dir
+                    and (args.resume or attempt > 0))
+
     if not args.paired_files:
         ec_argv = ec_common + ["-o", args.prefix, db_file] + list(args.reads)
         if args.debug:
             print("+ quorum_error_correct_reads " + " ".join(ec_argv),
                   file=sys.stderr)
+
+        def _stage2_attempt(attempt: int) -> int:
+            argv = list(ec_argv)
+            if _stage2_resume(attempt):
+                argv.append("--resume")
+            return ec_cli.main(argv, db=handoff.get("db"),
+                               prepacked=prepacked)
+
         t_s2 = time.perf_counter()
-        if ec_cli.main(ec_argv, db=handoff.get("db"),
-                       prepacked=prepacked) != 0:
+        if _run_stage_with_retries(reg, "error_correct",
+                                   _stage2_attempt, args.stage_retries,
+                                   args.retry_backoff_ms,
+                                   cursor_fn=_stage2_cursor) != 0:
             print("Error correction failed", file=sys.stderr)
             return 1
         record_stage2(t_s2)
@@ -437,13 +632,23 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                      ("homo_trim", args.homo_trim)):
         if val is not None:
             kwargs[key] = val
-    t_s2 = time.perf_counter()
-    try:
-        run_error_correct(db_file, [], None, opts,
+    def _stage2_paired_attempt(attempt: int) -> int:
+        o = opts
+        if args.checkpoint_dir:
+            o = dataclasses.replace(
+                opts, checkpoint_every=args.checkpoint_every,
+                resume=_stage2_resume(attempt))
+        run_error_correct(db_file, [], None, o,
                           records=merge_records(args.reads),
                           db=handoff.get("db"), **kwargs)
-    except (RuntimeError, ValueError, OSError) as e:
-        print(str(e), file=sys.stderr)
+        return 0
+
+    t_s2 = time.perf_counter()
+    if _run_stage_with_retries(reg, "error_correct",
+                               _stage2_paired_attempt,
+                               args.stage_retries,
+                               args.retry_backoff_ms,
+                               cursor_fn=_stage2_cursor) != 0:
         print("Error correction failed", file=sys.stderr)
         return 1
     record_stage2(t_s2)

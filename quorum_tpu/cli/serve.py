@@ -15,6 +15,7 @@ import argparse
 import signal
 import sys
 
+from ..utils import faults
 from ..utils import vlog as vlog_mod
 from ..utils.vlog import vlog
 from .observability import add_observability_args, observability
@@ -86,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-grace-s", type=float, default=30.0,
                    help="Max seconds a drain waits for in-flight "
                         "batches (default 30)")
+    p.add_argument("--max-consecutive-failures", metavar="n", type=int,
+                   default=5,
+                   help="After n device-step failures in a row, "
+                        "/healthz answers 503 (unhealthy) so load "
+                        "balancers eject the replica; any success "
+                        "resets the streak (default 5; 0 = never)")
     p.add_argument("--warmup-lengths", metavar="L1,L2,...", default=None,
                    help="Comma-separated read lengths to pre-compile "
                         "before listening (one device step per "
@@ -93,6 +100,7 @@ def build_parser() -> argparse.ArgumentParser:
     # observability (same surface as the other CLIs; --metrics
     # writes the final document on drain)
     add_observability_args(p, metrics=True)
+    faults.add_fault_args(p)
     p.add_argument("db", help="Mer database")
     return p
 
@@ -103,6 +111,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # OR, not assign: QUORUM_TPU_VERBOSE may have enabled it already
     vlog_mod.verbose = args.verbose or vlog_mod.verbose
+    faults.setup(args.fault_plan)
 
     if args.qual_cutoff_char is not None and args.qual_cutoff_value is not None:
         print("Switches -q and -Q are conflicting.", file=sys.stderr)
@@ -170,10 +179,12 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
     if warmup_lengths:
         vlog("Warming ", len(warmup_lengths), " length buckets")
         engine.warmup(warmup_lengths)
-    batcher = DynamicBatcher(engine, max_batch=args.max_batch,
-                             max_wait_ms=args.max_wait_ms,
-                             queue_requests=args.queue_requests,
-                             registry=reg)
+    batcher = DynamicBatcher(
+        engine, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_requests=args.queue_requests,
+        max_consecutive_failures=args.max_consecutive_failures,
+        registry=reg)
     server = CorrectionServer(batcher, host=args.host, port=args.port,
                               deadline_ms=args.deadline_ms, registry=reg,
                               drain_grace_s=args.drain_grace_s)

@@ -1,0 +1,316 @@
+"""Crash-safe checkpoint/resume artifacts for both pipeline stages
+(ISSUE 4).
+
+A kill anywhere mid-run — IO error, device failure, SIGKILL — used to
+discard every completed batch; production counters don't accept that
+(KMC 3 survives on disk-resident partial bins, PAPERS.md). Two
+artifacts fix it:
+
+* **Stage-1 snapshot** (`Stage1Checkpoint`): the build-side counting
+  table (ops/ctable.TBuildState: tag/hq/lq planes) plus the input
+  batch cursor and running stats, as ONE file — a JSON header line
+  followed by the raw planes — written tmp-then-rename (the
+  `atomic_write` idiom, streamed so a multi-GB table never doubles in
+  host RAM). `--resume` reloads the last valid snapshot and skips the
+  first `cursor` batches of the (deterministically re-batched) input.
+
+* **Stage-2 journal** (`Stage2Journal`): corrected output streams to
+  `<prefix>.fa.partial` / `<prefix>.log.partial`; after every
+  `--checkpoint-every` batches the pipeline drains, flushes, and
+  commits `<prefix>.resume.json` (atomic_write) recording the batch
+  cursor, completed-read stats, and the exact committed byte length
+  of each partial. `--resume` truncates the partials back to the last
+  committed bytes (discarding any torn tail), skips the journaled
+  batches, and continues appending; `finalize()` renames the partials
+  over the real outputs and removes the journal — so a kill → resume
+  run is byte-identical to an uninterrupted one and readers of
+  `<prefix>.fa` can never observe a half-written file.
+
+Both artifacts validate geometry/config on load: resuming with a
+different k, batch size, or input set is a hard error, not silent
+corruption.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..telemetry.registry import atomic_write
+
+STAGE1_FORMAT = "quorum_tpu_stage1_ckpt/1"
+STAGE2_FORMAT = "quorum_tpu_stage2_journal/1"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint/journal exists but cannot be used (corrupt, or
+    written by a run with different parameters). Deterministic — the
+    driver's retry loop must NOT back off and re-try it."""
+
+
+# the rc the stage CLIs return for a CheckpointError, so the driver's
+# retry loop can tell a deterministic refusal from a transient failure
+# across the main()-returns-int boundary
+NON_RETRYABLE_RC = 3
+
+
+# ---------------------------------------------------------------------------
+# Stage 1: counting-table snapshot
+# ---------------------------------------------------------------------------
+
+
+class Stage1Snapshot:
+    """A loaded stage-1 snapshot: host-side table planes + cursor."""
+
+    def __init__(self, header: dict, tag: np.ndarray, hq: np.ndarray,
+                 lq: np.ndarray):
+        self.header = header
+        self.tag = tag
+        self.hq = hq
+        self.lq = lq
+
+    @property
+    def rb_log2(self) -> int:
+        return int(self.header["rb_log2"])
+
+    @property
+    def cursor(self) -> int:
+        return int(self.header["cursor"])
+
+    def check_config(self, k: int, bits: int, qual_thresh: int,
+                     batch_size: int, paths) -> None:
+        h = self.header
+        want = {"k": k, "bits": bits, "qual_thresh": qual_thresh,
+                "batch_size": batch_size}
+        for key, val in want.items():
+            if int(h.get(key, -1)) != int(val):
+                raise CheckpointError(
+                    f"stage-1 checkpoint was written with {key}="
+                    f"{h.get(key)}, this run uses {val}; refusing to "
+                    "resume (delete the checkpoint to start over)")
+        if list(h.get("paths", [])) != list(paths):
+            raise CheckpointError(
+                f"stage-1 checkpoint covers inputs {h.get('paths')}, "
+                f"this run reads {list(paths)}; refusing to resume")
+
+
+class Stage1Checkpoint:
+    """Atomic snapshot file `<dir>/stage1.ckpt`."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, "stage1.ckpt")
+
+    def save(self, bstate, meta, cfg, cursor: int, stats,
+             paths) -> None:
+        """Snapshot the build table after `cursor` fully-inserted
+        batches. D2H happens here (np.asarray) — the snapshot is a
+        sync point, which is why `--checkpoint-every` is a cadence
+        knob. Streamed tmp-then-rename: same atomicity contract as
+        atomic_write without materializing a second copy of a
+        multi-GB table in RAM."""
+        os.makedirs(self.dir, exist_ok=True)
+        tag = np.ascontiguousarray(np.asarray(bstate.tag, dtype=np.uint32))
+        hq = np.ascontiguousarray(np.asarray(bstate.hq, dtype=np.uint32))
+        lq = np.ascontiguousarray(np.asarray(bstate.lq, dtype=np.uint32))
+        header = {
+            "format": STAGE1_FORMAT,
+            "k": meta.k,
+            "bits": meta.bits,
+            "rb_log2": meta.rb_log2,
+            "cursor": int(cursor),
+            "reads": int(stats.reads),
+            "bases": int(stats.bases),
+            "batches": int(stats.batches),
+            "grows": int(stats.grows),
+            "qual_thresh": int(cfg.qual_thresh),
+            "batch_size": int(cfg.batch_size),
+            "paths": list(paths),
+            "tag_shape": list(tag.shape),
+            "acc_len": int(hq.shape[0]),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(tag.tobytes())
+            f.write(hq.tobytes())
+            f.write(lq.tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self) -> Stage1Snapshot | None:
+        """The last valid snapshot, or None when there is none. A
+        truncated/corrupt file raises CheckpointError (resuming from
+        garbage must not look like a fresh start)."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            line = f.readline(1 << 20)
+            try:
+                header = json.loads(line)
+            except ValueError:
+                raise CheckpointError(
+                    f"corrupt stage-1 checkpoint '{self.path}' (bad "
+                    "header)") from None
+            if header.get("format") != STAGE1_FORMAT:
+                raise CheckpointError(
+                    f"'{self.path}' is not a stage-1 checkpoint "
+                    f"(format={header.get('format')!r})")
+            rows, tile = header["tag_shape"]
+            acc = header["acc_len"]
+            want = (rows * tile + 2 * acc) * 4
+            payload = f.read()
+        if len(payload) != want:
+            raise CheckpointError(
+                f"corrupt stage-1 checkpoint '{self.path}': payload "
+                f"{len(payload)} bytes, want {want}")
+        arr = np.frombuffer(payload, dtype=np.uint32)
+        tag = arr[:rows * tile].reshape(rows, tile)
+        hq = arr[rows * tile:rows * tile + acc]
+        lq = arr[rows * tile + acc:]
+        return Stage1Snapshot(header, tag, hq, lq)
+
+    def cursor(self) -> int | None:
+        """Header-only peek at the snapshot's batch cursor (for the
+        driver's retry events); None when no usable snapshot."""
+        try:
+            if not os.path.exists(self.path):
+                return None
+            with open(self.path, "rb") as f:
+                header = json.loads(f.readline(1 << 20))
+            return int(header["cursor"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def clear(self) -> None:
+        """Remove the snapshot (a completed build must not feed a
+        later unrelated --resume)."""
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: output journal
+# ---------------------------------------------------------------------------
+
+
+class Stage2Journal:
+    """Journal + partial-output lifecycle for one `-o PREFIX` run."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self.fa_final = prefix + ".fa"
+        self.log_final = prefix + ".log"
+        self.fa_partial = self.fa_final + ".partial"
+        self.log_partial = self.log_final + ".partial"
+        self.path = prefix + ".resume.json"
+
+    def load(self) -> dict | None:
+        """The committed journal state, or None when there is nothing
+        to resume (no journal, or the partials are gone — e.g. a
+        crash landed between finalize's renames; the run simply
+        starts fresh and converges on the same bytes)."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except ValueError:
+            raise CheckpointError(
+                f"corrupt stage-2 journal '{self.path}'") from None
+        if doc.get("format") != STAGE2_FORMAT:
+            raise CheckpointError(
+                f"'{self.path}' is not a stage-2 journal "
+                f"(format={doc.get('format')!r})")
+        if not (os.path.exists(self.fa_partial)
+                and os.path.exists(self.log_partial)):
+            return None
+        return doc
+
+    def check_config(self, st: dict, batch_size: int,
+                     context: dict | None = None) -> None:
+        """Refuse to resume across a changed run: a different batch
+        size skips the wrong reads; a different database, input set,
+        or correction config would silently splice two different
+        corrections into one output file."""
+        if int(st.get("batch_size", -1)) != int(batch_size):
+            raise CheckpointError(
+                f"stage-2 journal was written with batch_size="
+                f"{st.get('batch_size')}, this run uses {batch_size}; "
+                "resuming would skip the wrong reads")
+        want = st.get("context", {})
+        for key, val in (context or {}).items():
+            if key in want and want[key] != val:
+                raise CheckpointError(
+                    f"stage-2 journal was written with {key}="
+                    f"{want[key]!r}, this run uses {val!r}; refusing "
+                    "to resume (remove the journal to start over)")
+
+    def open_outputs(self, st: dict | None):
+        """Open the partial output streams. With a journal state,
+        truncate each partial back to its last committed byte length
+        first (a kill mid-write leaves a torn tail past the commit;
+        the truncate discards exactly that) and append; without one,
+        start fresh."""
+        if st is not None:
+            for p, committed in ((self.fa_partial, st["fa_bytes"]),
+                                 (self.log_partial, st["log_bytes"])):
+                size = os.path.getsize(p)
+                if size < committed:
+                    raise CheckpointError(
+                        f"'{p}' is {size} bytes but the journal "
+                        f"committed {committed}; cannot resume")
+                with open(p, "r+b") as f:
+                    f.truncate(int(committed))
+            mode = "a"
+        else:
+            mode = "w"
+        return open(self.fa_partial, mode), open(self.log_partial, mode)
+
+    def commit(self, batches: int, stats, fa_bytes: int,
+               log_bytes: int, batch_size: int,
+               context: dict | None = None) -> None:
+        """Record that the first `batches` batches are fully rendered,
+        written, and flushed. Caller guarantees the flush happened
+        BEFORE this call — the journal must never claim bytes the
+        partials might not have. `context` (db path, input paths,
+        config fingerprint) is what check_config holds a resume to."""
+        atomic_write(self.path, json.dumps({
+            "format": STAGE2_FORMAT,
+            "batches": int(batches),
+            "fa_bytes": int(fa_bytes),
+            "log_bytes": int(log_bytes),
+            "batch_size": int(batch_size),
+            "context": context or {},
+            "reads": int(stats.reads),
+            "corrected": int(stats.corrected),
+            "skipped": int(stats.skipped),
+            "bases_in": int(stats.bases_in),
+            "bases_out": int(stats.bases_out),
+        }) + "\n")
+
+    def batches_done(self) -> int | None:
+        """Peek at the journaled batch cursor (driver retry events)."""
+        try:
+            st = self.load()
+        except CheckpointError:
+            return None
+        return int(st["batches"]) if st else None
+
+    def finalize(self) -> None:
+        """Atomically promote the partials to the real outputs and
+        drop the journal. Idempotent: a crash between the renames
+        leaves a state this (or a fresh run) completes."""
+        if os.path.exists(self.fa_partial):
+            os.replace(self.fa_partial, self.fa_final)
+        if os.path.exists(self.log_partial):
+            os.replace(self.log_partial, self.log_final)
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass

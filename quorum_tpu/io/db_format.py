@@ -64,6 +64,19 @@ def _header_common(cmdline):
     }
 
 
+def _atomic_db_write(path: str, header: dict, payload: bytes) -> None:
+    """tmp-then-rename with fsync: a kill mid-write must never leave
+    a torn (or unflushed-then-renamed) file at `path` — the quorum
+    driver's --resume treats an existing database as stage 1 done."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(json.dumps(header).encode() + b"\n")
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def write_db(path: str, state, meta, cmdline: list[str] | None = None,
              compact: bool = True, n_entries: int | None = None) -> None:
     """`n_entries` (optional) spares the occupancy-counting pass when
@@ -103,9 +116,7 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
                 "value_bytes": int(buf.nbytes),
                 **_header_common(cmdline),
             }
-            with open(path, "wb") as f:
-                f.write(json.dumps(header).encode() + b"\n")
-                f.write(buf.tobytes())
+            _atomic_db_write(path, header, buf.tobytes())
             return
         rows = np.asarray(state.rows, dtype=np.uint32)
         header = {
@@ -118,9 +129,7 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
             "value_bytes": int(rows.nbytes),
             **_header_common(cmdline),
         }
-        with open(path, "wb") as f:
-            f.write(json.dumps(header).encode() + b"\n")
-            f.write(rows.tobytes())
+        _atomic_db_write(path, header, rows.tobytes())
         return
     raise TypeError(f"write_db expects a tile table, got {type(meta)}")
 

@@ -18,11 +18,14 @@ import dataclasses
 import gzip
 import io as _io
 import sys
+import threading
 from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
 from ..ops import mer
+from ..utils import faults
+from ..utils.vlog import vlog
 
 # Read-length buckets: batches are padded to the smallest bucket that
 # fits the longest read in the batch, so jit specializations stay few.
@@ -47,6 +50,73 @@ class ReadBatch:
     n: int
 
 
+class BadReadPolicy:
+    """What to do with a malformed record mid-stream (`--on-bad-read`).
+
+    * ``abort`` (the default, and the only behavior before ISSUE 4):
+      raise — one bad record kills the run.
+    * ``skip``: drop the record, count it (`bad_reads_total`), keep
+      streaming.
+    * ``quarantine``: like skip, but the offending record's raw bytes
+      are appended to `quarantine_path` (a `.quarantine.fastq`) so the
+      operator can triage what the instrument produced instead of
+      grepping a Gbase input for it.
+
+    Thread-safe (the multi-file reader parses on worker threads);
+    shared by stage 1, stage 2, and the quorum driver's one-parse
+    path. `registry` (an enabled telemetry registry, or None) carries
+    the counter."""
+
+    MODES = ("abort", "skip", "quarantine")
+
+    def __init__(self, mode: str = "abort",
+                 quarantine_path: str | None = None, registry=None):
+        if mode not in self.MODES:
+            raise ValueError(f"bad on-bad-read mode {mode!r} "
+                             f"(one of {self.MODES})")
+        if mode == "quarantine" and not quarantine_path:
+            raise ValueError("quarantine mode needs a quarantine path")
+        self.mode = mode
+        self.quarantine_path = quarantine_path
+        self.registry = registry
+        self.bad = 0
+        self._lock = threading.Lock()
+        self._f = None
+        self._closed = False
+
+    @property
+    def wants_raw(self) -> bool:
+        return self.mode == "quarantine"
+
+    def handle(self, path: str, err: Exception, raw_lines) -> None:
+        """One malformed record: raise (abort) or record and
+        continue."""
+        if self.mode == "abort":
+            raise err
+        with self._lock:
+            self.bad += 1
+            if self.registry is not None:
+                self.registry.counter("bad_reads_total").inc()
+            if (self.mode == "quarantine" and raw_lines
+                    and not self._closed):
+                if self._f is None:
+                    self._f = open(self.quarantine_path, "wb")
+                for ln in raw_lines:
+                    self._f.write(ln)
+                self._f.flush()
+        vlog("Bad read in ", path, ": ", err)
+
+    def close(self) -> None:
+        """Idempotent; a straggler worker hitting a bad record after
+        close still counts it but writes nothing (reopening would
+        truncate the quarantine)."""
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
 def _open(path: str):
     if path == "-" or path == "/dev/fd/0" or path == "/dev/stdin":
         return sys.stdin.buffer
@@ -61,60 +131,109 @@ def _open(path: str):
     return f
 
 
-def iter_records(paths: Sequence[str]) -> Iterator[tuple[str, bytes, bytes]]:
+def iter_records(paths: Sequence[str],
+                 policy: BadReadPolicy | None = None,
+                 ) -> Iterator[tuple[str, bytes, bytes]]:
     """Yield (header, seq, qual) byte records across files. qual is b''
     for FASTA records (Jellyfish's parser does the same; merge_mate_pairs
-    then fabricates '*' quals, src/merge_mate_pairs.cc:51-59)."""
+    then fabricates '*' quals, src/merge_mate_pairs.cc:51-59).
+
+    `policy` (a BadReadPolicy, or None = abort) decides what happens
+    to malformed records mid-stream."""
     for path in paths:
         f = _open(path)
         try:
-            yield from _iter_one(f, path)
+            yield from _iter_one(f, path, policy)
         finally:
             if f is not sys.stdin.buffer:
                 f.close()
 
 
-def _iter_one(f, path: str) -> Iterator[tuple[str, bytes, bytes]]:
+def _iter_one(f, path: str, policy: BadReadPolicy | None = None,
+              ) -> Iterator[tuple[str, bytes, bytes]]:
+    # raw-line capture (for quarantine) only when someone wants it —
+    # the common abort/skip paths never build the list
+    capture = policy is not None and policy.wants_raw
     line = f.readline()
     while line:
-        line = line.rstrip(b"\r\n")
-        if not line:
+        stripped = line.rstrip(b"\r\n")
+        if not stripped:
             line = f.readline()
             continue
-        if line.startswith(b">"):
-            header = line[1:].decode()
+        if stripped.startswith(b">"):
+            raw = [line] if capture else None
+            header_b = stripped[1:]
             seq_parts = []
             line = f.readline()
             while line and not line.startswith(b">") and not line.startswith(b"@"):
+                if capture:
+                    raw.append(line)
                 seq_parts.append(line.rstrip(b"\r\n"))
                 line = f.readline()
+            try:
+                header = header_b.decode()
+            except UnicodeDecodeError as err:
+                # a corrupt header byte is a malformed record like any
+                # other — the policy decides, after the record's lines
+                # are consumed so the stream resyncs cleanly
+                if policy is None:
+                    raise
+                policy.handle(path, err, raw or [])
+                continue
+            faults.inject("fastq.read")
             yield header, b"".join(seq_parts), b""
-        elif line.startswith(b"@"):
-            header = line[1:].decode()
+        elif stripped.startswith(b"@"):
+            raw = [line] if capture else None
+            header_b = stripped[1:]
             seq_parts = []
             line = f.readline()
             while line and not line.startswith(b"+"):
+                if capture:
+                    raw.append(line)
                 seq_parts.append(line.rstrip(b"\r\n"))
                 line = f.readline()
             seq = b"".join(seq_parts)
             # line is the '+' separator; read quals until length matches
+            if capture and line:
+                raw.append(line)
             qual_parts = []
             qlen = 0
             line = f.readline()
             while line and qlen < len(seq):
+                if capture:
+                    raw.append(line)
                 q = line.rstrip(b"\r\n")
                 qual_parts.append(q)
                 qlen += len(q)
                 line = f.readline()
             qual = b"".join(qual_parts)
+            try:
+                header = header_b.decode()
+            except UnicodeDecodeError as err:
+                if policy is None:
+                    raise
+                policy.handle(path, err, raw or [])
+                continue
             if len(qual) != len(seq):
-                raise ValueError(
+                err = ValueError(
                     f"{path}: quality length {len(qual)} != sequence length "
                     f"{len(seq)} for read '{header}'"
                 )
+                if policy is None:
+                    raise err
+                # `line` already holds the first unconsumed line, so
+                # the stream resyncs at the next record boundary
+                policy.handle(path, err, raw or [])
+                continue
+            faults.inject("fastq.read")
             yield header, seq, qual
         else:
-            raise ValueError(f"{path}: unrecognized record start: {line[:40]!r}")
+            err = ValueError(
+                f"{path}: unrecognized record start: {stripped[:40]!r}")
+            if policy is None:
+                raise err
+            policy.handle(path, err, [line] if capture else [])
+            line = f.readline()
 
 
 def bucket_for(length: int) -> int:
@@ -158,23 +277,33 @@ def _make_batch(buf, batch_size) -> ReadBatch:
                      headers=headers, n=n)
 
 
-def _read_batches_one(paths: Sequence[str],
-                      batch_size: int) -> Iterator[ReadBatch]:
+def _read_batches_one(paths: Sequence[str], batch_size: int,
+                      policy: BadReadPolicy | None = None,
+                      ) -> Iterator[ReadBatch]:
     use_native = False
-    try:  # C++ fast path, if the shared library is built
-        from ..native import binding as _nb
-        use_native = _nb.available()
-    except Exception:
-        use_native = False
+    # a non-abort bad-read policy needs the pure-Python parser (the
+    # C++ fast path has no record-recovery hooks), and so does an
+    # active fault plan (the fastq.read injection site lives here —
+    # a chaos test must not false-pass because the native path
+    # silently bypassed it)
+    if (policy is None or policy.mode == "abort") \
+            and not faults.active():
+        try:  # C++ fast path, if the shared library is built
+            from ..native import binding as _nb
+            use_native = _nb.available()
+        except Exception:
+            use_native = False
     if use_native:
         from ..native import binding as _nb
         yield from _nb.read_batches(paths, batch_size)
     else:
-        yield from batch_records(iter_records(paths), batch_size)
+        yield from batch_records(iter_records(paths, policy), batch_size)
 
 
 def read_batches(paths: Sequence[str], batch_size: int = 8192,
-                 threads: int = 1) -> Iterator[ReadBatch]:
+                 threads: int = 1,
+                 policy: BadReadPolicy | None = None,
+                 ) -> Iterator[ReadBatch]:
     """Batched reads from FASTQ/FASTA files.
 
     With threads > 1 and multiple input files, up to `threads` files
@@ -187,11 +316,17 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
     inputs decode on one worker regardless (gzip is inherently
     serial); the prefetch thread still overlaps it with device work."""
     if threads <= 1 or len(paths) <= 1:
-        yield from _read_batches_one(paths, batch_size)
+        try:
+            yield from _read_batches_one(paths, batch_size, policy)
+        finally:
+            # the reader owns the policy lifecycle: the quarantine
+            # stream closes however this generator ends (exhausted,
+            # abandoned, or errored) — callers don't have to remember
+            if policy is not None:
+                policy.close()
         return
     import itertools
     import queue
-    import threading
 
     from ..utils.pipeline import put_or_stop as _put_or_stop
 
@@ -218,7 +353,8 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
             if i >= len(paths):
                 return
             try:
-                for b in _read_batches_one([paths[i]], batch_size):
+                for b in _read_batches_one([paths[i]], batch_size,
+                                           policy):
                     if not put_or_stop(i, b):
                         return
                 if not put_or_stop(i, None):
@@ -243,3 +379,5 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
                 yield item
     finally:
         stop.set()
+        if policy is not None:
+            policy.close()

@@ -25,10 +25,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..io import checkpoint as ckpt_mod
 from ..io import fastq, db_format, packing
 from ..ops import ctable, mer
 from ..telemetry import NULL as NULL_METRICS
 from ..telemetry import NULL_TRACER, observe_dispatch_wait
+from ..utils import faults
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -45,6 +47,15 @@ class BuildConfig:
     threads: int = 1  # -t: parallel host decode workers (multi-file)
     max_grows: int = 16
     profile: str | None = None  # --profile DIR: jax.profiler trace
+    # fault tolerance (ISSUE 4): --checkpoint-dir enables atomic
+    # snapshots of the counting table every --checkpoint-every
+    # batches; --resume continues from the last valid one
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 64  # batches between snapshots
+    resume: bool = False
+    # --on-bad-read: malformed-record policy (io/fastq.BadReadPolicy)
+    on_bad_read: str = "abort"
+    quarantine_path: str | None = None
 
 
 # canonical home is ops/ctable (so the fused stage-1 dispatch can use
@@ -100,6 +111,37 @@ def build_database(
     reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
                  qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size)
 
+    # crash safety (ISSUE 4): resume from the last atomic snapshot —
+    # the table planes come back exactly as checkpointed, and the
+    # first `cursor` batches of the deterministically re-batched
+    # input are skipped instead of re-counted
+    ck = (ckpt_mod.Stage1Checkpoint(cfg.checkpoint_dir)
+          if cfg.checkpoint_dir else None)
+    skip_batches = 0
+    if ck is not None and cfg.resume:
+        snap = ck.load()
+        if snap is not None:
+            snap.check_config(cfg.k, cfg.bits, cfg.qual_thresh,
+                              cfg.batch_size, paths)
+            meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits,
+                                   rb_log2=snap.rb_log2)
+            bstate = ctable.TBuildState(jnp.asarray(snap.tag),
+                                        jnp.asarray(snap.hq),
+                                        jnp.asarray(snap.lq))
+            h = snap.header
+            stats.reads, stats.bases = h["reads"], h["bases"]
+            stats.batches, stats.grows = h["batches"], h["grows"]
+            skip_batches = snap.cursor
+            reg.counter("resume_skipped_reads")  # lands even at 0
+            reg.set_meta(resumed=True, resumed_from_batch=skip_batches)
+            reg.event("resume", stage="create_database",
+                      cursor=skip_batches)
+            vlog("Resuming stage 1 from checkpoint: ", skip_batches,
+                 " batches (", stats.reads, " reads) already counted")
+    if ck is not None:
+        reg.counter("checkpoint_writes_total")
+        reg.set_meta(checkpoint_every=cfg.checkpoint_every)
+
     if batches is None:
         # host decode/encode/bit-packing overlaps device rounds (double
         # buffering, the PP row of SURVEY §2.4). H2D stays on the MAIN
@@ -123,15 +165,32 @@ def build_database(
                 "multi-host build requires the sharded pipeline "
                 "(parallel.tile_sharded.build_database_tile_sharded + "
                 "parallel.multihost), not the single-chip CLI")
+        policy = None
+        if cfg.on_bad_read != "abort":
+            # read_batches owns the policy's lifecycle: its generator
+            # finally closes the quarantine stream however this build
+            # ends
+            policy = fastq.BadReadPolicy(
+                cfg.on_bad_read, cfg.quarantine_path,
+                reg if reg.enabled else None)
+            reg.counter("bad_reads_total")  # lands even at 0
+            reg.set_meta(on_bad_read=cfg.on_bad_read)
         src = fastq.read_batches(paths, cfg.batch_size,
-                                 threads=cfg.threads)
+                                 threads=cfg.threads, policy=policy)
         batches = prefetch(_pack(src),
                            metrics=reg if reg.enabled else None,
                            tracer=tracer)
     timer = StageTimer()
     with trace(cfg.profile):
         for batch, pk in batches:
+            if skip_batches > 0:
+                # resume fast-path: already counted before the crash
+                # (stats were restored from the snapshot)
+                skip_batches -= 1
+                reg.counter("resume_skipped_reads").inc(batch.n)
+                continue
             step_i = stats.batches
+            faults.inject("stage1.insert", batch=step_i)
             stats.batches += 1
             stats.reads += batch.n
             nb = int(batch.lengths.sum())
@@ -185,6 +244,19 @@ def build_database(
                 else:
                     if full:
                         raise RuntimeError("Hash is full")
+            if (ck is not None and cfg.checkpoint_every > 0
+                    and stats.batches % cfg.checkpoint_every == 0):
+                # atomic snapshot: table planes + batch cursor. The
+                # D2H here is the sync point --checkpoint-every
+                # amortizes; a kill at ANY instant leaves either the
+                # old snapshot or the new one, never a torn file.
+                with timer.stage("checkpoint"), tracer.span(
+                        "checkpoint", batch=stats.batches):
+                    ck.save(bstate, meta, cfg, stats.batches, stats,
+                            paths)
+                reg.counter("checkpoint_writes_total").inc()
+                reg.event("checkpoint", stage="create_database",
+                          cursor=stats.batches)
     with timer.stage("seal"), tracer.span("seal"):
         # ONE dispatch: dup check + finalize + stats fused (separate
         # calls each walk the full build planes; measured seconds per
@@ -245,4 +317,8 @@ def create_database_main(
     else:
         db_format.write_db(output, state, meta, cmdline,
                            n_entries=stats.distinct)
+    if cfg.checkpoint_dir:
+        # the finished database IS the durable artifact now; a stale
+        # snapshot must not feed a later unrelated --resume
+        ckpt_mod.Stage1Checkpoint(cfg.checkpoint_dir).clear()
     return stats

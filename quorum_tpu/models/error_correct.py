@@ -31,10 +31,12 @@ from typing import Sequence
 
 import jax
 
+from ..io import checkpoint as ckpt_mod
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
 from ..telemetry import observe_dispatch_wait
+from ..utils import faults
 from ..utils.pipeline import AsyncWriter, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -140,6 +142,13 @@ class ECOptions:
     metrics_force: bool = False  # --metrics-live: real registry for a
     # parent-owned exposition endpoint (quorum driver --metrics-port)
     trace_spans: str | None = None  # --trace-spans PATH: span JSONL
+    # fault tolerance (ISSUE 4): with checkpoint_every > 0 the output
+    # streams to <prefix>.fa/.log.partial with a resume journal
+    # committed every N batches; resume=True skips already-corrected
+    # reads and atomically finalizes (io/checkpoint.Stage2Journal)
+    checkpoint_every: int = 0
+    resume: bool = False
+    on_bad_read: str = "abort"  # malformed-record policy (io/fastq)
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -235,6 +244,13 @@ def _run_ec(db_path: str, sequences: Sequence[str],
             trim_contaminant: bool,
             no_discard: bool,
             records, db, prepacked) -> ECStats:
+    if opts.checkpoint_every > 0 and (not opts.output or opts.gzip):
+        # before the DB load: a misconfigured flag must fail fast,
+        # not after minutes of device upload
+        raise RuntimeError(
+            "--checkpoint-every requires -o PREFIX and is "
+            "incompatible with --gzip (a gzip stream cannot be "
+            "truncated back to a commit point)")
     vlog("Loading mer database")
     if db is not None:
         # in-process handoff from stage 1: the table is already device
@@ -268,9 +284,60 @@ def _run_ec(db_path: str, sequences: Sequence[str],
         vlog("Loading contaminant sequences")
         contam = contaminant_mod.load_contaminant(opts.contaminant, cfg.k)
 
-    out = _open_out(opts.output, ".fa", sys.stdout, opts.gzip)
-    log = _open_out(opts.output, ".log", sys.stderr, opts.gzip)
+    # crash safety (ISSUE 4): with journaling the output streams to
+    # .partial files, a journal commits completed batches + exact byte
+    # offsets, and a kill -> --resume run truncates the torn tail,
+    # skips the journaled batches, and finalizes atomically — byte-
+    # identical to an uninterrupted run
+    journal = None
+    jctx = None
+    if opts.checkpoint_every > 0:  # flags validated at entry
+        journal = ckpt_mod.Stage2Journal(opts.output)
+        # the resume identity: same database, same inputs, same
+        # correction config — anything else would splice two
+        # different corrections into one output file
+        jctx = {"db": db_path, "inputs": list(sequences),
+                "config": repr(cfg)}
+        reg.counter("checkpoint_writes_total")  # lands even at 0
+        reg.set_meta(checkpoint_every=opts.checkpoint_every)
+    jstate = None
+    skip_batches = 0
     stats = ECStats(cutoff=cutoff)
+    if journal is not None and opts.resume:
+        jstate = journal.load()
+        if jstate is not None:
+            journal.check_config(jstate, opts.batch_size, jctx)
+            skip_batches = int(jstate["batches"])
+            stats.reads = int(jstate["reads"])
+            stats.corrected = int(jstate["corrected"])
+            stats.skipped = int(jstate["skipped"])
+            stats.bases_in = int(jstate["bases_in"])
+            stats.bases_out = int(jstate["bases_out"])
+            reg.counter("resume_skipped_reads")  # lands even at 0
+            reg.set_meta(resumed=True, resumed_from_batch=skip_batches)
+            reg.event("resume", stage="error_correct",
+                      cursor=skip_batches)
+            vlog("Resuming stage 2 from journal: ", skip_batches,
+                 " batches (", stats.reads, " reads) already written")
+
+    policy = None
+    if opts.on_bad_read != "abort":
+        qpath = ((opts.output + ".quarantine.fastq")
+                 if opts.output else None)
+        if opts.on_bad_read == "quarantine" and qpath is None:
+            raise RuntimeError(
+                "--on-bad-read=quarantine requires -o PREFIX (the "
+                "quarantine file lands beside the output)")
+        policy = fastq.BadReadPolicy(opts.on_bad_read, qpath,
+                                     reg if reg.enabled else None)
+        reg.counter("bad_reads_total")  # lands even at 0
+        reg.set_meta(on_bad_read=opts.on_bad_read)
+
+    if journal is not None:
+        out, log = journal.open_outputs(jstate)
+    else:
+        out = _open_out(opts.output, ".fa", sys.stdout, opts.gzip)
+        log = _open_out(opts.output, ".log", sys.stderr, opts.gzip)
     pipe_metrics = reg if reg.enabled else None
     writer = AsyncWriter([out, log], metrics=pipe_metrics)
     timer = StageTimer()
@@ -293,7 +360,8 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                 "parallel.multihost), not the single-chip CLI")
         else:
             src = fastq.read_batches(sequences, opts.batch_size,
-                                     threads=opts.threads)
+                                     threads=opts.threads,
+                                     policy=policy)
 
         # NOTE: H2D stays on the MAIN thread — device_put from the
         # prefetch thread measured SLOWER end-to-end (3.2 vs 1.4
@@ -366,6 +434,18 @@ def _run_ec(db_path: str, sequences: Sequence[str],
         try:
             with trace(opts.profile):
                 for batch, pk in batches:
+                    if skip_batches > 0:
+                        # resume fast-path: this batch's output is
+                        # already committed in the journal (stats were
+                        # restored from it); parsing is unavoidable —
+                        # the cursor is a batch count over the
+                        # deterministic re-batching — but no device
+                        # step or render runs
+                        skip_batches -= 1
+                        reg.counter("resume_skipped_reads").inc(batch.n)
+                        step_i += 1
+                        continue
+                    faults.inject("stage2.correct", batch=step_i)
                     with tracer.span("stage2_batch", step=step_i,
                                      reads=batch.n):
                         # per-batch device-time attribution: dispatch
@@ -410,7 +490,23 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                         reg.heartbeat(stage="error_correct",
                                       reads=stats.reads,
                                       bases=stats.bases_in)
-                        step_i += 1
+                    step_i += 1
+                    if (journal is not None
+                            and step_i % opts.checkpoint_every == 0):
+                        # commit point: drain the render pipeline and
+                        # the writer so every byte of batches
+                        # [0, step_i) is REALLY in the partials, then
+                        # journal the cursor + byte offsets atomically
+                        with timer.stage("checkpoint"):
+                            while pending:
+                                _drain(pending.popleft())
+                            writer.flush()
+                            journal.commit(step_i, stats, out.tell(),
+                                           log.tell(), opts.batch_size,
+                                           jctx)
+                        reg.counter("checkpoint_writes_total").inc()
+                        reg.event("checkpoint", stage="error_correct",
+                                  cursor=step_i)
                 while pending:
                     _drain(pending.popleft())
         finally:
@@ -439,6 +535,13 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                 _finish(out)
             finally:
                 _finish(log)
+                if policy is not None:
+                    policy.close()
+    if journal is not None:
+        # success only (an exception above skips this): promote the
+        # partials over the real outputs atomically and drop the
+        # journal — a failed run keeps both, ready for --resume
+        journal.finalize()
     vlog("Done. ", stats.corrected, " corrected, ", stats.skipped,
          " skipped of ", stats.reads, " reads")
     if reg.enabled:

@@ -20,6 +20,19 @@ high-water gauge (set_max), a `queue_wait_us` histogram
 the engine, and request outcome counters
 (`requests_accepted/_rejected_queue_full/_deadline_exceeded/_failed`
 /`_completed`).
+
+Fault isolation (ISSUE 4): a device-step exception fails ONLY that
+batch's futures (the HTTP layer maps them to 500) while the
+dispatcher keeps running; a failed multi-request batch is
+bisect-retried once so a single poisoned request doesn't take its
+batchmates down with it (`batch_bisections`); after
+`max_consecutive_failures` engine-step failures in a row the batcher
+reports unhealthy and `/healthz` answers 503, so a load balancer
+ejects the replica instead of the process dying silently
+(`engine_step_failures`, `consecutive_failures`). And ANY dispatcher
+exit path — clean drain or a bug in the dispatch loop itself — fails
+every queued future immediately instead of stranding clients until
+their deadline.
 """
 
 from __future__ import annotations
@@ -30,6 +43,7 @@ import time
 from concurrent.futures import Future
 
 from ..telemetry import NULL
+from ..utils.vlog import vlog
 
 
 class QueueFull(Exception):
@@ -48,6 +62,22 @@ class Draining(Exception):
 class DeadlineExceeded(Exception):
     """The request's deadline passed before its batch dispatched
     (504)."""
+
+
+def _deliver_exception(fut: Future, err: BaseException) -> bool:
+    """Fail a future that may or may not already be running/resolved
+    (the watchdog paths can race a normal resolution): True if this
+    call delivered the exception."""
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False  # cancelled by an abandoned waiter
+    except RuntimeError:
+        pass  # already marked running
+    try:
+        fut.set_exception(err)
+        return True
+    except Exception:
+        return False  # already resolved
 
 
 class _Request:
@@ -72,6 +102,7 @@ class DynamicBatcher:
 
     def __init__(self, engine, max_batch: int | None = None,
                  max_wait_ms: float = 5.0, queue_requests: int = 64,
+                 max_consecutive_failures: int = 0,
                  registry=NULL):
         self.engine = engine
         self.max_batch = int(max_batch or engine.rows)
@@ -81,12 +112,16 @@ class DynamicBatcher:
                 f"{engine.rows}")
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
         self.queue_requests = int(queue_requests)
+        # 0 = never flip unhealthy (the CLI default is 5)
+        self.max_consecutive_failures = int(max_consecutive_failures)
         self.registry = registry
         self._q: collections.deque[_Request] = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._draining = False
         self._closed = False
+        self._dead = False  # dispatcher exited (drain or death)
+        self._consecutive_failures = 0
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="quorum-serve-dispatch",
                                         daemon=True)
@@ -104,7 +139,7 @@ class DynamicBatcher:
         req = _Request(list(records), fut, deadline)
         reg = self.registry
         with self._lock:
-            if self._draining:
+            if self._draining or self._dead:
                 reg.counter("requests_rejected_draining").inc()
                 raise Draining()
             if len(self._q) >= self.queue_requests:
@@ -136,6 +171,24 @@ class DynamicBatcher:
         with self._lock:
             return len(self._q)
 
+    # -- health -----------------------------------------------------------
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    @property
+    def healthy(self) -> bool:
+        """False once the dispatcher is gone or
+        `max_consecutive_failures` engine steps failed in a row —
+        the `/healthz` 503 signal load balancers eject on."""
+        with self._lock:
+            if self._dead:
+                return False
+            return (self.max_consecutive_failures <= 0
+                    or self._consecutive_failures
+                    < self.max_consecutive_failures)
+
     # -- drain / shutdown -------------------------------------------------
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admitting, flush everything already admitted, stop the
@@ -164,6 +217,23 @@ class DynamicBatcher:
         return taken
 
     def _dispatch_loop(self) -> None:
+        try:
+            self._dispatch_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - loop bug
+            # a bug in the dispatch loop itself (not an engine step —
+            # those are contained below): count it and fall through to
+            # the shutdown; re-raising from a daemon thread would only
+            # print a traceback nobody handles while clients hang
+            self.registry.counter("dispatcher_crashes").inc()
+            vlog("quorum-serve dispatcher died: ", e)
+        finally:
+            # EVERY dispatcher exit path — clean drain or a bug in the
+            # loop itself — must fail the queued futures immediately:
+            # a stranded future means a client hung until its deadline
+            # for work that can never run
+            self._shutdown_pending()
+
+    def _dispatch_loop_inner(self) -> None:
         reg = self.registry
         while True:
             with self._work:
@@ -191,7 +261,69 @@ class DynamicBatcher:
                     if not self._q:
                         continue
                 taken = self._take_locked()
-            self._run_batch(taken, reg)
+            try:
+                self._run_batch(taken, reg)
+            except BaseException as e:  # noqa: BLE001 - watchdog
+                # _run_batch contains engine failures itself; anything
+                # escaping is a bug in the dispatch path — fail THIS
+                # batch's futures and keep the dispatcher alive
+                self._record_step(reg, ok=False)
+                n = 0
+                for req in taken:
+                    if _deliver_exception(req.future, e):
+                        n += 1
+                if n:
+                    reg.counter("requests_failed").inc(n)
+
+    def _shutdown_pending(self) -> None:
+        err = RuntimeError("quorum-serve dispatcher exited")
+        with self._lock:
+            self._dead = True
+            stranded = list(self._q)
+            self._q.clear()
+        n = 0
+        for req in stranded:
+            if _deliver_exception(req.future, err):
+                n += 1
+        if n:
+            self.registry.counter("requests_failed").inc(n)
+
+    def _record_step(self, reg, ok: bool) -> None:
+        """Track engine-step health: consecutive failures drive the
+        unhealthy flip; any success resets the streak."""
+        with self._lock:
+            if ok:
+                self._consecutive_failures = 0
+            else:
+                self._consecutive_failures += 1
+            n = self._consecutive_failures
+        if not ok:
+            reg.counter("engine_step_failures").inc()
+        reg.gauge("consecutive_failures").set(n)
+
+    def _step_requests(self, reqs: list[_Request]) -> list[list]:
+        """One coalesced engine pass over `reqs`: flatten, step in
+        max_batch chunks, return each request's slice of results."""
+        flat: list = []
+        slices: list[tuple[int, int]] = []
+        for req in reqs:
+            slices.append((len(flat), len(flat) + len(req.records)))
+            flat.extend(req.records)
+        results: list = []
+        for off in range(0, len(flat), self.max_batch):
+            results.extend(
+                self.engine.step(flat[off:off + self.max_batch]))
+        return [results[s:e] for s, e in slices]
+
+    def _resolve(self, reqs: list[_Request], per_req: list[list],
+                 reg) -> None:
+        reg.counter("requests_completed").inc(len(reqs))
+        for req, res in zip(reqs, per_req):
+            try:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_result(res)
+            except Exception:  # pragma: no cover - abandoned future
+                pass
 
     def _run_batch(self, taken: list[_Request], reg) -> None:
         now = time.perf_counter()
@@ -208,25 +340,39 @@ class DynamicBatcher:
                 live.append(req)
         if not live:
             return
-        flat: list = []
-        slices: list[tuple[_Request, int, int]] = []
-        for req in live:
-            slices.append((req, len(flat), len(flat) + len(req.records)))
-            flat.extend(req.records)
         try:
-            results: list = []
-            for off in range(0, len(flat), self.max_batch):
-                results.extend(
-                    self.engine.step(flat[off:off + self.max_batch]))
-        except BaseException as e:  # noqa: BLE001 - delivered per request
-            reg.counter("requests_failed").inc(len(live))
-            for req, _s, _e in slices:
-                if not req.future.set_running_or_notify_cancel():
-                    continue
-                req.future.set_exception(e)
+            per_req = self._step_requests(live)
+        except BaseException as e:  # noqa: BLE001 - isolated per batch
+            self._record_step(reg, ok=False)
+            if len(live) > 1:
+                self._bisect_retry(live, reg)
+            else:
+                reg.counter("requests_failed").inc(1)
+                _deliver_exception(live[0].future, e)
             return
-        reg.counter("requests_completed").inc(len(live))
-        for req, s, e in slices:
-            if not req.future.set_running_or_notify_cancel():
-                continue  # abandoned by a timed-out waiter
-            req.future.set_result(results[s:e])
+        self._record_step(reg, ok=True)
+        self._resolve(live, per_req, reg)
+
+    def _bisect_retry(self, live: list[_Request], reg) -> None:
+        """A failed multi-request batch is bisect-retried ONCE: each
+        half runs its own engine pass, so a single poisoned request
+        fails only its half's futures (with one more split it would
+        be exactly isolated; one level keeps worst-case extra device
+        steps at two) while innocent batchmates still get answers. A
+        half succeeding also proves the device is alive, resetting
+        the consecutive-failure streak."""
+        reg.counter("batch_bisections").inc()
+        mid = (len(live) + 1) // 2
+        for half in (live[:mid], live[mid:]):
+            if not half:
+                continue
+            try:
+                per_req = self._step_requests(half)
+            except BaseException as e:  # noqa: BLE001 - per half
+                self._record_step(reg, ok=False)
+                reg.counter("requests_failed").inc(len(half))
+                for req in half:
+                    _deliver_exception(req.future, e)
+                continue
+            self._record_step(reg, ok=True)
+            self._resolve(half, per_req, reg)

@@ -101,6 +101,13 @@ class ServeClient:
             raise RuntimeError(f"/healthz -> {resp.status}")
         return json.loads(data.decode())
 
+    def healthz_full(self) -> tuple[int, dict]:
+        """GET /healthz without raising on 503 — (status_code, body).
+        The unhealthy flip (max consecutive engine failures) answers
+        503 with the same JSON body."""
+        resp, data = self._request("GET", "/healthz")
+        return resp.status, json.loads(data.decode())
+
     def metrics_text(self) -> str:
         resp, data = self._request("GET", "/metrics")
         if resp.status != 200:

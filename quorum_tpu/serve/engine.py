@@ -37,6 +37,7 @@ from ..models.error_correct import (ECOptions, new_outcome,
                                     pack_for_stage2, record_outcome,
                                     render_result, resolve_cutoff)
 from ..telemetry import NULL, NULL_TRACER, observe_dispatch_wait
+from ..utils import faults
 from ..utils.vlog import vlog
 
 
@@ -121,6 +122,9 @@ class CorrectionEngine:
                 f"{self.rows}")
         if not records:
             return []
+        # chaos-harness site: a plan can fail the Nth device step to
+        # exercise the batcher's fault isolation (utils/faults.py)
+        faults.inject("serve.engine.step")
         reg = NULL if _warmup else self.registry
         batch = fastq._make_batch(list(records), self.rows)
         pk = pack_for_stage2(batch, self.cfg)

@@ -88,7 +88,13 @@ class CorrectionServer:
                     self._reply(200, body,
                                 "text/plain; version=0.0.4; charset=utf-8")
                 elif route == "/healthz":
-                    self._reply_json(200, outer.health())
+                    h = outer.health()
+                    # 503 once the batcher flips unhealthy (dispatcher
+                    # gone, or --max-consecutive-failures device-step
+                    # failures in a row): load balancers eject the
+                    # replica instead of the process dying silently
+                    self._reply_json(200 if h.get("healthy", True)
+                                     else 503, h)
                 else:
                     self._reply_json(404, {"error": "not found"})
 
@@ -240,9 +246,17 @@ class CorrectionServer:
     def health(self) -> dict:
         with self._req_lock:
             served = self._requests
+        healthy = bool(getattr(self.batcher, "healthy", True))
+        draining = self._drain_started.is_set()
         return {
-            "status": ("draining" if self._drain_started.is_set()
-                       else "ok"),
+            # a draining replica is still healthy (it answers what it
+            # admitted); an unhealthy one is NOT draining — it needs
+            # ejection, not patience
+            "status": ("draining" if draining
+                       else "ok" if healthy else "unhealthy"),
+            "healthy": healthy or draining,
+            "consecutive_failures": int(getattr(
+                self.batcher, "consecutive_failures", 0)),
             "uptime_s": round(time.perf_counter() - self._t0, 3),
             "queue_depth": self.batcher.depth,
             "requests_served": served,

@@ -31,14 +31,17 @@ import weakref
 from .schema import SCHEMA_VERSION
 
 
-def atomic_write(path: str, text: str) -> None:
+def atomic_write(path: str, data) -> None:
     """The one atomic-replace idiom every telemetry artifact uses
     (final JSON, Chrome trace, Prometheus textfile, multi-host
-    aggregate): write a sibling tmp, then os.replace — a reader at
-    `path` can never observe a torn file."""
+    aggregate — and the fault-tolerance layer's checkpoint cursors,
+    io/checkpoint.py): write a sibling tmp, then os.replace — a
+    reader at `path` can never observe a torn file. Accepts str or
+    bytes."""
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(text)
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with open(tmp, mode) as f:
+        f.write(data)
     os.replace(tmp, path)
 
 

@@ -1,0 +1,294 @@
+"""Deterministic fault injection: the chaos harness behind the
+fault-tolerance layer (ISSUE 4).
+
+Production k-mer counters treat restartability as table stakes (KMC 3
+survives on disk-resident partial bins; the streaming counters in
+"These are not the k-mers you are looking for" assume interruptible
+ingest, PAPERS.md) — but none of that machinery is testable without a
+way to make the failure happen on demand, at an exact batch, every
+time. This module is that way: a *fault plan* — JSON from
+``--fault-plan`` or the ``QUORUM_FAULT_PLAN`` env var — names
+injection sites the hot paths already carry and the action to take
+when execution reaches them.
+
+Plan format (a JSON list; a single object or ``{"faults": [...]}``
+also accepted)::
+
+    [
+      {"site": "stage2.correct", "batch": 2, "action": "exit",
+       "code": 41},
+      {"site": "fastq.read", "at": 100, "action": "io_error"},
+      {"site": "serve.engine.step", "at": 3, "count": 2,
+       "action": "error"},
+      {"site": "stage1.insert@batch=1", "action": "sleep",
+       "seconds": 0.2}
+    ]
+
+Fields per spec:
+
+* ``site`` (required) — the injection-point name. The shorthand
+  ``site@batch=N`` folds the ``batch`` field in.
+* ``batch`` — match only calls tagged with this batch index (sites in
+  the per-batch device loops pass ``batch=``).
+* ``at`` — fire on the Nth *matching* call (1-based, default 1).
+* ``count`` — how many consecutive matching calls fire (default 1;
+  ``-1`` = every one from ``at`` on).
+* ``action`` — one of:
+  - ``io_error``: raise OSError (a disk/input failure),
+  - ``error``: raise FaultError (a RuntimeError — a device-step or
+    logic failure the stage error paths already map),
+  - ``exit``: ``os._exit(code)`` (default 41) — a hard kill, the
+    checkpoint/resume acceptance case,
+  - ``sleep``: ``time.sleep(seconds)`` (default 0.05) then continue —
+    artificial slowness for deadline/backpressure tests.
+* ``message`` / ``code`` / ``seconds`` — action parameters.
+
+Known sites (each is one ``faults.inject(...)`` call on a hot path;
+the disabled cost is a module-global None check):
+
+* ``stage1.insert`` (``batch=``) — before each stage-1 device insert
+  (models/create_database.py).
+* ``stage2.correct`` (``batch=``) — before each stage-2 device step
+  (models/error_correct.py).
+* ``serve.engine.step`` — at the top of CorrectionEngine.step
+  (serve/engine.py).
+* ``fastq.read`` — per parsed record in the pure-Python FASTQ reader
+  (io/fastq.py).
+
+Determinism: per-spec hit counters under one lock; the same plan over
+the same input fires at exactly the same points, which is what lets
+``ci/tier1.sh`` kill stage 2 at batch 2 and assert a byte-identical
+resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+class FaultError(RuntimeError):
+    """An injected non-IO failure (action "error"): a RuntimeError so
+    the stages' existing error contracts catch it like a real
+    device-step failure."""
+
+
+_ACTIONS = ("io_error", "error", "exit", "sleep")
+
+ENV_VAR = "QUORUM_FAULT_PLAN"
+
+DEFAULT_EXIT_CODE = 41
+
+
+class FaultSpec:
+    """One parsed fault: where, when, and what."""
+
+    __slots__ = ("site", "batch", "at", "count", "action", "message",
+                 "code", "seconds", "hits", "fired")
+
+    def __init__(self, raw: dict):
+        if not isinstance(raw, dict):
+            raise ValueError(f"fault spec must be an object, got {raw!r}")
+        site = raw.get("site")
+        if not site or not isinstance(site, str):
+            raise ValueError(f"fault spec needs a 'site': {raw!r}")
+        batch = raw.get("batch")
+        if "@" in site:
+            # "stage1.insert@batch=3" shorthand
+            site, _, tail = site.partition("@")
+            key, _, val = tail.partition("=")
+            if key != "batch" or not val.lstrip("-").isdigit():
+                raise ValueError(
+                    f"bad site shorthand {raw.get('site')!r} "
+                    "(want site@batch=N)")
+            batch = int(val)
+        self.site = site
+        self.batch = None if batch is None else int(batch)
+        self.at = int(raw.get("at", 1))
+        if self.at < 1:
+            raise ValueError(f"'at' must be >= 1: {raw!r}")
+        self.count = int(raw.get("count", 1))
+        self.action = raw.get("action", "error")
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"unknown action {self.action!r} (one of {_ACTIONS})")
+        self.message = raw.get("message")
+        self.code = int(raw.get("code", DEFAULT_EXIT_CODE))
+        self.seconds = float(raw.get("seconds", 0.05))
+        self.hits = 0   # matching calls seen
+        self.fired = 0  # actions taken
+
+    def matches(self, site: str, batch) -> bool:
+        if site != self.site:
+            return False
+        return self.batch is None or (batch is not None
+                                      and int(batch) == self.batch)
+
+    def should_fire(self) -> bool:
+        """Call after incrementing hits: fire on hits in
+        [at, at + count), unbounded when count < 0."""
+        if self.hits < self.at:
+            return False
+        return self.count < 0 or self.fired < self.count
+
+    def describe(self) -> str:
+        where = (f"{self.site}@batch={self.batch}"
+                 if self.batch is not None else self.site)
+        return f"{self.action} at {where} (at={self.at}, count={self.count})"
+
+
+class FaultPlan:
+    """A parsed, thread-safe fault plan."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = specs
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, obj) -> "FaultPlan":
+        """From the JSON-decoded plan value: a list of specs, one
+        spec, or {"faults": [...]}."""
+        if isinstance(obj, dict) and "faults" in obj:
+            obj = obj["faults"]
+        if isinstance(obj, dict):
+            obj = [obj]
+        if not isinstance(obj, list):
+            raise ValueError(
+                f"fault plan must be a list of specs, got {type(obj)}")
+        return cls([FaultSpec(raw) for raw in obj])
+
+    def fire(self, site: str, batch=None) -> None:
+        """Record one arrival at `site`; execute any due action.
+        Raising actions raise from here; `sleep` returns after the
+        delay."""
+        due: list[FaultSpec] = []
+        with self._lock:
+            for spec in self.specs:
+                if not spec.matches(site, batch):
+                    continue
+                spec.hits += 1
+                if spec.should_fire():
+                    spec.fired += 1
+                    due.append(spec)
+        for spec in due:
+            self._act(spec, site, batch)
+
+    @staticmethod
+    def _act(spec: FaultSpec, site: str, batch) -> None:
+        where = site if batch is None else f"{site}@batch={batch}"
+        msg = spec.message or f"injected fault at {where}"
+        if spec.action == "sleep":
+            time.sleep(spec.seconds)
+            return
+        if spec.action == "io_error":
+            raise OSError(msg)
+        if spec.action == "error":
+            raise FaultError(msg)
+        # exit: a hard kill — no cleanup, no atexit, no finally blocks;
+        # exactly what checkpoint/resume must survive. Flush the std
+        # streams so the operator sees where the kill landed.
+        print(f"quorum-tpu: fault plan: hard exit ({spec.code}) at "
+              f"{where}", file=sys.stderr)
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001 - nothing may stop the exit
+            pass
+        os._exit(spec.code)
+
+    def summary(self) -> str:
+        return "; ".join(s.describe() for s in self.specs) or "(empty)"
+
+
+# -- module-global install point ------------------------------------------
+# The hot paths guard on `_PLAN is None`, so the disabled cost of an
+# injection point is one function call and one global load. _SPEC
+# remembers the exact string that produced the installed plan: a
+# stage entry point re-reading the SAME env var / arg must keep the
+# running plan (and its spent hit counters), not reset it.
+_PLAN: FaultPlan | None = None
+_SPEC: str | None = None
+
+
+def install(plan: FaultPlan | None, spec: str | None = None) -> None:
+    global _PLAN, _SPEC
+    _PLAN = plan
+    _SPEC = spec
+
+
+def reset() -> None:
+    install(None)
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def inject(site: str, batch=None) -> None:
+    """THE injection point. No-op (one global check) without a plan."""
+    if _PLAN is None:
+        return
+    _PLAN.fire(site, batch)
+
+
+def load_plan(spec: str) -> FaultPlan:
+    """Parse a plan argument: inline JSON text, `@/path/to/plan.json`,
+    or a bare path to an existing file."""
+    text = spec
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            text = f.read()
+    elif not spec.lstrip().startswith(("[", "{")) and os.path.exists(spec):
+        with open(spec) as f:
+            text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError as e:
+        raise ValueError(f"bad fault plan {spec!r}: {e}") from None
+    return FaultPlan.parse(obj)
+
+
+def setup(arg: str | None = None) -> FaultPlan | None:
+    """Install the plan from `--fault-plan` (or, when absent, the
+    QUORUM_FAULT_PLAN env var — how a subprocess under test gets its
+    plan). Called by every CLI entry point.
+
+    With neither source set this is a NO-OP, not a reset: the quorum
+    driver installs ONE plan for the whole run and its in-process
+    stage children must inherit it — including the per-spec hit/fired
+    counters, which is what makes a driver retry deterministic (a
+    count=1 fault fires on attempt 1 and stays spent on attempt 2).
+    An EXPLICIT empty value (``--fault-plan ''`` or an empty env var)
+    clears any installed plan; tests use `faults.reset()`."""
+    spec = arg if arg is not None else os.environ.get(ENV_VAR)
+    if spec is None:
+        return _PLAN
+    if not spec:
+        reset()
+        return None
+    if spec == _SPEC and _PLAN is not None:
+        # same plan text as the one already running (the driver's env
+        # var seen again by an in-process stage entry): keep the live
+        # plan — reinstalling would resurrect spent count=1 faults on
+        # every retry attempt
+        return _PLAN
+    plan = load_plan(spec)
+    install(plan, spec)
+    from .vlog import vlog
+    vlog("Fault plan installed: ", plan.summary())
+    return plan
+
+
+def add_fault_args(p) -> None:
+    """The shared `--fault-plan` CLI flag (every entry point carries
+    it; the QUORUM_FAULT_PLAN env var is the fallback so plans reach
+    subprocesses too)."""
+    p.add_argument("--fault-plan", metavar="json|@file", default=None,
+                   help="Deterministic fault-injection plan (JSON, "
+                        "@file, or path): inject IO errors, device-"
+                        "step failures, slowness, or a hard process "
+                        "exit at named sites (utils/faults.py). Env "
+                        f"fallback: {ENV_VAR}.")

@@ -21,6 +21,7 @@ from typing import Iterable, Iterator, TypeVar
 T = TypeVar("T")
 
 _STOP = object()
+_FLUSH = object()
 
 
 def put_or_stop(q: "queue.Queue", item, stop: threading.Event,
@@ -143,6 +144,19 @@ class AsyncWriter:
             item = self.q.get()
             if item is _STOP:
                 return
+            if isinstance(item, tuple) and item[0] is _FLUSH:
+                # barrier: everything queued before it is written;
+                # flush the streams so the bytes are really down
+                # before the waiter (the stage-2 journal commit)
+                # proceeds
+                if self.err is None:
+                    try:
+                        for s in self.streams:
+                            s.flush()
+                    except BaseException as e:  # noqa: BLE001
+                        self.err = e
+                item[1].set()
+                continue
             if self.err is not None:
                 continue  # drain without writing after a failure
             i, text = item
@@ -150,6 +164,18 @@ class AsyncWriter:
                 self.streams[i].write(text)
             except BaseException as e:  # noqa: BLE001 - surfaced in close
                 self.err = e
+
+    def flush(self) -> None:
+        """Block until every record queued so far is written AND the
+        streams are flushed. The stage-2 journal (io/checkpoint)
+        commits byte offsets only after this barrier — the journal
+        must never claim bytes the files might not have."""
+        done = threading.Event()
+        self.q.put((_FLUSH, done))
+        done.wait()
+        if self.err is not None:
+            self._raised = True
+            raise self.err
 
     def write(self, i: int, text: str) -> None:
         if self.err is not None:

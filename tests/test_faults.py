@@ -1,0 +1,656 @@
+"""Chaos tests for the fault-tolerance layer (ISSUE 4): the
+deterministic fault-injection harness itself, kill/resume for both
+stages asserting byte-identical output, the driver's retry/backoff
+with a mocked clock, malformed-FASTQ degradation, and the
+checkpoint/journal artifacts' corruption handling.
+
+The expensive truths (a killed stage resumed from its checkpoint
+converges on the same bytes) run the REAL device pipeline over the
+small synthetic dataset the other end-to-end suites use, so the jit
+shapes are shared; everything about the driver's retry loop is tested
+with stubbed stages and a mocked clock — the logic under test lives
+in the driver, not the stages.
+"""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import json
+import os
+import threading
+
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.cli import quorum as quorum_cli
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.io import db_format, fastq
+from quorum_tpu.telemetry import registry_for
+from quorum_tpu.utils import faults
+from quorum_tpu.utils.pipeline import AsyncWriter
+
+from test_error_correct_cli import K, build_db, make_dataset
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends without an installed fault plan."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# the fault plan itself
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_forms():
+    p = faults.FaultPlan.parse(
+        [{"site": "a", "action": "error"},
+         {"site": "b@batch=3", "action": "sleep", "seconds": 0.01}])
+    assert p.specs[0].site == "a" and p.specs[0].batch is None
+    assert p.specs[1].site == "b" and p.specs[1].batch == 3
+    # single object and {"faults": [...]} wrappers
+    assert len(faults.FaultPlan.parse({"site": "x"}).specs) == 1
+    assert len(faults.FaultPlan.parse(
+        {"faults": [{"site": "x"}, {"site": "y"}]}).specs) == 2
+    with pytest.raises(ValueError, match="site"):
+        faults.FaultPlan.parse([{"action": "error"}])
+    with pytest.raises(ValueError, match="unknown action"):
+        faults.FaultPlan.parse([{"site": "x", "action": "explode"}])
+    with pytest.raises(ValueError, match="shorthand"):
+        faults.FaultPlan.parse([{"site": "x@reads=3"}])
+
+
+def test_fault_plan_at_count_batch_matching():
+    plan = faults.FaultPlan.parse([
+        {"site": "s", "at": 2, "count": 2, "action": "error"},
+        {"site": "t", "batch": 5, "action": "error"},
+    ])
+    faults.install(plan)
+    faults.inject("s")                      # hit 1: below `at`
+    with pytest.raises(faults.FaultError):
+        faults.inject("s")                  # hit 2: fires
+    with pytest.raises(faults.FaultError):
+        faults.inject("s")                  # hit 3: count=2 still firing
+    faults.inject("s")                      # hit 4: spent
+    faults.inject("t", batch=4)             # wrong batch: no match
+    faults.inject("t")                      # no batch tag: no match
+    with pytest.raises(faults.FaultError):
+        faults.inject("t", batch=5)
+    faults.inject("t", batch=5)             # count=1 spent
+    # unknown site never fires; disabled inject is a no-op
+    faults.inject("nowhere", batch=123)
+    faults.reset()
+    faults.inject("s")
+
+
+def test_fault_actions_and_load_plan(tmp_path, monkeypatch):
+    plan = faults.FaultPlan.parse([
+        {"site": "io", "action": "io_error", "message": "disk gone"},
+        {"site": "zzz", "action": "sleep", "seconds": 0.0},
+    ])
+    faults.install(plan)
+    with pytest.raises(OSError, match="disk gone"):
+        faults.inject("io")
+    faults.inject("zzz")  # sleeps 0 then continues
+
+    # @file and bare-path loading
+    pf = tmp_path / "plan.json"
+    pf.write_text('[{"site": "p", "action": "error"}]')
+    assert faults.load_plan(f"@{pf}").specs[0].site == "p"
+    assert faults.load_plan(str(pf)).specs[0].site == "p"
+    with pytest.raises(ValueError, match="bad fault plan"):
+        faults.load_plan("not json {")
+
+    # env-var fallback installs; explicit empty clears
+    monkeypatch.setenv(faults.ENV_VAR, '[{"site": "e", "action": "error"}]')
+    assert faults.setup(None).specs[0].site == "e"
+    monkeypatch.setenv(faults.ENV_VAR, "")
+    assert faults.setup(None) is None
+    assert not faults.active()
+
+
+def test_fault_env_reinstall_keeps_counters(monkeypatch):
+    """An in-process stage entry re-reading the SAME env spec must
+    keep the running plan's spent counters — a driver retry would
+    otherwise re-fire a count=1 fault forever."""
+    monkeypatch.setenv(faults.ENV_VAR, '[{"site": "s", "action": "error"}]')
+    faults.setup(None)
+    with pytest.raises(faults.FaultError):
+        faults.inject("s")
+    faults.setup(None)          # same spec: plan (and counters) kept
+    faults.inject("s")          # count=1 stays spent — no re-fire
+    monkeypatch.setenv(faults.ENV_VAR, '[{"site": "t", "action": "error"}]')
+    faults.setup(None)          # different spec: fresh plan
+    with pytest.raises(faults.FaultError):
+        faults.inject("t")
+
+
+# ---------------------------------------------------------------------------
+# malformed-FASTQ degradation (--on-bad-read)
+# ---------------------------------------------------------------------------
+
+BAD_FASTQ = (b"@good1\nACGT\n+\nIIII\n"
+             b"@bad_qual\nACGT\n+\nIIIIIII\n"     # qual longer than seq
+             b"@good2\nACGTA\n+\nIIIII\n"
+             b"not_a_record_start\n"              # stray line
+             b"@good3\nAC\n+\nII\n")
+
+
+def _write_bad(tmp_path):
+    p = tmp_path / "bad.fastq"
+    p.write_bytes(BAD_FASTQ)
+    return str(p)
+
+
+def test_bad_read_abort_is_default(tmp_path):
+    p = _write_bad(tmp_path)
+    with pytest.raises(ValueError, match="quality length"):
+        list(fastq.iter_records([p]))
+
+
+def test_bad_read_skip_counts_and_continues(tmp_path):
+    p = _write_bad(tmp_path)
+    reg = registry_for(None, force=True)
+    pol = fastq.BadReadPolicy("skip", registry=reg)
+    recs = list(fastq.iter_records([p], pol))
+    assert [h for h, _s, _q in recs] == ["good1", "good2", "good3"]
+    assert pol.bad == 2
+    assert reg.counter("bad_reads_total").value == 2
+
+
+def test_bad_read_quarantine_routes_raw_records(tmp_path):
+    p = _write_bad(tmp_path)
+    qpath = str(tmp_path / "q.quarantine.fastq")
+    pol = fastq.BadReadPolicy("quarantine", quarantine_path=qpath)
+    recs = list(fastq.iter_records([p], pol))
+    pol.close()
+    assert len(recs) == 3
+    quarantined = open(qpath, "rb").read()
+    assert b"@bad_qual\nACGT\n+\nIIIIIII\n" in quarantined
+    assert b"not_a_record_start\n" in quarantined
+    assert b"good" not in quarantined  # only the bad records
+
+
+def test_bad_read_unicode_header(tmp_path):
+    """A corrupt (non-UTF-8) header byte is a malformed record like
+    any other: abort raises, skip drops and counts."""
+    p = tmp_path / "u.fastq"
+    p.write_bytes(b"@ok\nACGT\n+\nIIII\n"
+                  b"@bad\xff\nACGT\n+\nIIII\n"
+                  b"@ok2\nAC\n+\nII\n")
+    with pytest.raises(UnicodeDecodeError):
+        list(fastq.iter_records([str(p)]))
+    reg = registry_for(None, force=True)
+    pol = fastq.BadReadPolicy("skip", registry=reg)
+    recs = list(fastq.iter_records([str(p)], pol))
+    assert [h for h, _s, _q in recs] == ["ok", "ok2"]
+    assert pol.bad == 1
+    assert reg.counter("bad_reads_total").value == 1
+
+
+def test_bad_read_policy_validation():
+    with pytest.raises(ValueError, match="on-bad-read"):
+        fastq.BadReadPolicy("explode")
+    with pytest.raises(ValueError, match="quarantine"):
+        fastq.BadReadPolicy("quarantine")  # no path
+
+
+def test_ec_cli_skips_bad_reads(tmp_path):
+    """End-to-end --on-bad-read=skip through the stage-2 CLI: the bad
+    record is dropped mid-stream, every real read still corrects, and
+    the counter lands in the metrics document."""
+    reads_path, _reads, _quals = make_dataset(tmp_path, n_reads=40)
+    db = build_db(tmp_path, reads_path)
+    lines = open(reads_path).read().splitlines(keepends=True)
+    bad = tmp_path / "bad.fastq"
+    # a broken record (qual longer than seq) spliced mid-file
+    bad.write_text("".join(lines[:80]) + "@broken\nACGT\n+\nIIIIIII\n"
+                   + "".join(lines[80:]))
+    mpath = str(tmp_path / "m.json")
+    out = str(tmp_path / "out")
+    rc = ec_cli.main(["-d", "--on-bad-read", "skip",
+                      "--metrics", mpath, "-o", out, db, str(bad)])
+    assert rc == 0
+    fa = open(out + ".fa").read()
+    assert fa.count(">") == 40          # -d: one record per real read
+    assert ">broken" not in fa
+    doc = json.load(open(mpath))
+    assert doc["counters"]["bad_reads_total"] == 1
+    assert doc["meta"]["on_bad_read"] == "skip"
+
+
+# ---------------------------------------------------------------------------
+# AsyncWriter.flush barrier (the journal's commit precondition)
+# ---------------------------------------------------------------------------
+
+def test_async_writer_flush_barrier(tmp_path):
+    p = tmp_path / "w.txt"
+    f = open(p, "w")
+    w = AsyncWriter([f])
+    for i in range(50):
+        w.write(0, f"line{i}\n")
+    w.flush()
+    # everything queued before the barrier is on disk when it returns
+    assert open(p).read().count("\n") == 50
+    w.write(0, "tail\n")
+    w.close()
+    f.close()
+    assert open(p).read().endswith("tail\n")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint artifacts: corruption and config mismatch
+# ---------------------------------------------------------------------------
+
+def test_stage1_checkpoint_corruption_and_peek(tmp_path):
+    ck = ckpt_mod.Stage1Checkpoint(str(tmp_path))
+    assert ck.load() is None and ck.cursor() is None
+    with open(ck.path, "wb") as f:
+        f.write(b"garbage, not a header\n")
+    with pytest.raises(ckpt_mod.CheckpointError):
+        ck.load()
+    assert ck.cursor() is None  # peek is non-raising
+    ck.clear()
+    assert not os.path.exists(ck.path)
+    ck.clear()  # idempotent
+
+
+def test_stage2_journal_truncates_torn_tail(tmp_path):
+    prefix = str(tmp_path / "out")
+    j = ckpt_mod.Stage2Journal(prefix)
+    assert j.load() is None
+
+    class S:
+        reads = corrected = skipped = bases_in = bases_out = 0
+
+    out, log = j.open_outputs(None)
+    out.write("committed\n")
+    out.flush()
+    j.commit(1, S(), out.tell(), log.tell(), 64,
+             {"db": "a.jf", "inputs": ["r.fastq"]})
+    out.write("torn-tail-after-the-commit")
+    out.close()
+    log.close()
+
+    st = j.load()
+    assert st["batches"] == 1 and st["batch_size"] == 64
+    with pytest.raises(ckpt_mod.CheckpointError, match="batch_size"):
+        j.check_config(st, 128)
+    # a different database or input set must refuse to resume —
+    # splicing two runs' corrections into one file is corruption
+    with pytest.raises(ckpt_mod.CheckpointError, match="db="):
+        j.check_config(st, 64, {"db": "OTHER.jf",
+                                "inputs": ["r.fastq"]})
+    j.check_config(st, 64, {"db": "a.jf", "inputs": ["r.fastq"]})
+    out2, log2 = j.open_outputs(st)
+    out2.write("resumed\n")
+    out2.close()
+    log2.close()
+    assert open(j.fa_partial).read() == "committed\nresumed\n"
+    j.finalize()
+    assert open(prefix + ".fa").read() == "committed\nresumed\n"
+    assert not os.path.exists(j.path)
+    assert not os.path.exists(j.fa_partial)
+    j.finalize()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# kill/resume, stage 1: the counting table converges
+# ---------------------------------------------------------------------------
+
+def _db_entries(path):
+    state, meta, _ = db_format.read_db(path, to_device=False)
+    khi, klo, vals = db_format.db_iterate(state, meta)
+    return sorted(zip(khi.tolist(), klo.tolist(), vals.tolist()))
+
+
+def test_stage1_kill_resume_matches_uninterrupted(tmp_path):
+    reads_path, _reads, _quals = make_dataset(tmp_path)
+    ckdir = str(tmp_path / "ck")
+    base_args = ["-s", "64k", "-m", str(K), "-b", "7", "-q", "38",
+                 "--batch-size", "64"]
+    db0 = str(tmp_path / "db0.jf")
+    assert cdb_cli.main(base_args + ["-o", db0, reads_path]) == 0
+
+    # killed at batch 2 (batches 0 and 1 inserted and checkpointed)
+    db1 = str(tmp_path / "db1.jf")
+    plan = json.dumps([{"site": "stage1.insert", "batch": 2,
+                        "action": "error"}])
+    rc = cdb_cli.main(base_args + [
+        "-o", db1, "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--fault-plan", plan, reads_path])
+    assert rc == 1
+    assert not os.path.exists(db1)
+    ck = ckpt_mod.Stage1Checkpoint(ckdir)
+    assert ck.cursor() == 2
+
+    # resume (no plan): finishes and clears the checkpoint
+    mpath = str(tmp_path / "resume.json")
+    rc = cdb_cli.main(base_args + [
+        "-o", db1, "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--resume", "--metrics", mpath, "--fault-plan", "", reads_path])
+    assert rc == 0
+    assert ck.cursor() is None  # cleared on success
+    assert _db_entries(db1) == _db_entries(db0)
+
+    doc = json.load(open(mpath))
+    assert doc["meta"]["resumed"] is True
+    assert doc["meta"]["resumed_from_batch"] == 2
+    assert doc["counters"]["resume_skipped_reads"] == 128  # 2 x 64
+    assert doc["counters"]["checkpoint_writes_total"] >= 1
+    assert doc["counters"]["reads"] == 240  # restored + new
+
+
+def test_stage1_resume_refuses_config_mismatch(tmp_path):
+    reads_path, _r, _q = make_dataset(tmp_path)
+    ckdir = str(tmp_path / "ck")
+    plan = json.dumps([{"site": "stage1.insert", "batch": 1,
+                        "action": "error"}])
+    args = ["-s", "64k", "-m", str(K), "-b", "7", "-q", "38",
+            "--batch-size", "64", "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1"]
+    rc = cdb_cli.main(args + ["-o", str(tmp_path / "x.jf"),
+                              "--fault-plan", plan, reads_path])
+    assert rc == 1
+    # different batch size -> the cursor would skip the wrong reads;
+    # rc 3 marks the refusal non-retryable for the driver's loop
+    rc = cdb_cli.main(["-s", "64k", "-m", str(K), "-b", "7", "-q", "38",
+                       "--batch-size", "32", "--checkpoint-dir", ckdir,
+                       "--resume", "--fault-plan", "",
+                       "-o", str(tmp_path / "x.jf"), reads_path])
+    assert rc == ckpt_mod.NON_RETRYABLE_RC
+
+
+# ---------------------------------------------------------------------------
+# kill/resume, stage 2: byte-identical output
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ec_fixture(tmp_path_factory):
+    """Dataset + database + uninterrupted baseline output, shared by
+    the stage-2 chaos tests."""
+    tmp = tmp_path_factory.mktemp("faults_ec")
+    reads_path, reads, quals = make_dataset(tmp)
+    db = build_db(tmp, reads_path)
+    base = str(tmp / "base")
+    assert ec_cli.main(["--batch-size", "64", "-o", base,
+                        db, reads_path]) == 0
+    return tmp, reads_path, db, base
+
+
+def test_stage2_kill_resume_byte_identical(ec_fixture, tmp_path):
+    tmp, reads_path, db, base = ec_fixture
+    out = str(tmp_path / "out")
+    plan = json.dumps([{"site": "stage2.correct@batch=2",
+                        "action": "error"}])
+    rc = ec_cli.main(["--batch-size", "64", "--checkpoint-every", "1",
+                      "-o", out, "--fault-plan", plan, db, reads_path])
+    assert rc == 1
+    j = ckpt_mod.Stage2Journal(out)
+    assert j.batches_done() == 2
+    assert os.path.exists(out + ".fa.partial")
+    assert not os.path.exists(out + ".fa")
+
+    mpath = str(tmp_path / "resume.json")
+    rc = ec_cli.main(["--batch-size", "64", "--checkpoint-every", "1",
+                      "--resume", "--metrics", mpath,
+                      "--fault-plan", "", "-o", out, db, reads_path])
+    assert rc == 0
+    # THE acceptance property: kill -> resume is byte-identical to the
+    # uninterrupted run, and the journal/partials are gone
+    assert open(out + ".fa").read() == open(base + ".fa").read()
+    assert open(out + ".log").read() == open(base + ".log").read()
+    assert not os.path.exists(out + ".fa.partial")
+    assert not os.path.exists(j.path)
+
+    doc = json.load(open(mpath))
+    assert doc["meta"]["resumed"] is True
+    assert doc["counters"]["resume_skipped_reads"] == 128
+    assert doc["counters"]["checkpoint_writes_total"] >= 1
+    # restored + freshly-corrected totals equal the uninterrupted run
+    assert doc["counters"]["reads_in"] == 240
+
+
+def test_stage2_resume_without_journal_is_fresh(ec_fixture, tmp_path):
+    """--resume with nothing to resume is a plain run (and still
+    finalizes atomically)."""
+    _tmp, reads_path, db, base = ec_fixture
+    out = str(tmp_path / "fresh")
+    rc = ec_cli.main(["--batch-size", "64", "--checkpoint-every", "2",
+                      "--resume", "-o", out, db, reads_path])
+    assert rc == 0
+    assert open(out + ".fa").read() == open(base + ".fa").read()
+    assert not os.path.exists(out + ".fa.partial")
+
+
+def test_stage2_checkpoint_flag_validation(ec_fixture, tmp_path):
+    _tmp, reads_path, db, _base = ec_fixture
+    # no -o prefix: nowhere to journal
+    assert ec_cli.main(["--checkpoint-every", "1", db,
+                        reads_path]) == 1
+    # gzip output cannot be truncated to a commit point
+    assert ec_cli.main(["--checkpoint-every", "1", "--gzip", "-o",
+                        str(tmp_path / "z"), db, reads_path]) == 1
+
+
+# ---------------------------------------------------------------------------
+# driver retry/backoff (mocked clock, stubbed stages)
+# ---------------------------------------------------------------------------
+
+def test_retry_helper_backoff_sequence_and_cap(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(quorum_cli, "_sleep", sleeps.append)
+    reg = registry_for(None, force=True)
+    attempts = []
+
+    def fn(attempt):
+        attempts.append(attempt)
+        return 1  # always fails
+
+    rc = quorum_cli._run_stage_with_retries(
+        reg, "s", fn, retries=4, backoff_ms=10_000.0,
+        cursor_fn=lambda: 7)
+    assert rc == 1
+    assert attempts == [0, 1, 2, 3, 4]
+    # 10s, 20s, then capped at 30s
+    assert sleeps == [10.0, 20.0, 30.0, 30.0]
+    assert reg.counter("stage_retries_total").value == 4
+
+
+def test_retry_helper_catches_stage_exceptions(monkeypatch):
+    monkeypatch.setattr(quorum_cli, "_sleep", lambda s: None)
+    reg = registry_for(None, force=True)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        if attempt == 0:
+            raise OSError("transient disk error")
+        return 0
+
+    rc = quorum_cli._run_stage_with_retries(reg, "s", fn, retries=1,
+                                            backoff_ms=1.0)
+    assert rc == 0
+    assert calls == [0, 1]
+    assert reg.counter("stage_retries_total").value == 1
+
+
+def test_retry_helper_checkpoint_error_fails_fast(monkeypatch):
+    """A deterministic refusal (CheckpointError, or a stage CLI's
+    rc 3) must not be retried with backoff."""
+    sleeps = []
+    monkeypatch.setattr(quorum_cli, "_sleep", sleeps.append)
+    reg = registry_for(None, force=True)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        raise ckpt_mod.CheckpointError("config mismatch")
+
+    rc = quorum_cli._run_stage_with_retries(reg, "s", fn, retries=5,
+                                            backoff_ms=100.0)
+    assert rc == ckpt_mod.NON_RETRYABLE_RC
+    assert calls == [0] and sleeps == []
+    rc = quorum_cli._run_stage_with_retries(
+        reg, "s", lambda a: ckpt_mod.NON_RETRYABLE_RC, retries=5,
+        backoff_ms=100.0)
+    assert rc == ckpt_mod.NON_RETRYABLE_RC and sleeps == []
+
+
+def test_driver_retries_stage2_with_mocked_clock(tmp_path, monkeypatch):
+    """The driver's retry loop end-to-end with stubbed stages: stage 2
+    fails twice, the backoff sequence is exact, retried attempts pass
+    --resume, and the manifest records every attempt."""
+    monkeypatch.chdir(tmp_path)
+    reads_path, _r, _q = make_dataset(tmp_path, n_reads=8)
+    sleeps = []
+    monkeypatch.setattr(quorum_cli, "_sleep", sleeps.append)
+    monkeypatch.setattr(quorum_cli.cdb_cli, "main",
+                        lambda argv, handoff=None, batches=None: 0)
+    ec_argvs = []
+
+    def fake_ec(argv, db=None, prepacked=None):
+        ec_argvs.append(list(argv))
+        return 1 if len(ec_argvs) <= 2 else 0
+
+    monkeypatch.setattr(quorum_cli.ec_cli, "main", fake_ec)
+    mpath = str(tmp_path / "run.json")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K),
+                          "-p", str(tmp_path / "qc"),
+                          "--stage-retries", "2",
+                          "--retry-backoff-ms", "100",
+                          "--checkpoint-dir", str(tmp_path / "ck"),
+                          "--metrics", mpath,
+                          "--metrics-interval", "60",
+                          reads_path])
+    assert rc == 0
+    assert len(ec_argvs) == 3
+    assert sleeps == [0.1, 0.2]                  # 100ms, then doubled
+    assert "--resume" not in ec_argvs[0]
+    assert "--resume" in ec_argvs[1] and "--resume" in ec_argvs[2]
+    assert "--checkpoint-every" in ec_argvs[0]
+
+    doc = json.load(open(mpath))
+    assert doc["counters"]["stage_retries_total"] == 2
+    assert doc["meta"]["error_correct_attempts"] == 3
+    assert doc["meta"]["create_database_attempts"] == 1
+    events = [json.loads(ln)
+              for ln in open(mpath[:-5] + ".events.jsonl")]
+    retries = [e for e in events if e["event"] == "stage_retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+    assert retries[0]["backoff_ms"] == 100
+    assert retries[1]["backoff_ms"] == 200
+    assert all(e["stage"] == "error_correct" for e in retries)
+
+
+def test_driver_gives_up_after_retries(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    reads_path, _r, _q = make_dataset(tmp_path, n_reads=8)
+    monkeypatch.setattr(quorum_cli, "_sleep", lambda s: None)
+    monkeypatch.setattr(quorum_cli.cdb_cli, "main",
+                        lambda argv, handoff=None, batches=None: 1)
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K),
+                          "-p", str(tmp_path / "qc"),
+                          "--stage-retries", "1", reads_path])
+    assert rc == 1
+
+
+def test_driver_resume_skips_finished_stage1(tmp_path, monkeypatch):
+    """driver --resume with the stage-1 database already on disk (and
+    no pending checkpoint) goes straight to stage 2."""
+    monkeypatch.chdir(tmp_path)
+    reads_path, _r, _q = make_dataset(tmp_path, n_reads=8)
+    prefix = str(tmp_path / "qc")
+    db_file = prefix + "_mer_database.jf"
+    # a file with a valid database header (reuse validates it; a
+    # garbage file must trigger a rebuild instead — see below)
+    open(db_file, "w").write(
+        json.dumps({"format": "binary/quorum_tpu_db", "version": 2,
+                    "key_len": 2 * K, "bits": 7, "rb_log2": 4,
+                    "rows": 16}) + "\n")
+    cdb_calls = []
+    monkeypatch.setattr(
+        quorum_cli.cdb_cli, "main",
+        lambda argv, handoff=None, batches=None: cdb_calls.append(1) or 0)
+    ec_argvs = []
+
+    def fake_ec(argv, db=None, prepacked=None):
+        ec_argvs.append(list(argv))
+        assert db is None and prepacked is None  # re-reads from disk
+        return 0
+
+    monkeypatch.setattr(quorum_cli.ec_cli, "main", fake_ec)
+    mpath = str(tmp_path / "run.json")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "--resume", "--metrics", mpath, reads_path])
+    assert rc == 0
+    assert cdb_calls == []            # stage 1 skipped
+    assert len(ec_argvs) == 1
+    doc = json.load(open(mpath))
+    assert doc["meta"]["stage1_resumed_db"] == db_file
+
+    # a torn/foreign file at the db path must NOT be reused: stage 1
+    # reruns instead of feeding stage 2 garbage
+    open(db_file, "w").write("torn garbage, not a database")
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-p", prefix,
+                          "--resume", reads_path])
+    assert rc == 0
+    assert cdb_calls == [1]           # stage 1 ran this time
+
+
+# ---------------------------------------------------------------------------
+# hard process exit (the real kill) — subprocess, shared compile cache
+# ---------------------------------------------------------------------------
+
+def test_hard_exit_fault_kills_process(tmp_path):
+    """The `exit` action is a real os._exit: no cleanup, no atexit.
+    Exercised on a trivial script so the test stays cheap; the full
+    kill-at-batch-N -> resume -> byte-diff acceptance runs in
+    ci/tier1.sh (tools/resume_smoke.py)."""
+    import subprocess
+    import sys as _sys
+
+    code = ("from quorum_tpu.utils import faults\n"
+            "faults.setup('[{\"site\": \"x\", \"action\": \"exit\", "
+            "\"code\": 43}]')\n"
+            "faults.inject('x')\n"
+            "print('unreachable')\n")
+    res = subprocess.run(
+        [_sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 43
+    assert "unreachable" not in res.stdout
+    assert "hard exit (43) at x" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# metrics_check learns the fault-tolerance names
+# ---------------------------------------------------------------------------
+
+def test_metrics_check_fault_names(tmp_path):
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_check", os.path.join(repo, "tools", "metrics_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+
+    ok = {"meta": {"checkpoint_every": 4, "resumed": True,
+                   "on_bad_read": "skip", "driver": "quorum"},
+          "counters": {"checkpoint_writes_total": 0,
+                       "resume_skipped_reads": 128,
+                       "bad_reads_total": 2,
+                       "stage_retries_total": 1}}
+    assert mc._check_fault_names(ok) == []
+    missing = {"meta": ok["meta"], "counters": {}}
+    errs = mc._check_fault_names(missing)
+    assert len(errs) == 4
+    assert any("checkpoint_writes_total" in e for e in errs)
+    assert any("resume_skipped_reads" in e for e in errs)
+    assert any("bad_reads_total" in e for e in errs)
+    assert any("stage_retries_total" in e for e in errs)
+    # undeclared features require nothing
+    assert mc._check_fault_names({"meta": {}, "counters": {}}) == []

@@ -372,11 +372,14 @@ def test_quorum_driver_uncaught_error_frees_port_and_stamps_manifest(
     reads_path, _, _ = make_dataset(tmp_path)
     mpath = str(tmp_path / "run.json")
 
+    # TypeError: outside the failure shapes the retry loop contains
+    # (RuntimeError/ValueError/OSError become rc-1 stage failures now,
+    # covered by test_faults.py) — a genuinely uncaught exception
     def boom(*a, **kw):
-        raise OSError("stage 1 exploded")
+        raise TypeError("stage 1 exploded")
 
     monkeypatch.setattr(qmod.cdb_cli, "main", boom)
-    with pytest.raises(OSError, match="stage 1 exploded"):
+    with pytest.raises(TypeError, match="stage 1 exploded"):
         quorum_cli.main(["-s", "64k", "-k", str(K),
                          "-p", str(tmp_path / "qc"),
                          "--metrics", mpath, "--metrics-port", "0",

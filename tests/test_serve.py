@@ -25,8 +25,8 @@ from quorum_tpu.cli import create_database as cdb_cli
 from quorum_tpu.cli import error_correct_reads as ec_cli
 from quorum_tpu.cli import serve as serve_cli
 from quorum_tpu.serve import (CorrectionEngine, CorrectionServer,
-                              DeadlineExceeded, DynamicBatcher,
-                              QueueFull)
+                              DeadlineExceeded, Draining,
+                              DynamicBatcher, QueueFull)
 from quorum_tpu.serve.client import ServeClient, bench_main
 from quorum_tpu.telemetry import registry_for, validate_metrics
 
@@ -419,6 +419,148 @@ def test_serve_bench_closed_loop(capsys):
     assert validate_bench_line(obj) == []
     assert obj["ok"] == 9 and obj["reads"] == 36
     assert obj["latency_p50_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serve fault isolation (ISSUE 4): poisoned batches, healthz flip,
+# dispatcher-death future failing
+# ---------------------------------------------------------------------------
+
+class PoisonEngine:
+    """Engine-shaped stub that raises whenever a batch contains a
+    record whose header is 'poison' — a deterministic device-step
+    failure localized to one request."""
+
+    def __init__(self, rows=1024):
+        self.rows = rows
+        self.steps = 0
+
+    compiles = 0
+
+    def step(self, records):
+        self.steps += 1
+        if any(h == "poison" for h, _s, _q in records):
+            raise RuntimeError("poisoned batch")
+        return [(f">{h}\n{s.decode()}\n", "") for h, s, _q in records]
+
+
+def test_poisoned_batch_bisection_isolates_request():
+    """Acceptance: a device-step exception fails only its own batch —
+    and with bisection, only the poisoned REQUEST: its batchmate still
+    gets its answer, the dispatcher survives, later requests succeed."""
+    reg = registry_for(None, force=True)
+    eng = PoisonEngine()
+    bat = DynamicBatcher(eng, max_batch=8, max_wait_ms=100,
+                         queue_requests=8,
+                         max_consecutive_failures=3, registry=reg)
+    try:
+        good = bat.submit([("good", b"ACGT", b"IIII")])
+        poison = bat.submit([("poison", b"ACGT", b"IIII")])
+        # the coalesced batch fails; the bisect retry isolates halves
+        assert good.result(timeout=10) == [(">good\nACGT\n", "")]
+        with pytest.raises(RuntimeError, match="poisoned"):
+            poison.result(timeout=10)
+        assert reg.counter("batch_bisections").value == 1
+        assert reg.counter("requests_failed").value == 1
+        assert reg.counter("engine_step_failures").value >= 1
+        # the dispatcher is alive and healthy: a half succeeded, so
+        # the consecutive-failure streak reset
+        later = bat.submit([("later", b"GG", b"II")])
+        assert later.result(timeout=10) == [(">later\nGG\n", "")]
+        assert bat.healthy
+    finally:
+        bat.drain(timeout=5)
+
+
+def test_consecutive_failures_flip_healthz_and_recover():
+    """After --max-consecutive-failures device-step failures in a row
+    /healthz answers 503 (load balancers eject the replica); a
+    successful step flips it back."""
+    reg = registry_for(None, force=True)
+    eng = PoisonEngine()
+    bat = DynamicBatcher(eng, max_batch=8, max_wait_ms=0,
+                         queue_requests=8,
+                         max_consecutive_failures=2, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        client = ServeClient(port=srv.port)
+        code, h = client.healthz_full()
+        assert code == 200 and h["status"] == "ok" and h["healthy"]
+        # two single-request poisoned batches: no bisection (nothing
+        # to isolate), two consecutive engine failures
+        for _ in range(2):
+            f = bat.submit([("poison", b"ACGT", b"IIII")])
+            with pytest.raises(RuntimeError):
+                f.result(timeout=10)
+        code, h = client.healthz_full()
+        assert code == 503
+        assert h["status"] == "unhealthy" and not h["healthy"]
+        assert h["consecutive_failures"] == 2
+        # the HTTP surface still isolates the failure per request:
+        # a good request succeeds AND heals the streak
+        r = client.correct("@ok\nACGT\n+\nIIII\n")
+        assert r.status == 200 and r.fa == ">ok\nACGT\n"
+        code, h = client.healthz_full()
+        assert code == 200 and h["status"] == "ok"
+        # a poisoned HTTP request maps to 500, later requests fine
+        r = client.correct("@poison\nACGT\n+\nIIII\n")
+        assert r.status == 500 and "poisoned" in r.error
+        r = client.correct("@ok2\nAC\n+\nII\n")
+        assert r.status == 200
+    finally:
+        srv.close()
+
+
+def test_dispatcher_death_fails_queued_futures(monkeypatch):
+    """Satellite fix: ANY dispatcher exit path must fail queued
+    futures immediately — before this, a dead dispatcher stranded
+    clients until their deadline."""
+    reg = registry_for(None, force=True)
+    gate = threading.Event()
+    eng = FakeEngine(gate)
+    bat = DynamicBatcher(eng, max_batch=4, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    recs = [("r", b"ACGT", b"IIII")]
+    f1 = bat.submit(recs)              # dispatched, blocked in engine
+    assert eng.entered.wait(5)
+    _drain_to_depth(bat, 0)
+    f2 = bat.submit(recs)              # queued behind the blocked step
+
+    # kill the dispatch loop itself (outside the per-batch watchdog)
+    def boom():
+        raise AssertionError("dispatch loop bug")
+
+    monkeypatch.setattr(bat, "_take_locked", boom)
+    gate.set()
+    assert f1.result(timeout=10)       # in-flight work still resolves
+    with pytest.raises(RuntimeError, match="dispatcher exited"):
+        f2.result(timeout=10)          # queued future fails FAST
+    bat._thread.join(timeout=5)
+    assert not bat._thread.is_alive()
+    assert not bat.healthy
+    assert reg.counter("dispatcher_crashes").value == 1
+    with pytest.raises(Draining):
+        bat.submit(recs)               # admission refused, not hung
+
+
+def test_drained_batcher_refuses_politely():
+    """A cleanly-drained replica is not "unhealthy": /healthz keeps
+    answering 200 with status=draining (it finished what it admitted;
+    it needs patience, not ejection), and admission raises Draining."""
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=4, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        srv.initiate_drain()
+        assert srv._drained.wait(timeout=5)
+        assert not bat.healthy  # the batcher itself reports done
+        code, h = ServeClient(port=srv.port).healthz_full()
+        assert code == 200 and h["status"] == "draining"
+        with pytest.raises(Draining):
+            bat.submit([("r", b"A", b"I")])
+    finally:
+        srv.close()
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,43 @@ SERVE_REQUIRED_HISTOGRAMS = (
     "serve_wait_us",
 )
 
+# The fault-tolerance metric surface (ISSUE 4): documents that declare
+# the corresponding feature in meta must carry its counters — the
+# stages create them at setup (value 0 counts), so a missing name
+# means the retry/checkpoint/quarantine telemetry regressed.
+#   meta.checkpoint_every > 0  -> checkpoint_writes_total
+#   meta.resumed truthy        -> resume_skipped_reads
+#   meta.on_bad_read in
+#     ("skip", "quarantine")   -> bad_reads_total
+#   meta.driver == "quorum"    -> stage_retries_total
+FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
+                  "bad_reads_total", "stage_retries_total")
+
+
+def _check_fault_names(doc: dict) -> list[str]:
+    errs = []
+    meta = doc.get("meta", {})
+    counters = doc.get("counters", {})
+
+    def want(cond, name, why):
+        if cond and name not in counters:
+            errs.append(f"document with {why} missing counter {name!r}")
+
+    try:
+        every = float(meta.get("checkpoint_every") or 0)
+    except (TypeError, ValueError):
+        every = 0
+    want(every > 0, "checkpoint_writes_total",
+         f"meta.checkpoint_every={meta.get('checkpoint_every')!r}")
+    want(bool(meta.get("resumed")), "resume_skipped_reads",
+         "meta.resumed set")
+    want(meta.get("on_bad_read") in ("skip", "quarantine"),
+         "bad_reads_total",
+         f"meta.on_bad_read={meta.get('on_bad_read')!r}")
+    want(meta.get("driver") == "quorum", "stage_retries_total",
+         "meta.driver=quorum")
+    return errs
+
 
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
@@ -81,8 +118,9 @@ def _check_serve_names(doc: dict) -> list[str]:
 
 def _check_with_serve_names(path: str) -> list[str]:
     """check_file, plus the serve-name requirements when the artifact
-    is a serve final document (dispatch on meta.stage, like the rest
-    of the content dispatch)."""
+    is a serve final document and the fault-tolerance names whenever
+    the document's meta declares the feature (dispatch on meta, like
+    the rest of the content dispatch)."""
     problems = check_file(path)
     try:
         import json
@@ -90,9 +128,12 @@ def _check_with_serve_names(path: str) -> list[str]:
             doc = json.load(f)
     except (OSError, ValueError):
         return problems
-    if (isinstance(doc, dict)
-            and doc.get("meta", {}).get("stage") == "serve"):
+    if not isinstance(doc, dict):
+        return problems
+    if doc.get("meta", {}).get("stage") == "serve":
         problems = problems + _check_serve_names(doc)
+    if "meta" in doc:
+        problems = problems + _check_fault_names(doc)
     return problems
 
 

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Golden kill-resume run for CI (ci/tier1.sh): the ISSUE 4
+acceptance property, end to end, with a REAL process kill.
+
+1. Build the mer database from the committed golden reads.
+2. Run `quorum_error_correct_reads` as a SUBPROCESS with a fault plan
+   (via the QUORUM_FAULT_PLAN env var) that hard-exits the process
+   (`os._exit`) at stage2.correct batch 2, journaling every batch —
+   the run dies with batches 0-1 committed and partial outputs on
+   disk.
+3. Re-run in-process with `--resume`: the journal's batches are
+   skipped, the torn tail truncated, and the output finalized
+   atomically.
+4. Assert the resumed `.fa` is BYTE-IDENTICAL to
+   tests/golden/expected.fa (and `.log` to expected.log), the journal
+   and partials are gone, and the resume metrics document carries the
+   checkpoint/resume counters (`metrics_check.py` gates it after).
+
+Artifacts land in --out-dir:
+  resume_metrics.json — the resumed run's final metrics document
+                        (gated by tools/metrics_check.py, which
+                        requires the checkpoint/resume counter names)
+
+Exit 0 = all checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+KILL_CODE = 41
+BATCH_SIZE = 64  # 242 golden reads -> 4 batches; the kill lands at 2
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden kill-resume run: hard-kill stage 2 mid-run "
+                    "via fault plan, resume, byte-diff (ci/tier1.sh "
+                    "gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where the work files and resume_metrics.json "
+                        "land (default: a temp dir)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="resume_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    expected_fa = os.path.join(GOLDEN, "expected.fa")
+    expected_log = os.path.join(GOLDEN, "expected.log")
+    db = os.path.join(out_dir, "db.jf")
+    prefix = os.path.join(out_dir, "corrected")
+    metrics_path = os.path.join(out_dir, "resume_metrics.json")
+
+    print(f"[resume_smoke] building golden database -> {db}")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, reads])
+    if rc != 0:
+        print("[resume_smoke] FAIL: database build", file=sys.stderr)
+        return 1
+
+    # -- the kill: a subprocess dies by os._exit mid-stage-2 ----------
+    plan = json.dumps([{"site": "stage2.correct", "batch": 2,
+                        "action": "exit", "code": KILL_CODE}])
+    ec_args = ["-p", "4", "--batch-size", str(BATCH_SIZE),
+               "--checkpoint-every", "1", "-o", prefix, db, reads]
+    env = dict(os.environ, QUORUM_FAULT_PLAN=plan)
+    print(f"[resume_smoke] killed run (fault plan: {plan})")
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.error_correct_reads"]
+        + ec_args, cwd=REPO, env=env)
+    if res.returncode != KILL_CODE:
+        print(f"[resume_smoke] FAIL: killed run exited "
+              f"{res.returncode}, want {KILL_CODE}", file=sys.stderr)
+        return 1
+    if os.path.exists(prefix + ".fa"):
+        print("[resume_smoke] FAIL: final .fa exists after the kill "
+              "(finalize must be atomic, not incremental)",
+              file=sys.stderr)
+        return 1
+    if not (os.path.exists(prefix + ".fa.partial")
+            and os.path.exists(prefix + ".resume.json")):
+        print("[resume_smoke] FAIL: no partial/journal after the kill",
+              file=sys.stderr)
+        return 1
+    journal = json.load(open(prefix + ".resume.json"))
+    print(f"[resume_smoke] killed at batch 2; journal committed "
+          f"{journal['batches']} batches / {journal['reads']} reads")
+    if journal["batches"] != 2:
+        print(f"[resume_smoke] FAIL: journal batches "
+              f"{journal['batches']}, want 2", file=sys.stderr)
+        return 1
+
+    # -- the resume: skips journaled reads, finalizes atomically ------
+    print("[resume_smoke] resuming with --resume")
+    rc = ec_cli.main(ec_args + ["--resume", "--metrics", metrics_path,
+                                "--fault-plan", ""])
+    if rc != 0:
+        print("[resume_smoke] FAIL: resume run rc", rc, file=sys.stderr)
+        return 1
+
+    # -- byte identity vs the committed golden output -----------------
+    for got, want in ((prefix + ".fa", expected_fa),
+                      (prefix + ".log", expected_log)):
+        if open(got, "rb").read() != open(want, "rb").read():
+            print(f"[resume_smoke] FAIL: {got} differs from {want} "
+                  "(kill -> resume must be byte-identical)",
+                  file=sys.stderr)
+            return 1
+    for leftover in (prefix + ".fa.partial", prefix + ".log.partial",
+                     prefix + ".resume.json"):
+        if os.path.exists(leftover):
+            print(f"[resume_smoke] FAIL: {leftover} survived finalize",
+                  file=sys.stderr)
+            return 1
+
+    doc = json.load(open(metrics_path))
+    skipped = doc["counters"].get("resume_skipped_reads", 0)
+    if not doc["meta"].get("resumed") or skipped != 2 * BATCH_SIZE:
+        print(f"[resume_smoke] FAIL: resume telemetry (resumed="
+              f"{doc['meta'].get('resumed')}, skipped={skipped})",
+              file=sys.stderr)
+        return 1
+    print(f"[resume_smoke] OK: kill at batch 2 -> resume skipped "
+          f"{skipped} reads -> byte-identical output; metrics -> "
+          f"{metrics_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
