@@ -11,9 +11,17 @@
 # through metrics_check too, which requires the checkpoint/resume
 # counter names).
 #
+# Also gates a 2-device CPU-mesh golden run (ISSUE 5,
+# tools/multichip_smoke.py: quorum --devices 2 byte-identical to
+# --devices 1, sharded stage-1 kill/resume restoring every shard at
+# the same cursor) whose sharded stage-1 metrics document and the
+# driver's aggregated hosts document go through metrics_check (which
+# requires the per-shard counter names).
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
+#        SKIP_MULTICHIP_SMOKE=1  skips the 2-device mesh gate.
 set -o pipefail
 set -u
 
@@ -84,7 +92,33 @@ else
     fi
 fi
 
+multichip_rc=0
+if [ "${SKIP_MULTICHIP_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: multichip smoke skipped (SKIP_MULTICHIP_SMOKE=1)"
+else
+    echo "== golden 2-device mesh run =="
+    MC_DIR=$(mktemp -d /tmp/multichip_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "$MC_DIR"' EXIT
+    # same shared compile cache as the pytest pass (see serve note);
+    # the virtual 8-device CPU mesh must be forced BEFORE jax imports
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/multichip_smoke.py \
+        --out-dir "$MC_DIR" || multichip_rc=$?
+    if [ "$multichip_rc" -eq 0 ]; then
+        echo "== metrics_check gates (multichip) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$MC_DIR/multichip_metrics.stage1.json" \
+            "$MC_DIR/multichip_metrics.hosts.json" || multichip_rc=1
+    fi
+    if [ "$multichip_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: multichip gate FAILED (rc=$multichip_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
+if [ "$multichip_rc" -ne 0 ]; then exit "$multichip_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Maximum number of reprobes")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--devices", default="auto", metavar="N",
+                   help="Shard the counting table over N local "
+                        "devices (power of two; 'all' = every local "
+                        "device, 'auto' = all on a real accelerator, "
+                        "1 on CPU; 1 = single-chip path). Output is "
+                        "byte-identical to --devices 1")
     p.add_argument("--ref-format", action="store_true",
                    help="Write the reference's binary/quorum_db format "
                         "instead of the native format")
@@ -101,14 +107,22 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         print("Mer length must be between 1 and 31", file=sys.stderr)
         return 1
     faults.setup(args.fault_plan)
+    from ..parallel.tile_sharded import resolve_devices_and_batch
+    try:
+        devices, batch_size = resolve_devices_and_batch(
+            args.devices, args.batch_size, "quorum_create_database")
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     cfg = BuildConfig(
         k=args.mer,
         bits=args.bits,
         qual_thresh=qual_thresh,
         initial_size=parse_size(args.size),
         max_reprobe=args.reprobe,
-        batch_size=args.batch_size,
+        batch_size=batch_size,
         threads=args.threads,
+        devices=devices,
         profile=args.profile,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true", help="Be verbose")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--devices", default="auto", metavar="N",
+                   help="Correct data-parallel over N local devices "
+                        "(power of two; 'all' = every local device, "
+                        "'auto' = all on a real accelerator, 1 on "
+                        "CPU). The table replicates per device below "
+                        "the size threshold and stays row-sharded "
+                        "with routed lookups above it; output is "
+                        "byte-identical to --devices 1")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("--metrics", metavar="path", default=None,
@@ -121,13 +129,21 @@ def main(argv=None, db=None, prepacked=None) -> int:
         print("The qual-cutoff-value must be in the range 0-127.",
               file=sys.stderr)
         return 1
+    from ..models.ec_config import DEFAULT_QUAL_CUTOFF
     qual_cutoff = (
         ord(args.qual_cutoff_char) if args.qual_cutoff_char is not None
         else args.qual_cutoff_value if args.qual_cutoff_value is not None
-        else 127  # numeric_limits<char>::max()
+        else DEFAULT_QUAL_CUTOFF  # numeric_limits<char>::max()
     )
 
     faults.setup(args.fault_plan)
+    from ..parallel.tile_sharded import resolve_devices_and_batch
+    try:
+        devices, batch_size = resolve_devices_and_batch(
+            args.devices, args.batch_size, "quorum_error_correct_reads")
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     opts = ECOptions(
         output=args.output,
         gzip=args.gzip,
@@ -135,8 +151,9 @@ def main(argv=None, db=None, prepacked=None) -> int:
         cutoff=args.cutoff,
         apriori_error_rate=args.apriori_error_rate,
         poisson_threshold=args.poisson_threshold,
-        batch_size=args.batch_size,
+        batch_size=batch_size,
         threads=args.thread,
+        devices=devices,
         no_mmap=args.no_mmap,
         profile=args.profile,
         metrics=args.metrics,

@@ -26,9 +26,11 @@ from ..utils import faults
 from ..models.error_correct import ECOptions, run_error_correct
 
 # EC's default quality cutoff when the driver passes no -q/-Q to it —
-# numeric_limits<char>::max(), matching the reference driver which
-# never forwards a qual cutoff (quorum.in:160-171)
-_EC_QUAL_CUTOFF = 127
+# the SAME constant the EC CLI defaults to (models/ec_config), so the
+# replay cache's packed qual>=cutoff plane can never drift from the
+# cutoff stage 2 resolves (ADVICE r5). The reference driver likewise
+# never forwards a qual cutoff (quorum.in:160-171).
+from ..models.ec_config import DEFAULT_QUAL_CUTOFF as _EC_QUAL_CUTOFF
 
 # Replay-cache budget: the driver keeps stage 1's decoded+packed
 # batches in RAM so stage 2 skips the second parse (the reference gets
@@ -169,6 +171,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Trim homo-polymer on 3' end")
     p.add_argument("--batch-size", type=int, default=8192,
                    help="Reads per device batch")
+    p.add_argument("--devices", default="auto", metavar="N",
+                   help="Scale out over N local devices (power of "
+                        "two; 'all' = every local device, 'auto' = "
+                        "all on a real accelerator, 1 on CPU): "
+                        "stage 1 builds the table sharded by leading "
+                        "row bits, stage 2 corrects data-parallel "
+                        "(replicated or routed table by size). "
+                        "Output is byte-identical to --devices 1")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write jax.profiler traces (per-stage "
                         "subdirectories of this directory)")
@@ -292,6 +302,22 @@ def main(argv=None) -> int:
         rc = _main_inner(args, reg, obs.tracer, cache_dir)
         if rc != 0:
             obs.status = "error"
+        elif reg.enabled:
+            # the "real driver entry point" for aggregate_metrics the
+            # telemetry ROADMAP item has wanted since PR 2: every run
+            # lands ONE job-level aggregated document (per-host shards
+            # under `hosts`; a single host on a local --devices mesh is
+            # simply a one-shard reduce). Collective + symmetric, so
+            # this is also where a future multi-host driver merges.
+            try:
+                from ..parallel import multihost
+                hosts_path = (_stage_path(args.metrics, "hosts")
+                              if args.metrics else None)
+                reg.set_meta(metrics_hosts=hosts_path)
+                multihost.aggregate_metrics(reg, path=hosts_path)
+            except Exception as e:  # noqa: BLE001 - reporting only
+                print(f"quorum: metrics aggregation failed: {e}",
+                      file=sys.stderr)
     return rc
 
 
@@ -312,15 +338,30 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     import jax
     if jax.process_count() > 1:
         # the driver is single-controller by design: its build state is
-        # host-local and both stages write one output path. Multi-host
-        # = global mesh + parallel.tile_sharded fed by
-        # parallel.multihost (the stage CLIs refuse too, but the
-        # driver must refuse BEFORE handing them its own batches,
-        # which would bypass their checks).
-        print("quorum: multi-host runs require the sharded pipeline "
-              "(parallel.tile_sharded + parallel.multihost); the "
-              "driver is single-controller", file=sys.stderr)
+        # host-local and both stages write one output path. Local
+        # scale-out is --devices N (this PR); multi-HOST needs a
+        # global mesh fed by parallel.multihost with per-host output
+        # prefixes (the stage CLIs refuse too, but the driver must
+        # refuse BEFORE handing them its own batches, which would
+        # bypass their checks).
+        print("quorum: multi-host runs are not wired yet — use "
+              "--devices N for local scale-out; multi-host needs "
+              "parallel.multihost input sharding + per-host outputs",
+              file=sys.stderr)
         return 1
+
+    # --devices: resolve once, forward the RESOLVED count to both
+    # stages (their own 'auto' could disagree if device enumeration
+    # races a plugin registration), and shape the shared producer's
+    # batches to whole per-device slices
+    from ..parallel.tile_sharded import resolve_devices_and_batch
+    try:
+        n_devices, args.batch_size = resolve_devices_and_batch(
+            args.devices, args.batch_size, "quorum")
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    vlog("Using ", n_devices, " device(s)")
 
     # per-stage observability paths (forward --metrics, --profile and
     # --trace-spans consistently to both children, suffixed per
@@ -342,6 +383,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                     for k, v in vars(args).items()},
             jax_backend=jax.default_backend(),
             device_count=len(devs),
+            devices_resolved=n_devices,
             device_kinds=sorted({d.device_kind for d in devs}),
             process_count=jax.process_count(),
             compile_cache_dir=str(cache_dir),
@@ -368,7 +410,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     cdb_argv = ["-s", args.size, "-m", str(args.kmer_len),
                 "-q", str(min_q_char + args.min_quality), "-b", "7",
                 "-t", str(threads),
-                "-o", db_file, "--batch-size", str(args.batch_size)]
+                "-o", db_file, "--batch-size", str(args.batch_size),
+                "--devices", str(n_devices)]
     if args.checkpoint_dir:
         cdb_argv.extend(["--checkpoint-dir", args.checkpoint_dir,
                          "--checkpoint-every",
@@ -469,7 +512,9 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     def _stage1_cursor():
         if not args.checkpoint_dir:
             return None
-        return ckpt_mod.Stage1Checkpoint(args.checkpoint_dir).cursor()
+        cls = (ckpt_mod.Stage1ShardedCheckpoint if n_devices > 1
+               else ckpt_mod.Stage1Checkpoint)
+        return cls(args.checkpoint_dir).cursor()
 
     def _stage1_attempt(attempt: int) -> int:
         # every attempt gets a FRESH shared producer and replay cache
@@ -535,7 +580,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
 
     # Stage 2: error correction (quorum.in:162-231)
     ec_common = ["--batch-size", str(args.batch_size),
-                 "-t", str(threads)]
+                 "-t", str(threads), "--devices", str(n_devices)]
     for flag, val in (("--min-count", args.min_count),
                       ("--skip", args.skip),
                       ("--good", args.anchor),
@@ -618,6 +663,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
               file=sys.stderr)
     opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
                      batch_size=args.batch_size, threads=threads,
+                     devices=n_devices,
                      profile=p2, metrics=m2,
                      metrics_interval=args.metrics_interval,
                      metrics_textfile=args.metrics_textfile,

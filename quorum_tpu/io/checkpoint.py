@@ -41,6 +41,8 @@ import numpy as np
 from ..telemetry.registry import atomic_write
 
 STAGE1_FORMAT = "quorum_tpu_stage1_ckpt/1"
+STAGE1_SHARDED_FORMAT = "quorum_tpu_stage1_sharded/1"
+STAGE1_SHARD_FORMAT = "quorum_tpu_stage1_shard/1"
 STAGE2_FORMAT = "quorum_tpu_stage2_journal/1"
 
 
@@ -192,6 +194,269 @@ class Stage1Checkpoint:
             os.remove(self.path)
         except FileNotFoundError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Stage 1, sharded (--devices N): per-shard snapshots under one manifest
+# ---------------------------------------------------------------------------
+
+
+class Stage1ShardedSnapshot:
+    """A loaded sharded stage-1 snapshot: the manifest header plus the
+    REASSEMBLED global table planes (shard slices concatenated in
+    leading-row-bit order — the global array is identical to what the
+    build held, whatever mesh it re-lands on)."""
+
+    def __init__(self, header: dict, tag: np.ndarray, hq: np.ndarray,
+                 lq: np.ndarray):
+        self.header = header
+        self.tag = tag
+        self.hq = hq
+        self.lq = lq
+
+    @property
+    def rb_log2(self) -> int:
+        return int(self.header["rb_log2"])
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.header["n_shards"])
+
+    @property
+    def cursor(self) -> int:
+        return int(self.header["cursor"])
+
+    def check_config(self, k: int, bits: int, qual_thresh: int,
+                     batch_size: int, paths, n_shards: int) -> None:
+        h = self.header
+        want = {"k": k, "bits": bits, "qual_thresh": qual_thresh,
+                "batch_size": batch_size, "n_shards": n_shards}
+        for key, val in want.items():
+            if int(h.get(key, -1)) != int(val):
+                raise CheckpointError(
+                    f"sharded stage-1 checkpoint was written with "
+                    f"{key}={h.get(key)}, this run uses {val}; refusing "
+                    "to resume (delete the checkpoint to start over)")
+        if list(h.get("paths", [])) != list(paths):
+            raise CheckpointError(
+                f"sharded stage-1 checkpoint covers inputs "
+                f"{h.get('paths')}, this run reads {list(paths)}; "
+                "refusing to resume")
+
+
+class Stage1ShardedCheckpoint:
+    """Crash-safe snapshots of a SHARDED stage-1 build (`--devices N`,
+    parallel/tile_sharded): one payload file per shard plus ONE
+    manifest, `<dir>/stage1.sharded.json`.
+
+    Write protocol (kill-safe at any instant): every shard of the new
+    generation lands first (tmp-then-rename each, its own header
+    recording shard id / generation / cursor / geometry), a multihost
+    barrier ensures every host finished its shards, then process 0
+    atomically replaces the manifest — which is the commit point —
+    and only then are the previous generation's shard files removed.
+    A kill before the manifest swap resumes from the OLD generation;
+    after it, from the new one. There is no window where the manifest
+    names missing or mixed-generation shards.
+
+    Load verifies the shard set against the manifest — every shard
+    present, same generation, same cursor, same geometry, exact
+    payload size — and REFUSES (CheckpointError) on any disagreement:
+    a resume must restore every shard at the same cursor or fail
+    loudly, never splice table states from different points of the
+    input stream."""
+
+    MANIFEST = "stage1.sharded.json"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, self.MANIFEST)
+
+    def _shard_path(self, s: int, gen: int) -> str:
+        return os.path.join(self.dir, f"stage1.shard{s:04d}.g{gen}.ckpt")
+
+    def _read_manifest(self) -> dict | None:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                header = json.load(f)
+        except ValueError:
+            raise CheckpointError(
+                f"corrupt sharded stage-1 manifest '{self.path}'"
+            ) from None
+        if header.get("format") != STAGE1_SHARDED_FORMAT:
+            raise CheckpointError(
+                f"'{self.path}' is not a sharded stage-1 manifest "
+                f"(format={header.get('format')!r})")
+        return header
+
+    def save(self, bstate, meta, cfg, cursor: int, stats, paths) -> None:
+        """Snapshot the sharded build planes after `cursor` fully
+        inserted batches. Each host writes the shards its devices
+        hold (single-controller: all of them); the manifest swap is
+        the commit point."""
+        from ..ops.ctable import TSLOTS
+        from ..parallel.multihost import barrier, process_index
+        os.makedirs(self.dir, exist_ok=True)
+        try:
+            old = self._read_manifest()
+        except CheckpointError:
+            old = None  # never let a corrupt old manifest block saving
+        gen = (int(old.get("gen", 0)) + 1) if old else 1
+        S = meta.n_shards
+        rows_local = meta.rows // S
+        acc_local = rows_local * TSLOTS
+        shards = _addressable_row_shards(bstate, S, meta.rows)
+        for s, (tag_s, hq_s, lq_s) in shards.items():
+            header = {
+                "format": STAGE1_SHARD_FORMAT, "shard": s,
+                "n_shards": S, "gen": gen, "cursor": int(cursor),
+                "rb_log2": meta.rb_log2,
+                "rows_local": rows_local, "acc_local": acc_local,
+            }
+            tmp = self._shard_path(s, gen) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(np.ascontiguousarray(tag_s).tobytes())
+                f.write(np.ascontiguousarray(hq_s).tobytes())
+                f.write(np.ascontiguousarray(lq_s).tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._shard_path(s, gen))
+        # every host's shards must be durable BEFORE the manifest
+        # commits to this generation
+        barrier("stage1_sharded_ckpt_save")
+        if process_index() == 0:
+            atomic_write(self.path, json.dumps({
+                "format": STAGE1_SHARDED_FORMAT,
+                "gen": gen,
+                "cursor": int(cursor),
+                "k": meta.k, "bits": meta.bits,
+                "rb_log2": meta.rb_log2, "n_shards": S,
+                "rows_local": rows_local, "acc_local": acc_local,
+                "reads": int(stats.reads), "bases": int(stats.bases),
+                "batches": int(stats.batches), "grows": int(stats.grows),
+                "qual_thresh": int(cfg.qual_thresh),
+                "batch_size": int(cfg.batch_size),
+                "paths": list(paths),
+            }) + "\n")
+        barrier("stage1_sharded_ckpt_commit")
+        # the old generation is dead only now that the manifest moved on
+        if old:
+            for s in range(int(old.get("n_shards", 0))):
+                try:
+                    os.remove(self._shard_path(s, int(old["gen"])))
+                except OSError:
+                    pass
+
+    def load(self) -> Stage1ShardedSnapshot | None:
+        """The last committed snapshot, or None when there is none. Any
+        shard missing, truncated, or disagreeing with the manifest
+        (generation, cursor, geometry) raises CheckpointError."""
+        manifest = self._read_manifest()
+        if manifest is None:
+            return None
+        S = int(manifest["n_shards"])
+        gen = int(manifest["gen"])
+        rows_local = int(manifest["rows_local"])
+        acc_local = int(manifest["acc_local"])
+        from ..ops.ctable import TILE
+        want_payload = (rows_local * TILE + 2 * acc_local) * 4
+        tags, hqs, lqs = [], [], []
+        for s in range(S):
+            p = self._shard_path(s, gen)
+            if not os.path.exists(p):
+                raise CheckpointError(
+                    f"sharded stage-1 checkpoint is missing shard {s} "
+                    f"('{p}'); refusing to resume from a partial "
+                    "snapshot")
+            with open(p, "rb") as f:
+                try:
+                    h = json.loads(f.readline(1 << 20))
+                except ValueError:
+                    raise CheckpointError(
+                        f"corrupt shard snapshot '{p}' (bad header)"
+                    ) from None
+                payload = f.read()
+            for key, want in (("format", STAGE1_SHARD_FORMAT),
+                              ("shard", s), ("n_shards", S),
+                              ("gen", gen),
+                              ("cursor", int(manifest["cursor"])),
+                              ("rb_log2", int(manifest["rb_log2"]))):
+                if h.get(key) != want:
+                    raise CheckpointError(
+                        f"shard snapshot '{p}' disagrees with the "
+                        f"manifest on {key} ({h.get(key)!r} != "
+                        f"{want!r}); every shard must restore at the "
+                        "same cursor — refusing to resume")
+            if len(payload) != want_payload:
+                raise CheckpointError(
+                    f"corrupt shard snapshot '{p}': payload "
+                    f"{len(payload)} bytes, want {want_payload}")
+            arr = np.frombuffer(payload, dtype=np.uint32)
+            tags.append(arr[:rows_local * TILE].reshape(rows_local,
+                                                        TILE))
+            hqs.append(arr[rows_local * TILE:rows_local * TILE
+                           + acc_local])
+            lqs.append(arr[rows_local * TILE + acc_local:])
+        return Stage1ShardedSnapshot(
+            manifest, np.concatenate(tags, axis=0),
+            np.concatenate(hqs), np.concatenate(lqs))
+
+    def cursor(self) -> int | None:
+        """Header-only peek at the committed batch cursor (driver
+        retry events); None when no usable manifest."""
+        try:
+            manifest = self._read_manifest()
+            return None if manifest is None else int(manifest["cursor"])
+        except (CheckpointError, KeyError, ValueError):
+            return None
+
+    def clear(self) -> None:
+        """Remove the manifest and every shard payload (the finished
+        database is the durable artifact now)."""
+        import glob
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+        # the *.ckpt.tmp pattern catches orphans of a save() killed
+        # between the tmp write and its rename — later generations
+        # never reuse the name, so nothing else would reap them
+        for p in glob.glob(os.path.join(self.dir,
+                                        "stage1.shard*.ckpt*")):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _addressable_row_shards(bstate, S: int, rows_total: int) -> dict:
+    """{shard id: (tag, hq, lq)} host copies of every shard THIS
+    process can address (single-controller: all of them). Shard s owns
+    the contiguous leading-bit row range [s*rows/S, (s+1)*rows/S); on
+    a 1-D mesh each device holds exactly one such range, so the
+    device-local buffer IS the shard payload — no global gather."""
+    rows_local = rows_total // S
+
+    def by_shard(arr, unit_rows: int):
+        out = {}
+        jarr = arr
+        if not hasattr(jarr, "addressable_shards"):
+            import jax.numpy as jnp
+            jarr = jnp.asarray(jarr)
+        for sh in jarr.addressable_shards:
+            idx = sh.index[0]
+            start = 0 if idx.start is None else int(idx.start)
+            out[start // unit_rows] = np.asarray(sh.data)
+        return out
+
+    from ..ops.ctable import TSLOTS
+    tags = by_shard(bstate.tag, rows_local)
+    hqs = by_shard(bstate.hq, rows_local * TSLOTS)
+    lqs = by_shard(bstate.lq, rows_local * TSLOTS)
+    return {s: (tags[s], hqs[s], lqs[s]) for s in tags}
 
 
 # ---------------------------------------------------------------------------

@@ -54,11 +54,15 @@ class PackedReads:
 
     @property
     def nbytes(self) -> int:
-        # lengths ride inside the wire once it exists — don't count
-        # them twice (the driver's replay-cache budget uses this)
-        arrs = [self.pcodes, self.nmask, self._wire, *self.hq.values()]
-        if self._wire is None:
-            arrs.append(self.lengths)
+        # once the wire exists it CONTAINS every plane (codes, masks,
+        # hq, lengths); counting the standalone arrays alongside it
+        # would double the figure ~2x and overstate the driver's
+        # replay-cache budget (ADVICE r5). The standalone planes only
+        # count while no wire has been built yet.
+        if self._wire is not None:
+            return self._wire.nbytes
+        arrs = [self.pcodes, self.nmask, self.lengths,
+                *self.hq.values()]
         return sum(a.nbytes for a in arrs if a is not None)
 
     def require_plane(self, threshold: int) -> None:

@@ -56,6 +56,10 @@ class BuildConfig:
     # --on-bad-read: malformed-record policy (io/fastq.BadReadPolicy)
     on_bad_read: str = "abort"
     quarantine_path: str | None = None
+    # --devices (ISSUE 5): 1 = the single-chip path; >1 shards the
+    # table by leading row bits over a local device mesh
+    # (parallel/tile_sharded) and routes observations owner-bucketed
+    devices: int = 1
 
 
 # canonical home is ops/ctable (so the fused stage-1 dispatch can use
@@ -104,6 +108,11 @@ def build_database(
     """
     reg = metrics if metrics is not None else NULL_METRICS
     tracer = tracer if tracer is not None else NULL_TRACER
+    if cfg.devices > 1:
+        # --devices N: the tile-sharded multi-device build
+        # (parallel/tile_sharded), fed by the SAME packed-wire
+        # producer; bit-identical table content by construction
+        return _build_database_sharded(paths, cfg, batches, reg, tracer)
     rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
     meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb)
     bstate = ctable.make_tile_build(meta)
@@ -143,43 +152,7 @@ def build_database(
         reg.set_meta(checkpoint_every=cfg.checkpoint_every)
 
     if batches is None:
-        # host decode/encode/bit-packing overlaps device rounds (double
-        # buffering, the PP row of SURVEY §2.4). H2D stays on the MAIN
-        # thread in the packed wire format (io/packing.py, 0.5 B/base):
-        # device_put from the prefetch thread measured slower (tunnel
-        # client degrades under concurrent access; PERF_NOTES.md r4).
-        def _pack(it):
-            for b in it:
-                pk = packing.pack_reads(b.codes, b.quals, b.lengths,
-                                        thresholds=(cfg.qual_thresh,))
-                pk.to_wire()  # warm the fused H2D buffer off-thread
-                yield b, pk
-        import jax as _jax
-        if _jax.process_count() > 1:
-            # the single-chip build is host-local state; running it
-            # per-host would write racing PARTIAL tables. Multi-host
-            # stage 1 = global mesh + parallel/tile_sharded.
-            # build_database_tile_sharded fed by
-            # parallel/multihost.read_batches_multihost.
-            raise RuntimeError(
-                "multi-host build requires the sharded pipeline "
-                "(parallel.tile_sharded.build_database_tile_sharded + "
-                "parallel.multihost), not the single-chip CLI")
-        policy = None
-        if cfg.on_bad_read != "abort":
-            # read_batches owns the policy's lifecycle: its generator
-            # finally closes the quarantine stream however this build
-            # ends
-            policy = fastq.BadReadPolicy(
-                cfg.on_bad_read, cfg.quarantine_path,
-                reg if reg.enabled else None)
-            reg.counter("bad_reads_total")  # lands even at 0
-            reg.set_meta(on_bad_read=cfg.on_bad_read)
-        src = fastq.read_batches(paths, cfg.batch_size,
-                                 threads=cfg.threads, policy=policy)
-        batches = prefetch(_pack(src),
-                           metrics=reg if reg.enabled else None,
-                           tracer=tracer)
+        batches = _default_batches(paths, cfg, reg, tracer)
     timer = StageTimer()
     with trace(cfg.profile):
         for batch, pk in batches:
@@ -284,6 +257,219 @@ def build_database(
     return state, meta, stats
 
 
+def _default_batches(paths, cfg: BuildConfig, reg, tracer):
+    """The disk -> decode -> bit-pack producer BOTH build paths (and
+    the quorum driver's shared replay cache) consume: host
+    decode/encode/bit-packing overlaps device rounds (double
+    buffering, the PP row of SURVEY §2.4). H2D stays on the MAIN
+    thread in the packed wire format (io/packing.py, 0.5 B/base):
+    device_put from the prefetch thread measured slower (tunnel
+    client degrades under concurrent access; PERF_NOTES.md r4)."""
+    def _pack(it):
+        for b in it:
+            pk = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                    thresholds=(cfg.qual_thresh,))
+            pk.to_wire()  # warm the fused H2D buffer off-thread
+            yield b, pk
+    import jax as _jax
+    if _jax.process_count() > 1:
+        # per-host runs of this CLI would write racing PARTIAL
+        # tables / race on one output path. Multi-host stage 1 =
+        # global mesh + the sharded build fed by
+        # parallel/multihost.read_batches_multihost.
+        raise RuntimeError(
+            "multi-host build requires the sharded pipeline over a "
+            "global mesh fed by parallel.multihost, not this "
+            "single-controller CLI")
+    policy = None
+    if cfg.on_bad_read != "abort":
+        # read_batches owns the policy's lifecycle: its generator
+        # finally closes the quarantine stream however this build
+        # ends
+        policy = fastq.BadReadPolicy(
+            cfg.on_bad_read, cfg.quarantine_path,
+            reg if reg.enabled else None)
+        reg.counter("bad_reads_total")  # lands even at 0
+        reg.set_meta(on_bad_read=cfg.on_bad_read)
+    src = fastq.read_batches(paths, cfg.batch_size,
+                             threads=cfg.threads, policy=policy)
+    return prefetch(_pack(src),
+                    metrics=reg if reg.enabled else None,
+                    tracer=tracer)
+
+
+def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
+                            tracer):
+    """Stage 1 over a local device mesh (`--devices N`): the
+    tile-sharded build of parallel/tile_sharded promoted to the
+    production path — packed-wire input (the same producer as the
+    single-chip loop), routed owner-bucketed inserts, sharded
+    grow/finalize, per-shard checkpoints under one manifest
+    (io/checkpoint.Stage1ShardedCheckpoint), and the per-shard
+    occupancy/insert telemetry. Returns (TileState row-sharded,
+    TileShardedMeta, stats) — same contract as build_database, with
+    the sharded meta standing in for TileMeta (duck-typed)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel import tile_sharded as ts
+
+    S = cfg.devices
+    mesh = ts.make_mesh(S)
+    owner_bits = int(S).bit_length() - 1
+    rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
+    # global geometry: at least a few rows per shard, at most the
+    # per-chip cap on every shard (growth lifts it from there)
+    rb = min(max(rb, owner_bits + 4), 24 + owner_bits)
+    meta = ts.TileShardedMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb,
+                              n_shards=S)
+    bstate = ts.make_build_state(meta, mesh)
+    stats = BuildStats()
+    reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
+                 qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size,
+                 devices=S)
+
+    ck = (ckpt_mod.Stage1ShardedCheckpoint(cfg.checkpoint_dir)
+          if cfg.checkpoint_dir else None)
+    skip_batches = 0
+    if ck is not None and cfg.resume:
+        snap = ck.load()
+        if snap is not None:
+            snap.check_config(cfg.k, cfg.bits, cfg.qual_thresh,
+                              cfg.batch_size, paths, S)
+            meta = ts.TileShardedMeta(k=cfg.k, bits=cfg.bits,
+                                      rb_log2=snap.rb_log2, n_shards=S)
+            sh = NamedSharding(mesh, PartitionSpec(ts.AXIS))
+            bstate = ctable.TBuildState(
+                jax.device_put(snap.tag, sh),
+                jax.device_put(snap.hq, sh),
+                jax.device_put(snap.lq, sh))
+            h = snap.header
+            stats.reads, stats.bases = h["reads"], h["bases"]
+            stats.batches, stats.grows = h["batches"], h["grows"]
+            skip_batches = snap.cursor
+            reg.counter("resume_skipped_reads")  # lands even at 0
+            reg.set_meta(resumed=True, resumed_from_batch=skip_batches)
+            reg.event("resume", stage="create_database",
+                      cursor=skip_batches, devices=S)
+            vlog("Resuming sharded stage 1 from checkpoint: ",
+                 skip_batches, " batches (", stats.reads,
+                 " reads) already counted on ", S, " shards")
+    if ck is not None:
+        reg.counter("checkpoint_writes_total")
+        reg.set_meta(checkpoint_every=cfg.checkpoint_every)
+
+    if batches is None:
+        batches = _default_batches(paths, cfg, reg, tracer)
+    timer = StageTimer()
+    steps: dict = {}
+    shard_inserts = np.zeros((S,), np.int64)
+    with trace(cfg.profile):
+        for batch, pk in batches:
+            if skip_batches > 0:
+                skip_batches -= 1
+                reg.counter("resume_skipped_reads").inc(batch.n)
+                continue
+            step_i = stats.batches
+            faults.inject("stage1.insert", batch=step_i)
+            stats.batches += 1
+            stats.reads += batch.n
+            nb = int(batch.lengths.sum())
+            stats.bases += nb
+            timer.add_units("insert_wait", nb)
+            reg.heartbeat(stage="create_database", reads=stats.reads,
+                          bases=stats.bases, batches=stats.batches,
+                          devices=S)
+            reg.counter("shard_batches").inc()
+            reg.counter("shard_reads").inc(batch.n)
+            wire = jnp.asarray(pk.to_wire())
+            b_rows, length = pk.n_reads, pk.length
+            pending = jnp.ones((b_rows * length,), bool)
+            grows = 0
+            # overflow-only retries always make progress; the budget
+            # per grow LEVEL only guards a wedged loop (see
+            # tile_sharded.build_database_tile_sharded)
+            level_budget = 2 * S + 8
+            passes = 0
+            with tracer.span("stage1_batch", step=step_i,
+                             reads=batch.n):
+                while True:
+                    key = (meta.rb_log2, b_rows, length, pk.thresholds)
+                    step = steps.get(key)
+                    if step is None:
+                        step = ts.build_step_wire(
+                            mesh, meta, cfg.qual_thresh, b_rows, length,
+                            pk.thresholds)
+                        steps[key] = step
+                    t0 = time.perf_counter()
+                    with tracer.step("stage1_insert", step_i,
+                                     reads=batch.n):
+                        bstate, full, over, placed, n_ins = step(
+                            bstate, wire, pending)
+                        t1 = time.perf_counter()
+                        full_b, over_b = bool(full), bool(over)
+                        t2 = time.perf_counter()
+                    observe_dispatch_wait(reg, "insert", t0, t1, t2,
+                                          timer=timer)
+                    shard_inserts += np.asarray(n_ins, np.int64)
+                    if not (full_b or over_b):
+                        break
+                    pending = jnp.logical_and(pending,
+                                              jnp.logical_not(placed))
+                    if full_b:
+                        if grows >= cfg.max_grows:
+                            raise RuntimeError("Hash is full")
+                        grows += 1
+                        passes = 0
+                        rows_before = meta.rows
+                        vlog("Sharded hash full at ", rows_before,
+                             " buckets; doubling")
+                        with timer.stage("grow"), tracer.span(
+                                "hash_grow", rows_before=rows_before):
+                            bstate, meta = ts.grow(bstate, meta, mesh)
+                            stats.grows += 1
+                            reg.counter("hash_grows").inc()
+                            reg.counter("shard_grows").inc()
+                            reg.event("hash_grow",
+                                      rows_before=rows_before,
+                                      rows_after=meta.rows)
+                        steps.clear()  # old geometry's executables
+                    else:
+                        passes += 1
+                        reg.counter("shard_overflow_passes").inc()
+                        if passes > level_budget:
+                            raise RuntimeError("Hash is full")
+            if (ck is not None and cfg.checkpoint_every > 0
+                    and stats.batches % cfg.checkpoint_every == 0):
+                # per-shard snapshots under one manifest; the manifest
+                # swap is the commit point (kill-safe at any instant)
+                with timer.stage("checkpoint"), tracer.span(
+                        "checkpoint", batch=stats.batches):
+                    ck.save(bstate, meta, cfg, stats.batches, stats,
+                            paths)
+                reg.counter("checkpoint_writes_total").inc()
+                reg.event("checkpoint", stage="create_database",
+                          cursor=stats.batches)
+    with timer.stage("seal"), tracer.span("seal"):
+        state = ts.finalize(bstate, meta, mesh)
+        per = ts.shard_occupancy(state, meta)
+    timer.report(stats.bases)
+    stats.distinct = sum(per)
+    if reg.enabled:
+        reg.counter("reads").inc(stats.reads)
+        reg.counter("bases").inc(stats.bases)
+        reg.counter("batches").inc(stats.batches)
+        slots = meta.rows * ctable.TSLOTS
+        reg.gauge("hash_buckets").set(meta.rows)
+        reg.gauge("hash_slots").set(slots)
+        reg.gauge("hash_fill").set(round(stats.distinct / slots, 6))
+        ts.record_shard_metrics(reg, state, meta, shard_inserts,
+                                per=per)
+        reg.set_timer("stage1", timer.as_dict(stats.bases))
+    vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
+         stats.distinct, " distinct mers over ", S, " shards")
+    return state, meta, stats
+
+
 def create_database_main(
     paths: Sequence[str],
     output: str,
@@ -304,21 +490,44 @@ def create_database_main(
     state, meta, stats = build_database(paths, cfg, batches=batches,
                                         metrics=metrics, tracer=tracer)
     if handoff is not None:
+        # the sharded build hands over the ROW-SHARDED table +
+        # TileShardedMeta; stage 2 reshards once per its chosen layout
         handoff["db"] = (state, meta)
+    write_state, write_meta = state, meta
+    if getattr(meta, "n_shards", 1) > 1:
+        # the concatenated shard rows ARE the single-chip table
+        # (leading-bit sharding), so the on-disk format is unchanged
+        # and --devices N and --devices 1 write identical databases
+        from ..parallel import tile_sharded as ts
+        try:
+            write_state, write_meta = ts.gather_table(state, meta)
+        except ValueError as e:
+            # rb_log2 grew past the single-chip cap: the table content
+            # is fine but no on-disk format can hold it yet (ROADMAP:
+            # sharded database format). Fail with the real options —
+            # there is no code path that avoids this write today.
+            raise RuntimeError(
+                f"the sharded table grew past the single-file "
+                f"database geometry ({e}); no sharded on-disk format "
+                "exists yet (ROADMAP) — reduce the distinct-mer load "
+                "(smaller input set, larger -m, or a higher -q "
+                "threshold) to fit rb_log2<=24") from None
     if ref_format:
         # the reference's own binary/quorum_db on-disk format
         # (io/quorum_db; mer_database.hpp:115-126)
         from ..io import quorum_db
         from ..ops import ctable
 
-        khi, klo, vals = ctable.tile_iterate(state, meta)
-        quorum_db.write_ref_db(output, khi, klo, vals, meta.k, meta.bits,
-                               cmdline=cmdline)
+        khi, klo, vals = ctable.tile_iterate(write_state, write_meta)
+        quorum_db.write_ref_db(output, khi, klo, vals, write_meta.k,
+                               write_meta.bits, cmdline=cmdline)
     else:
-        db_format.write_db(output, state, meta, cmdline,
+        db_format.write_db(output, write_state, write_meta, cmdline,
                            n_entries=stats.distinct)
     if cfg.checkpoint_dir:
         # the finished database IS the durable artifact now; a stale
         # snapshot must not feed a later unrelated --resume
-        ckpt_mod.Stage1Checkpoint(cfg.checkpoint_dir).clear()
+        cls = (ckpt_mod.Stage1ShardedCheckpoint if cfg.devices > 1
+               else ckpt_mod.Stage1Checkpoint)
+        cls(cfg.checkpoint_dir).clear()
     return stats

@@ -8,6 +8,14 @@ from __future__ import annotations
 
 import dataclasses
 
+# The stage-2 quality cutoff when no -q/-Q is given:
+# numeric_limits<char>::max() (error_correct_reads_cmdline.yaggo), i.e.
+# "no base is quality-protected". THE single definition — the EC CLI's
+# default and the quorum driver's replay-cache packing both import it,
+# so the cached qual>=cutoff plane can never drift from the cutoff the
+# corrector resolves (ADVICE r5).
+DEFAULT_QUAL_CUTOFF = 127
+
 
 @dataclasses.dataclass(frozen=True)
 class ECConfig:
@@ -21,7 +29,7 @@ class ECConfig:
     # error_correct_reads.cc:710-717) — models/error_correct.resolve_cutoff
     # does that; library users must pass a value explicitly.
     cutoff: int = dataclasses.field(default=None)  # type: ignore[assignment]
-    qual_cutoff: int = 127  # ASCII code; numeric_limits<char>::max() default
+    qual_cutoff: int = DEFAULT_QUAL_CUTOFF  # ASCII code
     window: int = 10
     error: int = 3
     homo_trim: int | None = None

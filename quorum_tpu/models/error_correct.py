@@ -101,6 +101,16 @@ def record_outcome(reg, outcome: dict) -> None:
         reg.counter(f"skipped_{slug}").inc(n)
 
 
+def _replay_plane_missing(prepacked, qual_cutoff: int) -> bool:
+    """True when a materialized replay cache (the quorum driver hands
+    a list) was packed WITHOUT this run's qual>=cutoff plane. A
+    streaming iterable can't be peeked without consuming it — those
+    fall through to require_plane's per-batch error."""
+    if isinstance(prepacked, (list, tuple)) and prepacked:
+        return int(qual_cutoff) not in prepacked[0][1].hq
+    return False
+
+
 def pack_for_stage2(batch: fastq.ReadBatch, cfg: ECConfig):
     """Bit-pack one ReadBatch for the corrector's wire format (runs in
     the decode/prefetch thread; the main thread only does H2D)."""
@@ -149,6 +159,11 @@ class ECOptions:
     checkpoint_every: int = 0
     resume: bool = False
     on_bad_read: str = "abort"  # malformed-record policy (io/fastq)
+    # --devices (ISSUE 5): 1 = single-chip; >1 runs data-parallel
+    # correction over a local device mesh — table replicated below
+    # the size threshold, row-sharded with routed lookups above it
+    # (parallel/tile_sharded.ShardedCorrector)
+    devices: int = 1
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -284,6 +299,26 @@ def _run_ec(db_path: str, sequences: Sequence[str],
         vlog("Loading contaminant sequences")
         contam = contaminant_mod.load_contaminant(opts.contaminant, cfg.k)
 
+    # --devices N: data-parallel correction over a local mesh. The
+    # corrector consumes the SAME packed wire and returns the SAME
+    # lean finish buffer as correct_batch_packed, so everything
+    # downstream (fetch/render/write) is untouched and the output is
+    # byte-identical to --devices 1 by construction.
+    sharded = None
+    if opts.devices > 1:
+        from ..parallel import tile_sharded as ts
+        if opts.batch_size % opts.devices:
+            raise RuntimeError(
+                f"--batch-size {opts.batch_size} is not divisible by "
+                f"--devices {opts.devices}; round it up")
+        mesh = ts.make_mesh(opts.devices)
+        sharded = ts.ShardedCorrector(mesh, state, meta, cfg,
+                                      contam=contam)
+        vlog("Correcting over ", opts.devices, " devices, table ",
+             sharded.layout)
+        reg.gauge("n_shards").set(opts.devices)
+        reg.set_meta(devices=opts.devices, table_layout=sharded.layout)
+
     # crash safety (ISSUE 4): with journaling the output streams to
     # .partial files, a journal commits completed batches + exact byte
     # offsets, and a kill -> --resume run truncates the torn tail,
@@ -342,6 +377,21 @@ def _run_ec(db_path: str, sequences: Sequence[str],
     writer = AsyncWriter([out, log], metrics=pipe_metrics)
     timer = StageTimer()
     vlog("Correcting reads")
+    if prepacked is not None and _replay_plane_missing(prepacked,
+                                                       cfg.qual_cutoff):
+        # the driver's replay cache was packed for a DIFFERENT quality
+        # cutoff than this run resolved (config drift between the
+        # driver's constant and the stage's flags). Falling back to
+        # the disk re-read costs a second parse; dying mid-stream on
+        # an uncaught KeyError costs the run (ADVICE r5).
+        if not sequences:
+            raise RuntimeError(
+                f"replay cache lacks the qual>={cfg.qual_cutoff} plane "
+                "and no input paths were given to re-read from disk")
+        vlog("Replay cache lacks the qual>=", cfg.qual_cutoff,
+             " plane; re-reading inputs from disk")
+        reg.event("replay_cache_fallback", qual_cutoff=cfg.qual_cutoff)
+        prepacked = None
     try:
         if records is not None:
             src = fastq.batch_records(records, opts.batch_size)
@@ -467,9 +517,12 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                             # error rates with 2x+ headroom; rarer batches
                             # overflow and re-pack once in fetch_finish.
                             cap = 4 * batch.codes.shape[0]
-                            res, packed = correct_batch_packed(
-                                state, meta, pk, cfg, contam=contam,
-                                pack_cap=cap)
+                            if sharded is not None:
+                                res, packed = sharded(pk, cap)
+                            else:
+                                res, packed = correct_batch_packed(
+                                    state, meta, pk, cfg, contam=contam,
+                                    pack_cap=cap)
                             t1 = time.perf_counter()
                             jax.block_until_ready(packed)
                             t2 = time.perf_counter()

@@ -38,6 +38,23 @@ from ..telemetry.registry import atomic_write
 from ..telemetry.schema import SCHEMA_VERSION
 
 
+def process_index() -> int:
+    """jax.process_index(), importable without repeating the jax
+    import at call sites that must stay cheap (io/checkpoint)."""
+    return jax.process_index()
+
+
+def barrier(name: str = "quorum_barrier") -> None:
+    """Block until every host reaches this point. A no-op on a single
+    process, so single-controller code paths (the local `--devices N`
+    mesh) pay nothing; on a multi-host mesh it is the synchronization
+    the sharded checkpoint protocol needs between the shard writes
+    and the manifest commit."""
+    if jax.process_count() > 1:  # pragma: no cover - needs real hosts
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
 def host_shard_paths(paths: Sequence[str],
                      process_index: int | None = None,
                      process_count: int | None = None) -> list[str]:
@@ -46,9 +63,21 @@ def host_shard_paths(paths: Sequence[str],
     Greedy size-balanced assignment (largest file first onto the
     least-loaded host) so hosts finish their decode at roughly the
     same time; ties and unstatable files fall back to round-robin
-    order. Every path is assigned to exactly one host."""
-    pi = jax.process_index() if process_index is None else process_index
-    pc = jax.process_count() if process_count is None else process_count
+    order. Every path is assigned to exactly one host.
+
+    Each path is stat'ed EXACTLY once (ADVICE r5): on network
+    filesystems the attribute cache can return different sizes on
+    consecutive stats, and a size that changes between the sort and
+    the load update could compute a plan other hosts don't — a shard
+    silently parsed twice or dropped. As defense in depth, on a real
+    multi-host job the locally computed plan is verified against a
+    hash broadcast from process 0; a mismatch (clock-skewed file
+    mutation, heterogeneous mounts) is a hard error, not silent
+    corruption."""
+    pi = (jax.process_index() if process_index is None
+          else process_index)
+    pc = (jax.process_count() if process_count is None
+          else process_count)
     if pc <= 1:
         return list(paths)
 
@@ -58,16 +87,41 @@ def host_shard_paths(paths: Sequence[str],
         except OSError:
             return 0
 
+    sizes = [size_of(p) for p in paths]  # one stat per path, ever
     # stable plan: sort by (size desc, original order)
-    order = sorted(range(len(paths)),
-                   key=lambda i: (-size_of(paths[i]), i))
+    order = sorted(range(len(paths)), key=lambda i: (-sizes[i], i))
     load = [0] * pc
     owner = [0] * len(paths)
-    for rank, i in enumerate(order):
+    for i in order:
         h = min(range(pc), key=lambda j: (load[j], j))
         owner[i] = h
-        load[h] += size_of(paths[i]) or 1
+        load[h] += sizes[i] or 1
+    # plan agreement across hosts (real multi-host only; callers that
+    # pass explicit index/count are computing a hypothetical plan)
+    if (process_index is None and process_count is None
+            and jax.process_count() > 1):  # pragma: no cover - hosts
+        _verify_plan_hash(paths, sizes, owner)
     return [p for i, p in enumerate(paths) if owner[i] == pi]
+
+
+def _verify_plan_hash(paths, sizes, owner) -> None:  # pragma: no cover
+    """Broadcast process 0's plan digest and require every host to
+    have computed the same one."""
+    import hashlib
+
+    from jax.experimental import multihost_utils
+
+    digest = hashlib.sha256(json.dumps(
+        [list(paths), list(sizes), list(owner)]).encode()).digest()
+    mine = np.frombuffer(digest, np.uint8)
+    theirs = np.asarray(
+        multihost_utils.broadcast_one_to_all(mine)).astype(np.uint8)
+    if not np.array_equal(mine, theirs):
+        raise RuntimeError(
+            "host_shard_paths: input plan disagrees with process 0 "
+            "(stat results differ across hosts — attribute-cache lag "
+            "or a file changed mid-launch); refusing to shard input, "
+            "a divergent plan would double-parse or drop shards")
 
 
 def read_batches_multihost(paths: Sequence[str], batch_size: int = 8192,

@@ -53,14 +53,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..io import packing
 from ..models import corrector
 from ..models.create_database import extract_observations_impl
 from ..models.ec_config import ECConfig
-from ..ops import ctable
+from ..ops import ctable, mer
 from ..telemetry import NULL as NULL_METRICS
 from ..telemetry import observe_dispatch_wait
 
 AXIS = "shards"
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across the API move: top-level `jax.shard_map`
+    (new jax, `check_vma` kwarg) vs `jax.experimental.shard_map`
+    (0.4.x, same semantics under the `check_rep` name). Every
+    shard_map in this module goes through here so the sharded path
+    works on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def make_mesh(n_devices: int, devices=None) -> Mesh:
@@ -68,6 +83,59 @@ def make_mesh(n_devices: int, devices=None) -> Mesh:
     if len(devs) < n_devices:
         raise ValueError(f"need {n_devices} devices, have {len(devs)}")
     return Mesh(np.asarray(devs[:n_devices]), (AXIS,))
+
+
+def resolve_devices(spec) -> int:
+    """`--devices` semantics shared by the three CLIs: `auto` (the
+    default) uses every local device on a real accelerator and 1 on
+    CPU (tests and laptops shouldn't silently shard over virtual host
+    devices); `all` forces every local device; an integer asks for
+    exactly that many. 1 is the single-chip path; anything larger
+    must be a power of two (the leading-bit shard layout) and
+    actually present."""
+    avail = len(jax.devices())
+    # auto/all must never pick an unusable count: round DOWN to the
+    # largest power of two the leading-bit layout can shard over
+    pow2 = 1 << (avail.bit_length() - 1)
+    if spec is None or spec in ("", "auto"):
+        return pow2 if jax.default_backend() != "cpu" else 1
+    if spec == "all":
+        n = pow2
+    else:
+        try:
+            n = int(spec)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"--devices must be an integer, 'all' or 'auto', got "
+                f"{spec!r}") from None
+    if n < 1:
+        raise ValueError(f"--devices must be >= 1, got {n}")
+    if n > avail:
+        raise ValueError(
+            f"--devices {n} but only {avail} local device(s) present")
+    if n & (n - 1):
+        raise ValueError(
+            f"--devices must be a power of two (leading-bit shard "
+            f"layout), got {n}")
+    return n
+
+
+def resolve_devices_and_batch(spec, batch_size: int, prog: str,
+                              err=None) -> tuple[int, int]:
+    """The one `--devices` CLI policy, shared by all three entry
+    points: resolve the device spec and round `--batch-size` UP to a
+    whole number of per-device read slices (every ReadBatch row plane
+    is exactly batch_size rows, tail included, so divisibility of the
+    configured size is the only requirement). Prints the round-up
+    note (and errors) as `prog` to `err` (default stderr)."""
+    import sys
+    out = err if err is not None else sys.stderr
+    devices = resolve_devices(spec)
+    if batch_size % devices:
+        batch_size += devices - batch_size % devices
+        print(f"{prog}: rounding --batch-size up to {batch_size} "
+              f"(multiple of --devices {devices})", file=out)
+    return devices, batch_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,11 +236,14 @@ def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
     single-chip write-then-verify rounds on the local slice (GLOBAL
     key parts, localized row index), and route per-lane placed flags
     back. Lanes with hq_add == lq_add == 0 are inactive. Returns
-    (bst, placed, place_fail_local, overflow_local): place_fail means
-    a routed lane genuinely failed to place (table pressure — grow);
-    overflow means a valid lane missed the send-bucket cap (a
-    bucket_slack/skew artifact — the un-placed lanes just need another
-    exchange pass, NOT a grow)."""
+    (bst, placed, place_fail_local, overflow_local, n_recv_placed):
+    place_fail means a routed lane genuinely failed to place (table
+    pressure — grow); overflow means a valid lane missed the
+    send-bucket cap (a bucket_slack/skew artifact — the un-placed
+    lanes just need another exchange pass, NOT a grow); n_recv_placed
+    is how many routed observations THIS shard accepted into its
+    slice (the per-shard insert counter the telemetry layer
+    reports)."""
     S = meta.n_shards
     local = meta.local_meta
     n = chi.shape[0]
@@ -230,7 +301,8 @@ def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
                                        S * cap - 1)]
     place_fail = jnp.any(~done)
     overflow = jnp.any(valid & ~fitted)
-    return bst, placed, place_fail, overflow
+    n_recv_placed = jnp.sum(r_valid & done, dtype=jnp.int32)
+    return bst, placed, place_fail, overflow, n_recv_placed
 
 
 def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
@@ -238,13 +310,15 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
     """Compile the sharded tile build step.
 
     Returns f(bstate, codes_i8[B,L], quals_u8[B,L], pending[B*L]) ->
-    (bstate, full, overflow, placed[B*L]) with reads sharded over the
-    mesh axis and the table sharded by leading row bits. `full` is the
-    global any-shard-PLACEMENT-failed flag (grow); `overflow` means
-    some valid lane missed its send-bucket cap (skew artifact — rerun
-    the step with `pending & ~placed`, no grow). The exact-once
-    grow-retry contract is `pending & ~placed` either way (same as the
-    single-chip tile_insert_observations)."""
+    (bstate, full, overflow, placed[B*L], shard_inserts[S]) with reads
+    sharded over the mesh axis and the table sharded by leading row
+    bits. `full` is the global any-shard-PLACEMENT-failed flag (grow);
+    `overflow` means some valid lane missed its send-bucket cap (skew
+    artifact — rerun the step with `pending & ~placed`, no grow);
+    `shard_inserts` counts the observations each shard accepted this
+    step (telemetry). The exact-once grow-retry contract is
+    `pending & ~placed` either way (same as the single-chip
+    tile_insert_observations)."""
     S = meta.n_shards
 
     def fn(tag, hq, lq, codes_i8, quals_u8, pending):
@@ -256,25 +330,81 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
         cap = n if S == 1 else max(64, int(n // S * bucket_slack))
         hq_add = jnp.where(valid & (q == 1), 1, 0).astype(jnp.uint32)
         lq_add = jnp.where(valid & (q == 0), 1, 0).astype(jnp.uint32)
-        bst, placed, place_fail, overflow = _routed_insert_local(
+        bst, placed, place_fail, overflow, n_recv = _routed_insert_local(
             bst, meta, chi, clo, hq_add, lq_add, cap)
         full = lax.pmax(place_fail.astype(jnp.int32), AXIS) > 0
         over = lax.pmax(overflow.astype(jnp.int32), AXIS) > 0
-        return bst.tag, bst.hq, bst.lq, full, over, placed & valid
+        return (bst.tag, bst.hq, bst.lq, full, over, placed & valid,
+                n_recv[None])
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS, None),
                   P(AXIS)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P(AXIS), P(AXIS)),
         check_vma=False,
     )
 
     @jax.jit
     def step(bstate: ctable.TBuildState, codes_i8, quals_u8, pending):
-        tag, hq, lq, full, over, placed = mapped(
+        tag, hq, lq, full, over, placed, n_ins = mapped(
             bstate.tag, bstate.hq, bstate.lq, codes_i8, quals_u8, pending)
-        return ctable.TBuildState(tag, hq, lq), full, over, placed
+        return ctable.TBuildState(tag, hq, lq), full, over, placed, n_ins
+
+    return step
+
+
+def build_step_wire(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
+                    b: int, length: int, thresholds: tuple,
+                    bucket_slack: float = 2.0):
+    """`build_step` fed the fused packed wire (io/packing
+    .PackedReads.to_wire — 0.5 B/base over the H2D link, the SAME
+    producer the single-chip stage 1 consumes): the flat u8 buffer is
+    sliced back into planes on device, each shard widens ITS row range
+    to int32 codes + the synthetic qual plane, and the insert body is
+    identical. Returns f(bstate, wire_u8, pending[b*length]) ->
+    (bstate, full, overflow, placed, shard_inserts[S])."""
+    S = meta.n_shards
+    if b % S:
+        raise ValueError(
+            f"batch rows {b} not divisible by {S} shards — round "
+            "--batch-size up to a multiple of --devices")
+
+    def fn(tag, hq, lq, pcodes, nmask, hqp, lengths, pending):
+        bst = ctable.TBuildState(tag, hq, lq)
+        codes = packing.unpack_codes_device(pcodes, nmask, lengths,
+                                            length)
+        quals = packing.synth_quals_device(hqp, length, qual_thresh)
+        chi, clo, q, valid = extract_observations_impl(
+            codes, quals, meta.k, qual_thresh)
+        valid = valid & pending
+        n = chi.shape[0]
+        cap = n if S == 1 else max(64, int(n // S * bucket_slack))
+        hq_add = jnp.where(valid & (q == 1), 1, 0).astype(jnp.uint32)
+        lq_add = jnp.where(valid & (q == 0), 1, 0).astype(jnp.uint32)
+        bst, placed, place_fail, overflow, n_recv = _routed_insert_local(
+            bst, meta, chi, clo, hq_add, lq_add, cap)
+        full = lax.pmax(place_fail.astype(jnp.int32), AXIS) > 0
+        over = lax.pmax(overflow.astype(jnp.int32), AXIS) > 0
+        return (bst.tag, bst.hq, bst.lq, full, over, placed & valid,
+                n_recv[None])
+
+    mapped = _shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(bstate: ctable.TBuildState, wire, pending):
+        pcodes, nmask, hqd, lengths = mer.wire_parts_device(
+            wire, b, length, thresholds)
+        tag, hq, lq, full, over, placed, n_ins = mapped(
+            bstate.tag, bstate.hq, bstate.lq, pcodes, nmask,
+            hqd[int(qual_thresh)], lengths, pending)
+        return ctable.TBuildState(tag, hq, lq), full, over, placed, n_ins
 
     return step
 
@@ -346,13 +476,13 @@ def _try_place_all(khi, klo, hqc, lqc, nmeta: TileShardedMeta, mesh: Mesh,
         cap = e_hi.shape[0]  # worst case: every entry owned by one shard
         # cap == lane count makes send-bucket overflow impossible, so
         # any failure here is genuine table pressure
-        bst, placed, place_fail, overflow = _routed_insert_local(
+        bst, placed, place_fail, overflow, _n_recv = _routed_insert_local(
             bst, nmeta, e_hi, e_lo, e_hq, e_lq, cap)
         full = lax.pmax((place_fail | overflow).astype(jnp.int32),
                         AXIS) > 0
         return bst.tag, bst.hq, bst.lq, full, placed
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn, mesh=mesh,
         in_specs=(P(AXIS),) * 3 + (P(AXIS),) * 4,
         out_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(AXIS)),
@@ -389,9 +519,9 @@ def finalize(bstate: ctable.TBuildState, meta: TileShardedMeta,
         return ctable.tile_finalize(ctable.TBuildState(tag, hq, lq),
                                     local).rows
 
-    mapped = jax.shard_map(fn, mesh=mesh,
-                           in_specs=(P(AXIS), P(AXIS), P(AXIS)),
-                           out_specs=P(AXIS), check_vma=False)
+    mapped = _shard_map(fn, mesh=mesh,
+                        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+                        out_specs=P(AXIS), check_vma=False)
     return ctable.TileState(jax.jit(mapped)(bstate.tag, bstate.hq,
                                             bstate.lq))
 
@@ -443,6 +573,7 @@ def build_database_tile_sharded(batches, mesh: Mesh,
     bstate = make_build_state(meta, mesh)
     step = build_step(mesh, meta, qual_thresh)
     step_i = 0
+    shard_inserts = np.zeros((meta.n_shards,), np.int64)
     for codes, quals in batches:
         reg.counter("shard_batches").inc()
         reg.counter("shard_reads").inc(codes.shape[0])
@@ -464,12 +595,13 @@ def build_database_tile_sharded(batches, mesh: Mesh,
             # an output of the same executable as the table planes)
             t0 = time.perf_counter()
             with tracer.step("shard_build_step", step_i):
-                bstate, full, over, placed = step(bstate, codes, quals,
-                                                  pending)
+                bstate, full, over, placed, n_ins = step(
+                    bstate, codes, quals, pending)
                 t1 = time.perf_counter()
                 full_b, over_b = bool(full), bool(over)
                 t2 = time.perf_counter()
             step_i += 1
+            shard_inserts += np.asarray(n_ins, np.int64)
             observe_dispatch_wait(reg, "shard_step", t0, t1, t2)
             if not (full_b or over_b):
                 break
@@ -496,13 +628,29 @@ def build_database_tile_sharded(batches, mesh: Mesh,
                     raise RuntimeError("Hash is full")
     state = finalize(bstate, meta, mesh)
     if reg.enabled:
-        per = shard_occupancy(state, meta)
-        reg.gauge("n_shards").set(meta.n_shards)
-        reg.gauge("shard_distinct_min").set(min(per))
-        reg.gauge("shard_distinct_max").set(max(per))
-        reg.counter("distinct_mers").inc(sum(per))
-        reg.set_meta(shard_distinct_mers=per)
+        record_shard_metrics(reg, state, meta, shard_inserts)
     return state, meta
+
+
+def record_shard_metrics(reg, state: ctable.TileState,
+                         meta: TileShardedMeta, shard_inserts,
+                         per: list[int] | None = None) -> None:
+    """The per-shard telemetry surface of a finished sharded build:
+    occupancy spread gauges, the per-shard distinct-mer and insert
+    lists under meta, and the totals — ONE place so the dryrun driver
+    and the production build report identical names
+    (tools/metrics_check.py requires them when n_shards > 1)."""
+    if per is None:
+        per = shard_occupancy(state, meta)
+    ins = [int(x) for x in shard_inserts]
+    reg.gauge("n_shards").set(meta.n_shards)
+    reg.gauge("shard_distinct_min").set(min(per))
+    reg.gauge("shard_distinct_max").set(max(per))
+    reg.counter("distinct_mers").inc(sum(per))
+    reg.counter("shard_inserts_total").inc(sum(ins))
+    reg.gauge("shard_inserts_min").set(min(ins))
+    reg.gauge("shard_inserts_max").set(max(ins))
+    reg.set_meta(shard_distinct_mers=per, shard_inserts=ins)
 
 
 # ---------------------------------------------------------------------------
@@ -561,9 +709,9 @@ def query_step(mesh: Mesh, meta: TileShardedMeta):
     def fn(rows_local, khi, klo):
         return routed_lookup_local(rows_local, meta, khi, klo)
 
-    mapped = jax.shard_map(fn, mesh=mesh,
-                           in_specs=(P(AXIS), P(AXIS), P(AXIS)),
-                           out_specs=P(AXIS), check_vma=False)
+    mapped = _shard_map(fn, mesh=mesh,
+                        in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+                        out_specs=P(AXIS), check_vma=False)
 
     @jax.jit
     def step(state: ctable.TileState, khi, klo):
@@ -587,10 +735,18 @@ def gather_table(state: ctable.TileState, meta: TileShardedMeta
                  ) -> tuple[ctable.TileState, ctable.TileMeta]:
     """Row-sharded -> single-chip table (geometry permitting): the
     concatenated rows ARE the single-chip table (leading-bit
-    sharding), so this is a pure reshard."""
+    sharding), so this is a pure gather onto ONE device. The gather
+    must be real, not a lazy view: a still-sharded result leaks the
+    mesh into every downstream jit (the single-chip executables get
+    GSPMD-partitioned — measured: write_db's v4 export compile went
+    from <1 s to ~13 min on a 2-device CPU mesh)."""
     if meta.rb_log2 > 24:
         raise ValueError("table exceeds the single-chip geometry")
-    return (ctable.TileState(jnp.asarray(state.rows)),
+    rows = state.rows
+    sharding = getattr(rows, "sharding", None)
+    if sharding is not None and len(sharding.device_set) > 1:
+        rows = jax.device_put(rows, next(iter(sharding.device_set)))
+    return (ctable.TileState(jnp.asarray(rows)),
             ctable.TileMeta(k=meta.k, bits=meta.bits,
                             rb_log2=meta.rb_log2))
 
@@ -604,7 +760,7 @@ def correct_step(mesh, tmeta: ctable.TileMeta, cfg: ECConfig):
         return corrector.correct_batch(st, tmeta, codes, quals, lengths,
                                        cfg)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(AXIS, None), P(AXIS)),
         out_specs=P(AXIS), check_vma=False)
@@ -687,7 +843,7 @@ def correct_step_routed(mesh, meta: TileShardedMeta, cfg: ECConfig):
         return corrector.correct_batch(st, rmeta, codes, quals, lengths,
                                        cfg)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(AXIS), P(AXIS, None), P(AXIS, None), P(AXIS)),
         out_specs=P(AXIS), check_vma=False)
@@ -698,3 +854,138 @@ def correct_step_routed(mesh, meta: TileShardedMeta, cfg: ECConfig):
                       jnp.asarray(lengths, jnp.int32))
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Production stage 2: wire in, lean finish buffer out
+# ---------------------------------------------------------------------------
+
+def correct_step_wire(mesh, cfg: ECConfig, b: int, length: int,
+                      thresholds: tuple, pack_cap: int,
+                      tmeta: ctable.TileMeta | None = None,
+                      routed_meta: TileShardedMeta | None = None,
+                      contam=None):
+    """The multi-device twin of corrector.correct_batch_packed: the
+    SAME fused u8 wire crosses H2D once, each shard widens its row
+    range and runs the full corrector on its read slice (table
+    replicated under `tmeta`, or row-sharded with routed lookups
+    under `routed_meta`), and the lean finish buffer is packed over
+    the GLOBAL result — so the D2H buffer, and therefore the host
+    finish/render path and the output bytes, are identical to the
+    single-chip loop by construction.
+
+    Returns f(rows, contam_rows, wire_u8) -> (BatchResult, packed_u32).
+    """
+    if (tmeta is None) == (routed_meta is None):
+        raise ValueError("pass exactly one of tmeta / routed_meta")
+    S = mesh.devices.size
+    if b % S:
+        raise ValueError(
+            f"batch rows {b} not divisible by {S} shards — round "
+            "--batch-size up to a multiple of --devices")
+    lookup_meta = routed_meta if routed_meta is not None else tmeta
+    table_spec = P(AXIS) if routed_meta is not None else P()
+    has_contam = contam is not None
+    cmeta = contam[1] if has_contam else corrector._dummy_contam(cfg.k)[1]
+    # per-shard default, same policy as correct_batch's global formula
+    # (the cap only bounds the ambiguous-lane compaction scratch;
+    # overflow falls back to the in-loop probe with identical results)
+    ambig_cap = max(256, (2 * (b // S)) // 8)
+
+    def local_fn(rows, crows, pcodes, nmask, hqp, lengths):
+        st = ctable.TileState(rows)
+        codes = packing.unpack_codes_device(pcodes, nmask, lengths,
+                                            length)
+        quals = packing.synth_quals_device(hqp, length, cfg.qual_cutoff)
+        return corrector._correct_core(
+            st, lookup_meta, codes, quals, lengths, cfg,
+            ctable.TileState(crows), cmeta, has_contam, None, ambig_cap,
+            True, None)
+
+    mapped = _shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(table_spec, P(), P(AXIS, None), P(AXIS, None),
+                  P(AXIS, None), P(AXIS)),
+        out_specs=P(AXIS), check_vma=False)
+
+    @jax.jit
+    def step(rows, crows, wire):
+        pcodes, nmask, hqd, lengths = mer.wire_parts_device(
+            wire, b, length, thresholds)
+        res = mapped(rows, crows, pcodes, nmask,
+                     hqd[int(cfg.qual_cutoff)], lengths)
+        return res, corrector._pack_finish_lean(res, pack_cap)
+
+    return step
+
+
+def replicate_cap_bytes() -> int:
+    """Stage-2 layout threshold: tables at or under this many bytes
+    are replicated per device (every probe a local gather); bigger
+    tables stay row-sharded with routed lookups. Tunable via
+    QUORUM_REPLICATE_TABLE_BYTES (k/M/G/T suffixes)."""
+    import os
+
+    from ..utils.sizes import parse_size
+    raw = os.environ.get("QUORUM_REPLICATE_TABLE_BYTES")
+    if raw:
+        try:
+            return parse_size(raw)
+        except (TypeError, ValueError):
+            pass
+    return 4 * 1024 ** 3
+
+
+class ShardedCorrector:
+    """Stage 2 over a local device mesh: picks the table layout
+    (replicated below `replicate_cap_bytes()`, routed above it or
+    whenever the geometry exceeds the single-chip cap), reshards the
+    loaded table once, and serves `(pk, pack_cap) -> (BatchResult,
+    lean buffer)` calls with one compiled step per batch shape — a
+    drop-in for corrector.correct_batch_packed in the offline loop.
+
+    Accepts the table as either a single-chip (TileState, TileMeta)
+    or a row-sharded (TileState, TileShardedMeta): the global row
+    plane is IDENTICAL between the two (leading-bit sharding), so
+    either way the reshard is a pure device_put."""
+
+    def __init__(self, mesh, state: ctable.TileState, meta, cfg: ECConfig,
+                 contam=None, replicate_max_bytes: int | None = None):
+        self.mesh = mesh
+        self.cfg = cfg
+        self._contam = contam
+        self._crows = (contam[0].rows if contam is not None
+                       else corrector._dummy_contam(cfg.k)[0].rows)
+        self.n_shards = mesh.devices.size
+        k, bits, rb = meta.k, meta.bits, meta.rb_log2
+        cap = (replicate_cap_bytes() if replicate_max_bytes is None
+               else replicate_max_bytes)
+        table_bytes = (1 << rb) * ctable.TILE * 4
+        self.routed = rb > 24 or table_bytes > cap
+        self.tmeta = None
+        self.routed_meta = None
+        if self.routed:
+            self.routed_meta = RoutedTileMeta(k=k, bits=bits, rb_log2=rb,
+                                              n_shards=self.n_shards)
+            spec = P(AXIS)
+        else:
+            self.tmeta = ctable.TileMeta(k=k, bits=bits, rb_log2=rb)
+            spec = P()
+        self.rows = jax.device_put(state.rows, NamedSharding(mesh, spec))
+        self._steps: dict = {}
+
+    @property
+    def layout(self) -> str:
+        return "routed" if self.routed else "replicated"
+
+    def __call__(self, pk, pack_cap: int):
+        pk.require_plane(self.cfg.qual_cutoff)
+        key = (pk.n_reads, pk.length, pk.thresholds, pack_cap)
+        step = self._steps.get(key)
+        if step is None:
+            step = correct_step_wire(
+                self.mesh, self.cfg, pk.n_reads, pk.length,
+                pk.thresholds, pack_cap, tmeta=self.tmeta,
+                routed_meta=self.routed_meta, contam=self._contam)
+            self._steps[key] = step
+        return step(self.rows, self._crows, jnp.asarray(pk.to_wire()))

@@ -1,0 +1,297 @@
+"""ISSUE 5: the tile-sharded multi-device path as a PRODUCT surface —
+`--devices N` through the real CLIs, byte-identical output vs the
+single-chip path, per-shard checkpoint/resume semantics, and the
+satellite fixes (PackedReads.nbytes, replay-plane fallback,
+host_shard_paths hardening, resolve_devices)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.io import packing
+from quorum_tpu.models.create_database import BuildConfig, BuildStats
+from quorum_tpu.parallel import tile_sharded as ts
+
+K = 13
+RLEN = 48
+BATCH = 32
+N_READS = 64
+
+
+@pytest.fixture(scope="module")
+def reads_fastq(tmp_path_factory):
+    rng = np.random.default_rng(9)
+    genome = rng.integers(0, 4, size=1200, dtype=np.int8)
+    starts = rng.integers(0, 1200 - RLEN, size=N_READS)
+    codes = genome[starts[:, None] + np.arange(RLEN)[None, :]]
+    codes = codes.astype(np.int8)
+    err = rng.random(codes.shape) < 0.03
+    codes = np.where(err, (codes + rng.integers(1, 4, size=codes.shape))
+                     % 4, codes).astype(np.int8)
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[err] = 34
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    path = tmp_path_factory.mktemp("mdev") / "reads.fastq"
+    with open(path, "wb") as f:
+        for i in range(N_READS):
+            f.write(b"@r%d\n" % i + bases[codes[i]].tobytes()
+                    + b"\n+\n" + quals[i].tobytes() + b"\n")
+    return str(path)
+
+
+def _build(reads, out, devices, extra=()):
+    from quorum_tpu.cli import create_database as cdb_cli
+    rc = cdb_cli.main(["-s", "32k", "-m", str(K), "-b", "7", "-q", "53",
+                       "-o", out, "--batch-size", str(BATCH),
+                       "--devices", str(devices), *extra, reads])
+    assert rc == 0
+    return out
+
+
+def _correct(reads, db, prefix, devices, extra=()):
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    rc = ec_cli.main(["-o", prefix, "--batch-size", str(BATCH),
+                      "-p", "2", "--devices", str(devices), *extra,
+                      db, reads])
+    assert rc == 0
+    return prefix
+
+
+def _payload(path):
+    """Database bytes past the header line (the header timestamps)."""
+    return open(path, "rb").read().split(b"\n", 1)[1]
+
+
+def test_cli_parity_multidevice(reads_fastq, tmp_path):
+    """The acceptance property: --devices 2 end-to-end (build then
+    correct, through the real CLI mains) produces a byte-identical
+    database payload and byte-identical corrected FASTQ/log output
+    vs --devices 1."""
+    db1 = _build(reads_fastq, str(tmp_path / "db1.jf"), 1)
+    db2 = _build(reads_fastq, str(tmp_path / "db2.jf"), 2)
+    assert _payload(db1) == _payload(db2)
+    p1 = _correct(reads_fastq, db1, str(tmp_path / "out1"), 1)
+    p2 = _correct(reads_fastq, db2, str(tmp_path / "out2"), 2)
+    for suffix in (".fa", ".log"):
+        a = open(p1 + suffix, "rb").read()
+        b = open(p2 + suffix, "rb").read()
+        assert a == b, f"--devices 2 {suffix} differs from --devices 1"
+    assert open(p1 + ".fa", "rb").read()  # non-trivial output
+
+
+def test_routed_layout_parity(reads_fastq, tmp_path, monkeypatch):
+    """Forcing the replicate threshold to 1 byte keeps the table
+    row-sharded with routed lookups — output must still match."""
+    monkeypatch.setenv("QUORUM_REPLICATE_TABLE_BYTES", "1")
+    db = _build(reads_fastq, str(tmp_path / "db.jf"), 2)
+    pr = _correct(reads_fastq, db, str(tmp_path / "outR"), 2)
+    monkeypatch.delenv("QUORUM_REPLICATE_TABLE_BYTES")
+    p1 = _correct(reads_fastq, db, str(tmp_path / "out1"), 1)
+    assert open(pr + ".fa", "rb").read() == open(p1 + ".fa",
+                                                 "rb").read()
+    assert open(pr + ".log", "rb").read() == open(p1 + ".log",
+                                                  "rb").read()
+
+
+def test_sharded_build_kill_resume(reads_fastq, tmp_path):
+    """A killed sharded stage-1 build resumed with --resume converges
+    on the byte-identical database, and the checkpoint clears once
+    the database lands."""
+    ref = _build(reads_fastq, str(tmp_path / "ref.jf"), 2)
+    ckdir = str(tmp_path / "ck")
+    plan = json.dumps([{"site": "stage1.insert", "batch": 1,
+                        "action": "error", "message": "injected"}])
+    from quorum_tpu.cli import create_database as cdb_cli
+    rc = cdb_cli.main(["-s", "32k", "-m", str(K), "-b", "7", "-q", "53",
+                       "-o", str(tmp_path / "k.jf"),
+                       "--batch-size", str(BATCH), "--devices", "2",
+                       "--checkpoint-dir", ckdir,
+                       "--checkpoint-every", "1",
+                       "--fault-plan", plan, reads_fastq])
+    assert rc != 0
+    ck = ckpt_mod.Stage1ShardedCheckpoint(ckdir)
+    assert ck.cursor() == 1  # one batch committed before the fault
+    _build(reads_fastq, str(tmp_path / "k.jf"), 2,
+           extra=("--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+                  "--resume", "--fault-plan", ""))
+    assert _payload(str(tmp_path / "k.jf")) == _payload(ref)
+    assert ck.cursor() is None  # cleared with the durable database
+
+
+def test_sharded_checkpoint_consistency(tmp_path):
+    """Per-shard snapshots under one manifest: load round-trips the
+    planes; a truncated shard, a missing shard, or a config mismatch
+    refuses loudly (CheckpointError), never a silent partial
+    restore."""
+    mesh = ts.make_mesh(2, conftest.cpu_devices(2))
+    meta = ts.TileShardedMeta(k=K, bits=7, rb_log2=6, n_shards=2)
+    bstate = ts.make_build_state(meta, mesh)
+    cfg = BuildConfig(k=K, bits=7, qual_thresh=53, batch_size=BATCH,
+                      devices=2)
+    stats = BuildStats(reads=10, bases=480, batches=3)
+    ck = ckpt_mod.Stage1ShardedCheckpoint(str(tmp_path))
+    ck.save(bstate, meta, cfg, 3, stats, ["a.fastq"])
+    snap = ck.load()
+    assert snap.cursor == 3 and snap.n_shards == 2
+    assert snap.tag.shape == (meta.rows, np.asarray(bstate.tag).shape[1])
+    np.testing.assert_array_equal(snap.tag, np.asarray(bstate.tag))
+    snap.check_config(K, 7, 53, BATCH, ["a.fastq"], 2)
+    with pytest.raises(ckpt_mod.CheckpointError, match="n_shards"):
+        snap.check_config(K, 7, 53, BATCH, ["a.fastq"], 4)
+    with pytest.raises(ckpt_mod.CheckpointError, match="inputs"):
+        snap.check_config(K, 7, 53, BATCH, ["b.fastq"], 2)
+    # a second save bumps the generation; the old payloads are gone
+    ck.save(bstate, meta, cfg, 4, stats, ["a.fastq"])
+    assert ck.load().cursor == 4
+    shard_files = sorted(p for p in os.listdir(str(tmp_path))
+                         if p.startswith("stage1.shard")
+                         and p.endswith(".ckpt"))
+    assert len(shard_files) == 2  # exactly one generation retained
+    # truncate one shard payload -> loud refusal
+    victim = os.path.join(str(tmp_path), shard_files[0])
+    data = open(victim, "rb").read()
+    open(victim, "wb").write(data[:-4])
+    with pytest.raises(ckpt_mod.CheckpointError, match="corrupt"):
+        ck.load()
+    # remove it entirely -> loud refusal
+    os.remove(victim)
+    with pytest.raises(ckpt_mod.CheckpointError, match="missing"):
+        ck.load()
+    # a .tmp orphan from a save killed pre-rename is reaped by clear
+    with open(os.path.join(str(tmp_path),
+                           "stage1.shard0000.g9.ckpt.tmp"), "wb") as f:
+        f.write(b"x")
+    ck.clear()
+    assert ck.load() is None
+    assert [p for p in os.listdir(str(tmp_path))
+            if p.startswith("stage1.")] == []
+
+
+def test_packed_nbytes_no_double_count():
+    """ADVICE r5: once the wire is warmed, nbytes is the wire's size —
+    not wire + the standalone planes it already contains."""
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, 4, size=(8, 16)).astype(np.int8)
+    quals = np.full((8, 16), 70, np.uint8)
+    lengths = np.full((8,), 16, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths, thresholds=(53,))
+    before = pk.nbytes
+    plane_bytes = (pk.pcodes.nbytes + pk.nmask.nbytes
+                   + pk.hq[53].nbytes + pk.lengths.nbytes)
+    assert before == plane_bytes
+    wire = pk.to_wire()
+    assert pk.nbytes == wire.nbytes  # warmed: counted exactly once
+    assert pk.compact().nbytes == wire.nbytes
+
+
+def test_host_shard_paths_stats_once(tmp_path, monkeypatch):
+    """ADVICE r5: every path is stat'ed exactly once per plan (an
+    attribute cache returning different sizes between the sort and
+    the load update could silently desynchronize the plan)."""
+    from quorum_tpu.parallel import multihost
+    paths = []
+    for i, size in enumerate((300, 100, 200, 50)):
+        p = tmp_path / f"f{i}.fastq"
+        p.write_bytes(b"x" * size)
+        paths.append(str(p))
+    calls = {}
+    real = os.path.getsize
+
+    def counting(p):
+        calls[p] = calls.get(p, 0) + 1
+        return real(p)
+
+    monkeypatch.setattr(os.path, "getsize", counting)
+    mine = [multihost.host_shard_paths(paths, process_index=i,
+                                       process_count=2)
+            for i in range(2)]
+    # each of the two plan computations stats each path exactly once
+    assert all(n == 2 for n in calls.values()), calls
+    assert sorted(mine[0] + mine[1]) == sorted(paths)
+    assert mine[0] and mine[1]  # both hosts got work
+
+
+def test_resolve_devices_validation(monkeypatch):
+    import jax
+    avail = len(jax.devices())
+    assert ts.resolve_devices("1") == 1
+    assert ts.resolve_devices(2) == 2
+    assert ts.resolve_devices("all") == avail
+    with pytest.raises(ValueError, match="power of two"):
+        ts.resolve_devices(3)
+    with pytest.raises(ValueError, match="local device"):
+        ts.resolve_devices(str(2 * avail))
+    with pytest.raises(ValueError, match=">= 1"):
+        ts.resolve_devices(0)
+    with pytest.raises(ValueError, match="integer"):
+        ts.resolve_devices("banana")
+    # auto on the CPU backend is the single-chip path
+    assert ts.resolve_devices("auto") == 1
+
+
+def test_replay_plane_fallback(reads_fastq, tmp_path):
+    """A replay cache packed for a different qual cutoff falls back to
+    the disk re-read (same output), instead of a KeyError mid-run."""
+    from quorum_tpu.models.error_correct import (ECOptions,
+                                                 _replay_plane_missing,
+                                                 run_error_correct)
+    db = _build(reads_fastq, str(tmp_path / "db.jf"), 1)
+    # a cache whose only plane is qual>=53 cannot serve cutoff 127
+    rng = np.random.default_rng(1)
+    codes = rng.integers(0, 4, size=(BATCH, RLEN)).astype(np.int8)
+    quals = np.full((BATCH, RLEN), 70, np.uint8)
+    lengths = np.full((BATCH,), RLEN, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths, thresholds=(53,))
+    assert _replay_plane_missing([(None, pk)], 127)
+    assert not _replay_plane_missing([(None, pk)], 53)
+    assert not _replay_plane_missing([], 127)
+    opts = ECOptions(output=str(tmp_path / "fb"), batch_size=BATCH,
+                     cutoff=2)
+    stats = run_error_correct(db, [reads_fastq], None, opts,
+                              prepacked=[(None, pk)])
+    assert stats.reads == N_READS  # re-read ALL reads from disk
+    ref = _correct(reads_fastq, db, str(tmp_path / "ref"), 1)
+    assert (open(str(tmp_path / "fb") + ".fa", "rb").read()
+            == open(ref + ".fa", "rb").read())
+    # no inputs to fall back to -> a clear error, not a KeyError
+    with pytest.raises(RuntimeError, match="replay cache"):
+        run_error_correct(db, [], None, opts, prepacked=[(None, pk)])
+
+
+def test_metrics_check_sharded_requirements():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "metrics_check", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "metrics_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+    good = {
+        "meta": {"stage": "create_database",
+                 "shard_distinct_mers": [3, 4],
+                 "shard_inserts": [10, 12]},
+        "counters": {"shard_batches": 1, "shard_reads": 2,
+                     "shard_inserts_total": 22, "distinct_mers": 7},
+        "gauges": {"n_shards": 2, "shard_distinct_min": 3,
+                   "shard_distinct_max": 4, "shard_inserts_min": 10,
+                   "shard_inserts_max": 12},
+    }
+    assert mc._check_shard_names(good) == []
+    # single-chip documents are exempt
+    assert mc._check_shard_names(
+        {"meta": {"stage": "create_database"}, "gauges": {}}) == []
+    bad = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in good.items()}
+    bad["counters"] = {}
+    bad["meta"] = dict(good["meta"], shard_inserts=[10])
+    errs = mc._check_shard_names(bad)
+    assert any("shard_inserts_total" in e for e in errs)
+    assert any("meta.shard_inserts" in e for e in errs)
+    assert mc._check_hosts_doc(
+        {"meta": {"aggregated_hosts": 1}, "hosts": {"0": {}}}) == []
+    errs = mc._check_hosts_doc(
+        {"meta": {"aggregated_hosts": 2}, "hosts": {"0": {}}})
+    assert errs and "aggregated_hosts" in errs[0]

@@ -175,8 +175,6 @@ def test_build_metrics_counters():
     and the final per-shard occupancy matching the table content."""
     from quorum_tpu.telemetry import MetricsRegistry, validate_metrics
 
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("jax.shard_map unavailable in this environment")
     n_shards = 2
     rng = np.random.default_rng(3)
     codes, quals = _reads(rng, 32, genome_size=1500)

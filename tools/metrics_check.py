@@ -79,6 +79,64 @@ SERVE_REQUIRED_HISTOGRAMS = (
 FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
                   "bad_reads_total", "stage_retries_total")
 
+# The sharded (--devices N) metric surface (ISSUE 5): a stage-1
+# document built over more than one shard must carry the per-shard
+# insert/occupancy telemetry parallel/tile_sharded.record_shard_metrics
+# writes — the scale-out observability is the point of the feature.
+SHARD_REQUIRED_COUNTERS = ("shard_batches", "shard_reads",
+                           "shard_inserts_total", "distinct_mers")
+SHARD_REQUIRED_GAUGES = ("n_shards", "shard_distinct_min",
+                         "shard_distinct_max", "shard_inserts_min",
+                         "shard_inserts_max")
+SHARD_REQUIRED_META_LISTS = ("shard_distinct_mers", "shard_inserts")
+
+
+def _check_shard_names(doc: dict) -> list[str]:
+    """Sharded-build requirements: dispatch on gauges.n_shards > 1 in
+    a stage-1 document; also verify the per-shard meta lists have
+    exactly n_shards entries (a truncated list means a shard's
+    telemetry was dropped)."""
+    errs = []
+    gauges = doc.get("gauges", {})
+    try:
+        n_shards = int(gauges.get("n_shards", 1))
+    except (TypeError, ValueError):
+        return ["gauges.n_shards is not an integer"]
+    if doc.get("meta", {}).get("stage") != "create_database" \
+            or n_shards <= 1:
+        return []
+    for name in SHARD_REQUIRED_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"sharded build document missing counter "
+                        f"{name!r}")
+    for name in SHARD_REQUIRED_GAUGES:
+        if name not in gauges:
+            errs.append(f"sharded build document missing gauge "
+                        f"{name!r}")
+    for name in SHARD_REQUIRED_META_LISTS:
+        val = doc.get("meta", {}).get(name)
+        if not isinstance(val, list) or len(val) != n_shards:
+            errs.append(
+                f"sharded build document meta.{name} must be a list "
+                f"of {n_shards} per-shard values, got {val!r}")
+    return errs
+
+
+def _check_hosts_doc(doc: dict) -> list[str]:
+    """Aggregated-document requirements (parallel/multihost.
+    aggregate_metrics, written by the quorum driver every run): the
+    shard count recorded in meta must match the shards present."""
+    if "hosts" not in doc:
+        return []
+    errs = []
+    hosts = doc["hosts"]
+    n = doc.get("meta", {}).get("aggregated_hosts")
+    if isinstance(hosts, dict) and n != len(hosts):
+        errs.append(
+            f"aggregated document meta.aggregated_hosts={n!r} but "
+            f"{len(hosts)} host shard(s) present")
+    return errs
+
 
 def _check_fault_names(doc: dict) -> list[str]:
     errs = []
@@ -134,6 +192,8 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_serve_names(doc)
     if "meta" in doc:
         problems = problems + _check_fault_names(doc)
+        problems = problems + _check_shard_names(doc)
+        problems = problems + _check_hosts_doc(doc)
     return problems
 
 

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Golden 2-device CPU-mesh run for CI (ci/tier1.sh): the ISSUE 5
+acceptance properties, end to end, on the committed golden reads.
+
+1. Run the `quorum` driver at `--devices 1` and `--devices 2` over
+   tests/golden/reads.fastq (2-device mesh via
+   XLA_FLAGS=--xla_force_host_platform_device_count, which the CI
+   wrapper sets) and assert the corrected `.fa`/`.log` outputs are
+   BYTE-IDENTICAL — scale-out must never change the answer.
+2. Hard-kill (`os._exit` fault plan, real subprocess) a sharded
+   stage-1 build mid-run with per-batch checkpoints, resume it with
+   `--resume`, and assert the finished database's table payload is
+   byte-identical to an uninterrupted sharded build — every shard
+   restored at the same cursor.
+3. Leave the sharded run's telemetry in --out-dir for the
+   metrics_check gates that follow:
+     multichip_metrics.stage1.json — sharded stage-1 document (the
+       per-shard insert/occupancy counter requirements)
+     multichip_metrics.hosts.json  — the driver's aggregated document
+       (parallel/multihost.aggregate_metrics)
+
+Exit 0 = all checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+KILL_CODE = 43
+BATCH_SIZE = 64  # 242 golden reads -> 4 batches
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden 2-device mesh run: --devices 2 byte parity "
+                    "vs --devices 1 plus a sharded stage-1 kill/resume "
+                    "(ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where the work files and metrics land "
+                        "(default: a temp dir)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="multichip_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    import jax
+    if len(jax.devices()) < 2:
+        print("[multichip_smoke] FAIL: need >= 2 devices (set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=2+ "
+              "before importing jax)", file=sys.stderr)
+        return 1
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import quorum as quorum_cli
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    metrics_path = os.path.join(out_dir, "multichip_metrics.json")
+
+    # -- driver parity: --devices 2 output == --devices 1 output ------
+    outputs = {}
+    for dev in ("1", "2"):
+        prefix = os.path.join(out_dir, f"corrected_d{dev}")
+        argv_d = ["-s", "64k", "-k", "13", "-p", prefix,
+                  "--batch-size", str(BATCH_SIZE), "--devices", dev]
+        if dev == "2":
+            argv_d += ["--metrics", metrics_path]
+        print(f"[multichip_smoke] quorum --devices {dev}")
+        rc = quorum_cli.main(argv_d + [reads])
+        if rc != 0:
+            print(f"[multichip_smoke] FAIL: driver rc {rc} at "
+                  f"--devices {dev}", file=sys.stderr)
+            return 1
+        outputs[dev] = (open(prefix + ".fa", "rb").read(),
+                        open(prefix + ".log", "rb").read())
+    if outputs["1"] != outputs["2"]:
+        print("[multichip_smoke] FAIL: --devices 2 output differs "
+              "from --devices 1 (must be byte-identical)",
+              file=sys.stderr)
+        return 1
+    print(f"[multichip_smoke] parity OK "
+          f"({len(outputs['1'][0])} fa bytes)")
+
+    # -- sharded stage-1 kill -> resume -> identical database ---------
+    ckdir = os.path.join(out_dir, "ck")
+    ref_db = os.path.join(out_dir, "ref_db.jf")
+    db = os.path.join(out_dir, "resumed_db.jf")
+    cdb_args = ["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                "--batch-size", str(BATCH_SIZE), "--devices", "2"]
+    rc = cdb_cli.main(cdb_args + ["-o", ref_db, reads])
+    if rc != 0:
+        print("[multichip_smoke] FAIL: reference sharded build",
+              file=sys.stderr)
+        return 1
+    plan = json.dumps([{"site": "stage1.insert", "batch": 2,
+                        "action": "exit", "code": KILL_CODE}])
+    env = dict(os.environ, QUORUM_FAULT_PLAN=plan)
+    print(f"[multichip_smoke] killed sharded build (fault plan: {plan})")
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.create_database"]
+        + cdb_args + ["-o", db, "--checkpoint-dir", ckdir,
+                      "--checkpoint-every", "1", reads],
+        cwd=REPO, env=env)
+    if res.returncode != KILL_CODE:
+        print(f"[multichip_smoke] FAIL: killed run exited "
+              f"{res.returncode}, want {KILL_CODE}", file=sys.stderr)
+        return 1
+    manifest = os.path.join(ckdir, "stage1.sharded.json")
+    if not os.path.exists(manifest):
+        print("[multichip_smoke] FAIL: no sharded manifest after the "
+              "kill", file=sys.stderr)
+        return 1
+    cursor = json.load(open(manifest))["cursor"]
+    print(f"[multichip_smoke] killed at batch 2; manifest committed "
+          f"cursor {cursor}")
+    rc = cdb_cli.main(cdb_args + ["-o", db, "--checkpoint-dir", ckdir,
+                                  "--checkpoint-every", "1", "--resume",
+                                  "--fault-plan", "", reads])
+    if rc != 0:
+        print("[multichip_smoke] FAIL: sharded resume rc", rc,
+              file=sys.stderr)
+        return 1
+    # headers carry a timestamp; the table payload after the header
+    # line is the invariant
+    ref = open(ref_db, "rb").read().split(b"\n", 1)[1]
+    got = open(db, "rb").read().split(b"\n", 1)[1]
+    if ref != got:
+        print("[multichip_smoke] FAIL: resumed sharded database "
+              "differs from uninterrupted build", file=sys.stderr)
+        return 1
+    if os.path.exists(manifest):
+        print("[multichip_smoke] FAIL: manifest survived the finished "
+              "build", file=sys.stderr)
+        return 1
+
+    s1 = json.load(open(os.path.join(
+        out_dir, "multichip_metrics.stage1.json")))
+    if int(s1.get("gauges", {}).get("n_shards", 0)) != 2:
+        print("[multichip_smoke] FAIL: stage-1 document does not "
+              "report n_shards=2", file=sys.stderr)
+        return 1
+    print("[multichip_smoke] OK: 2-device parity, sharded kill/resume "
+          f"byte-identical; metrics -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
