@@ -276,6 +276,132 @@ def run_multichip(ns=(1, 2, 4, 8)):
     return results
 
 
+def run_ab():
+    """Within-process A/B probes of the round-7 device levers (the
+    measurement discipline PERF_NOTES demands: tunnel throughput
+    varies 2-3x BETWEEN processes, so lever comparisons must be
+    in-process and interleaved):
+
+      * stage-2 device step — full-width sibling sweep vs compacted
+        (QUORUM_COMPACT_SWEEP) and single-level vs lane-draining
+        extension loop (QUORUM_DRAIN_LEVELS), with the lean output
+        buffer byte-compared across variants;
+      * stage-1 insert — per-observation vs batch-local pre-aggregated
+        (QUORUM_S1_AGGREGATE), with table content compared.
+
+    Emits BENCH-style metric lines (gated in CI by
+    tools/metrics_check.py --require-metric). Sizes come from
+    QUORUM_AB_{READS,LEN,K,REPS} so ci/tier1.sh can run an honest
+    small version; defaults match the headline bench regime."""
+    from quorum_tpu.utils.jaxcache import enable_cache
+    enable_cache()
+    import jax
+    from quorum_tpu.io import packing
+    from quorum_tpu.models import corrector
+    from quorum_tpu.models.ec_config import ECConfig
+    from quorum_tpu.ops import ctable
+
+    n_reads = int(os.environ.get("QUORUM_AB_READS", "16384"))
+    read_len = int(os.environ.get("QUORUM_AB_LEN", str(READ_LEN)))
+    k = int(os.environ.get("QUORUM_AB_K", str(K)))
+    reps = int(os.environ.get("QUORUM_AB_REPS", "3"))
+    genome_size = max(2 * read_len, n_reads * read_len // COVERAGE)
+    rng = np.random.default_rng(5)
+    genome = rng.integers(0, 4, size=genome_size, dtype=np.int8)
+    codes, quals, _s, _e = synth_reads(rng, genome, n_reads, read_len,
+                                       ERR_RATE)
+    lengths = np.full((n_reads,), read_len, np.int32)
+    qt = 38
+    pk1 = packing.pack_reads(codes, quals, lengths, thresholds=(qt,))
+    pk1.to_wire()
+    meta = ctable.TileMeta(
+        k=k, bits=7,
+        rb_log2=ctable.tile_rb_for(
+            genome_size + int(codes.size * ERR_RATE * k * 1.3), k, 7))
+    print(metric_line(
+        "ab_env", backend=jax.default_backend(),
+        n_reads=n_reads, read_len=read_len, k=k, reps=reps))
+
+    def bench_pair(fn_a, fn_b):
+        """Interleaved timing; returns (min_a_s, min_b_s)."""
+        fn_a(), fn_b()  # warm both (compiles land in the cache)
+        ta, tb = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn_a()
+            ta.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fn_b()
+            tb.append(time.perf_counter() - t0)
+        return min(ta), min(tb)
+
+    # -- stage 1: per-observation vs pre-aggregated insert ------------
+    tables = {}  # last finished build per variant (parity, for free)
+
+    def insert_once(agg: bool):
+        os.environ["QUORUM_S1_AGGREGATE"] = "1" if agg else "0"
+        try:
+            bstate = ctable.make_tile_build(meta)
+            bstate, full, _obs = ctable.tile_insert_reads_packed(
+                bstate, meta, pk1, qt)
+            assert not full
+            import jax as _j
+            _j.block_until_ready(bstate.tag)
+            tables[agg] = bstate
+            return bstate
+        finally:
+            os.environ.pop("QUORUM_S1_AGGREGATE", None)
+
+    base_s, agg_s = bench_pair(lambda: insert_once(False),
+                               lambda: insert_once(True))
+
+    def ent(bs):
+        return sorted(zip(*(
+            a.tolist() for a in ctable.tile_iterate(
+                ctable.tile_finalize(bs, meta), meta))))
+
+    s1_par = ent(tables[False]) == ent(tables[True])
+    print(metric_line(
+        "ab_stage1_insert", base_ms=round(base_s * 1e3, 1),
+        aggregated_ms=round(agg_s * 1e3, 1),
+        speedup=round(base_s / agg_s, 3),
+        parity="content-identical" if s1_par else "MISMATCH"))
+    assert s1_par, "aggregated stage-1 table differs"
+
+    # -- stage 2: sweep compaction x loop draining --------------------
+    state = ctable.tile_finalize(tables[True], meta)
+    cfg = ECConfig(k=k, cutoff=4, poisson_dtype="float32")
+    pk2 = packing.pack_reads(codes, quals, lengths,
+                             thresholds=(cfg.qual_cutoff,))
+    pk2.to_wire()
+    outs = {}
+
+    def correct_once(compact, drain):
+        import jax as _j
+        res, packed = corrector.correct_batch_packed(
+            state, meta, pk2, cfg, pack_cap=4 * n_reads,
+            compact_sweep=compact, drain_levels=drain)
+        _j.block_until_ready(packed)
+        outs[(compact, drain)] = np.asarray(packed)
+        return res
+
+    base_s, sweep_s = bench_pair(lambda: correct_once(False, 0),
+                                 lambda: correct_once(True, 0))
+    _b2, full_s = bench_pair(lambda: correct_once(False, 0),
+                             lambda: correct_once(True, 2))
+    base_s = min(base_s, _b2)
+    par = (np.array_equal(outs[(False, 0)], outs[(True, 0)])
+           and np.array_equal(outs[(False, 0)], outs[(True, 2)]))
+    print(metric_line(
+        "ab_stage2_device", base_ms=round(base_s * 1e3, 1),
+        compact_sweep_ms=round(sweep_s * 1e3, 1),
+        compact_drain_ms=round(full_s * 1e3, 1),
+        speedup_sweep=round(base_s / sweep_s, 3),
+        speedup_sweep_drain=round(base_s / full_s, 3),
+        parity="byte-identical" if par else "MISMATCH"))
+    assert par, "round-7 stage-2 variants disagree"
+
+
 def main():
     from quorum_tpu.utils.jaxcache import enable_cache
     enable_cache()
@@ -498,5 +624,7 @@ if __name__ == "__main__":
 
     if "--multichip" in sys.argv[1:]:
         run_multichip()
+    elif "--ab" in sys.argv[1:]:
+        run_ab()
     else:
         main()

@@ -18,10 +18,18 @@
 # driver's aggregated hosts document go through metrics_check (which
 # requires the per-shard counter names).
 #
+# Round 7 adds a BENCH-style gate: a small honest run of the
+# within-process A/B probes (bench.py --ab — compacted sibling sweep,
+# lane-draining loop, stage-1 pre-aggregation, parity asserted
+# in-process) whose freshly produced metric-line document goes through
+# tools/metrics_check.py --require-metric, so CI validates a BENCH
+# document the same way it validates the stage/serve docs.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
 #        SKIP_MULTICHIP_SMOKE=1  skips the 2-device mesh gate.
+#        SKIP_BENCH_AB=1      skips the bench A/B gate.
 set -o pipefail
 set -u
 
@@ -117,8 +125,37 @@ else
     fi
 fi
 
+bench_rc=0
+if [ "${SKIP_BENCH_AB:-0}" = "1" ]; then
+    echo "ci/tier1.sh: bench A/B gate skipped (SKIP_BENCH_AB=1)"
+else
+    # a FRESHLY produced BENCH-style document, gated like the stage
+    # and serve docs (ISSUE 6 satellite): a small honest run of the
+    # round-7 within-process A/B probes — metric lines valid per the
+    # schema AND the required probe names present, parity asserted
+    # inside bench.run_ab itself
+    echo "== bench A/B gate =="
+    AB_DIR=$(mktemp -d /tmp/bench_ab.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "$AB_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        QUORUM_AB_READS=256 QUORUM_AB_LEN=100 QUORUM_AB_K=15 \
+        QUORUM_AB_REPS=2 \
+        python bench.py --ab > "$AB_DIR/bench_ab.json" || bench_rc=$?
+    if [ "$bench_rc" -eq 0 ]; then
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            --require-metric ab_stage1_insert \
+            --require-metric ab_stage2_device \
+            "$AB_DIR/bench_ab.json" || bench_rc=1
+    fi
+    if [ "$bench_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: bench A/B gate FAILED (rc=$bench_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
 if [ "$multichip_rc" -ne 0 ]; then exit "$multichip_rc"; fi
+if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

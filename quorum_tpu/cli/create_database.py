@@ -141,7 +141,8 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
                        live=args.metrics_live,
-                       trace_spans=args.trace_spans) as obs:
+                       trace_spans=args.trace_spans,
+                       profile=args.profile) as obs:
         try:
             create_database_main(args.reads, args.output, cfg,
                                  cmdline=list(sys.argv),

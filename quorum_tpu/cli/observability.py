@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import os
 
 
 def add_observability_args(p: argparse.ArgumentParser,
@@ -117,12 +118,19 @@ class ObservabilitySession:
 def observability(metrics: str | None = None, interval: float = 0.0,
                   port: int | None = None, textfile: str | None = None,
                   live: bool = False, trace_spans: str | None = None,
+                  profile: str | None = None,
                   **meta):
     """The one observability lifecycle (ISSUE 3 satellite): registry +
     tracer up front, exposition started inside the umbrella, and a
     teardown that runs on every exit — span close, status-stamped
     final write (skipped when the body already wrote), endpoint
     close. `meta` seeds `registry.set_meta` (stage=..., etc.).
+
+    `profile` (the run's `--profile` trace directory, when both flags
+    are set): the span tracer's Chrome-trace twin is ALSO exported
+    into it as `spans.trace.json`, so one directory carries the XLA
+    device timeline and the host span timeline side by side — load
+    both in Perfetto without hunting for the `--trace-spans` path.
 
     Typical shape::
 
@@ -155,5 +163,12 @@ def observability(metrics: str | None = None, interval: float = 0.0,
         # an interrupted run is exactly when it's needed, and the
         # port must free for the next stage/run
         tracer.close()
+        if profile and tracer.enabled:
+            try:
+                os.makedirs(profile, exist_ok=True)
+                tracer.write_chrome_trace(
+                    os.path.join(profile, "spans.trace.json"))
+            except OSError:  # pragma: no cover - unwritable profile dir
+                pass
         if obs.server is not None:
             obs.server.close()

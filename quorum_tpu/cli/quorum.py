@@ -289,7 +289,8 @@ def main(argv=None) -> int:
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
                        trace_spans=(_stage_path(args.trace_spans, "driver")
-                                    if args.trace_spans else None)) as obs:
+                                    if args.trace_spans else None),
+                       profile=args.profile) as obs:
         reg = obs.registry
         track_jax_compile_cache(reg)
 
@@ -445,7 +446,21 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     # and replayed into stage 2, sparing the second disk parse + H2D
     # re-pack that the two-process reference gets from the page cache.
     reads_cache: list = []
-    cache_state = {"bytes": 0, "ok": not args.paired_files}
+    cache_state = {"bytes": 0, "ok": not args.paired_files,
+                   "writer": None}
+    # with --checkpoint-dir the replay cache ALSO streams to disk
+    # (io/checkpoint.ReplayCache), so a later --resume run feeds
+    # stage 2 from the capture instead of re-parsing the FASTQ —
+    # before round 7 only the stage OUTPUTS resumed
+    replay_identity = {
+        "inputs": list(args.reads),
+        "batch_size": int(args.batch_size),
+        "qual_cutoff": int(_EC_QUAL_CUTOFF),
+        "on_bad_read": args.on_bad_read,
+    }
+    replay_store = (ckpt_mod.ReplayCache(args.checkpoint_dir)
+                    if args.checkpoint_dir and not args.paired_files
+                    else None)
 
     def _cached_batches():
         from ..utils.pipeline import prefetch
@@ -466,6 +481,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         def _pack_and_keep(it):
             import numpy as _np
             cap_bytes = _replay_cap()  # resolve once, not per batch
+            writer = cache_state["writer"]
             for b in it:
                 # SEPARATE single-plane wires per stage: a combined
                 # two-plane wire would give the driver's executables
@@ -497,9 +513,18 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                     if cache_state["bytes"] > cap_bytes:
                         cache_state["ok"] = False
                         reads_cache.clear()
+                        if writer is not None:
+                            writer.abort()
                     else:
                         reads_cache.append(cached)
+                        if writer is not None:
+                            writer.add(cached[0], cached[1])
                 yield item
+            # every batch landed: commit the on-disk capture (the
+            # manifest is the atomic commit point — a kill before
+            # this line just means the next resume re-parses)
+            if writer is not None and cache_state["ok"]:
+                writer.finish()
         return prefetch(_pack_and_keep(src),
                         metrics=reg if reg.enabled else None,
                         name="reads_producer",
@@ -523,6 +548,9 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         reads_cache.clear()
         cache_state["bytes"] = 0
         cache_state["ok"] = not args.paired_files
+        cache_state["writer"] = (
+            replay_store.start(replay_identity, _replay_cap())
+            if replay_store is not None else None)
         argv = list(cdb_argv)
         if args.checkpoint_dir and (args.resume or attempt > 0):
             argv.append("--resume")
@@ -577,6 +605,19 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             reg.event("stage_done", stage="create_database",
                       seconds=s1_s)
     prepacked = reads_cache if cache_state["ok"] and reads_cache else None
+    prepacked_factory = (lambda: prepacked) if prepacked else None
+    if prepacked_factory is None and replay_store is not None:
+        # resumed run with stage 1 skipped (or its RAM cache lost):
+        # replay the on-disk capture instead of re-parsing the FASTQ
+        replay = replay_store.load(replay_identity)
+        if replay is not None:
+            vlog("Resume: replaying ", replay.n_batches,
+                 " cached batches from ", replay_store.dir,
+                 " (no FASTQ re-parse)")
+            reg.event("replay_cache_resume",
+                      n_batches=replay.n_batches)
+            reg.set_meta(replay_cache_resumed=True)
+            prepacked_factory = replay.batches
 
     # Stage 2: error correction (quorum.in:162-231)
     ec_common = ["--batch-size", str(args.batch_size),
@@ -641,7 +682,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             if _stage2_resume(attempt):
                 argv.append("--resume")
             return ec_cli.main(argv, db=handoff.get("db"),
-                               prepacked=prepacked)
+                               prepacked=(prepacked_factory()
+                                          if prepacked_factory else None))
 
         t_s2 = time.perf_counter()
         if _run_stage_with_retries(reg, "error_correct",
@@ -651,6 +693,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             print("Error correction failed", file=sys.stderr)
             return 1
         record_stage2(t_s2)
+        if replay_store is not None:
+            # the corrected output is final — the capture is garbage
+            # now (and sizeable); a finished stage-1 checkpoint clears
+            # the same way
+            replay_store.clear()
         return 0
 
     # Paired mode: merge | correct | split, in-process

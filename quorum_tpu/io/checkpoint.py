@@ -579,3 +579,163 @@ class Stage2Journal:
             os.remove(self.path)
         except FileNotFoundError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Driver replay cache (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+REPLAY_FORMAT = "quorum_tpu_replay_cache/1"
+
+
+class ReplayCache:
+    """The quorum driver's stage-2 replay cache, persisted under
+    `--checkpoint-dir` so a RESUMED run doesn't re-parse the input
+    FASTQ. In one process the driver parses+packs the reads once and
+    replays them into stage 2 from RAM; before round 7 a `--resume`
+    that reused the finished stage-1 database still paid a second full
+    disk parse, because the RAM cache died with the killed process.
+    This store is that cache on disk: one `.npz` per batch (the
+    decoded int8 codes stage-2 rendering needs, the bit-packed stage-2
+    wire planes, lengths, headers) streamed out as stage 1 consumes
+    the producer, plus a manifest written ATOMICALLY only once every
+    batch landed — the manifest is the commit point, so a kill
+    mid-write just means the next resume re-parses (correct, only
+    slower). `load()` validates the recorded identity (inputs,
+    batch size, qual cutoff) and hands back lazily-loaded
+    (ReadBatch, PackedReads) pairs, one batch in RAM at a time."""
+
+    def __init__(self, directory: str):
+        self.dir = os.path.join(directory, "replay")
+        self.manifest_path = os.path.join(self.dir, "manifest.json")
+
+    def _batch_path(self, i: int) -> str:
+        return os.path.join(self.dir, f"batch_{i:06d}.npz")
+
+    # -- writer ----------------------------------------------------------
+    def start(self, identity: dict, cap_bytes: int) -> "_ReplayWriter":
+        """Begin a fresh capture (drops any previous one — a retried
+        stage-1 attempt re-consumes the producer from batch 0)."""
+        self.clear()
+        os.makedirs(self.dir, exist_ok=True)
+        return _ReplayWriter(self, identity, cap_bytes)
+
+    # -- reader ----------------------------------------------------------
+    def manifest(self) -> dict | None:
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if doc.get("format") != REPLAY_FORMAT:
+            return None
+        return doc
+
+    def load(self, identity: dict):
+        """A complete, identity-matched capture, or None (caller falls
+        back to the disk re-parse). Returns an object whose
+        `.batches()` yields fresh (ReadBatch, PackedReads) pairs per
+        call (driver retries need a new iterator per attempt)."""
+        doc = self.manifest()
+        if doc is None or doc.get("identity") != identity:
+            return None
+        n = int(doc.get("n_batches", -1))
+        if n < 0 or not all(os.path.exists(self._batch_path(i))
+                            for i in range(n)):
+            return None
+        return _ReplayReader(self, n)
+
+    def clear(self) -> None:
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class _ReplayWriter:
+    """Streaming side of ReplayCache: `add()` per cached batch,
+    `finish()` commits the manifest. Exceeding `cap_bytes` (the same
+    budget as the RAM replay cache) aborts and removes the capture."""
+
+    def __init__(self, cache: ReplayCache, identity: dict,
+                 cap_bytes: int):
+        self.cache = cache
+        self.identity = identity
+        self.cap_bytes = cap_bytes
+        self.bytes = 0
+        self.n = 0
+        self.ok = True
+
+    def add(self, batch, pk) -> None:
+        if not self.ok:
+            return
+        path = self.cache._batch_path(self.n)
+        arrays = {
+            "codes": batch.codes,
+            "lengths": np.asarray(batch.lengths, np.int32),
+            "n": np.int64(batch.n),
+            "headers": np.asarray(batch.headers),
+            # the packed side stores the ONE fused wire buffer (the
+            # same bytes the device consumes) + geometry: the driver
+            # caches compacted PackedReads whose plane arrays are
+            # already folded into the wire
+            "pk_wire": pk.to_wire(),
+            "pk_b": np.int64(pk.n_reads),
+            "pk_lengths": np.asarray(pk.lengths, np.int32),
+            "pk_length": np.int64(pk.length),
+            "pk_thresholds": np.asarray(sorted(pk.hq), np.int64),
+        }
+        try:
+            with open(path + ".tmp", "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(path + ".tmp", path)
+            self.bytes += os.path.getsize(path)
+        except OSError:
+            self.abort()
+            return
+        self.n += 1
+        if self.bytes > self.cap_bytes:
+            self.abort()
+
+    def abort(self) -> None:
+        self.ok = False
+        self.cache.clear()
+
+    def finish(self) -> bool:
+        """Commit: the manifest is written only when every batch is on
+        disk (atomic_write = the commit point)."""
+        if not self.ok:
+            return False
+        atomic_write(self.cache.manifest_path, json.dumps({
+            "format": REPLAY_FORMAT,
+            "identity": self.identity,
+            "n_batches": self.n,
+            "bytes": self.bytes,
+        }) + "\n")
+        return True
+
+
+class _ReplayReader:
+    def __init__(self, cache: ReplayCache, n: int):
+        self.cache = cache
+        self.n_batches = n
+
+    def batches(self):
+        """Fresh lazy iterator of (ReadBatch, PackedReads) pairs."""
+        from . import fastq, packing
+
+        def gen():
+            for i in range(self.n_batches):
+                with np.load(self.cache._batch_path(i),
+                             allow_pickle=False) as z:
+                    pk = packing.PackedReads(
+                        pcodes=None, nmask=None,
+                        hq={int(t): None for t in z["pk_thresholds"]},
+                        lengths=z["pk_lengths"],
+                        length=int(z["pk_length"]),
+                        _wire=z["pk_wire"], _b=int(z["pk_b"]))
+                    batch = fastq.ReadBatch(
+                        codes=z["codes"], quals=None,
+                        lengths=z["lengths"],
+                        headers=[str(h) for h in z["headers"]],
+                        n=int(z["n"]))
+                yield batch, pk
+        return gen()

@@ -282,12 +282,11 @@ def _read_batches_one(paths: Sequence[str], batch_size: int,
                       ) -> Iterator[ReadBatch]:
     use_native = False
     # a non-abort bad-read policy needs the pure-Python parser (the
-    # C++ fast path has no record-recovery hooks), and so does an
-    # active fault plan (the fastq.read injection site lives here —
-    # a chaos test must not false-pass because the native path
-    # silently bypassed it)
-    if (policy is None or policy.mode == "abort") \
-            and not faults.active():
+    # C++ fast path has no record-recovery hooks). Fault plans no
+    # longer force the bypass: the native reader carries its own
+    # per-record `fastq.read` injection point (native/binding.py), so
+    # chaos tests exercise the production parser too.
+    if policy is None or policy.mode == "abort":
         try:  # C++ fast path, if the shared library is built
             from ..native import binding as _nb
             use_native = _nb.available()

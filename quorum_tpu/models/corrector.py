@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import NamedTuple
 
 import numpy as np
@@ -217,6 +218,22 @@ def _db_lookup_big(state, tmeta, khi, klo, active=None):
             state, tmeta, khi[s:e], klo[s:e],
             None if active is None else active[s:e]))
     return jnp.concatenate(parts)
+
+
+def _compact_select(mask, cap: int, idx):
+    """THE cumsum/scatter compaction idiom, shared by every compacted
+    probe: the first `cap` set lanes of `mask` scatter their `idx`
+    value into a [cap] selector. Masked / overflow lanes use POSITIVE
+    out-of-bounds sentinels with mode="drop" (negative indices would
+    silently wrap — PERF_NOTES layout landmines). Returns
+    (slot[n], fitted[n], sel[cap], slot_live[cap])."""
+    slot = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    fitted = mask & (slot < cap)
+    sel = jnp.zeros((cap,), idx.dtype).at[
+        jnp.where(fitted, slot, cap)].set(idx, mode="drop")
+    n_fit = jnp.sum(fitted.astype(jnp.int32))
+    slot_live = jnp.arange(cap, dtype=jnp.int32) < n_fit
+    return slot, fitted, sel, slot_live
 
 
 def _gba_reduce(vals):
@@ -440,6 +457,31 @@ def _extend_env(state, tmeta, codes, quals, cfg, end, contam_state,
             window, error, b, l, thresh)
 
 
+def compact_sweep_default() -> bool:
+    """Round-7 accelerator default (see ctable.accel_backend): the
+    sibling sweep runs compacted (exact own-value pre-pass + candidate
+    probe + c1k walk). QUORUM_COMPACT_SWEEP=1/0 forces it either way
+    (A/B escape hatch)."""
+    raw = os.environ.get("QUORUM_COMPACT_SWEEP")
+    if raw is not None and raw != "":
+        return raw != "0"
+    return ctable.accel_backend()
+
+
+def drain_levels_default() -> int:
+    """Round-7 accelerator default (see ctable.accel_backend): the
+    event-driven extension loop re-compacts live lanes to half then
+    quarter width as lanes retire. QUORUM_DRAIN_LEVELS forces a level
+    count (0 = single-level loop)."""
+    raw = os.environ.get("QUORUM_DRAIN_LEVELS")
+    if raw is not None and raw != "":
+        try:
+            return max(0, min(2, int(raw)))
+        except ValueError:
+            pass
+    return 2 if ctable.accel_backend() else 0
+
+
 # Steps per while_loop iteration. Each step is fully masked
 # (active = alive & in_range), so running several per iteration is a
 # pure strength reduction: same total work, fewer loop iterations —
@@ -494,7 +536,8 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
                  carry, end, guard_thresh,
                  contam_state, contam_meta, d: int, has_contam: bool,
                  unroll: int = UNROLL, ambig_cap: int = 1 << 30,
-                 planes: EventPlanes | None = None):
+                 planes: EventPlanes | None = None,
+                 drain_levels: int = 0):
     """The lockstep extension loop.
 
     Plain mode (planes=None): every live lane advances one base per
@@ -514,442 +557,525 @@ def _extend_loop(state, tmeta, codes, quals, cfg: ECConfig,
     would-be mers under a no-further-edit assumption) teleports over
     the desync region's exact-keep prefix in one step. Iterations
     collapse from ~L to ~(events on the worst lane): measured 1.5 mean
-    / 8 max events per 150 bp read at 40x coverage (PERF_NOTES.md)."""
+    / 8 max events per 150 bp read at 40x coverage (PERF_NOTES.md).
+
+    `drain_levels` (event mode only): the per-iteration cost of the
+    loop is CONSTANT in the lane count, not live-lane-proportional
+    (masked gathers pay per index — PERF_NOTES round 4), so once most
+    lanes retire, every remaining iteration still bills full width.
+    With drain_levels=N, the loop exits once the live count drops to
+    half the current width, re-compacts the live lanes (and their
+    whole step environment) into a half-width buffer, and keeps
+    stepping there — repeated N times (full -> B/2 -> B/4). Stalls and
+    caps shrink with the width, so per-lane semantics are unchanged
+    (stall = pure delay); output is bit-identical to the single-level
+    loop (round-7 parity tests)."""
     k = cfg.k
-    (in_range, gather_code, take4, contam, lane, codes32, quals32,
-     window, error, b, l, thresh) = _extend_env(
-        state, tmeta, codes, quals, cfg, end, contam_state, contam_meta,
-        d, has_contam, guard_thresh)
     if planes is not None:
         assert d == 1, "event-driven stepping runs in the merged d=+1 frame"
+    else:
+        drain_levels = 0  # plain mode keeps the single-level loop
+    if drain_levels and guard_thresh is None:
+        guard_thresh = jnp.full((codes.shape[0],), cfg.effective_window,
+                                jnp.int32)
     tail_t = k - 1
-    # 92 rows/slot: bound the in-loop gather transient
-    cap_tail = max(1, min(b // 4, 12288))
-    cap_gba = max(1, b // 8)
 
-    def gat(plane, idx):
-        safe = jnp.clip(idx, 0, l - 1)
-        return jnp.take_along_axis(plane, safe[:, None], axis=1)[:, 0]
+    def _make_level(codes_lv, quals_lv, end_lv, thresh_lv, planes_lv):
+        """Build the loop body closed over ONE width's environment:
+        the drained levels re-instantiate it at half/quarter width so
+        the compaction caps and the per-iteration op volume shrink
+        with the buffer."""
+        (in_range, gather_code, take4, contam, lane, codes32, quals32,
+         window, error, b, l, thresh) = _extend_env(
+            state, tmeta, codes_lv, quals_lv, cfg, end_lv, contam_state,
+            contam_meta, d, has_contam, thresh_lv)
+        planes = planes_lv
+        end = end_lv
+        # 92 rows/slot: bound the in-loop gather transient
+        cap_tail = max(1, min(b // 4, 12288))
+        cap_gba = max(1, b // 8)
 
-    def _compact(mask, cap):
-        """cumsum/scatter compaction: returns (slot, fitted, lane_of,
-        slot_live). Masked lanes scatter to index cap, dropped as
-        out-of-bounds (negative sentinels would wrap)."""
-        slot = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        fitted = mask & (slot < cap)
-        lane_of = jnp.zeros((cap,), jnp.int32).at[
-            jnp.where(fitted, slot, cap)].set(lane, mode="drop")
-        n_fit = jnp.sum(fitted.astype(jnp.int32))
-        slot_live = jnp.arange(cap, dtype=jnp.int32) < n_fit
-        return slot, fitted, lane_of, slot_live
+        def gat(plane, idx):
+            safe = jnp.clip(idx, 0, l - 1)
+            return jnp.take_along_axis(plane, safe[:, None], axis=1)[:, 0]
 
-    def _ambig_probe(need, fh, fl, rh, rl, counts, level, read_nbase):
-        """The 16-lookup continuation probe (error_correct_reads.cc:
-        473-507) over compacted lanes; returns full-width
-        (succ[B,4] incl. the elig gate, cwn[B,4], stalled)."""
-        cap = min(max(1, ambig_cap), b)
-        slot, fitted, lane_of, slot_live = _compact(need, cap)
-        stalled = need & ~fitted
-        cfh, cfl = fh[lane_of], fl[lane_of]
-        crh, crl = rh[lane_of], rl[lane_of]
-        elig_c = [(counts[:, i] > cfg.min_count)[lane_of] & slot_live
-                  for i in range(4)]
-        level_c = level[lane_of]
-        nb_c = read_nbase[lane_of]
-        safe_nb = jnp.clip(nb_c, 0, 3)
-        chis, clos, acts = [], [], []
-        for i in range(4):
-            ifh, ifl, irh, irl = mer.dir_replace0(
-                cfh, cfl, crh, crl, mer.u32(i), d, k)
-            ifh, ifl, irh, irl = mer.dir_shift(
-                ifh, ifl, irh, irl, mer.u32(0), d, k)
-            for j in range(4):
-                jfh, jfl, jrh, jrl = mer.dir_replace0(
-                    ifh, ifl, irh, irl, mer.u32(j), d, k)
-                chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
-                chis.append(chi)
-                clos.append(clo)
-                acts.append(elig_c[i])
-        nv = _db_lookup(
-            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
-            jnp.stack(acts).ravel(),
-        ).reshape(4, 4, cap)
-        succ_c, cwn_c = [], []
-        for i in range(4):
-            ncounts, _nu, nlevel, ncount = _gba_reduce(list(nv[i]))
-            s_i = elig_c[i] & (ncount > 0) & (nlevel >= level_c)
-            succ_c.append(s_i)
-            cwn_c.append(s_i & (nb_c >= 0) & (_sel4(ncounts, safe_nb) > 0))
-        safe_slot = jnp.clip(slot, 0, cap - 1)
-        succ = jnp.stack(
-            [jnp.where(fitted, s[safe_slot], False) for s in succ_c],
-            axis=1)
-        cwn = jnp.stack(
-            [jnp.where(fitted, c[safe_slot], False) for c in cwn_c],
-            axis=1)
-        return succ, cwn, stalled
+        def _compact(mask, cap):
+            """The shared compaction idiom over this level's lanes:
+            (slot, fitted, lane_of, slot_live)."""
+            return _compact_select(mask, cap, lane)
 
-    def _tail_probe(want, fh, fl, rh, rl, pos, opos, prev, resync):
-        """Teleport through the desync region after a substitution:
-        compute the next tail_t mers under a no-further-edit assumption
-        (the shifted-in bases are the original read), run the full
-        4-variant gba on each, and advance over the maximal EXACT-KEEP
-        prefix (c1-keep with ucode==ori, keep_cut, or Poisson keep;
-        anything else — another sub, ambiguity, truncation,
-        contaminant, N — stops the teleport and is re-processed live,
-        which is always correct). prev updates from count==1 positions
-        in the prefix are exact (full sibling info)."""
-        slot, fitted, lane_of, slot_live = _compact(want, cap_tail)
-        li = lane_of[:, None]
-        tpos = pos[lane_of]
-        tend = jnp.minimum(resync[lane_of], end[lane_of])
-        tq = tpos[:, None] + jnp.arange(tail_t, dtype=jnp.int32)[None, :]
-        stq = jnp.clip(tq, 0, l - 1)
-        tori = codes32[li, stq]  # [cap, T]
-        tqual = quals32[li, stq]
-        t_in = slot_live[:, None] & (tq < tend[:, None])
-        cfh, cfl = fh[lane_of], fl[lane_of]
-        crh, crl = rh[lane_of], rl[lane_of]
-        m_fh, m_fl, m_rh, m_rl = [cfh], [cfl], [crh], [crl]
-        chis, clos, acts = [], [], []
-        cchis, cclos = [], []
-        for t in range(tail_t):
-            code_t = mer.u32(jnp.maximum(tori[:, t], 0))
-            nfh, nfl, nrh, nrl = mer.dir_shift(
-                m_fh[-1], m_fl[-1], m_rh[-1], m_rl[-1], code_t, d, k)
-            m_fh.append(nfh)
-            m_fl.append(nfl)
-            m_rh.append(nrh)
-            m_rl.append(nrl)
-            if has_contam:
-                cchi, cclo = mer.canonical(nfh, nfl, nrh, nrl)
-                cchis.append(cchi)
-                cclos.append(cclo)
+        def _ambig_probe(need, fh, fl, rh, rl, counts, level, read_nbase):
+            """The 16-lookup continuation probe (error_correct_reads.cc:
+            473-507) over compacted lanes; returns full-width
+            (succ[B,4] incl. the elig gate, cwn[B,4], stalled)."""
+            cap = min(max(1, ambig_cap), b)
+            slot, fitted, lane_of, slot_live = _compact(need, cap)
+            stalled = need & ~fitted
+            cfh, cfl = fh[lane_of], fl[lane_of]
+            crh, crl = rh[lane_of], rl[lane_of]
+            elig_c = [(counts[:, i] > cfg.min_count)[lane_of] & slot_live
+                      for i in range(4)]
+            level_c = level[lane_of]
+            nb_c = read_nbase[lane_of]
+            safe_nb = jnp.clip(nb_c, 0, 3)
+            chis, clos, acts = [], [], []
             for i in range(4):
-                vfh, vfl, vrh, vrl = mer.dir_replace0(
-                    nfh, nfl, nrh, nrl, mer.u32(i), d, k)
-                chi, clo = mer.canonical(vfh, vfl, vrh, vrl)
-                chis.append(chi)
-                clos.append(clo)
-                acts.append(t_in[:, t] & (tori[:, t] >= 0))
-        act = jnp.stack(acts).ravel()
-        tv = _db_lookup(
-            state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
-            act,
-        ).reshape(tail_t, 4, cap_tail)
-        keep_rows, c1keep_rows, cori_rows = [], [], []
-        for t in range(tail_t):
-            tcounts, tuc, tlev, tcnt = _gba_reduce(list(tv[t]))
-            ori_t = tori[:, t]
-            safe_o = jnp.clip(ori_t, 0, 3)
-            c_ori = jnp.where(ori_t >= 0, _sel4(tcounts, safe_o), 0)
-            c1k = (tcnt == 1) & (tuc == ori_t)
-            hi = c_ori > cfg.min_count
-            kcut = (tcnt > 1) & hi & ((c_ori >= cfg.cutoff)
-                                     | (tqual[:, t] >= cfg.qual_cutoff))
-            lam = ((tcounts[0] + tcounts[1] + tcounts[2] + tcounts[3])
-                   .astype(jnp.float32) * jnp.float32(cfg.collision_prob))
-            kpoi = ((tcnt > 1) & hi & ~kcut
-                    & (poisson_term(lam, c_ori) < cfg.poisson_threshold))
-            keep_rows.append((c1k | kcut | kpoi) & t_in[:, t]
-                             & (ori_t >= 0))
-            c1keep_rows.append(c1k)
-            cori_rows.append(c_ori)
-        keep_t = jnp.stack(keep_rows)  # [T, cap]
-        if has_contam:
-            tcon = _db_lookup(
-                contam_state, contam_meta,
-                jnp.stack(cchis).ravel(), jnp.stack(cclos).ravel(),
-                (t_in & (tori >= 0)).T.ravel(),
-            ).reshape(tail_t, cap_tail) != 0
-            keep_t = keep_t & ~tcon
-        pk = jnp.cumprod(keep_t.astype(jnp.int32), axis=0) > 0
-        plen = jnp.sum(pk.astype(jnp.int32), axis=0)  # [cap]
-        c1p = jnp.stack(c1keep_rows) & pk
-        has_c1p = jnp.any(c1p, axis=0)
-        t_last = (tail_t - 1) - jnp.argmax(c1p[::-1, :], axis=0)
-        arange_cap = jnp.arange(cap_tail, dtype=jnp.int32)
-        prev_t = jnp.stack(cori_rows)[t_last, arange_cap]
-        sel_fh = jnp.stack(m_fh)[plen, arange_cap]
-        sel_fl = jnp.stack(m_fl)[plen, arange_cap]
-        sel_rh = jnp.stack(m_rh)[plen, arange_cap]
-        sel_rl = jnp.stack(m_rl)[plen, arange_cap]
-        safe_slot = jnp.clip(slot, 0, cap_tail - 1)
-        adv = jnp.where(fitted, plen[safe_slot], 0)
-        fh = jnp.where(fitted, sel_fh[safe_slot], fh)
-        fl = jnp.where(fitted, sel_fl[safe_slot], fl)
-        rh = jnp.where(fitted, sel_rh[safe_slot], rh)
-        rl = jnp.where(fitted, sel_rl[safe_slot], rl)
-        pos = pos + adv
-        opos = opos + adv
-        prev = jnp.where(fitted & has_c1p[safe_slot], prev_t[safe_slot],
-                         prev)
-        return fh, fl, rh, rl, pos, opos, prev
+                ifh, ifl, irh, irl = mer.dir_replace0(
+                    cfh, cfl, crh, crl, mer.u32(i), d, k)
+                ifh, ifl, irh, irl = mer.dir_shift(
+                    ifh, ifl, irh, irl, mer.u32(0), d, k)
+                for j in range(4):
+                    jfh, jfl, jrh, jrl = mer.dir_replace0(
+                        ifh, ifl, irh, irl, mer.u32(j), d, k)
+                    chi, clo = mer.canonical(jfh, jfl, jrh, jrl)
+                    chis.append(chi)
+                    clos.append(clo)
+                    acts.append(elig_c[i])
+            nv = _db_lookup(
+                state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+                jnp.stack(acts).ravel(),
+            ).reshape(4, 4, cap)
+            succ_c, cwn_c = [], []
+            for i in range(4):
+                ncounts, _nu, nlevel, ncount = _gba_reduce(list(nv[i]))
+                s_i = elig_c[i] & (ncount > 0) & (nlevel >= level_c)
+                succ_c.append(s_i)
+                cwn_c.append(s_i & (nb_c >= 0) & (_sel4(ncounts, safe_nb) > 0))
+            safe_slot = jnp.clip(slot, 0, cap - 1)
+            succ = jnp.stack(
+                [jnp.where(fitted, s[safe_slot], False) for s in succ_c],
+                axis=1)
+            cwn = jnp.stack(
+                [jnp.where(fitted, c[safe_slot], False) for c in cwn_c],
+                axis=1)
+            return succ, cwn, stalled
 
-    def body(carry):
-        (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
-         resync) = carry
+        def _tail_probe(want, fh, fl, rh, rl, pos, opos, prev, resync):
+            """Teleport through the desync region after a substitution:
+            compute the next tail_t mers under a no-further-edit assumption
+            (the shifted-in bases are the original read), run the full
+            4-variant gba on each, and advance over the maximal EXACT-KEEP
+            prefix (c1-keep with ucode==ori, keep_cut, or Poisson keep;
+            anything else — another sub, ambiguity, truncation,
+            contaminant, N — stops the teleport and is re-processed live,
+            which is always correct). prev updates from count==1 positions
+            in the prefix are exact (full sibling info)."""
+            slot, fitted, lane_of, slot_live = _compact(want, cap_tail)
+            li = lane_of[:, None]
+            tpos = pos[lane_of]
+            tend = jnp.minimum(resync[lane_of], end[lane_of])
+            tq = tpos[:, None] + jnp.arange(tail_t, dtype=jnp.int32)[None, :]
+            stq = jnp.clip(tq, 0, l - 1)
+            tori = codes32[li, stq]  # [cap, T]
+            tqual = quals32[li, stq]
+            t_in = slot_live[:, None] & (tq < tend[:, None])
+            cfh, cfl = fh[lane_of], fl[lane_of]
+            crh, crl = rh[lane_of], rl[lane_of]
+            m_fh, m_fl, m_rh, m_rl = [cfh], [cfl], [crh], [crl]
+            chis, clos, acts = [], [], []
+            cchis, cclos = [], []
+            for t in range(tail_t):
+                code_t = mer.u32(jnp.maximum(tori[:, t], 0))
+                nfh, nfl, nrh, nrl = mer.dir_shift(
+                    m_fh[-1], m_fl[-1], m_rh[-1], m_rl[-1], code_t, d, k)
+                m_fh.append(nfh)
+                m_fl.append(nfl)
+                m_rh.append(nrh)
+                m_rl.append(nrl)
+                if has_contam:
+                    cchi, cclo = mer.canonical(nfh, nfl, nrh, nrl)
+                    cchis.append(cchi)
+                    cclos.append(cclo)
+                for i in range(4):
+                    vfh, vfl, vrh, vrl = mer.dir_replace0(
+                        nfh, nfl, nrh, nrl, mer.u32(i), d, k)
+                    chi, clo = mer.canonical(vfh, vfl, vrh, vrl)
+                    chis.append(chi)
+                    clos.append(clo)
+                    acts.append(t_in[:, t] & (tori[:, t] >= 0))
+            act = jnp.stack(acts).ravel()
+            tv = _db_lookup(
+                state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+                act,
+            ).reshape(tail_t, 4, cap_tail)
+            keep_rows, c1keep_rows, cori_rows = [], [], []
+            for t in range(tail_t):
+                tcounts, tuc, tlev, tcnt = _gba_reduce(list(tv[t]))
+                ori_t = tori[:, t]
+                safe_o = jnp.clip(ori_t, 0, 3)
+                c_ori = jnp.where(ori_t >= 0, _sel4(tcounts, safe_o), 0)
+                c1k = (tcnt == 1) & (tuc == ori_t)
+                hi = c_ori > cfg.min_count
+                kcut = (tcnt > 1) & hi & ((c_ori >= cfg.cutoff)
+                                         | (tqual[:, t] >= cfg.qual_cutoff))
+                lam = ((tcounts[0] + tcounts[1] + tcounts[2] + tcounts[3])
+                       .astype(jnp.float32) * jnp.float32(cfg.collision_prob))
+                kpoi = ((tcnt > 1) & hi & ~kcut
+                        & (poisson_term(lam, c_ori) < cfg.poisson_threshold))
+                keep_rows.append((c1k | kcut | kpoi) & t_in[:, t]
+                                 & (ori_t >= 0))
+                c1keep_rows.append(c1k)
+                cori_rows.append(c_ori)
+            keep_t = jnp.stack(keep_rows)  # [T, cap]
+            if has_contam:
+                tcon = _db_lookup(
+                    contam_state, contam_meta,
+                    jnp.stack(cchis).ravel(), jnp.stack(cclos).ravel(),
+                    (t_in & (tori >= 0)).T.ravel(),
+                ).reshape(tail_t, cap_tail) != 0
+                keep_t = keep_t & ~tcon
+            pk = jnp.cumprod(keep_t.astype(jnp.int32), axis=0) > 0
+            plen = jnp.sum(pk.astype(jnp.int32), axis=0)  # [cap]
+            c1p = jnp.stack(c1keep_rows) & pk
+            has_c1p = jnp.any(c1p, axis=0)
+            t_last = (tail_t - 1) - jnp.argmax(c1p[::-1, :], axis=0)
+            arange_cap = jnp.arange(cap_tail, dtype=jnp.int32)
+            prev_t = jnp.stack(cori_rows)[t_last, arange_cap]
+            sel_fh = jnp.stack(m_fh)[plen, arange_cap]
+            sel_fl = jnp.stack(m_fl)[plen, arange_cap]
+            sel_rh = jnp.stack(m_rh)[plen, arange_cap]
+            sel_rl = jnp.stack(m_rl)[plen, arange_cap]
+            safe_slot = jnp.clip(slot, 0, cap_tail - 1)
+            adv = jnp.where(fitted, plen[safe_slot], 0)
+            fh = jnp.where(fitted, sel_fh[safe_slot], fh)
+            fl = jnp.where(fitted, sel_fl[safe_slot], fl)
+            rh = jnp.where(fitted, sel_rh[safe_slot], rh)
+            rl = jnp.where(fitted, sel_rl[safe_slot], rl)
+            pos = pos + adv
+            opos = opos + adv
+            prev = jnp.where(fitted & has_c1p[safe_slot], prev_t[safe_slot],
+                             prev)
+            return fh, fl, rh, rl, pos, opos, prev
 
-        if planes is not None:
-            # ---- teleport phase: synced lanes jump to the next event,
-            # prev updated in O(1) from the lastc1/prevval planes
-            synced = pos >= resync
-            at_clean = alive & in_range(pos) & synced & gat(planes.clean,
-                                                            pos)
-            tgt = jnp.minimum(gat(planes.nd, pos), end)
-            nfh = gat(planes.mfh, tgt - 1)
-            nfl = gat(planes.mfl, tgt - 1)
-            nrh = gat(planes.mrh, tgt - 1)
-            nrl = gat(planes.mrl, tgt - 1)
-            lc = gat(planes.lastc1, tgt - 1)
-            pv = gat(planes.prevval, tgt - 1)
-            fh = jnp.where(at_clean, nfh, fh)
-            fl = jnp.where(at_clean, nfl, fl)
-            rh = jnp.where(at_clean, nrh, rh)
-            rl = jnp.where(at_clean, nrl, rl)
-            prev = jnp.where(at_clean & (lc >= pos), pv, prev)
-            opos = opos + jnp.where(at_clean, tgt - pos, 0)
-            pos = jnp.where(at_clean, tgt, pos)
+        def body(carry):
+            (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+             resync) = carry
 
-        active = alive & in_range(pos)
-        cpos = pos
-        pos = jnp.where(active, pos + d, pos)
+            if planes is not None:
+                # ---- teleport phase: synced lanes jump to the next event,
+                # prev updated in O(1) from the lastc1/prevval planes
+                synced = pos >= resync
+                at_clean = alive & in_range(pos) & synced & gat(planes.clean,
+                                                                pos)
+                tgt = jnp.minimum(gat(planes.nd, pos), end)
+                nfh = gat(planes.mfh, tgt - 1)
+                nfl = gat(planes.mfl, tgt - 1)
+                nrh = gat(planes.mrh, tgt - 1)
+                nrl = gat(planes.mrl, tgt - 1)
+                lc = gat(planes.lastc1, tgt - 1)
+                pv = gat(planes.prevval, tgt - 1)
+                fh = jnp.where(at_clean, nfh, fh)
+                fl = jnp.where(at_clean, nfl, fl)
+                rh = jnp.where(at_clean, nrh, rh)
+                rl = jnp.where(at_clean, nrl, rl)
+                prev = jnp.where(at_clean & (lc >= pos), pv, prev)
+                opos = opos + jnp.where(at_clean, tgt - pos, 0)
+                pos = jnp.where(at_clean, tgt, pos)
 
-        ori = gather_code(codes32, cpos, active)
-        qualc = jnp.where(active,
-                          gather_code(quals32, cpos, active), 0)
+            active = alive & in_range(pos)
+            cpos = pos
+            pos = jnp.where(active, pos + d, pos)
 
-        # pre-step mers, restored for stalled lanes
-        pfh, pfl, prh, prl = fh, fl, rh, rl
-        shift_code = mer.u32(jnp.maximum(ori, 0))
-        sfh, sfl, srh, srl = mer.dir_shift(fh, fl, rh, rl, shift_code, d, k)
-        fh = jnp.where(active, sfh, fh)
-        fl = jnp.where(active, sfl, fl)
-        rh = jnp.where(active, srh, rh)
-        rl = jnp.where(active, srl, rl)
+            ori = gather_code(codes32, cpos, active)
+            qualc = jnp.where(active,
+                              gather_code(quals32, cpos, active), 0)
 
-        # contaminant on the shifted mer (error_correct_reads.cc:401-407)
-        con1 = contam(fh, fl, rh, rl, active & (ori >= 0))
-        con1_trim = con1 if cfg.trim_contaminant else jnp.zeros_like(con1)
-        con1_err = con1 & ~con1_trim
-        status = jnp.where(con1_err, ST_CONTAMINANT, status)
-        alive = alive & ~con1
-        live = active & ~con1
+            # pre-step mers, restored for stalled lanes
+            pfh, pfl, prh, prl = fh, fl, rh, rl
+            shift_code = mer.u32(jnp.maximum(ori, 0))
+            sfh, sfl, srh, srl = mer.dir_shift(fh, fl, rh, rl, shift_code, d, k)
+            fh = jnp.where(active, sfh, fh)
+            fl = jnp.where(active, sfl, fl)
+            rh = jnp.where(active, srh, rh)
+            rl = jnp.where(active, srl, rl)
 
-        if planes is not None:
-            # ---- mixed gba: synced lanes unpack the planes; only
-            # desynced lanes pay live lookups, compacted
-            synced_step = cpos >= resync
-            pcnt = gat(planes.cnt, cpos)
-            paux = gat(planes.aux, cpos)
-            need_live = live & ~synced_step
-            slot_g, fit_g, lane_g, live_g = _compact(need_live, cap_gba)
-            stall_g = need_live & ~fit_g
-            lcounts, lucode, llevel, lcount = _gba(
-                state, tmeta, fh[lane_g], fl[lane_g], rh[lane_g],
-                rl[lane_g], d, live_g)
-            safe_g = jnp.clip(slot_g, 0, cap_gba - 1)
-            counts = jnp.stack([
-                jnp.where(synced_step,
-                          ((pcnt >> (7 * i)) & 127).astype(jnp.int32),
-                          jnp.where(fit_g, lcounts[safe_g, i], 0))
-                for i in range(4)], axis=1)
-            level = jnp.where(synced_step,
-                              (paux & 1).astype(jnp.int32),
-                              llevel[safe_g])
-            count = jnp.where(synced_step,
-                              ((paux >> _AX_COUNT) & 7).astype(jnp.int32),
-                              lcount[safe_g])
-            ucode = jnp.where(synced_step,
-                              ((paux >> _AX_UCODE) & 3).astype(jnp.int32),
-                              lucode[safe_g])
-            live = live & ~stall_g
-        else:
-            synced_step = jnp.zeros_like(live)
-            paux = None
-            stall_g = jnp.zeros_like(live)
-            counts, ucode, level, count = _gba(
-                state, tmeta, fh, fl, rh, rl, d, live)
+            # contaminant on the shifted mer (error_correct_reads.cc:401-407)
+            con1 = contam(fh, fl, rh, rl, active & (ori >= 0))
+            con1_trim = con1 if cfg.trim_contaminant else jnp.zeros_like(con1)
+            con1_err = con1 & ~con1_trim
+            status = jnp.where(con1_err, ST_CONTAMINANT, status)
+            alive = alive & ~con1
+            live = active & ~con1
 
-        # count == 0: truncate (cc:416-419)
-        t0 = live & (count == 0)
-        alive = alive & ~t0
-        live = live & ~t0
+            if planes is not None:
+                # ---- mixed gba: synced lanes unpack the planes; only
+                # desynced lanes pay live lookups, compacted
+                synced_step = cpos >= resync
+                pcnt = gat(planes.cnt, cpos)
+                paux = gat(planes.aux, cpos)
+                need_live = live & ~synced_step
+                slot_g, fit_g, lane_g, live_g = _compact(need_live, cap_gba)
+                stall_g = need_live & ~fit_g
+                lcounts, lucode, llevel, lcount = _gba(
+                    state, tmeta, fh[lane_g], fl[lane_g], rh[lane_g],
+                    rl[lane_g], d, live_g)
+                safe_g = jnp.clip(slot_g, 0, cap_gba - 1)
+                counts = jnp.stack([
+                    jnp.where(synced_step,
+                              ((pcnt >> (7 * i)) & 127).astype(jnp.int32),
+                              jnp.where(fit_g, lcounts[safe_g, i], 0))
+                    for i in range(4)], axis=1)
+                level = jnp.where(synced_step,
+                                  (paux & 1).astype(jnp.int32),
+                                  llevel[safe_g])
+                count = jnp.where(synced_step,
+                                  ((paux >> _AX_COUNT) & 7).astype(jnp.int32),
+                                  lcount[safe_g])
+                ucode = jnp.where(synced_step,
+                                  ((paux >> _AX_UCODE) & 3).astype(jnp.int32),
+                                  lucode[safe_g])
+                live = live & ~stall_g
+            else:
+                synced_step = jnp.zeros_like(live)
+                paux = None
+                stall_g = jnp.zeros_like(live)
+                counts, ucode, level, count = _gba(
+                    state, tmeta, fh, fl, rh, rl, d, live)
 
-        # count == 1 (cc:421-430)
-        c1 = live & (count == 1)
-        prev = jnp.where(c1, take4(counts, ucode), prev)
-        sub1 = c1 & (ori != ucode)
-        nfh, nfl, nrh, nrl = mer.dir_replace0(
-            fh, fl, rh, rl, mer.u32(jnp.clip(ucode, 0)), d, k)
-        fh = jnp.where(c1, nfh, fh)
-        fl = jnp.where(c1, nfl, fl)
-        rh = jnp.where(c1, nrh, rh)
-        rl = jnp.where(c1, nrl, rl)
-        # log_substitution (cc:360-379): contaminant check on the
-        # substituted mer, then window-budget bookkeeping
-        con2 = contam(fh, fl, rh, rl, sub1)
-        con2_trim = con2 if cfg.trim_contaminant else jnp.zeros_like(con2)
-        con2_err = con2 & ~con2_trim
-        status = jnp.where(con2_err, ST_CONTAMINANT, status)
-        alive = alive & ~con2
-        sub1 = sub1 & ~con2
-        log, trip1 = _log_append(
-            log, sub1, cpos, _pack_sub(ori, ucode), window, error, d, thresh)
-        log, diff1 = _log_remove_last_window(log, trip1, window, d, thresh)
-        log = _append_trunc(log, trip1, cpos - d * diff1, window, error, d,
-                            thresh)
-        opos = jnp.where(trip1, opos - d * diff1, opos)
-        alive = alive & ~trip1
-        write1 = c1 & ~con2 & ~trip1
+            # count == 0: truncate (cc:416-419)
+            t0 = live & (count == 0)
+            alive = alive & ~t0
+            live = live & ~t0
 
-        # count > 1 (cc:432-561)
-        cm = live & (count > 1)
-        c_ori = jnp.where(cm & (ori >= 0), take4(counts, ori), 0)
-        ori_hi = cm & (ori >= 0) & (c_ori > cfg.min_count)
-        keep_cut = ori_hi & ((c_ori >= cfg.cutoff)
-                             | (qualc >= cfg.qual_cutoff))
-        p_lam = (jnp.sum(counts, axis=1).astype(jnp.float32)
-                 * jnp.float32(cfg.collision_prob))
-        prob = poisson_term(p_lam, c_ori)
-        keep_poi = ori_hi & ~keep_cut & (prob < cfg.poisson_threshold)
-        keep_simple = keep_cut | keep_poi
-        t_a = cm & (ori >= 0) & ~ori_hi & (level == 0) & (c_ori == 0)
-        t_b = cm & (ori < 0) & (level == 0)
-        alive = alive & ~(t_a | t_b)
-        # one merged truncation append: the five masks are disjoint per
-        # lane (each lane takes one branch), all at cpos, and no
-        # intermediate computation reads the log — 5 sets of [B, E]
-        # log ops become 1
-        log = _append_trunc(log, con1_trim | t0 | con2_trim | t_a | t_b,
-                            cpos, window, error, d, thresh)
-        ambig = cm & ~keep_simple & ~t_a & ~t_b
+            # count == 1 (cc:421-430)
+            c1 = live & (count == 1)
+            prev = jnp.where(c1, take4(counts, ucode), prev)
+            sub1 = c1 & (ori != ucode)
+            nfh, nfl, nrh, nrl = mer.dir_replace0(
+                fh, fl, rh, rl, mer.u32(jnp.clip(ucode, 0)), d, k)
+            fh = jnp.where(c1, nfh, fh)
+            fl = jnp.where(c1, nfl, fl)
+            rh = jnp.where(c1, nrh, rh)
+            rl = jnp.where(c1, nrl, rl)
+            # log_substitution (cc:360-379): contaminant check on the
+            # substituted mer, then window-budget bookkeeping
+            con2 = contam(fh, fl, rh, rl, sub1)
+            con2_trim = con2 if cfg.trim_contaminant else jnp.zeros_like(con2)
+            con2_err = con2 & ~con2_trim
+            status = jnp.where(con2_err, ST_CONTAMINANT, status)
+            alive = alive & ~con2
+            sub1 = sub1 & ~con2
+            log, trip1 = _log_append(
+                log, sub1, cpos, _pack_sub(ori, ucode), window, error, d, thresh)
+            log, diff1 = _log_remove_last_window(log, trip1, window, d, thresh)
+            log = _append_trunc(log, trip1, cpos - d * diff1, window, error, d,
+                                thresh)
+            opos = jnp.where(trip1, opos - d * diff1, opos)
+            alive = alive & ~trip1
+            write1 = c1 & ~con2 & ~trip1
 
-        # ---- ambiguous path (cc:473-545): synced lanes with pre-pass
-        # data take the elementwise tie-break directly; the rest run
-        # the compacted continuation probe (stall-and-retry past cap)
-        read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
-        if planes is not None:
-            pre_ok = ambig & synced_step & (((paux >> _AX_PRE) & 1) == 1)
-        else:
-            pre_ok = jnp.zeros_like(ambig)
-        probe_need = ambig & ~pre_ok
-        succ_p, cwn_p, stall_a = _ambig_probe(
-            probe_need, fh, fl, rh, rl, counts, level, read_nbase)
-        if planes is not None:
-            psucc = jnp.stack([(((paux >> (_AX_SUCC + i)) & 1) == 1)
-                               for i in range(4)], axis=1)
-            pcwn = jnp.stack([(((paux >> (_AX_CWN + i)) & 1) == 1)
-                              for i in range(4)], axis=1)
-            succ4 = jnp.where(pre_ok[:, None], psucc, succ_p)
-            cwn4 = jnp.where(pre_ok[:, None], pcwn, cwn_p)
-        else:
-            succ4, cwn4 = succ_p, cwn_p
-        amb_go = ambig & ~stall_a
-        succ4 = succ4 & amb_go[:, None]
-        cwn4 = cwn4 & amb_go[:, None]
+            # count > 1 (cc:432-561)
+            cm = live & (count > 1)
+            c_ori = jnp.where(cm & (ori >= 0), take4(counts, ori), 0)
+            ori_hi = cm & (ori >= 0) & (c_ori > cfg.min_count)
+            keep_cut = ori_hi & ((c_ori >= cfg.cutoff)
+                                 | (qualc >= cfg.qual_cutoff))
+            p_lam = (jnp.sum(counts, axis=1).astype(jnp.float32)
+                     * jnp.float32(cfg.collision_prob))
+            prob = poisson_term(p_lam, c_ori)
+            keep_poi = ori_hi & ~keep_cut & (prob < cfg.poisson_threshold)
+            keep_simple = keep_cut | keep_poi
+            t_a = cm & (ori >= 0) & ~ori_hi & (level == 0) & (c_ori == 0)
+            t_b = cm & (ori < 0) & (level == 0)
+            alive = alive & ~(t_a | t_b)
+            # one merged truncation append: the five masks are disjoint per
+            # lane (each lane takes one branch), all at cpos, and no
+            # intermediate computation reads the log — 5 sets of [B, E]
+            # log ops become 1
+            log = _append_trunc(log, con1_trim | t0 | con2_trim | t_a | t_b,
+                                cpos, window, error, d, thresh)
+            ambig = cm & ~keep_simple & ~t_a & ~t_b
 
-        cont_counts = jnp.where(succ4, counts, 0)
-        check_code = jnp.where(amb_go, ori, 0)
-        for i in range(4):
-            check_code = jnp.where(
-                amb_go & (counts[:, i] > cfg.min_count), i, check_code)
-        success = jnp.any(succ4, axis=1)
+            # ---- ambiguous path (cc:473-545): synced lanes with pre-pass
+            # data take the elementwise tie-break directly; the rest run
+            # the compacted continuation probe (stall-and-retry past cap)
+            read_nbase = gather_code(codes32, pos, in_range(pos) & ambig)
+            if planes is not None:
+                pre_ok = ambig & synced_step & (((paux >> _AX_PRE) & 1) == 1)
+            else:
+                pre_ok = jnp.zeros_like(ambig)
+            probe_need = ambig & ~pre_ok
+            succ_p, cwn_p, stall_a = _ambig_probe(
+                probe_need, fh, fl, rh, rl, counts, level, read_nbase)
+            if planes is not None:
+                psucc = jnp.stack([(((paux >> (_AX_SUCC + i)) & 1) == 1)
+                                   for i in range(4)], axis=1)
+                pcwn = jnp.stack([(((paux >> (_AX_CWN + i)) & 1) == 1)
+                                  for i in range(4)], axis=1)
+                succ4 = jnp.where(pre_ok[:, None], psucc, succ_p)
+                cwn4 = jnp.where(pre_ok[:, None], pcwn, cwn_p)
+            else:
+                succ4, cwn4 = succ_p, cwn_p
+            amb_go = ambig & ~stall_a
+            succ4 = succ4 & amb_go[:, None]
+            cwn4 = cwn4 & amb_go[:, None]
 
-        # tie-break chain (cc:509-545). prev_count <= min_count takes
-        # the int-overflow dead-code path: no candidate ever matches.
-        prev_ok = prev > cfg.min_count
-        diffs = jnp.abs(cont_counts - prev[:, None])
-        min_diff = jnp.min(
-            jnp.where(cont_counts > 0, diffs, jnp.int32(2**31 - 1)), axis=1)
-        cand = (success[:, None] & prev_ok[:, None]
-                & (diffs == min_diff[:, None]))
-        ncand = jnp.sum(cand.astype(jnp.int32), axis=1)
-        cc2 = jnp.full((b,), -1, jnp.int32)
-        for i in range(4):
-            cc2 = jnp.where(cand[:, i], i, cc2)
-        tie = (ncand > 1) & (read_nbase >= 0)
-        ncand = jnp.where(
-            tie, jnp.sum((cand & cwn4).astype(jnp.int32), axis=1), ncand)
-        for i in range(4):
-            cc2 = jnp.where(tie & cand[:, i] & cwn4[:, i], i, cc2)
-        cc2 = jnp.where(ncand != 1, -1, cc2)
-        check_code = jnp.where(success, cc2, check_code)
+            cont_counts = jnp.where(succ4, counts, 0)
+            check_code = jnp.where(amb_go, ori, 0)
+            for i in range(4):
+                check_code = jnp.where(
+                    amb_go & (counts[:, i] > cfg.min_count), i, check_code)
+            success = jnp.any(succ4, axis=1)
 
-        sub2 = success & (check_code >= 0) & (check_code != ori)
-        nfh, nfl, nrh, nrl = mer.dir_replace0(
-            fh, fl, rh, rl, mer.u32(jnp.clip(check_code, 0)), d, k)
-        do_rep = success & (check_code >= 0)
-        fh = jnp.where(do_rep, nfh, fh)
-        fl = jnp.where(do_rep, nfl, fl)
-        rh = jnp.where(do_rep, nrh, rh)
-        rl = jnp.where(do_rep, nrl, rl)
-        con3 = contam(fh, fl, rh, rl, sub2)
-        con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
-        con3_err = con3 & ~con3_trim
-        status = jnp.where(con3_err, ST_CONTAMINANT, status)
-        alive = alive & ~con3
-        sub2 = sub2 & ~con3
-        log, trip2 = _log_append(
-            log, sub2, cpos, _pack_sub(ori, check_code), window, error, d,
-            thresh)
-        log, diff2 = _log_remove_last_window(log, trip2, window, d, thresh)
-        log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d,
-                            thresh)
-        opos = jnp.where(trip2, opos - d * diff2, opos)
-        alive = alive & ~trip2
+            # tie-break chain (cc:509-545). prev_count <= min_count takes
+            # the int-overflow dead-code path: no candidate ever matches.
+            prev_ok = prev > cfg.min_count
+            diffs = jnp.abs(cont_counts - prev[:, None])
+            min_diff = jnp.min(
+                jnp.where(cont_counts > 0, diffs, jnp.int32(2**31 - 1)), axis=1)
+            cand = (success[:, None] & prev_ok[:, None]
+                    & (diffs == min_diff[:, None]))
+            ncand = jnp.sum(cand.astype(jnp.int32), axis=1)
+            cc2 = jnp.full((b,), -1, jnp.int32)
+            for i in range(4):
+                cc2 = jnp.where(cand[:, i], i, cc2)
+            tie = (ncand > 1) & (read_nbase >= 0)
+            ncand = jnp.where(
+                tie, jnp.sum((cand & cwn4).astype(jnp.int32), axis=1), ncand)
+            for i in range(4):
+                cc2 = jnp.where(tie & cand[:, i] & cwn4[:, i], i, cc2)
+            cc2 = jnp.where(ncand != 1, -1, cc2)
+            check_code = jnp.where(success, cc2, check_code)
 
-        # N base with no good substitution: truncate (cc:553-556)
-        t_c = amb_go & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
-        log = _append_trunc(log, con3_trim | t_c, cpos, window, error, d,
-                            thresh)
-        alive = alive & ~t_c
+            sub2 = success & (check_code >= 0) & (check_code != ori)
+            nfh, nfl, nrh, nrl = mer.dir_replace0(
+                fh, fl, rh, rl, mer.u32(jnp.clip(check_code, 0)), d, k)
+            do_rep = success & (check_code >= 0)
+            fh = jnp.where(do_rep, nfh, fh)
+            fl = jnp.where(do_rep, nfl, fl)
+            rh = jnp.where(do_rep, nrh, rh)
+            rl = jnp.where(do_rep, nrl, rl)
+            con3 = contam(fh, fl, rh, rl, sub2)
+            con3_trim = con3 if cfg.trim_contaminant else jnp.zeros_like(con3)
+            con3_err = con3 & ~con3_trim
+            status = jnp.where(con3_err, ST_CONTAMINANT, status)
+            alive = alive & ~con3
+            sub2 = sub2 & ~con3
+            log, trip2 = _log_append(
+                log, sub2, cpos, _pack_sub(ori, check_code), window, error, d,
+                thresh)
+            log, diff2 = _log_remove_last_window(log, trip2, window, d, thresh)
+            log = _append_trunc(log, trip2, cpos - d * diff2, window, error, d,
+                                thresh)
+            opos = jnp.where(trip2, opos - d * diff2, opos)
+            alive = alive & ~trip2
 
-        # ---- stall rewind: stalled lanes redo the whole step next
-        # iteration (they took no branch, wrote nothing, appended
-        # nothing this iteration)
-        stalled = stall_g | stall_a
-        pos = jnp.where(stalled, cpos, pos)
-        fh = jnp.where(stalled, pfh, fh)
-        fl = jnp.where(stalled, pfl, fl)
-        rh = jnp.where(stalled, prh, rh)
-        rl = jnp.where(stalled, prl, rl)
+            # N base with no good substitution: truncate (cc:553-556)
+            t_c = amb_go & ~con3 & ~trip2 & (ori < 0) & (check_code < 0)
+            log = _append_trunc(log, con3_trim | t_c, cpos, window, error, d,
+                                thresh)
+            alive = alive & ~t_c
 
-        write = (write1 | (keep_simple & alive & active)
-                 | (amb_go & alive))
-        base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
-        # out-of-range positive sentinel: dropped (negative would wrap)
-        widx = jnp.where(write, opos, l)
-        outb = outb.at[lane, widx].set(base0, mode="drop")
-        opos = jnp.where(write, opos + d, opos)
+            # ---- stall rewind: stalled lanes redo the whole step next
+            # iteration (they took no branch, wrote nothing, appended
+            # nothing this iteration)
+            stalled = stall_g | stall_a
+            pos = jnp.where(stalled, cpos, pos)
+            fh = jnp.where(stalled, pfh, fh)
+            fl = jnp.where(stalled, pfl, fl)
+            rh = jnp.where(stalled, prh, rh)
+            rl = jnp.where(stalled, prl, rl)
 
-        if planes is not None:
-            mer_changed = (sub1 | (do_rep & (check_code != ori))) & ~stalled
-            resync = jnp.where(mer_changed, cpos + k, resync)
-            want_tail = (alive & in_range(pos) & (pos < resync)
-                         & ~stalled)
-            (fh, fl, rh, rl, pos, opos, prev) = _tail_probe(
-                want_tail, fh, fl, rh, rl, pos, opos, prev, resync)
+            write = (write1 | (keep_simple & alive & active)
+                     | (amb_go & alive))
+            base0 = mer.dir_base0(fh, fl, d, k).astype(jnp.int32)
+            # out-of-range positive sentinel: dropped (negative would wrap)
+            widx = jnp.where(write, opos, l)
+            outb = outb.at[lane, widx].set(base0, mode="drop")
+            opos = jnp.where(write, opos + d, opos)
 
-        return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
-                resync)
+            if planes is not None:
+                mer_changed = (sub1 | (do_rep & (check_code != ori))) & ~stalled
+                resync = jnp.where(mer_changed, cpos + k, resync)
+                want_tail = (alive & in_range(pos) & (pos < resync)
+                             & ~stalled)
+                (fh, fl, rh, rl, pos, opos, prev) = _tail_probe(
+                    want_tail, fh, fl, rh, rl, pos, opos, prev, resync)
 
-    def body_unrolled(carry):
-        for _ in range(unroll):
-            carry = body(carry)
-        return carry
+            return (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+                    resync)
 
-    def cond(carry):
-        pos, alive = carry[4], carry[7]
-        c = jnp.any(alive & in_range(pos))
-        ax = getattr(tmeta, "routed_axis", None)
-        if ax is not None:
-            # routed lookups put collectives inside the body: every
-            # shard must run the same number of lockstep iterations
-            c = jax.lax.pmax(c.astype(jnp.int32), ax) > 0
-        return c
+        def body_unrolled(carry):
+            for _ in range(unroll):
+                carry = body(carry)
+            return carry
 
-    return jax.lax.while_loop(cond, body_unrolled, carry)
+        return in_range, body_unrolled
+
+    def _run(env, carry_lv, floor):
+        codes_lv, quals_lv, end_lv, thresh_lv, planes_lv = env
+        in_range, body_unrolled = _make_level(codes_lv, quals_lv,
+                                              end_lv, thresh_lv,
+                                              planes_lv)
+
+        def cond(carry_c):
+            pos, alive = carry_c[4], carry_c[7]
+            live = alive & in_range(pos)
+            c = jnp.any(live)
+            if floor is not None:
+                # lane-draining exit: hand the survivors to the next
+                # (narrower) level once they'd fit it
+                c = c & (jnp.sum(live.astype(jnp.int32)) > floor)
+            ax = getattr(tmeta, "routed_axis", None)
+            if ax is not None:
+                # routed lookups put collectives inside the body:
+                # every shard must run the same number of lockstep
+                # iterations (and drain at the same moment)
+                c = jax.lax.pmax(c.astype(jnp.int32), ax) > 0
+            return c
+
+        return jax.lax.while_loop(cond, body_unrolled, carry_lv)
+
+    env = (codes, quals, end, guard_thresh, planes)
+    b0 = codes.shape[0]
+    widths = [max(1, b0 >> (i + 1)) for i in range(drain_levels)]
+    carry = _run(env, carry, widths[0] if widths else None)
+    for i, w in enumerate(widths):
+        floor = widths[i + 1] if i + 1 < len(widths) else None
+        carry = _drain_run(_run, env, carry, w, floor, d)
+    return carry
+
+
+def _drain_run(run, env, carry, width: int, floor, d: int):
+    """One drain step of the lane-draining extension loop: compact the
+    live lanes (and every per-lane row of their step environment) into
+    a `width`-lane buffer, keep stepping there via `run`, and scatter
+    the survivors' state back into the full-width carry. The previous
+    level's floor equals `width`, so every live lane fits by
+    construction; retired lanes' state (out rows, logs, status) never
+    moves."""
+    codes_l, quals_l, end_l, thresh_l, planes_l = env
+    (fh, fl, rh, rl, pos, opos, prev, alive, status, outb, log,
+     resync) = carry
+    b = pos.shape[0]
+    live = alive & ((pos < end_l) if d == 1 else (pos > end_l))
+    _slot, _fitted, lane_of, slot_live = _compact_select(
+        live, width, jnp.arange(b, dtype=jnp.int32))
+
+    def g(x):
+        return x[lane_of]
+
+    sub_env = (g(codes_l), g(quals_l), g(end_l), g(thresh_l),
+               None if planes_l is None
+               else EventPlanes(*(g(p) for p in planes_l)))
+    sub = (g(fh), g(fl), g(rh), g(rl), g(pos), g(opos), g(prev),
+           g(alive) & slot_live, g(status), g(outb),
+           LogState(g(log.n), g(log.lwin), g(log.pos), g(log.meta)),
+           g(resync))
+    sub = run(sub_env, sub, floor)
+    sidx = jnp.where(slot_live, lane_of, b)
+
+    def s(x, xs):
+        return x.at[sidx].set(xs, mode="drop")
+
+    (sfh, sfl, srh, srl, spos, sopos, sprev, salive, sstatus, soutb,
+     slog, sresync) = sub
+    return (s(fh, sfh), s(fl, sfl), s(rh, srh), s(rl, srl),
+            s(pos, spos), s(opos, sopos), s(prev, sprev),
+            s(alive, salive), s(status, sstatus), s(outb, soutb),
+            LogState(s(log.n, slog.n), s(log.lwin, slog.lwin),
+                     s(log.pos, slog.pos), s(log.meta, slog.meta)),
+            s(resync, sresync))
 
 
 def extend(state, tmeta, codes, quals, cfg: ECConfig,
@@ -957,7 +1083,7 @@ def extend(state, tmeta, codes, quals, cfg: ECConfig,
            pos0, end, status0,
            contam_state, contam_meta, d: int, has_contam: bool,
            ambig_cap: int | None = None, guard_thresh=None,
-           planes: EventPlanes | None = None):
+           planes: EventPlanes | None = None, drain_levels: int = 0):
     """extend (error_correct_reads.cc:384-565) in lockstep over a batch:
     one fused while_loop advancing every live lane one base per
     iteration, with the ambiguous-path continuation probe inline over
@@ -987,7 +1113,8 @@ def extend(state, tmeta, codes, quals, cfg: ECConfig,
     unroll = 1 if planes is not None else UNROLL
     carry = _extend_loop(state, tmeta, codes, quals, cfg, carry, end,
                          guard_thresh, contam_state, contam_meta, d,
-                         has_contam, unroll, ambig_cap, planes)
+                         has_contam, unroll, ambig_cap, planes,
+                         drain_levels)
     opos, status, outb, log = carry[5], carry[8], carry[9], carry[10]
     return ExtendResult(outb, opos, status, log)
 
@@ -1132,17 +1259,10 @@ def _frame_facts(sweep: SweepResult, codes32, quals32, lengths, start_off,
     return ori, qual, nbase, wfh, wfl, wrh, wrl
 
 
-def _class_planes(state, tmeta, sweep: SweepResult, facts, cfg: ECConfig):
-    """The sibling sweep: 3 lookups per position (the variants of the
-    consuming frame's base-0 other than the original) complete the
-    exact per-position get_best_alternatives, from which every branch
-    of the live step is classified (cited masks mirror _extend_loop's
-    body / error_correct_reads.cc:384-565). Returns
-    (vals4 list, counts list, level, count, ucode, clean, c1keep,
-    ambig_class) — all [B, L]."""
-    k = cfg.k
-    ori, qual, nbase, wfh, wfl, wrh, wrl = facts
-    orie = jnp.clip(ori, 0, 3)  # N windows are A-encoded: variant 0
+def _sibling_mers(wfh, wfl, wrh, wrl, orie, k: int):
+    """The 3 sibling canonical keys of a frame window (the base-0
+    variants other than the original), variant-compressed order:
+    slot j holds variant j + (orie <= j). Returns (chis, clos) lists."""
     chis, clos = [], []
     for j in range(3):
         i_j = (j + (orie <= j).astype(jnp.int32)).astype(jnp.uint32)
@@ -1150,16 +1270,18 @@ def _class_planes(state, tmeta, sweep: SweepResult, facts, cfg: ECConfig):
         chi, clo = mer.canonical(vfh, vfl, vrh, vrl)
         chis.append(chi)
         clos.append(clo)
-    sv = _db_lookup_big(
-        state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
-    ).reshape(3, *ori.shape)
-    svl = list(sv)
-    vals4 = [
-        jnp.where(orie == i, sweep.vals,
-                  _sel4(svl, jnp.where(i > orie, i - 1, i)))
-        for i in range(4)
-    ]
+    return chis, clos
+
+
+def _classify(vals4, ori, qual, con, cfg: ECConfig):
+    """Elementwise classification of a position from its exact
+    4-variant value words — every branch of the live step (cited masks
+    mirror _extend_loop's body / error_correct_reads.cc:384-565).
+    Shape-agnostic (full [B, L] planes or compacted [cap] lanes).
+    Returns (counts list[4], level, count, ucode, clean, c1keep,
+    ambig_class)."""
     counts, ucode, level, count = _gba_reduce(vals4)
+    orie = jnp.clip(ori, 0, 3)
     c_ori = jnp.where(ori >= 0, _sel4(counts, orie), 0)
     c1keep = (count == 1) & (ucode == ori)
     ori_hi = (ori >= 0) & (c_ori > cfg.min_count)
@@ -1169,11 +1291,257 @@ def _class_planes(state, tmeta, sweep: SweepResult, facts, cfg: ECConfig):
     lam = total.astype(jnp.float32) * jnp.float32(cfg.collision_prob)
     keep_poi = ((count > 1) & ori_hi & ~keep_cut
                 & (poisson_term(lam, c_ori) < cfg.poisson_threshold))
-    clean = (c1keep | keep_cut | keep_poi) & ~sweep.con
+    clean = (c1keep | keep_cut | keep_poi) & ~con
     t_a = (count > 1) & (ori >= 0) & ~ori_hi & (level == 0) & (c_ori == 0)
     t_b = (count > 1) & (ori < 0) & (level == 0)
     ambig_class = (count > 1) & ~(keep_cut | keep_poi) & ~t_a & ~t_b
-    return vals4, counts, level, count, ucode, clean, c1keep, ambig_class
+    return counts, level, count, ucode, clean, c1keep, ambig_class
+
+
+def _pack_counts(counts):
+    """4 level-filtered variant counts -> one u32 (7 bits each; counts
+    are bounded by the value word's bits <= 7)."""
+    return (counts[0].astype(jnp.uint32)
+            | (counts[1].astype(jnp.uint32) << 7)
+            | (counts[2].astype(jnp.uint32) << 14)
+            | (counts[3].astype(jnp.uint32) << 21))
+
+
+def _class_planes(state, tmeta, sweep: SweepResult, facts, cfg: ECConfig):
+    """The FULL-WIDTH sibling sweep: 3 lookups per position (the
+    variants of the consuming frame's base-0 other than the original)
+    complete the exact per-position get_best_alternatives. Returns
+    (counts list, level, count, ucode, clean, c1keep, ambig_class) —
+    all [B, L]. The production default is the compacted form
+    (_class_planes_compact); this full form is the A/B + parity
+    reference."""
+    k = cfg.k
+    ori, qual, nbase, wfh, wfl, wrh, wrl = facts
+    orie = jnp.clip(ori, 0, 3)  # N windows are A-encoded: variant 0
+    chis, clos = _sibling_mers(wfh, wfl, wrh, wrl, orie, k)
+    sv = _db_lookup_big(
+        state, tmeta, jnp.stack(chis).ravel(), jnp.stack(clos).ravel(),
+    ).reshape(3, *ori.shape)
+    svl = list(sv)
+    vals4 = [
+        jnp.where(orie == i, sweep.vals,
+                  _sel4(svl, jnp.where(i > orie, i - 1, i)))
+        for i in range(4)
+    ]
+    counts, level, count, ucode, clean, c1keep, ambig_class = _classify(
+        vals4, ori, qual, sweep.con, cfg)
+    return counts, level, count, ucode, clean, c1keep, ambig_class
+
+
+def _certainly_clean(sweep: SweepResult, ori, qual, cfg: ECConfig):
+    """The exact own-value pre-pass of the compacted sibling sweep:
+    positions whose canonical lookup alone proves them clean. Own HQ
+    with count past min_count and (count >= cutoff or qual >= cutoff)
+    is clean WHATEVER the siblings hold: own HQ pins level=1, so the
+    filtered own count equals the raw one; count==1 then means
+    ucode==ori (c1-keep), count>1 means keep_cut — both clean. Every
+    other position (incl. N windows and anything contaminated) stays a
+    candidate for the sibling probe. What this pre-pass CANNOT decide
+    is count==1 vs count>1 — the c1keep/prev circularity — which the
+    consumption-point walk (_c1k_walk) resolves with O(runs) probes
+    instead of O(positions)."""
+    co = (sweep.vals >> 1).astype(jnp.int32)
+    qo = (sweep.vals & 1).astype(jnp.int32)
+    return ((ori >= 0) & (qo == 1) & (co > cfg.min_count)
+            & ((co >= cfg.cutoff) | (qual >= cfg.qual_cutoff))
+            & ~sweep.con)
+
+
+def _class_planes_compact(state, tmeta, sweep: SweepResult, facts,
+                          cfg: ECConfig):
+    """The COMPACTED sibling sweep (round 7): the own-value pre-pass
+    classifies ~certainly-clean positions for free; only the surviving
+    candidates pay the 3-sibling probe, chunk-looped to a static cap so
+    any candidate count is exact (a masked full-width gather pays per
+    index whether or not the lane is live — compaction is the only way
+    to make the sweep cost follow the candidate rate). Returns
+    (cnt_packed, auxcore, clean, c1k_known, ambig_class, certain), all
+    [B, L]; cnt/aux fields are exact for candidates and zero for
+    certainly-clean positions (never consumed there: synced live steps
+    only ever land on non-clean positions, which are candidates)."""
+    k = cfg.k
+    ori, qual, nbase, wfh, wfl, wrh, wrl = facts
+    b, l = ori.shape
+    n = b * l
+    certain = _certainly_clean(sweep, ori, qual, cfg)
+    flat = (~certain).ravel()
+    slot = jnp.cumsum(flat.astype(jnp.int32)) - 1
+    # padded so the chunk loop's dynamic_slice never clamps; the 3x
+    # sibling lookup per chunk must stay under the in-loop row-gather
+    # transient bound (_LOOKUP_CHUNK — an unchunked multi-M-row tile
+    # gather materializes [N, 128] and OOMs at 32k-read batches)
+    ch = min(n, max(4096, min(n // 8, _LOOKUP_CHUNK // 3)))
+    pos_of = jnp.full((n + ch,), n, jnp.int32).at[
+        jnp.where(flat, slot, n + ch)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    n_cand = jnp.sum(flat.astype(jnp.int32))
+    mf = [x.ravel() for x in (wfh, wfl, wrh, wrl)]
+    ori_f = ori.ravel()
+    qual_f = qual.ravel()
+    con_f = sweep.con.ravel()
+    own_f = sweep.vals.ravel()
+
+    def body(c):
+        i, cnt_f, auxc_f, clean_f, c1k_f, amb_f = c
+        start = i * ch
+        live = (start + jnp.arange(ch, dtype=jnp.int32)) < n_cand
+        idx = jnp.where(live,
+                        jax.lax.dynamic_slice(pos_of, (start,), (ch,)), 0)
+        o = ori_f[idx]
+        q = qual_f[idx]
+        cn = con_f[idx]
+        ov = own_f[idx]
+        orie = jnp.clip(o, 0, 3)
+        cfh, cfl, crh, crl = (f[idx] for f in mf)
+        chis, clos = _sibling_mers(cfh, cfl, crh, crl, orie, k)
+        sv = _db_lookup(
+            state, tmeta, jnp.stack(chis).ravel(),
+            jnp.stack(clos).ravel(), jnp.tile(live, 3)).reshape(3, ch)
+        svl = list(sv)
+        vals4 = [jnp.where(orie == v, ov,
+                           _sel4(svl, jnp.where(v > orie, v - 1, v)))
+                 for v in range(4)]
+        counts, level, count, ucode, clean_c, c1k_c, amb_c = _classify(
+            vals4, o, q, cn, cfg)
+        auxc = (level.astype(jnp.uint32)
+                | (count.astype(jnp.uint32) << _AX_COUNT)
+                | (ucode.astype(jnp.uint32) << _AX_UCODE))
+        sidx = jnp.where(live, idx, n)
+        return (i + 1,
+                cnt_f.at[sidx].set(_pack_counts(counts), mode="drop"),
+                auxc_f.at[sidx].set(auxc, mode="drop"),
+                clean_f.at[sidx].set(clean_c, mode="drop"),
+                c1k_f.at[sidx].set(c1k_c, mode="drop"),
+                amb_f.at[sidx].set(amb_c, mode="drop"))
+
+    def cond(c):
+        go = c[0] * ch < n_cand
+        ax = getattr(tmeta, "routed_axis", None)
+        if ax is not None:
+            # routed lookups are collectives: every shard runs the
+            # same number of chunk iterations
+            go = jax.lax.pmax(go.astype(jnp.int32), ax) > 0
+        return go
+
+    zf = jnp.zeros((n,), jnp.uint32)
+    zb = jnp.zeros((n,), bool)
+    _i, cnt_f, auxc_f, clean_f, c1k_f, amb_f = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), zf, zf, zb, zb, zb))
+    clean = certain | clean_f.reshape(b, l)
+    return (cnt_f.reshape(b, l), auxc_f.reshape(b, l), clean,
+            c1k_f.reshape(b, l), amb_f.reshape(b, l), certain)
+
+
+def _c1k_walk(state, tmeta, clean2, kc1k0, unk0, mfh2, mfl2, mrh2, mrl2,
+              ori2, lengths2, cfg: ECConfig):
+    """Resolve the c1-keep bits the prev chain actually CONSUMES —
+    the compacted sweep's answer to the count==1 vs count>1
+    circularity (PERF_NOTES round 5): a certainly-clean position is
+    prev-defining iff it has no HQ sibling, which only a probe can
+    tell, but the chain is only ever read at CONSUMPTION POINTS
+    (teleports read lastc1/prevval at tgt-1, which is always the
+    position before a non-clean event or the last in-read position).
+    So instead of probing every clean position (that would be the full
+    sweep again), walk backward from each consumption point and probe
+    only until the run's LAST prev-definer is known: positions below
+    it are dominated and never influence a consumed value. At 40x,
+    ~77% of clean positions are count==1, so the expected probes per
+    run are ~1.3 (geometric) — O(runs), not O(positions).
+
+    Frame-space [2B, L] inputs: `clean2` exact everywhere, `kc1k0` the
+    known prev-definers (probed candidates), `unk0` the
+    certainly-clean positions whose c1k bit is unknown. Returns the
+    resolved kc1k plane (exact at and above every run's last
+    prev-definer; dominated positions may stay 0 — consumption-
+    equivalent, proven by the round-7 parity tests)."""
+    k = cfg.k
+    b2, l = clean2.shape
+    n = b2 * l
+    p_idx = jnp.arange(l, dtype=jnp.int32)[None, :]
+    # consumption points: last position of a clean run, plus the last
+    # in-read position of each lane (tgt = min(nd, end)). Positions at
+    # or past the read end can never be consumed (tgt <= end), so
+    # masking them skips whole walks over garbage windows — and every
+    # position of a padding row.
+    next_nonclean = ~_shl(clean2, 1, False)
+    cp = (clean2 & (p_idx < lengths2[:, None])
+          & (next_nonclean | (p_idx == lengths2[:, None] - 1)))
+    cap = min(max(1, n), max(1024, min(n // 16, _LOOKUP_CHUNK // 3)))
+    # walk stride: probe up to this many unknowns per consumption
+    # point per round (the last W of the run) instead of one — rounds
+    # collapse from the walk depth to depth/W at a bounded number of
+    # wasted probes (only positions below a c1k found in the same
+    # window)
+    stride = 8
+    neg = jnp.int32(-1)
+    mf = [x.ravel() for x in (mfh2, mfl2, mrh2, mrl2)]
+    ori_f = ori2.ravel()
+    # the run boundary never moves: hoist its cummax out of the loop
+    lastE = jax.lax.cummax(jnp.where(~clean2, p_idx, neg), axis=1)
+    big = jnp.int32(l + 1)
+    # next consumption point at-or-after p (per lane; big if none)
+    nextcp = jax.lax.cummin(jnp.where(cp, p_idx, big), axis=1,
+                            reverse=True)
+
+    def needed_plane(kc1k, unk):
+        """Positions to probe: unknowns within `stride` of an
+        UNRESOLVED consumption point, above that point's last known
+        stopper (event or prev-definer)."""
+        lastK = jax.lax.cummax(jnp.where(kc1k, p_idx, neg), axis=1)
+        lastU = jax.lax.cummax(jnp.where(unk, p_idx, neg), axis=1)
+        stopper = jnp.maximum(lastE, lastK)
+        unres = cp & (lastU > stopper)
+        safe_ncp = jnp.clip(nextcp, 0, l - 1)
+        unres_at = jnp.take_along_axis(unres, safe_ncp, axis=1)
+        stop_at = jnp.take_along_axis(stopper, safe_ncp, axis=1)
+        # window anchored at the unknown FRONTIER (the deepest unknown
+        # below the point), not the point itself: known-non-definer
+        # stretches between them could otherwise starve the window and
+        # stall the loop. p == frontier always qualifies -> progress.
+        front_at = jnp.take_along_axis(lastU, safe_ncp, axis=1)
+        need = (unk & (nextcp < big) & unres_at
+                & (p_idx > stop_at) & (p_idx > front_at - stride))
+        return need, jnp.any(unres)
+
+    def cond(c):
+        go = c[2]
+        ax = getattr(tmeta, "routed_axis", None)
+        if ax is not None:
+            go = jax.lax.pmax(go.astype(jnp.int32), ax) > 0
+        return go
+
+    def body(c):
+        kc1k, unk, _go, needed = c
+        # leftovers past the cap simply re-surface next round
+        _slot, _fit, pos_of, live = _compact_select(
+            needed.ravel(), cap, jnp.arange(n, dtype=jnp.int32))
+        o = ori_f[pos_of]
+        orie = jnp.clip(o, 0, 3)
+        cfh, cfl, crh, crl = (f[pos_of] for f in mf)
+        chis, clos = _sibling_mers(cfh, cfl, crh, crl, orie, k)
+        sv = _db_lookup(
+            state, tmeta, jnp.stack(chis).ravel(),
+            jnp.stack(clos).ravel(), jnp.tile(live, 3)).reshape(3, cap)
+        # walked positions are certainly-clean, i.e. own-HQ: level is
+        # pinned at 1 and count==1 iff no sibling carries the HQ bit
+        isc1k = live & (((sv[0] | sv[1] | sv[2]) & 1) == 0)
+        sidx = jnp.where(live, pos_of, n)
+        probed = jnp.zeros((n,), bool).at[sidx].set(True, mode="drop")
+        newc1k = jnp.zeros((n,), bool).at[sidx].set(isc1k, mode="drop")
+        kc1k = kc1k | newc1k.reshape(b2, l)
+        unk = unk & ~probed.reshape(b2, l)
+        needed, go = needed_plane(kc1k, unk)
+        return kc1k, unk, go, needed
+
+    needed0, go0 = needed_plane(kc1k0, unk0)
+    kc1k, _unk, _go, _need = jax.lax.while_loop(
+        cond, body, (kc1k0, unk0, go0, needed0))
+    return kc1k
 
 
 def _ambig_prepass(state, tmeta, ambig_class, counts, level, nbase, facts,
@@ -1241,32 +1609,48 @@ def _ambig_prepass(state, tmeta, ambig_class, counts, level, nbase, facts,
 
 def _event_planes(state, tmeta, sweep: SweepResult, codes32, quals32,
                   lengths, start_off, cfg: ECConfig,
-                  uniform_len: int | None, prepass_cap: int
-                  ) -> EventPlanes:
+                  uniform_len: int | None, prepass_cap: int,
+                  compact_sweep: bool = True) -> EventPlanes:
     """Build the [2B, L] event planes (see EventPlanes): sibling sweep
     -> exact per-position class, ambig continuation pre-pass, then the
     frame remap. The rc half is a pure index remap of the original-
     orientation facts: the window ending at rc position p' is the
     original window ending at len+k-2-p', and the rc-frame forward/
     revcomp mer words are the original window's revcomp/forward
-    words."""
+    words.
+
+    `compact_sweep` (the round-7 default) replaces the full 3-row/base
+    sibling sweep with the own-value pre-pass + compacted candidate
+    probe (_class_planes_compact), and resolves the c1keep/prev chain
+    with the consumption-point walk (_c1k_walk) — consumed plane
+    values are bit-exact against the full sweep (round-7 parity
+    tests)."""
     k = cfg.k
     l = codes32.shape[1]
     facts = _frame_facts(sweep, codes32, quals32, lengths, start_off, k)
-    (vals4, counts, level, count, ucode, clean, c1keep,
-     ambig_class) = _class_planes(state, tmeta, sweep, facts, cfg)
+    if compact_sweep:
+        (cnt_packed, auxcore, clean, c1k_known, ambig_class,
+         certain) = _class_planes_compact(state, tmeta, sweep, facts,
+                                          cfg)
+        counts = [((cnt_packed >> (7 * i)) & 127).astype(jnp.int32)
+                  for i in range(4)]
+        level = (auxcore & 1).astype(jnp.int32)
+        c1k_bit = clean & c1k_known
+    else:
+        (counts, level, count, ucode, clean, c1keep,
+         ambig_class) = _class_planes(state, tmeta, sweep, facts, cfg)
+        certain = None
+        cnt_packed = _pack_counts(counts)
+        auxcore = (level.astype(jnp.uint32)
+                   | (count.astype(jnp.uint32) << _AX_COUNT)
+                   | (ucode.astype(jnp.uint32) << _AX_UCODE))
+        c1k_bit = clean & c1keep
     pre, succ, cwn = _ambig_prepass(state, tmeta, ambig_class, counts,
                                     level, facts[2], facts, cfg,
                                     prepass_cap)
-    cnt_packed = (counts[0].astype(jnp.uint32)
-                  | (counts[1].astype(jnp.uint32) << 7)
-                  | (counts[2].astype(jnp.uint32) << 14)
-                  | (counts[3].astype(jnp.uint32) << 21))
-    aux = (level.astype(jnp.uint32)
-           | (count.astype(jnp.uint32) << _AX_COUNT)
-           | (ucode.astype(jnp.uint32) << _AX_UCODE)
+    aux = (auxcore
            | (pre.astype(jnp.uint32) << _AX_PRE)
-           | ((clean & c1keep).astype(jnp.uint32) << _AX_C1K)
+           | (c1k_bit.astype(jnp.uint32) << _AX_C1K)
            | (succ << _AX_SUCC) | (cwn << _AX_CWN))
 
     def rc_map(x, fill):
@@ -1288,10 +1672,21 @@ def _event_planes(state, tmeta, sweep: SweepResult, codes32, quals32,
     nd2 = jax.lax.cummin(jnp.where(clean2, jnp.int32(l), p_idx), axis=1,
                          reverse=True)
     c1k2 = ((aux2 >> _AX_C1K) & 1) == 1
+    lengths2 = cat([lengths, lengths])
+    # prevval at a prev-defining position is always the OWN count as
+    # stored: count==1 pins ucode==ori, and the level filter keeps the
+    # raw own count whether the own mer is HQ (level 1) or the lone
+    # LQ survivor (level 0) — so the chain value comes straight from
+    # the canonical sweep, no sibling data needed
+    co = (sweep.vals >> 1).astype(jnp.int32)
+    co2 = cat([co, rc_map(co, 0)])
+    if compact_sweep:
+        certain2 = cat([certain, rc_map(certain, False)])
+        ori2 = cat([facts[0], rc_map(facts[0], -2)])
+        c1k2 = _c1k_walk(state, tmeta, clean2, c1k2, certain2,
+                         mfh2, mfl2, mrh2, mrl2, ori2, lengths2, cfg)
     lastc1 = jax.lax.cummax(jnp.where(c1k2, p_idx, jnp.int32(-1)), axis=1)
-    sh = ((aux2 >> _AX_UCODE) & 3) * 7
-    c_u = ((cnt2 >> sh) & 127).astype(jnp.int32)  # counts[ucode] per pos
-    prevval = jnp.take_along_axis(c_u, jnp.clip(lastc1, 0), axis=1)
+    prevval = jnp.take_along_axis(co2, jnp.clip(lastc1, 0), axis=1)
     return EventPlanes(clean2, nd2, cnt2, aux2, lastc1, prevval,
                        mfh2, mfl2, mrh2, mrl2)
 
@@ -1299,7 +1694,9 @@ def _event_planes(state, tmeta, sweep: SweepResult, codes32, quals32,
 def correct_batch(state: ctable.TileState, tmeta: ctable.TileMeta,
                   codes, quals, lengths, cfg: ECConfig,
                   contam=None, ambig_cap: int | None = None,
-                  event_driven: bool = True, pack_cap: int | None = None):
+                  event_driven: bool = True, pack_cap: int | None = None,
+                  compact_sweep: bool | None = None,
+                  drain_levels: int | None = None):
     """Correct a batch of reads on device. `contam` is an optional
     (TableState, TableMeta) k-mer membership set (value word != 0).
     Mirrors error_correct_instance::start (error_correct_reads.cc:
@@ -1319,13 +1716,18 @@ def correct_batch(state: ctable.TileState, tmeta: ctable.TileMeta,
     quals = jnp.asarray(quals)
     uniform, cstate, cmeta, has_contam, ambig_cap = _batch_prologue(
         lengths, codes.shape[0], cfg, contam, ambig_cap)
+    if compact_sweep is None:
+        compact_sweep = compact_sweep_default()
+    if drain_levels is None:
+        drain_levels = drain_levels_default()
     # H2D in the NARROW dtype (int8 codes / uint8 quals are 4x smaller
     # than int32 over the ~170 ms/MB tunnel); _correct_device widens on
     # device. (correct_batch_packed goes further: 0.5 B/base planes.)
     lengths = jnp.asarray(lengths, jnp.int32)
     return _correct_device(state, tmeta, codes, quals, lengths, cfg,
                            cstate, cmeta, has_contam, uniform, ambig_cap,
-                           event_driven, pack_cap)
+                           event_driven, pack_cap, compact_sweep,
+                           drain_levels)
 
 
 def _batch_prologue(lengths, b: int, cfg: ECConfig, contam,
@@ -1362,7 +1764,9 @@ def correct_batch_packed(state: ctable.TileState, tmeta: ctable.TileMeta,
                          packed, cfg: ECConfig,
                          contam=None, ambig_cap: int | None = None,
                          event_driven: bool = True,
-                         pack_cap: int | None = None):
+                         pack_cap: int | None = None,
+                         compact_sweep: bool | None = None,
+                         drain_levels: int | None = None):
     """correct_batch over the bit-packed wire format (io/packing
     .PackedReads): 0.5 B/base crosses the H2D link instead of 2, the
     device widens. Requires the batch to have been packed with
@@ -1371,17 +1775,24 @@ def correct_batch_packed(state: ctable.TileState, tmeta: ctable.TileMeta,
     packed.require_plane(cfg.qual_cutoff)
     uniform, cstate, cmeta, has_contam, ambig_cap = _batch_prologue(
         packed.lengths, packed.n_reads, cfg, contam, ambig_cap)
+    if compact_sweep is None:
+        compact_sweep = compact_sweep_default()
+    if drain_levels is None:
+        drain_levels = drain_levels_default()
     return _correct_device_packed(
         state, tmeta, jnp.asarray(packed.to_wire()), cfg, cstate, cmeta,
         has_contam, uniform, ambig_cap, event_driven, pack_cap,
-        packed.n_reads, packed.length, packed.thresholds)
+        packed.n_reads, packed.length, packed.thresholds, compact_sweep,
+        drain_levels)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 5, 7, 8, 9, 10, 11, 12))
+@functools.partial(jax.jit,
+                   static_argnums=(1, 5, 7, 8, 9, 10, 11, 12, 13, 14))
 def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
                     cstate, cmeta, has_contam: bool, uniform: int | None,
                     ambig_cap: int, event_driven: bool,
-                    pack_cap: int | None = None):
+                    pack_cap: int | None = None,
+                    compact_sweep: bool = True, drain_levels: int = 2):
     """The whole device-side correction of one batch as ONE executable:
     position sweep, anchor scan, rc prologue, event planes, the merged
     extension loop, and the backward epilogue (separate dispatches cost
@@ -1390,17 +1801,21 @@ def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
     quals = quals.astype(jnp.int32)
     return _correct_core(state, tmeta, codes, quals, lengths, cfg,
                          cstate, cmeta, has_contam, uniform, ambig_cap,
-                         event_driven, pack_cap)
+                         event_driven, pack_cap, compact_sweep,
+                         drain_levels)
 
 
 @functools.partial(jax.jit,
-                   static_argnums=(1, 3, 5, 6, 7, 8, 9, 10, 11, 12, 13))
+                   static_argnums=(1, 3, 5, 6, 7, 8, 9, 10, 11, 12, 13,
+                                   14, 15))
 def _correct_device_packed(state, tmeta, wire, cfg: ECConfig,
                            cstate, cmeta,
                            has_contam: bool, uniform: int | None,
                            ambig_cap: int, event_driven: bool,
                            pack_cap: int | None, b: int, length: int,
-                           thresholds: tuple):
+                           thresholds: tuple,
+                           compact_sweep: bool = True,
+                           drain_levels: int = 2):
     """Same executable as _correct_device but fed the bit-packed wire
     format (io/packing.py: 2-bit codes + N mask + the 1-bit
     qual>=cutoff predicate plane — 0.5 B/base over the tunnel instead
@@ -1415,13 +1830,15 @@ def _correct_device_packed(state, tmeta, wire, cfg: ECConfig,
                                        cfg.qual_cutoff)
     return _correct_core(state, tmeta, codes, quals, lengths, cfg,
                          cstate, cmeta, has_contam, uniform, ambig_cap,
-                         event_driven, pack_cap)
+                         event_driven, pack_cap, compact_sweep,
+                         drain_levels)
 
 
 def _correct_core(state, tmeta, codes, quals, lengths, cfg: ECConfig,
                   cstate, cmeta, has_contam: bool, uniform: int | None,
                   ambig_cap: int, event_driven: bool,
-                  pack_cap: int | None = None):
+                  pack_cap: int | None = None,
+                  compact_sweep: bool = True, drain_levels: int = 2):
     b, l = codes.shape
     sweep = _position_sweep(state, tmeta, codes, cfg, cstate, cmeta,
                             has_contam)
@@ -1435,7 +1852,7 @@ def _correct_core(state, tmeta, codes, quals, lengths, cfg: ECConfig,
         prepass_cap = max(256, (b * l) // 16)
         planes = _event_planes(state, tmeta, sweep, codes, quals,
                                lengths, anc.start_off, cfg, uniform,
-                               prepass_cap)
+                               prepass_cap, compact_sweep)
     else:
         planes = None
     w = cfg.effective_window
@@ -1451,7 +1868,8 @@ def _correct_core(state, tmeta, codes, quals, lengths, cfg: ECConfig,
                  cat([anc.prev_count, anc.prev_count]),
                  cat([anc.found, anc.found]),
                  pos0, end2, cat([anc.status, anc.status]),
-                 cstate, cmeta, 1, has_contam, ambig_cap, thresh, planes)
+                 cstate, cmeta, 1, has_contam, ambig_cap, thresh, planes,
+                 drain_levels)
     flog = LogState(res.log.n[:b], res.log.lwin[:b], res.log.pos[:b],
                     res.log.meta[:b])
     blog_rc = LogState(res.log.n[b:], res.log.lwin[b:], res.log.pos[b:],

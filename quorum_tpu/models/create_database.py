@@ -118,7 +118,8 @@ def build_database(
     bstate = ctable.make_tile_build(meta)
     stats = BuildStats()
     reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
-                 qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size)
+                 qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size,
+                 s1_aggregate=ctable.s1_aggregate_default())
 
     # crash safety (ISSUE 4): resume from the last atomic snapshot —
     # the table planes come back exactly as checkpointed, and the
@@ -326,7 +327,7 @@ def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
     stats = BuildStats()
     reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
                  qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size,
-                 devices=S)
+                 devices=S, s1_aggregate=ctable.s1_aggregate_default())
 
     ck = (ckpt_mod.Stage1ShardedCheckpoint(cfg.checkpoint_dir)
           if cfg.checkpoint_dir else None)

@@ -238,6 +238,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                        textfile=opts.metrics_textfile,
                        live=opts.metrics_force,
                        trace_spans=opts.trace_spans,
+                       profile=opts.profile,
                        stage="error_correct", batch_size=opts.batch_size,
                        no_discard=bool(no_discard)) as obs:
         return _run_ec(db_path, sequences, cfg_in, opts, obs.registry,

@@ -142,8 +142,17 @@ def _parse_stream(f, batch_size: int, stride: int = 4096):
 def read_batches(paths: Sequence[str], batch_size: int = 8192
                  ) -> Iterator["object"]:
     """ReadBatch iterator via the native parser, falling back per-file
-    to the Python parser for FASTA/multi-line/oversized inputs."""
+    to the Python parser for FASTA/multi-line/oversized inputs.
+
+    Fault-plan coverage: the `fastq.read` injection site fires once
+    per parsed record here too (batch-granular: all of a batch's
+    records fire before the batch yields, so an `at=N` fault lands on
+    the same record count as the pure-Python parser and a raising
+    action still precedes any consumption of that record downstream).
+    Before round 7 an active plan silently bypassed the native path;
+    now chaos tests exercise the production parser."""
     from ..io import fastq
+    from ..utils import faults
 
     for path in paths:
         if path in ("-", "/dev/fd/0", "/dev/stdin"):
@@ -156,6 +165,9 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192
             try:
                 for codes, quals, lengths, headers, n in _parse_stream(
                         f, batch_size):
+                    if faults.active():
+                        for _ in range(int(n)):
+                            faults.inject("fastq.read")
                     if n < batch_size:  # inert padding rows
                         codes[n:] = -2
                         quals[n:] = 0

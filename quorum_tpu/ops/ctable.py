@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import NamedTuple
 
 import numpy as np
@@ -930,6 +931,106 @@ def _preferred_slot(rlo, rhi):
         .astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Batch-local observation pre-aggregation (round 7)
+# ---------------------------------------------------------------------------
+#
+# At real coverage a batch observes the same canonical mer many times
+# (a 16k x 150 bp batch covers a bacterial genome ~2x by itself), and
+# every duplicate lane pays full gather/claim cost through the
+# write-then-verify rounds even though its scatter-add would have
+# combined for free. The KMC 2 / Gerbil move (PAPERS.md): collapse the
+# duplicates BEFORE they reach the table — sort the batch's canonical
+# mers, segment-sum the hq/lq adds, and insert each distinct mer once
+# with its multiplicity. The rounds then run at the distinct-mer width
+# (~1/dup of the batch), which is where their cost lives.
+
+
+def accel_backend() -> bool:
+    """True when device work runs on a real accelerator. The round-7
+    levers (compacted sweep, drained loop, insert aggregation) trade
+    full-width work for compaction machinery — a winning trade exactly
+    when per-INDEX gather cost and width-proportional per-iteration
+    cost dominate (the measured TPU regime, PERF_NOTES rounds 3-5),
+    and a losing one in the CPU backend's fixed-cost regime (round-7
+    A/B). Keyed off the CONFIGURED default device first: test
+    environments pin CPU while an accelerator plugin stays registered
+    (tests/conftest.py), and default_backend() alone would misreport
+    them."""
+    try:
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return getattr(dev, "platform", "cpu") != "cpu"
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 - conservative on API drift
+        return False
+
+
+def s1_aggregate_default() -> bool:
+    """Round-7 default: stage-1 inserts pre-aggregate batch-local
+    duplicates (the finished table is identical either way — duplicate
+    adds combine in the scatter regardless). The trade — a device sort
+    + segment sums against claim rounds at 1/dup the width — measured
+    a win on BOTH regimes at the production batch size (1.19x on this
+    round's CPU at 16k x 150, PERF_NOTES round 7; the TPU's per-index
+    gather pricing only widens it), so unlike the stage-2 levers this
+    defaults ON everywhere. QUORUM_S1_AGGREGATE=1/0 forces it either
+    way."""
+    raw = os.environ.get("QUORUM_S1_AGGREGATE")
+    if raw is not None and raw != "":
+        return raw != "0"
+    return True
+
+
+def agg_cap_for(n: int) -> int | None:
+    """The static distinct-mer capacity of the aggregated insert for
+    an n-observation batch (None = aggregation off). Half the batch
+    covers the measured intra-batch duplication (~2x at 40x coverage);
+    distinct mers past the cap simply report un-placed and resolve
+    through the per-observation drain path — exact-once either way."""
+    if not s1_aggregate_default():
+        return None
+    return min(n, max(1024, n // 2))
+
+
+def _aggregate_obs_impl(chi, clo, hq_add, lq_add, valid, cap: int):
+    """Batch-local pre-aggregation: one device sort by canonical key,
+    segment sums of the split-quality adds, and compaction of the
+    distinct mers to `cap` lanes. Returns (u_chi, u_clo, u_hq, u_lq,
+    u_valid — the [cap] unique lanes) plus seg_of[n]: each
+    observation's unique slot, or `cap` for invalid / past-cap
+    observations (those stay the caller's to place).
+
+    The sort key sentinel 0xFFFFFFFF can never collide with a valid
+    canonical key: the packed hi word carries at most 2k-32 <= 30 live
+    bits for any k <= 31."""
+    n = chi.shape[0]
+    sent = jnp.uint32(0xFFFFFFFF)
+    key_hi = jnp.where(valid, chi, sent)
+    key_lo = jnp.where(valid, clo, sent)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    shi, slo, sidx = jax.lax.sort((key_hi, key_lo, iota), num_keys=2)
+    svalid = valid[sidx]
+    first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])])
+    segid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    hq_sum = jnp.zeros((n,), jnp.uint32).at[segid].add(hq_add[sidx])
+    lq_sum = jnp.zeros((n,), jnp.uint32).at[segid].add(lq_add[sidx])
+    sfit = first & svalid & (segid < cap)
+    tgt = jnp.where(sfit, segid, cap)
+    u_chi = jnp.zeros((cap,), jnp.uint32).at[tgt].set(shi, mode="drop")
+    u_clo = jnp.zeros((cap,), jnp.uint32).at[tgt].set(slo, mode="drop")
+    u_hq = jnp.zeros((cap,), jnp.uint32).at[tgt].set(
+        hq_sum[segid], mode="drop")
+    u_lq = jnp.zeros((cap,), jnp.uint32).at[tgt].set(
+        lq_sum[segid], mode="drop")
+    u_valid = jnp.zeros((cap,), bool).at[tgt].set(True, mode="drop")
+    seg_of_sorted = jnp.where(svalid & (segid < cap), segid, cap)
+    seg_of = jnp.zeros((n,), jnp.int32).at[sidx].set(seg_of_sorted)
+    return u_chi, u_clo, u_hq, u_lq, u_valid, seg_of
+
+
 def _tile_round_body(bstate: TBuildState, meta: TileMeta, addr, rlo, rhi,
                      p0, hq_add, lq_add, done):
     """One write-then-verify round (see section comment). Plain
@@ -1071,39 +1172,74 @@ def extract_observations_impl(codes_i8, quals_u8, k: int,
     return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
 
 
-def _insert_reads_fused_core(bstate: TBuildState, meta: TileMeta,
-                             codes, quals, qual_thresh: int,
-                             rounds: int, cap: int):
-    chi, clo, qual, valid = extract_observations_impl(
-        codes, quals, meta.k, qual_thresh)
+def _rounds_core(bstate: TBuildState, meta: TileMeta, chi, clo, qual,
+                 valid, rounds: int, cap: int, agg_cap: int | None):
+    """The shared insert body behind every tile entry point: round 1 +
+    compacted verify rounds, optionally over batch-local PRE-AGGREGATED
+    observations (agg_cap != None): the distinct mers insert once with
+    summed adds at agg_cap width, and per-observation done flags map
+    back through the segment ids so the grow/drain contracts are
+    unchanged. Returns (bstate, done[n], n_failed, n_unfit)."""
+    hq_add, lq_add, done = _prep_obs(qual, valid)
+    if agg_cap:
+        u_chi, u_clo, u_hq, u_lq, u_valid, seg_of = _aggregate_obs_impl(
+            chi, clo, hq_add, lq_add, valid, agg_cap)
+        addr, rlo, rhi = tile_key_parts(u_chi, u_clo, meta)
+        p0 = _preferred_slot(rlo, rhi)
+        udone = ~u_valid
+        bstate, udone, _left = _tile_round_body(
+            bstate, meta, addr, rlo, rhi, p0, u_hq, u_lq, udone)
+        ucap = min(agg_cap, max(1024, agg_cap // 8))
+        bstate, udone, n_failed, _uunfit = _tile_compact_rounds_body(
+            bstate, meta, addr, rlo, rhi, p0, u_hq, u_lq, udone,
+            rounds, ucap)
+        covered = seg_of < agg_cap
+        done = ((~valid) | (valid & covered
+                            & udone[jnp.clip(seg_of, 0, agg_cap - 1)]))
+        # past-cap or unresolved observations resolve through the
+        # caller's per-observation drain (exact-once either way)
+        n_unfit = jnp.sum((valid & ~done).astype(jnp.int32))
+        return bstate, done, n_failed, n_unfit
     addr, rlo, rhi = tile_key_parts(chi, clo, meta)
     p0 = _preferred_slot(rlo, rhi)
-    hq_add, lq_add, done = _prep_obs(qual, valid)
     bstate, done, _left = _tile_round_body(bstate, meta, addr, rlo, rhi,
                                            p0, hq_add, lq_add, done)
     bstate, done, n_failed, n_unfit = _tile_compact_rounds_body(
         bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
         rounds, cap)
+    return bstate, done, n_failed, n_unfit
+
+
+def _insert_reads_fused_core(bstate: TBuildState, meta: TileMeta,
+                             codes, quals, qual_thresh: int,
+                             rounds: int, cap: int,
+                             agg_cap: int | None = None):
+    chi, clo, qual, valid = extract_observations_impl(
+        codes, quals, meta.k, qual_thresh)
+    bstate, done, n_failed, n_unfit = _rounds_core(
+        bstate, meta, chi, clo, qual, valid, rounds, cap, agg_cap)
     return bstate, (chi, clo, qual, valid), done, n_failed, n_unfit
 
 
-@functools.partial(jax.jit, static_argnums=(1, 4, 5, 6),
+@functools.partial(jax.jit, static_argnums=(1, 4, 5, 6, 7),
                    donate_argnums=(0,))
 def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
                              codes_i8, quals_u8, qual_thresh: int,
-                             rounds: int, cap: int):
+                             rounds: int, cap: int,
+                             agg_cap: int | None = None):
     """extract + parts + round 1 + compacted rounds as ONE executable
     (each extra dispatch costs ~25-90 ms through the tunnel)."""
     return _insert_reads_fused_core(bstate, meta, codes_i8, quals_u8,
-                                    qual_thresh, rounds, cap)
+                                    qual_thresh, rounds, cap, agg_cap)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 6, 7, 8),
+@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 6, 7, 8, 9),
                    donate_argnums=(0,))
 def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
                                     wire, qual_thresh: int, rounds: int,
                                     cap: int, b: int, length: int,
-                                    thresholds: tuple):
+                                    thresholds: tuple,
+                                    agg_cap: int | None = None):
     """The fused insert fed the bit-packed wire format (io/packing.py:
     2-bit codes + N mask + the 1-bit qual>=thresh plane — 0.5 B/base
     over the tunnel instead of 2, fused into ONE u8 H2D buffer since
@@ -1118,7 +1254,7 @@ def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
     quals = mer.synth_quals_device(hq[int(qual_thresh)], length,
                                    qual_thresh)
     return _insert_reads_fused_core(bstate, meta, codes, quals,
-                                    qual_thresh, rounds, cap)
+                                    qual_thresh, rounds, cap, agg_cap)
 
 
 def _drain_survivors(bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add,
@@ -1150,7 +1286,7 @@ def tile_insert_reads(bstate: TBuildState, meta: TileMeta, codes_i8,
     cap = min(n, max(1024, n // 8))
     bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused(
         bstate, meta, codes_i8, quals_u8, qual_thresh, max_rounds - 1,
-        cap)
+        cap, agg_cap_for(n))
     return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
                               max_rounds, cap, n)
 
@@ -1168,7 +1304,8 @@ def tile_insert_reads_packed(bstate: TBuildState, meta: TileMeta,
     cap = min(n, max(1024, n // 8))
     bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused_packed(
         bstate, meta, jnp.asarray(packed.to_wire()), qual_thresh,
-        max_rounds - 1, cap, b, length, packed.thresholds)
+        max_rounds - 1, cap, b, length, packed.thresholds,
+        agg_cap_for(n))
     return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
                               max_rounds, cap, n)
 
@@ -1197,23 +1334,17 @@ def _tile_parts_jit(meta: TileMeta, khi, klo):
     return addr, rlo, rhi, _preferred_slot(rlo, rhi)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 6, 7), donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnums=(1, 6, 7, 8),
+                   donate_argnums=(0,))
 def _tile_insert_fused(bstate: TBuildState, meta: TileMeta, khi, klo,
-                       qual, valid, rounds: int, cap: int):
+                       qual, valid, rounds: int, cap: int,
+                       agg_cap: int | None = None):
     """parts + prep + round 1 + the first compacted-rounds call as ONE
     executable: each extra dispatch through the tunnel costs ~25-90 ms
     (PERF_NOTES.md), and the old flow paid 3-4 per batch plus a
     mid-path bool() sync."""
-    addr, rlo, rhi = tile_key_parts(khi, klo, meta)
-    p0 = _preferred_slot(rlo, rhi)
-    hq_add, lq_add, done = _prep_obs(qual, valid)
-    bstate, done, _left = _tile_round_body(bstate, meta, addr, rlo, rhi,
-                                           p0, hq_add, lq_add, done)
-    bstate, done, n_failed, n_unfit = _tile_compact_rounds_body(
-        bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add, done,
-        rounds, cap)
-    return bstate, (addr, rlo, rhi, p0, hq_add, lq_add), done, \
-        n_failed, n_unfit
+    return _rounds_core(bstate, meta, khi, klo, qual, valid, rounds,
+                        cap, agg_cap)
 
 
 def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
@@ -1234,14 +1365,18 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
     compacted calls."""
     n = int(khi.shape[0])
     cap = min(n, max(1024, n // 8))
-    bstate, parts, done, n_failed, n_unfit = _tile_insert_fused(
-        bstate, meta, khi, klo, qual, valid, max_rounds - 1, cap)
+    bstate, done, n_failed, n_unfit = _tile_insert_fused(
+        bstate, meta, khi, klo, qual, valid, max_rounds - 1, cap,
+        agg_cap_for(n))
     # ONE scalar D2H for both counters (each sync costs a tunnel
     # round trip)
     n_failed, n_unfit = (int(x) for x in
                          np.asarray(jnp.stack([n_failed, n_unfit])))
     if n_failed == 0 and n_unfit > 0:
-        addr, rlo, rhi, p0, hq_add, lq_add = parts
+        # rare path (aggregation-cap or compaction-cap overflow): the
+        # per-observation parts are recomputed only when needed
+        addr, rlo, rhi, p0 = _tile_parts_jit(meta, khi, klo)
+        hq_add, lq_add, _d0 = _prep_obs(qual, valid)
         bstate, done = _drain_survivors(bstate, meta, addr, rlo, rhi, p0,
                                         hq_add, lq_add, done, max_rounds,
                                         cap, n)
@@ -1364,15 +1499,34 @@ def tile_grow_build(bstate: TBuildState, meta: TileMeta,
     return new_state, new_meta
 
 
+def _canonical_rows(state: TileState, meta: TileMeta) -> TileState:
+    """Within-bucket canonical entry order: occupied entries sorted by
+    (hi, lo), empties last. Slot order inside a bucket is free for
+    lookups but visible in the v4 on-disk layout — sorting here makes
+    the database FILE a pure function of the table CONTENT, so any
+    insertion schedule (aggregated or per-observation, sharded or
+    single-chip) writes byte-identical output."""
+    lo = state.rows[:, 0::2]
+    hi = state.rows[:, 1::2]
+    empty = ((lo & jnp.uint32(meta.max_val)) == 0).astype(jnp.uint32)
+    _e, shi, slo = jax.lax.sort((empty, hi, lo), dimension=1, num_keys=3)
+    rows = jnp.zeros_like(state.rows)
+    rows = rows.at[:, 0::2].set(slo)
+    rows = rows.at[:, 1::2].set(shi)
+    return TileState(rows)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def tile_export_v4(state: TileState, meta: TileMeta, cap: int):
     """Device-side export for the v4 on-disk format (io/db_format):
     per-row occupancy counts (u8, <= TSLOTS by construction) plus the
     compact entries' lo words and the LIVE bytes of their hi words —
-    the bucket address is implied by row-major entry order, and hi
+    the bucket address is implied by row-major entry order (canonical:
+    sorted by key within each bucket — see _canonical_rows), and hi
     carries only rem_high = rem_bits - rlo_bits bits (1 byte at the
     k=24 default instead of 4). Returns (counts u8[rows],
     lo_bytes u8[4*cap], hi_byte_planes u8[hi_bytes, cap], n)."""
+    state = _canonical_rows(state, meta)
     lo = state.rows[:, 0::2]
     hi = state.rows[:, 1::2]
     occ = (lo & jnp.uint32(meta.max_val)) != 0

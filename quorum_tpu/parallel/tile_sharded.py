@@ -231,7 +231,7 @@ def _a2a(x):
 
 def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
                          chi, clo, hq_add, lq_add, cap: int,
-                         rounds: int = 23):
+                         rounds: int = 23, agg_cap: int | None = None):
     """Per-shard body: bucket (key, adds) by owner, exchange, run the
     single-chip write-then-verify rounds on the local slice (GLOBAL
     key parts, localized row index), and route per-lane placed flags
@@ -243,7 +243,27 @@ def _routed_insert_local(bst: ctable.TBuildState, meta: TileShardedMeta,
     lanes just need another exchange pass, NOT a grow); n_recv_placed
     is how many routed observations THIS shard accepted into its
     slice (the per-shard insert counter the telemetry layer
-    reports)."""
+    reports).
+
+    `agg_cap` (the round-7 batch-local pre-aggregation, the sharded
+    twin of ctable._rounds_core's): the shard's observations collapse
+    to distinct mers with summed adds BEFORE the exchange, so both the
+    all_to_all traffic and the claim-round width shrink by the
+    intra-batch duplication factor. Distinct mers past the cap report
+    un-placed and re-route on the caller's next overflow pass."""
+    if agg_cap:
+        valid0 = (hq_add | lq_add) != 0
+        u_chi, u_clo, u_hq, u_lq, u_valid, seg_of = \
+            ctable._aggregate_obs_impl(chi, clo, hq_add, lq_add, valid0,
+                                       agg_cap)
+        bst, u_placed, place_fail, u_over, n_recv = _routed_insert_local(
+            bst, meta, u_chi, u_clo, jnp.where(u_valid, u_hq, 0),
+            jnp.where(u_valid, u_lq, 0), cap, rounds)
+        covered = seg_of < agg_cap
+        placed = (valid0 & covered
+                  & u_placed[jnp.clip(seg_of, 0, agg_cap - 1)])
+        overflow = u_over | jnp.any(valid0 & ~covered)
+        return bst, placed, place_fail, overflow, n_recv
     S = meta.n_shards
     local = meta.local_meta
     n = chi.shape[0]
@@ -327,11 +347,14 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
             codes_i8, quals_u8, meta.k, qual_thresh)
         valid = valid & pending
         n = chi.shape[0]
-        cap = n if S == 1 else max(64, int(n // S * bucket_slack))
+        agg_cap = ctable.agg_cap_for(n)
+        inner_n = agg_cap if agg_cap else n
+        cap = inner_n if S == 1 else max(64,
+                                         int(inner_n // S * bucket_slack))
         hq_add = jnp.where(valid & (q == 1), 1, 0).astype(jnp.uint32)
         lq_add = jnp.where(valid & (q == 0), 1, 0).astype(jnp.uint32)
         bst, placed, place_fail, overflow, n_recv = _routed_insert_local(
-            bst, meta, chi, clo, hq_add, lq_add, cap)
+            bst, meta, chi, clo, hq_add, lq_add, cap, agg_cap=agg_cap)
         full = lax.pmax(place_fail.astype(jnp.int32), AXIS) > 0
         over = lax.pmax(overflow.astype(jnp.int32), AXIS) > 0
         return (bst.tag, bst.hq, bst.lq, full, over, placed & valid,
@@ -379,11 +402,14 @@ def build_step_wire(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
             codes, quals, meta.k, qual_thresh)
         valid = valid & pending
         n = chi.shape[0]
-        cap = n if S == 1 else max(64, int(n // S * bucket_slack))
+        agg_cap = ctable.agg_cap_for(n)
+        inner_n = agg_cap if agg_cap else n
+        cap = inner_n if S == 1 else max(64,
+                                         int(inner_n // S * bucket_slack))
         hq_add = jnp.where(valid & (q == 1), 1, 0).astype(jnp.uint32)
         lq_add = jnp.where(valid & (q == 0), 1, 0).astype(jnp.uint32)
         bst, placed, place_fail, overflow, n_recv = _routed_insert_local(
-            bst, meta, chi, clo, hq_add, lq_add, cap)
+            bst, meta, chi, clo, hq_add, lq_add, cap, agg_cap=agg_cap)
         full = lax.pmax(place_fail.astype(jnp.int32), AXIS) > 0
         over = lax.pmax(overflow.astype(jnp.int32), AXIS) > 0
         return (bst.tag, bst.hq, bst.lq, full, over, placed & valid,
@@ -891,6 +917,8 @@ def correct_step_wire(mesh, cfg: ECConfig, b: int, length: int,
     # (the cap only bounds the ambiguous-lane compaction scratch;
     # overflow falls back to the in-loop probe with identical results)
     ambig_cap = max(256, (2 * (b // S)) // 8)
+    compact_sweep = corrector.compact_sweep_default()
+    drain_levels = corrector.drain_levels_default()
 
     def local_fn(rows, crows, pcodes, nmask, hqp, lengths):
         st = ctable.TileState(rows)
@@ -900,7 +928,7 @@ def correct_step_wire(mesh, cfg: ECConfig, b: int, length: int,
         return corrector._correct_core(
             st, lookup_meta, codes, quals, lengths, cfg,
             ctable.TileState(crows), cmeta, has_contam, None, ambig_cap,
-            True, None)
+            True, None, compact_sweep, drain_levels)
 
     mapped = _shard_map(
         local_fn, mesh=mesh,

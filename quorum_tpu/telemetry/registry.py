@@ -198,9 +198,20 @@ class MetricsRegistry:
         line = json.dumps(obj) + "\n"
         with self._lock:
             if self._events_f is None:
-                self._events_f = open(self.events_path, "w")
-            self._events_f.write(line)
-            self._events_f.flush()
+                # line-journal discipline: an UNBUFFERED binary stream
+                # and exactly one os-level write per complete line,
+                # fsync'd — a hard kill (os._exit fault plans, SIGKILL,
+                # power loss) can land between lines but never inside
+                # one, so a reader never sees a torn last record.
+                # Buffered text IO could flush a line across several
+                # write(2) calls. Events are per-batch at most (and
+                # heartbeats rate-limited), so the fsync is noise.
+                self._events_f = open(self.events_path, "wb", buffering=0)
+            self._events_f.write(line.encode())
+            try:
+                os.fsync(self._events_f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
 
     def add_exporter(self, fn) -> None:
         """Register a live exporter: `fn(reg, final=False)` is called

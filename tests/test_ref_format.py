@@ -288,7 +288,14 @@ def test_v3_still_readable(tmp_path):
         f.write(lo[:n].tobytes())
         f.write(hi[:n].tobytes())
     s3, m3, _h3 = db_format.read_db(p3, to_device=False)
-    np.testing.assert_array_equal(np.asarray(s3.rows), np.asarray(s4.rows))
+    # compare CONTENT, not slot layout: slot order within a bucket is
+    # free (lookups compare all 64 slots), and round 7's v4 export
+    # canonicalizes it while a hand-written v3 keeps device slot order
+    def ents(s, m):
+        return sorted(zip(*(a.tolist() for a in _ct.tile_iterate(s, m))))
+
+    assert ents(s3, m3) == ents(s4, m4)
+    assert len(ents(s3, m3)) == n
 
 
 def test_v4_rejects_corrupt_counts(tmp_path):

@@ -1,0 +1,576 @@
+"""Round 7 (ISSUE 6): the compacted sibling sweep, the lane-draining
+extension loop, stage-1 batch-local insert pre-aggregation, and the
+satellite surfaces (journaled heartbeat JSONL, native-parser fault
+site, driver replay-cache resume, bench A/B gating, span export into
+the profile dir).
+
+The corrector parity chain: the plain lockstep loop is pinned to the
+oracle (tests/test_corrector.py), the event-driven loop to the plain
+loop (tests/test_event_driven.py); here each round-7 lever is pinned
+bit-exact against the path it replaces, closing the chain for the
+production default (compact sweep + drained loop)."""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.io import db_format, packing
+from quorum_tpu.models import corrector
+from quorum_tpu.models.ec_config import ECConfig
+from quorum_tpu.ops import ctable
+from quorum_tpu.utils import faults
+
+from test_event_driven import _assert_same
+
+K, RLEN, B = 9, 48, 512
+
+
+def _build(codes, quals):
+    meta = ctable.TileMeta(k=K, bits=7,
+                           rb_log2=ctable.tile_rb_for(100_000, K, 7))
+    bstate = ctable.make_tile_build(meta)
+    chi, clo, q, valid = ctable.extract_observations_impl(
+        jnp.asarray(codes), jnp.asarray(quals), K, 38)
+    bstate, full, _ = ctable.tile_insert_observations(
+        bstate, meta, chi, clo, q, valid)
+    assert not full
+    return ctable.tile_finalize(bstate, meta), meta
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(11)
+    genome = rng.integers(0, 4, size=1500, dtype=np.int8)
+    starts = rng.integers(0, len(genome) - RLEN, size=B)
+    codes = genome[starts[:, None] + np.arange(RLEN)[None, :]].astype(
+        np.int8)
+    errs = rng.random(codes.shape) < 0.02
+    errs[:32, 18] = True
+    errs[:32, 22] = True  # clustered: tail-stop paths
+    codes = np.where(errs,
+                     (codes + rng.integers(1, 4, size=codes.shape)) % 4,
+                     codes).astype(np.int8)
+    codes[32:48, 25] = -1  # N bases
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[errs] = 68
+    # a low-quality stripe so some own-mers are LQ (candidate class)
+    quals[48:64, 10:20] = 33
+    state, meta = _build(codes, quals)
+    return codes, quals, state, meta
+
+
+def _run(batch, compact, drain, event=True):
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    lengths = jnp.full((B,), RLEN, jnp.int32)
+    return corrector.correct_batch(state, meta, jnp.asarray(codes),
+                                   jnp.asarray(quals), lengths, cfg,
+                                   event_driven=event,
+                                   compact_sweep=compact,
+                                   drain_levels=drain)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 1: compacted sibling sweep
+# ---------------------------------------------------------------------------
+
+def test_compact_sweep_parity(batch):
+    """Compacted sibling sweep vs the full-width sweep, drain
+    isolated off: byte-identical correction."""
+    _assert_same(_run(batch, True, 0), _run(batch, False, 0))
+
+
+def test_compact_sweep_planes_consumed_parity(batch):
+    """The CONSUMED plane surfaces are bit-exact: clean and nd
+    everywhere, cnt/aux at every non-clean (event) position, and the
+    c1keep/prev chain (lastc1/prevval) at every consumption point —
+    the exactness argument behind the count==1 circularity fix."""
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    codes32 = jnp.asarray(codes, jnp.int32)
+    quals32 = jnp.asarray(quals, jnp.int32)
+    lengths = jnp.full((B,), RLEN, jnp.int32)
+    start_off = jnp.full((B,), K + 1, jnp.int32)
+    sweep = corrector._position_sweep(
+        state, meta, codes32, cfg, *corrector._dummy_contam(K), False)
+    cap = max(256, (B * RLEN) // 16)
+    full = corrector._event_planes(state, meta, sweep, codes32, quals32,
+                                   lengths, start_off, cfg, RLEN, cap,
+                                   compact_sweep=False)
+    comp = corrector._event_planes(state, meta, sweep, codes32, quals32,
+                                   lengths, start_off, cfg, RLEN, cap,
+                                   compact_sweep=True)
+    clean_f = np.asarray(full.clean)
+    np.testing.assert_array_equal(clean_f, np.asarray(comp.clean))
+    np.testing.assert_array_equal(np.asarray(full.nd),
+                                  np.asarray(comp.nd))
+    ev = ~clean_f
+    np.testing.assert_array_equal(
+        np.where(ev, np.asarray(full.cnt), 0),
+        np.where(ev, np.asarray(comp.cnt), 0))
+    # aux at events: every consumed bit field (level/count/ucode/pre/
+    # succ/cwn) — mask off the chain's C1K bit, which the compact path
+    # resolves separately
+    m = np.uint32(~(1 << corrector._AX_C1K) & 0xFFFFFFFF)
+    np.testing.assert_array_equal(
+        np.where(ev, np.asarray(full.aux) & m, 0),
+        np.where(ev, np.asarray(comp.aux) & m, 0))
+    # chain at consumption points t: same last prev-definer, or both
+    # below the lowest position the chain can be consumed FROM — the
+    # teleport guard is `lc >= pos` with pos inside t's clean run AND
+    # at/after the frame's extension start (fwd: start_off; rc:
+    # lengths - start_off + k), so smaller values are never read
+    l = clean_f.shape[1]
+    p = np.arange(l)[None, :]
+    ln = np.asarray(lengths)
+    so = np.asarray(start_off)
+    lengths2 = np.concatenate([ln, ln])[:, None]
+    min_pos = np.concatenate([so, ln - so + K])[:, None]
+    nxt_nonclean = np.concatenate(
+        [~clean_f[:, 1:], np.ones((clean_f.shape[0], 1), bool)], axis=1)
+    cp = clean_f & (p < lengths2) & (nxt_nonclean | (p == lengths2 - 1))
+    run_start = np.maximum.accumulate(
+        np.where(~clean_f, p, -1), axis=1) + 1
+    floor = np.maximum(run_start, min_pos)
+    lc_f = np.asarray(full.lastc1)
+    lc_c = np.asarray(comp.lastc1)
+    same = lc_f == lc_c
+    both_dead = (lc_f < floor) & (lc_c < floor)
+    assert np.all(~cp | same | both_dead)
+    pv_f = np.asarray(full.prevval)
+    pv_c = np.asarray(comp.prevval)
+    live = cp & (lc_f >= floor)
+    assert live.any()
+    np.testing.assert_array_equal(np.where(live, pv_f, 0),
+                                  np.where(live, pv_c, 0))
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 2: lane-draining extension loop
+# ---------------------------------------------------------------------------
+
+def test_drain_parity(batch):
+    """Two-level lane draining vs the single-level loop: byte-
+    identical correction. Both sides run the compacted sweep, so the
+    only varying lever is the drain — and both executables are reused
+    from the neighbouring parity tests (compile-budget discipline:
+    tier-1 runs the whole suite under one timeout)."""
+    _assert_same(_run(batch, True, 2), _run(batch, True, 0))
+
+
+def test_production_default_parity_vs_plain(batch):
+    """The full round-7 production default (compact sweep + drained
+    loop) against the oracle-pinned plain lockstep loop."""
+    _assert_same(_run(batch, True, 2), _run(batch, False, 0, event=False))
+
+
+def test_routed_compact_drain_parity(batch, monkeypatch):
+    """The levers under the ROUTED sharded corrector: every lookup is
+    a collective, so the compact sweep's chunk loop, the c1k walk, and
+    the drain levels must all stay in lockstep across shards (their
+    conds pmax). Shard 0 gets clean reads and shard 1 error-heavy ones
+    so per-shard candidate counts, walk depths, and live-lane counts
+    genuinely diverge — a lost pmax here deadlocks or corrupts."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from quorum_tpu.parallel import tile_sharded as ts
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    monkeypatch.setenv("QUORUM_COMPACT_SWEEP", "1")
+    monkeypatch.setenv("QUORUM_DRAIN_LEVELS", "2")
+    codes, quals, state, meta = batch
+    nb = 16
+    c = codes[:nb].copy()
+    q = quals[:nb].copy()
+    c[:nb // 2] = codes[256:256 + nb // 2]  # clean-ish half
+    rng = np.random.default_rng(5)
+    errs = rng.random(c[nb // 2:].shape) < 0.06
+    c[nb // 2:] = np.where(
+        errs, (c[nb // 2:] + rng.integers(1, 4, size=errs.shape)) % 4,
+        c[nb // 2:]).astype(np.int8)
+    lengths = np.full((nb,), RLEN, np.int32)
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    mesh = ts.make_mesh(2)
+    smeta = ts.TileShardedMeta(k=K, bits=7, rb_log2=meta.rb_log2,
+                               n_shards=2)
+    rows = _jax.device_put(state.rows,
+                           NamedSharding(mesh, P(ts.AXIS)))
+    step = ts.correct_step_routed(mesh, smeta, cfg)
+    res = step(ctable.TileState(rows), jnp.asarray(c), jnp.asarray(q),
+               jnp.asarray(lengths))
+    # single-chip reference rides the FULL-batch executable the other
+    # parity tests already compiled (batch composition is unobservable
+    # per lane — caps/stalls are pure delay): embed the 16 reads in a
+    # B-row batch and compare the first 16 rows
+    c512 = codes.copy()
+    q512 = quals.copy()
+    c512[:nb] = c
+    q512[:nb] = q
+    single = corrector.correct_batch(
+        state, meta, jnp.asarray(c512), jnp.asarray(q512),
+        jnp.full((B,), RLEN, jnp.int32), cfg,
+        compact_sweep=True, drain_levels=2)
+    for name in ("out", "start", "end", "status"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, name)),
+            np.asarray(getattr(single, name))[:nb])
+
+
+def test_variable_lengths_compact_drain(batch):
+    """Non-uniform lengths through the gather-path remap with both
+    levers on."""
+    codes, quals, state, meta = batch
+    cfg = ECConfig(k=K, cutoff=4, poisson_dtype="float32")
+    rng = np.random.default_rng(3)
+    lengths = rng.integers(K + 5, RLEN + 1, size=B).astype(np.int32)
+    c = codes.copy()
+    for i, ln in enumerate(lengths):
+        c[i, ln:] = -2
+    a = corrector.correct_batch(state, meta, jnp.asarray(c),
+                                jnp.asarray(quals), jnp.asarray(lengths),
+                                cfg, compact_sweep=True, drain_levels=2)
+    b = corrector.correct_batch(state, meta, jnp.asarray(c),
+                                jnp.asarray(quals), jnp.asarray(lengths),
+                                cfg, compact_sweep=False, drain_levels=0)
+    _assert_same(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 3: stage-1 batch-local pre-aggregation
+# ---------------------------------------------------------------------------
+
+def test_aggregate_obs_unit():
+    """Sort/segment/compact semantics: sums per distinct key, stable
+    mapping, invalid lanes and past-cap keys excluded."""
+    chi = jnp.asarray([5, 3, 5, 3, 5, 9, 7], jnp.uint32)
+    clo = jnp.asarray([1, 2, 1, 2, 1, 4, 6], jnp.uint32)
+    hq = jnp.asarray([1, 0, 1, 1, 0, 1, 1], jnp.uint32)
+    lq = jnp.asarray([0, 1, 0, 0, 1, 0, 0], jnp.uint32)
+    valid = jnp.asarray([1, 1, 1, 1, 1, 1, 0], bool)
+    cap = 4
+    u_chi, u_clo, u_hq, u_lq, u_valid, seg_of = jax.tree_util.tree_map(
+        np.asarray,
+        ctable._aggregate_obs_impl(chi, clo, hq, lq, valid, cap))
+    got = {}
+    for i in range(cap):
+        if u_valid[i]:
+            got[(int(u_chi[i]), int(u_clo[i]))] = (int(u_hq[i]),
+                                                   int(u_lq[i]))
+    assert got == {(3, 2): (1, 1), (5, 1): (2, 1), (9, 4): (1, 0)}
+    # every valid obs maps to the unique lane holding its key; the
+    # invalid lane maps to cap
+    for i, (c_, l_) in enumerate(zip([5, 3, 5, 3, 5, 9, 7],
+                                     [1, 2, 1, 2, 1, 4, 6])):
+        if not bool(valid[i]):
+            assert seg_of[i] == cap
+        else:
+            j = int(seg_of[i])
+            assert j < cap
+            assert (int(u_chi[j]), int(u_clo[j])) == (c_, l_)
+
+
+def test_insert_aggregation_parity(monkeypatch):
+    """Aggregated vs per-observation inserts: identical table CONTENT
+    (counts, quality bits) and — thanks to the canonical v4 export —
+    identical database bytes."""
+    rng = np.random.default_rng(4)
+    codes = rng.integers(0, 4, size=(96, RLEN)).astype(np.int8)
+    codes[:48] = codes[48:]  # heavy intra-batch duplication
+    quals = rng.integers(34, 71, size=codes.shape).astype(np.uint8)
+
+    def build(agg):
+        monkeypatch.setenv("QUORUM_S1_AGGREGATE", "1" if agg else "0")
+        meta = ctable.TileMeta(k=K, bits=7, rb_log2=6)
+        bstate = ctable.make_tile_build(meta)
+        bstate, full, _obs = ctable.tile_insert_reads(
+            bstate, meta, jnp.asarray(codes), jnp.asarray(quals), 38)
+        assert not full
+        return ctable.tile_finalize(bstate, meta), meta
+
+    sa, ma = build(True)
+    sb, mb = build(False)
+    ents = lambda s, m: sorted(zip(*(a.tolist()
+                                     for a in ctable.tile_iterate(s, m))))
+    assert ents(sa, ma) == ents(sb, mb)
+    assert len(ents(sa, ma)) > 0
+
+
+def test_agg_cap_overflow_exact(monkeypatch):
+    """Distinct mers past the aggregation cap resolve through the
+    per-observation drain — same table, just slower."""
+    rng = np.random.default_rng(6)
+    codes = rng.integers(0, 4, size=(64, RLEN)).astype(np.int8)
+    quals = np.full(codes.shape, 70, np.uint8)
+    chi, clo, q, valid = ctable.extract_observations_impl(
+        jnp.asarray(codes), jnp.asarray(quals), K, 38)
+
+    def insert(cap):
+        meta = ctable.TileMeta(k=K, bits=7, rb_log2=6)
+        bstate = ctable.make_tile_build(meta)
+        if cap is None:
+            monkeypatch.setenv("QUORUM_S1_AGGREGATE", "0")
+        else:
+            monkeypatch.setenv("QUORUM_S1_AGGREGATE", "1")
+            monkeypatch.setattr(ctable, "agg_cap_for", lambda n: cap)
+        bstate, full, _ = ctable.tile_insert_observations(
+            bstate, meta, chi, clo, q, valid)
+        assert not full
+        return ctable.tile_finalize(bstate, meta), meta
+
+    tiny, mt = insert(32)  # far fewer than the distinct-mer count
+    monkeypatch.undo()
+    base, mbs = insert(None)
+    ents = lambda s, m: sorted(zip(*(a.tolist()
+                                     for a in ctable.tile_iterate(s, m))))
+    assert ents(tiny, mt) == ents(base, mbs)
+
+
+def test_sharded_aggregated_build_parity(monkeypatch):
+    """The sharded step wire with pre-aggregation on: identical table
+    content to the single-chip aggregated build (the per-shard
+    aggregate runs BEFORE the owner exchange)."""
+    import jax as _jax
+    from quorum_tpu.parallel import tile_sharded as ts
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    monkeypatch.setenv("QUORUM_S1_AGGREGATE", "1")
+    rng = np.random.default_rng(8)
+    codes = rng.integers(0, 4, size=(64, RLEN)).astype(np.int8)
+    codes[:32] = codes[32:]  # duplication across the shard split
+    quals = rng.integers(34, 71, size=codes.shape).astype(np.uint8)
+    mesh = ts.make_mesh(2)
+    smeta = ts.TileShardedMeta(k=K, bits=7, rb_log2=7, n_shards=2)
+    sstate, smeta = ts.build_database_tile_sharded(
+        [(jnp.asarray(codes), jnp.asarray(quals))], mesh, smeta, 38)
+    gstate, gmeta = ts.gather_table(sstate, smeta)
+    meta1 = ctable.TileMeta(k=K, bits=7, rb_log2=7)
+    b1 = ctable.make_tile_build(meta1)
+    b1, full, _ = ctable.tile_insert_reads(
+        b1, meta1, jnp.asarray(codes), jnp.asarray(quals), 38)
+    assert not full
+    s1 = ctable.tile_finalize(b1, meta1)
+    ents = lambda s, m: sorted(zip(*(a.tolist()
+                                     for a in ctable.tile_iterate(s, m))))
+    assert ents(gstate, gmeta) == ents(s1, meta1)
+
+
+def test_v4_export_canonical_order(tmp_path):
+    """Two tables with identical content but different slot placement
+    (reversed insertion order) write byte-identical v4 databases."""
+    rng = np.random.default_rng(9)
+    n = 300
+    khi = jnp.zeros((n,), jnp.uint32)
+    klo = jnp.asarray(rng.choice(4 ** K, size=n, replace=False)
+                      .astype(np.uint32))
+
+    def build(order):
+        meta = ctable.TileMeta(k=K, bits=7, rb_log2=4)  # crowded rows
+        bstate = ctable.make_tile_build(meta)
+        q = jnp.ones((n,), jnp.int32)
+        valid = jnp.ones((n,), bool)
+        bstate, full, _ = ctable.tile_insert_observations(
+            bstate, meta, khi[order], klo[order], q[order], valid[order])
+        assert not full
+        return ctable.tile_finalize(bstate, meta), meta
+
+    fwd = jnp.arange(n)
+    sa, ma = build(fwd)
+    sb, mb = build(fwd[::-1])
+    pa = tmp_path / "a.jf"
+    pb = tmp_path / "b.jf"
+    db_format.write_db(str(pa), sa, ma, n_entries=n)
+    db_format.write_db(str(pb), sb, mb, n_entries=n)
+    payload = lambda p: p.read_bytes().split(b"\n", 1)[1]
+    assert payload(pa) == payload(pb)
+    # and the canonical file round-trips to the same content
+    st, mt, _ = db_format.read_db(str(pa), to_device=False)
+    got = sorted(zip(*(a.tolist() for a in ctable.tile_iterate(st, mt))))
+    want = sorted(zip(np.asarray(khi).tolist(),
+                      np.asarray(klo).tolist()))
+    assert [g[:2] for g in got] == want
+
+
+# ---------------------------------------------------------------------------
+# Satellite: journaled --metrics JSONL heartbeats
+# ---------------------------------------------------------------------------
+
+def test_events_jsonl_survives_hard_kill(tmp_path):
+    """A hard os._exit mid-run (the utils/faults.py hard-exit site)
+    must leave the heartbeat JSONL with COMPLETE lines only — the
+    line-journal write discipline in MetricsRegistry.event."""
+    from test_error_correct_cli import make_dataset
+    reads_path, _r, _q = make_dataset(tmp_path, n_reads=240)
+    mpath = str(tmp_path / "m.json")
+    plan = json.dumps([{"site": "stage1.insert", "batch": 2,
+                        "action": "exit", "code": 47}])
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               QUORUM_FAULT_PLAN=plan,
+               JAX_COMPILATION_CACHE_DIR="/tmp/quorum_tpu_test_jaxcache")
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.create_database",
+         "-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+         "--batch-size", "64", "-o", str(tmp_path / "db.jf"),
+         "--metrics", mpath, "--metrics-interval", "0.000001",
+         reads_path],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 47, res.stderr
+    events = tmp_path / "m.events.jsonl"
+    assert events.exists()
+    raw = events.read_bytes()
+    assert raw, "no events landed before the kill"
+    assert raw.endswith(b"\n"), "torn last line after hard kill"
+    lines = raw.decode().splitlines()
+    assert any(json.loads(ln).get("event") == "heartbeat"
+               for ln in lines)
+    for ln in lines:
+        json.loads(ln)  # every line complete
+
+
+# ---------------------------------------------------------------------------
+# Satellite: native-parser fastq.read fault site
+# ---------------------------------------------------------------------------
+
+def test_native_parser_carries_fault_site(tmp_path, monkeypatch):
+    """An active fault plan no longer bypasses the C++ parser: the
+    fastq.read site fires per record on the native path too."""
+    from quorum_tpu.io import fastq
+    from quorum_tpu.native import binding
+    if not binding.available():
+        pytest.skip("native parser not built")
+    p = tmp_path / "r.fastq"
+    with open(p, "w") as f:
+        for i in range(10):
+            f.write(f"@r{i}\nACGTACGTAC\n+\nIIIIIIIIII\n")
+
+    def no_python_parse(*a, **kw):  # pragma: no cover - guard
+        raise AssertionError("pure-Python parser used despite native")
+
+    monkeypatch.setattr(fastq, "iter_records", no_python_parse)
+    faults.install(faults.FaultPlan.parse(
+        [{"site": "fastq.read", "at": 3, "action": "io_error"}]))
+    try:
+        with pytest.raises(OSError):
+            list(fastq.read_batches([str(p)], batch_size=4))
+    finally:
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: driver replay-cache checkpoint across --resume
+# ---------------------------------------------------------------------------
+
+def test_replay_cache_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    from quorum_tpu.io import fastq
+    codes = rng.integers(0, 4, size=(8, 20)).astype(np.int8)
+    quals = rng.integers(40, 70, size=codes.shape).astype(np.uint8)
+    lengths = np.full((8,), 20, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths,
+                            thresholds=(64,)).compact()
+    batch = fastq.ReadBatch(codes=codes, quals=None, lengths=lengths,
+                            headers=[f"r{i}" for i in range(8)], n=8)
+    ident = {"inputs": ["x.fastq"], "batch_size": 8,
+             "qual_cutoff": 64, "on_bad_read": "abort"}
+    store = ckpt_mod.ReplayCache(str(tmp_path / "ck"))
+    w = store.start(ident, 1 << 30)
+    w.add(batch, pk)
+    assert store.load(ident) is None  # no manifest yet = no commit
+    assert w.finish()
+    rd = store.load(ident)
+    assert rd is not None and rd.n_batches == 1
+    (b2, pk2), = list(rd.batches())
+    np.testing.assert_array_equal(b2.codes, codes)
+    assert b2.headers == batch.headers and b2.n == 8
+    np.testing.assert_array_equal(pk2.to_wire(), pk.to_wire())
+    assert pk2.n_reads == 8 and 64 in pk2.hq
+    # identity mismatch refuses (falls back to the disk parse)
+    assert store.load(dict(ident, batch_size=16)) is None
+    # over-budget capture aborts and removes itself
+    w = store.start(ident, 1)
+    w.add(batch, pk)
+    assert not w.finish()
+    assert store.load(ident) is None
+
+
+def test_driver_resume_replays_without_reparse(tmp_path, monkeypatch):
+    """Kill stage 2, resume the driver: stage 1's database is reused
+    AND the reads replay from the on-disk capture — no FASTQ re-parse
+    (before round 7 only the stage outputs resumed)."""
+    from test_error_correct_cli import make_dataset
+    from quorum_tpu.cli import quorum as quorum_cli
+    monkeypatch.chdir(tmp_path)
+    reads_path, _r, _q = make_dataset(tmp_path)
+    ckdir = str(tmp_path / "ck")
+
+    ref_prefix = str(tmp_path / "ref")
+    rc = quorum_cli.main(["-s", "64k", "-k", "13", "-p", ref_prefix,
+                          "--batch-size", "64", reads_path])
+    assert rc == 0
+
+    prefix = str(tmp_path / "qc")
+    plan = json.dumps([{"site": "stage2.correct", "batch": 0,
+                        "action": "error"}])
+    args = ["-s", "64k", "-k", "13", "-p", prefix, "--batch-size", "64",
+            "--checkpoint-dir", ckdir]
+    rc = quorum_cli.main(args + ["--fault-plan", plan, reads_path])
+    assert rc == 1
+    # the capture committed when stage 1 drained the shared producer
+    store = ckpt_mod.ReplayCache(ckdir)
+    assert store.manifest() is not None
+
+    # resume: any re-parse attempt explodes
+    import quorum_tpu.models.create_database as cdb_mod
+    import quorum_tpu.models.error_correct as ec_mod
+
+    def no_reparse(*a, **kw):  # pragma: no cover - guard
+        raise AssertionError("resumed driver re-parsed the FASTQ")
+
+    monkeypatch.setattr(quorum_cli.fastq, "read_batches", no_reparse)
+    monkeypatch.setattr(cdb_mod.fastq, "read_batches", no_reparse)
+    monkeypatch.setattr(ec_mod.fastq, "read_batches", no_reparse)
+    rc = quorum_cli.main(args + ["--resume", "--fault-plan", "",
+                                 reads_path])
+    assert rc == 0
+    assert open(prefix + ".fa").read() == open(ref_prefix + ".fa").read()
+    assert open(prefix + ".log").read() == open(ref_prefix + ".log").read()
+    # success clears the (sizeable) capture
+    assert store.manifest() is None
+
+
+# ---------------------------------------------------------------------------
+# Satellite: BENCH-style gating + span export into the profile dir
+# ---------------------------------------------------------------------------
+
+def test_metrics_check_require_metric(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import metrics_check
+    p = tmp_path / "bench.json"
+    p.write_text('{"metric": "ab_stage1_insert", "speedup": 1.5}\n')
+    assert metrics_check.main(
+        ["--require-metric", "ab_stage1_insert", str(p), "-q"]) == 0
+    assert metrics_check.main(
+        ["--require-metric", "ab_stage2_device", str(p), "-q"]) == 1
+
+
+def test_span_twin_lands_in_profile_dir(tmp_path):
+    from quorum_tpu.cli.observability import observability
+    prof = tmp_path / "prof"
+    spans = str(tmp_path / "spans.jsonl")
+    with observability(trace_spans=spans, profile=str(prof)) as obs:
+        with obs.tracer.span("work", reads=1):
+            pass
+    twin = prof / "spans.trace.json"
+    assert twin.exists()
+    doc = json.loads(twin.read_text())
+    assert any(ev["name"] == "work" for ev in doc["traceEvents"])

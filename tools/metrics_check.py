@@ -197,6 +197,37 @@ def _check_with_serve_names(path: str) -> list[str]:
     return problems
 
 
+def _check_bench_required(path: str, required: list[str]) -> list[str]:
+    """BENCH-style gating (--require-metric): the file must be a valid
+    line-oriented artifact AND carry at least one bench metric line
+    for every required name — so CI fails loudly when a freshly
+    produced bench document silently lost its headline (a truncated
+    run emits valid-but-incomplete output)."""
+    import json
+    errs = []
+    seen = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue  # check_file already reports bad lines
+                if isinstance(obj, dict) and isinstance(
+                        obj.get("metric"), str):
+                    seen.add(obj["metric"])
+    except OSError as e:
+        return [str(e)]
+    for name in required:
+        if name not in seen:
+            errs.append(f"bench document missing required metric "
+                        f"{name!r} (has {sorted(seen)})")
+    return errs
+
+
 def _check_prom(path: str) -> list[str]:
     try:
         with open(path) as f:
@@ -216,11 +247,30 @@ def main(argv=None) -> int:
     p.add_argument("--prom", action="store_true",
                    help="Lint FILEs as Prometheus text exposition "
                         "format (--metrics-textfile output)")
+    p.add_argument("--require-metric", action="append", default=[],
+                   metavar="NAME",
+                   help="Additionally require every FILE (a BENCH-"
+                        "style metric-line document) to carry at "
+                        "least one line with this metric name; "
+                        "repeatable — ci/tier1.sh gates the fresh "
+                        "bench A/B document this way")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="Suppress per-file OK lines")
     args = p.parse_args(argv)
 
-    check = _check_prom if args.prom else _check_with_serve_names
+    if args.prom and args.require_metric:
+        # --require-metric names bench metric lines, which a
+        # Prometheus textfile cannot carry — combining them would
+        # silently drop the requirement
+        p.error("--require-metric cannot be combined with --prom")
+    if args.prom:
+        check = _check_prom
+    elif args.require_metric:
+        def check(path, _req=args.require_metric):
+            return (_check_with_serve_names(path)
+                    + _check_bench_required(path, _req))
+    else:
+        check = _check_with_serve_names
     bad = 0
     for path in args.files:
         problems = check(path)
