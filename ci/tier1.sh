@@ -25,11 +25,20 @@
 # tools/metrics_check.py --require-metric, so CI validates a BENCH
 # document the same way it validates the stage/serve docs.
 #
+# ISSUE 7 adds the serve-resilience gate: a short seeded chaos soak
+# (tools/chaos_soak.py, fixed seed, bounded wall time) driving a live
+# quorum-serve through watchdog hang containment, health flip/heal,
+# hedging, hot /reload with rollback, per-client quotas, and a
+# randomized fault storm — its final metrics document (including the
+# resilience feature counters) and its /metrics scrape are gated
+# through tools/metrics_check.py (--prom for the scrape).
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
 #        SKIP_MULTICHIP_SMOKE=1  skips the 2-device mesh gate.
 #        SKIP_BENCH_AB=1      skips the bench A/B gate.
+#        SKIP_CHAOS_SOAK=1    skips the serve-resilience chaos gate.
 set -o pipefail
 set -u
 
@@ -153,9 +162,36 @@ else
     fi
 fi
 
+chaos_rc=0
+if [ "${SKIP_CHAOS_SOAK:-0}" = "1" ]; then
+    echo "ci/tier1.sh: chaos soak skipped (SKIP_CHAOS_SOAK=1)"
+else
+    # the serve-resilience gate (ISSUE 7): seeded, bounded wall time;
+    # same shared compile cache so the first real step's lazy
+    # compiles stay well under the watchdog budget
+    echo "== seeded chaos soak =="
+    CHAOS_DIR=$(mktemp -d /tmp/chaos_soak.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "$CHAOS_DIR"' EXIT
+    timeout -k 10 780 env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/chaos_soak.py --seed 7 \
+        --out-dir "$CHAOS_DIR" || chaos_rc=$?
+    if [ "$chaos_rc" -eq 0 ]; then
+        echo "== metrics_check gates (chaos) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$CHAOS_DIR/chaos_metrics.json" || chaos_rc=1
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py --prom \
+            "$CHAOS_DIR/chaos_scrape.prom" || chaos_rc=1
+    fi
+    if [ "$chaos_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: chaos-soak gate FAILED (rc=$chaos_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
 if [ "$multichip_rc" -ne 0 ]; then exit "$multichip_rc"; fi
 if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
+if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

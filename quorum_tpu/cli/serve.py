@@ -97,6 +97,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Comma-separated read lengths to pre-compile "
                         "before listening (one device step per "
                         "length bucket)")
+    # resilience surface (ISSUE 7)
+    p.add_argument("--step-timeout-ms", metavar="ms", type=float,
+                   default=0,
+                   help="Engine-step watchdog: a device step "
+                        "exceeding this budget fails only its batch "
+                        "and the warm engine is rebuilt (DB reload + "
+                        "per-bucket recompile, engine_restarts_total)"
+                        " instead of wedging the process. Must "
+                        "comfortably exceed the worst warm step AND "
+                        "any cold compile not pre-paid by "
+                        "--warmup-lengths (default 0 = off)")
+    p.add_argument("--max-hedges", metavar="n", type=int, default=8,
+                   help="When a failed batch bisects ambiguously, "
+                        "re-run up to n surviving requests solo per "
+                        "failed batch (hedges_total) so an innocent "
+                        "batchmate never eats a 500 (default 8; "
+                        "0 = off)")
+    p.add_argument("--quota-rps", metavar="r", type=float, default=0,
+                   help="Per-client token-bucket quota: r requests/s "
+                        "per X-Quorum-Client identity, 429 + "
+                        "Retry-After past it (quota_rejections_total)"
+                        ". Requests without the header are not "
+                        "quota-limited (default 0 = off)")
+    p.add_argument("--quota-burst", metavar="n", type=float, default=0,
+                   help="Token-bucket capacity per client (default "
+                        "0 = max(1, --quota-rps))")
+    p.add_argument("--interactive-weight", metavar="w", type=int,
+                   default=4,
+                   help="Priority lanes: pop w interactive requests "
+                        "(X-Quorum-Priority: interactive, the "
+                        "default lane) for every bulk one while both "
+                        "lanes hold work (default 4)")
+    p.add_argument("--no-reload", action="store_true",
+                   help="Disable POST /reload (hot DB/contaminant/"
+                        "config swap); it answers 501")
     # observability (same surface as the other CLIs; --metrics
     # writes the final document on drain)
     add_observability_args(p, metrics=True)
@@ -163,31 +198,113 @@ def main(argv=None) -> int:
         return rc
 
 
-def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
-    from ..serve import CorrectionEngine, CorrectionServer, DynamicBatcher
-
-    reg = obs.registry
-    engine = CorrectionEngine(
-        args.db, cutoff=args.cutoff, qual_cutoff=qual_cutoff,
+def _make_engine(args, qual_cutoff: int, reg, tracer,
+                 db: str | None = None, **over):
+    """Construct a CorrectionEngine from the CLI flags, with optional
+    reload-time overrides (`db`, `contaminant`, `cutoff`). Looked up
+    through the package attribute so tests can stub the engine."""
+    from .. import serve as serve_pkg
+    return serve_pkg.CorrectionEngine(
+        db or args.db,
+        cutoff=over.get("cutoff", args.cutoff),
+        qual_cutoff=qual_cutoff,
         skip=args.skip, good=args.good, anchor_count=args.anchor_count,
         min_count=args.min_count, window=args.window, error=args.error,
         homo_trim=args.homo_trim, trim_contaminant=args.trim_contaminant,
-        no_discard=args.no_discard, contaminant=args.contaminant,
+        no_discard=args.no_discard,
+        contaminant=over.get("contaminant", args.contaminant),
         apriori_error_rate=args.apriori_error_rate,
         poisson_threshold=args.poisson_threshold, no_mmap=args.no_mmap,
-        rows=args.max_batch, registry=reg, tracer=obs.tracer)
+        rows=args.max_batch, registry=reg, tracer=tracer)
+
+
+def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
+    from ..io import db_format
+    from ..serve import (CorrectionServer, DynamicBatcher,
+                         TokenBucketQuota)
+
+    reg = obs.registry
+    engine = _make_engine(args, qual_cutoff, reg, obs.tracer)
     if warmup_lengths:
         vlog("Warming ", len(warmup_lengths), " length buckets")
         engine.warmup(warmup_lengths)
+
+    # the config actually serving: starts at the boot flags, advanced
+    # by every successful /reload — the watchdog's rebuild must
+    # reproduce the RELOADED config, not silently revert to boot
+    effective = {"db": args.db, "over": {}}
+
+    def _engine_factory(old):
+        """Watchdog rebuild: the EFFECTIVE db/config (boot flags plus
+        any /reload overrides), re-warmed to the hung engine's length
+        buckets so the replacement answers the next request without a
+        cold compile. `warm_lengths` is a lock-free snapshot — the
+        hung step may hold the old engine's lock forever."""
+        eng = _make_engine(args, qual_cutoff, reg, obs.tracer,
+                           db=effective["db"], **effective["over"])
+        eng.warmup(getattr(old, "warm_lengths", ()) or warmup_lengths)
+        return eng
+
     batcher = DynamicBatcher(
         engine, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
         queue_requests=args.queue_requests,
         max_consecutive_failures=args.max_consecutive_failures,
+        step_timeout_ms=args.step_timeout_ms or None,
+        engine_factory=_engine_factory,
+        max_hedges=args.max_hedges,
+        interactive_weight=args.interactive_weight,
         registry=reg)
-    server = CorrectionServer(batcher, host=args.host, port=args.port,
-                              deadline_ms=args.deadline_ms, registry=reg,
-                              drain_grace_s=args.drain_grace_s)
+
+    def _engine_builder(params: dict):
+        """POST /reload: validate the new DB with the PR-4 header/
+        k/bits reuse check BEFORE building, then return a warm engine
+        for the batcher to swap in. Any raise here rolls the reload
+        back (the server never swaps)."""
+        cur = batcher.current_engine()
+        db = params.get("db") or getattr(cur, "db_path", args.db)
+        header = db_format.read_header(db)  # raises on corrupt/foreign
+        cfg = getattr(cur, "cfg", None)
+        meta = getattr(cur, "meta", None)
+        if cfg is not None and meta is not None:
+            if (header.get("key_len") != 2 * cfg.k
+                    or header.get("bits") != meta.bits):
+                raise ValueError(
+                    f"reload refused: {db} is k="
+                    f"{header.get('key_len', 0) // 2}/bits="
+                    f"{header.get('bits')} but the serving engine is "
+                    f"k={cfg.k}/bits={meta.bits}")
+        over = dict(effective["over"])
+        over.update({k: params[k] for k in ("contaminant", "cutoff")
+                     if k in params})
+        eng = _make_engine(args, qual_cutoff, reg, obs.tracer,
+                           db=db, **over)
+        eng.warmup(getattr(cur, "warm_lengths", ()) or warmup_lengths)
+        # the build succeeded, so the server WILL swap it in (the
+        # engine's rows always match --max-batch): a later watchdog
+        # rebuild must reproduce this config
+        effective["db"] = db
+        effective["over"] = over
+        return eng
+
+    quota = None
+    if args.quota_rps and args.quota_rps > 0:
+        quota = TokenBucketQuota(args.quota_rps,
+                                 burst=args.quota_burst or None)
+    # meta declares the enabled resilience features so metrics_check
+    # can require their counters in the final document
+    reg.set_meta(max_hedges=args.max_hedges)
+    if args.step_timeout_ms:
+        reg.set_meta(step_timeout_ms=args.step_timeout_ms)
+    if quota is not None:
+        reg.set_meta(quota_rps=args.quota_rps)
+    if not args.no_reload:
+        reg.set_meta(reload=True)
+    server = CorrectionServer(
+        batcher, host=args.host, port=args.port,
+        deadline_ms=args.deadline_ms, registry=reg,
+        drain_grace_s=args.drain_grace_s, quota=quota,
+        engine_builder=None if args.no_reload else _engine_builder)
 
     def _sigterm(_signum, _frame):
         vlog("SIGTERM: draining")

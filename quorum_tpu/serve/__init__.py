@@ -18,19 +18,25 @@ k-mer counters in PAPERS.md):
   in, corrected FASTA out, byte-identical to the offline CLI),
   `/healthz`, the live `/metrics` exposition on the same registry,
   admission control (full queue -> 429 + Retry-After), per-request
-  deadlines, and graceful drain on SIGTERM / `POST /quiesce`.
+  deadlines, hot `POST /reload` (atomic engine swap with rollback),
+  and graceful drain on SIGTERM / `POST /quiesce`.
+* `admission.py` — TokenBucketQuota: per-client token buckets keyed
+  on the `X-Quorum-Client` header, so overload degrades by policy
+  (429 the greedy client) instead of queue order.
 * `client.py`  — a minimal stdlib client plus the
   `quorum-serve-bench` closed-loop load generator.
 
 The console entry point is `quorum-serve` (cli/serve.py).
 """
 
-from .batcher import (DeadlineExceeded, DynamicBatcher, Draining,
-                      QueueFull)
+from .admission import TokenBucketQuota
+from .batcher import (PRIORITIES, DeadlineExceeded, Draining,
+                      DynamicBatcher, EngineStepTimeout, QueueFull)
 from .engine import CorrectionEngine
 from .server import CorrectionServer
 
 __all__ = [
     "CorrectionEngine", "DynamicBatcher", "CorrectionServer",
-    "QueueFull", "Draining", "DeadlineExceeded",
+    "QueueFull", "Draining", "DeadlineExceeded", "EngineStepTimeout",
+    "TokenBucketQuota", "PRIORITIES",
 ]

@@ -14,6 +14,14 @@ whose deadline already passed, packs up to `max_batch` reads into one
 engine step, and demuxes each request's slice of the results back
 through its Future.
 
+Priority lanes (ISSUE 7): requests are admitted into one of two FIFO
+lanes — `interactive` or `bulk` (the `X-Quorum-Priority` header at
+the HTTP layer). The dispatcher pops them with a weighted scheme:
+when both lanes hold work, `interactive_weight` interactive pops are
+taken for every bulk pop, so a bulk backlog cannot starve interactive
+traffic while bulk still drains at a guaranteed floor. One capacity
+bound (`queue_requests`) covers both lanes.
+
 Telemetry mirrors the host pipeline's vocabulary: a `queue_depth`
 high-water gauge (set_max), a `queue_wait_us` histogram
 (admission -> dispatch), `batch_reads` + the dispatch/wait split from
@@ -21,18 +29,35 @@ the engine, and request outcome counters
 (`requests_accepted/_rejected_queue_full/_deadline_exceeded/_failed`
 /`_completed`).
 
-Fault isolation (ISSUE 4): a device-step exception fails ONLY that
-batch's futures (the HTTP layer maps them to 500) while the
-dispatcher keeps running; a failed multi-request batch is
-bisect-retried once so a single poisoned request doesn't take its
-batchmates down with it (`batch_bisections`); after
-`max_consecutive_failures` engine-step failures in a row the batcher
-reports unhealthy and `/healthz` answers 503, so a load balancer
-ejects the replica instead of the process dying silently
-(`engine_step_failures`, `consecutive_failures`). And ANY dispatcher
-exit path — clean drain or a bug in the dispatch loop itself — fails
-every queued future immediately instead of stranding clients until
-their deadline.
+Fault containment (ISSUEs 4 + 7):
+
+* A device-step exception fails ONLY that batch's futures (the HTTP
+  layer maps them to 500) while the dispatcher keeps running.
+* A failed multi-request batch is bisect-retried once
+  (`batch_bisections`); a half that fails AGAIN with more than one
+  request aboard is *hedged* — its requests re-run solo, bounded by
+  `max_hedges` per failed batch (`hedges_total`), so an innocent
+  batchmate never eats a 500 for a poisoned neighbor and its answer
+  stays byte-identical to the offline CLI.
+* The engine-step **watchdog** (`step_timeout_ms`): each device step
+  runs under a monitor thread; a step that exceeds the budget — a
+  wedged compile or hung device — is abandoned (`EngineStepTimeout`
+  fails only that batch), and the dispatcher rebuilds a warm engine
+  through `engine_factory` (DB reload + per-bucket recompile,
+  `engine_restarts_total`) instead of wedging the process forever.
+* After `max_consecutive_failures` engine-step failures in a row the
+  batcher reports unhealthy and `/healthz` answers 503, so a load
+  balancer ejects the replica (`engine_step_failures`,
+  `consecutive_failures`); any success heals the streak.
+* ANY dispatcher exit path — clean drain or a bug in the dispatch
+  loop itself — fails every queued future immediately instead of
+  stranding clients until their deadline.
+
+Engine swaps (`swap_engine`) are how both the watchdog restart and
+the server's hot `POST /reload` take effect: the dispatcher captures
+the engine once per step attempt, so a batch already on the device
+finishes on the OLD engine while every later step uses the new one;
+the `engine_generation` gauge stamps which generation is serving.
 """
 
 from __future__ import annotations
@@ -44,6 +69,8 @@ from concurrent.futures import Future
 
 from ..telemetry import NULL
 from ..utils.vlog import vlog
+
+PRIORITIES = ("interactive", "bulk")
 
 
 class QueueFull(Exception):
@@ -62,6 +89,12 @@ class Draining(Exception):
 class DeadlineExceeded(Exception):
     """The request's deadline passed before its batch dispatched
     (504)."""
+
+
+class EngineStepTimeout(RuntimeError):
+    """The watchdog abandoned a device step that exceeded
+    `step_timeout_ms` (the HTTP layer maps it to 500; the engine is
+    rebuilt underneath)."""
 
 
 def _deliver_exception(fut: Future, err: BaseException) -> bool:
@@ -91,18 +124,22 @@ class _Request:
 
 
 class DynamicBatcher:
-    """One dispatcher thread over a bounded deque of requests.
+    """One dispatcher thread over two bounded priority lanes.
 
     `max_batch` is also the engine's fixed row capacity; requests
     larger than `max_batch` reads are corrected across several device
     steps within one dispatch (their Future still resolves once, with
     the full result). `queue_requests` bounds ADMITTED requests not
-    yet dispatched — in-flight device work doesn't count against it.
+    yet dispatched, across both lanes — in-flight device work doesn't
+    count against it.
     """
 
     def __init__(self, engine, max_batch: int | None = None,
                  max_wait_ms: float = 5.0, queue_requests: int = 64,
                  max_consecutive_failures: int = 0,
+                 step_timeout_ms: float | None = None,
+                 engine_factory=None, max_hedges: int = 8,
+                 interactive_weight: int = 4,
                  registry=NULL):
         self.engine = engine
         self.max_batch = int(max_batch or engine.rows)
@@ -114,25 +151,56 @@ class DynamicBatcher:
         self.queue_requests = int(queue_requests)
         # 0 = never flip unhealthy (the CLI default is 5)
         self.max_consecutive_failures = int(max_consecutive_failures)
+        self.step_timeout_s = (float(step_timeout_ms) / 1000.0
+                               if step_timeout_ms else None)
+        # the watchdog's rebuild gets its own (larger) budget: DB
+        # reload + per-bucket recompile is legitimately slower than
+        # one step, but a wedged rebuild must not re-wedge the
+        # dispatcher (tests shrink this)
+        self.rebuild_timeout_s = (max(4 * self.step_timeout_s, 60.0)
+                                  if self.step_timeout_s else None)
+        # called as engine_factory(hung_engine) after a watchdog fire;
+        # must return a fresh warm engine (the CLI rebuilds from the
+        # same flags and re-pays the hung engine's length buckets)
+        self.engine_factory = engine_factory
+        self.max_hedges = max(0, int(max_hedges))
+        self.interactive_weight = max(1, int(interactive_weight))
         self.registry = registry
-        self._q: collections.deque[_Request] = collections.deque()
+        self._lanes: dict[str, collections.deque[_Request]] = {
+            p: collections.deque() for p in PRIORITIES}
+        self._pop_seq = 0
+        self._generation = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._draining = False
         self._closed = False
         self._dead = False  # dispatcher exited (drain or death)
         self._consecutive_failures = 0
+        # feature counters exist from setup (value 0 counts): a serve
+        # metrics document must show the watchdog/hedging surface even
+        # before the first fault (tools/metrics_check.py requires the
+        # names when meta declares the feature)
+        if self.max_hedges > 0:
+            registry.counter("hedges_total")
+        if self.step_timeout_s is not None:
+            registry.counter("engine_restarts_total")
+            registry.counter("engine_step_timeouts")
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="quorum-serve-dispatch",
                                         daemon=True)
         self._thread.start()
 
     # -- admission --------------------------------------------------------
-    def submit(self, records, deadline_s: float | None = None) -> Future:
-        """Enqueue one request (list of (header, seq, qual) records).
-        Returns a Future resolving to the per-read (fa, log) list.
-        Raises QueueFull (429) or Draining (503) at admission; an
-        expired deadline resolves the Future with DeadlineExceeded."""
+    def submit(self, records, deadline_s: float | None = None,
+               priority: str = "interactive") -> Future:
+        """Enqueue one request (list of (header, seq, qual) records)
+        into the `priority` lane. Returns a Future resolving to the
+        per-read (fa, log) list. Raises QueueFull (429) or Draining
+        (503) at admission; an expired deadline resolves the Future
+        with DeadlineExceeded."""
+        if priority not in self._lanes:
+            raise ValueError(f"unknown priority {priority!r} "
+                             f"(one of {PRIORITIES})")
         fut: Future = Future()
         deadline = (time.perf_counter() + deadline_s
                     if deadline_s is not None else None)
@@ -142,13 +210,13 @@ class DynamicBatcher:
             if self._draining or self._dead:
                 reg.counter("requests_rejected_draining").inc()
                 raise Draining()
-            if len(self._q) >= self.queue_requests:
+            if self._qlen_locked() >= self.queue_requests:
                 reg.counter("requests_rejected_queue_full").inc()
                 raise QueueFull(retry_after=self._retry_after_locked())
             reg.counter("requests_accepted").inc()
             if req.records:
-                self._q.append(req)
-                reg.gauge("queue_depth").set_max(len(self._q))
+                self._lanes[priority].append(req)
+                reg.gauge("queue_depth").set_max(self._qlen_locked())
                 self._work.notify()
         if not req.records:
             # nothing to correct: resolve immediately (never
@@ -166,10 +234,59 @@ class DynamicBatcher:
         batches = max(1, self.queue_requests)
         return max(1.0, round(batches * self.max_wait_s, 1))
 
+    def _qlen_locked(self) -> int:
+        return sum(len(q) for q in self._lanes.values())
+
+    def _reads_locked(self) -> int:
+        return sum(len(r.records) for q in self._lanes.values()
+                   for r in q)
+
+    def _first_enq_locked(self) -> float:
+        return min(q[0].t_enq for q in self._lanes.values() if q)
+
     @property
     def depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._qlen_locked()
+
+    # -- engine swap ------------------------------------------------------
+    def current_engine(self):
+        with self._lock:
+            return self.engine
+
+    @property
+    def generation(self) -> int:
+        """How many engine swaps (watchdog restarts + hot reloads)
+        this batcher has served across; 0 = the boot engine."""
+        with self._lock:
+            return self._generation
+
+    def swap_engine(self, new_engine,
+                    expected_generation: int | None = None) -> int:
+        """Atomically install `new_engine` for every step dispatched
+        from now on; a step already in flight finishes on the old
+        engine (the dispatcher captured its reference). Returns the
+        new generation number (also the `engine_generation` gauge).
+
+        `expected_generation` makes the swap conditional: if another
+        swap landed since the caller captured that generation, this
+        one is dropped and -1 returned — the watchdog's rebuild uses
+        it so a concurrent /reload's fresher engine is never
+        clobbered by a stale-config replacement."""
+        rows = int(getattr(new_engine, "rows", self.max_batch))
+        if rows < self.max_batch:
+            raise ValueError(
+                f"replacement engine rows {rows} below max_batch "
+                f"{self.max_batch}")
+        with self._lock:
+            if (expected_generation is not None
+                    and self._generation != expected_generation):
+                return -1
+            self.engine = new_engine
+            self._generation += 1
+            gen = self._generation
+        self.registry.gauge("engine_generation").set(gen)
+        return gen
 
     # -- health -----------------------------------------------------------
     @property
@@ -201,17 +318,37 @@ class DynamicBatcher:
         return not self._thread.is_alive()
 
     # -- dispatch ---------------------------------------------------------
+    def _next_lane_locked(self) -> str | None:
+        """The weighted pop: interactive unless it is empty, or the
+        pop sequence owes bulk its guaranteed slot (one of every
+        `interactive_weight + 1` pops while both lanes hold work)."""
+        inter = self._lanes["interactive"]
+        bulk = self._lanes["bulk"]
+        if not inter and not bulk:
+            return None
+        if not inter:
+            return "bulk"
+        if not bulk:
+            return "interactive"
+        w = self.interactive_weight
+        return "bulk" if self._pop_seq % (w + 1) == w else "interactive"
+
     def _take_locked(self) -> list[_Request]:
-        """Pop admitted requests up to max_batch reads. Always pops at
-        least one request (an oversize request dispatches alone and is
-        chunked across device steps)."""
+        """Pop admitted requests up to max_batch reads, in weighted
+        lane order. Always pops at least one request (an oversize
+        request dispatches alone and is chunked across device
+        steps)."""
         taken: list[_Request] = []
         reads = 0
-        while self._q:
-            nxt = len(self._q[0].records)
+        while True:
+            lane = self._next_lane_locked()
+            if lane is None:
+                break
+            nxt = len(self._lanes[lane][0].records)
             if taken and reads + nxt > self.max_batch:
                 break
-            req = self._q.popleft()
+            req = self._lanes[lane].popleft()
+            self._pop_seq += 1
             taken.append(req)
             reads += nxt
         return taken
@@ -237,9 +374,9 @@ class DynamicBatcher:
         reg = self.registry
         while True:
             with self._work:
-                while not self._q and not self._draining:
+                while not self._qlen_locked() and not self._draining:
                     self._work.wait(timeout=0.1)
-                if not self._q:
+                if not self._qlen_locked():
                     if self._draining:
                         self._closed = True
                         return
@@ -247,18 +384,16 @@ class DynamicBatcher:
                 # coalescing window: the FIRST waiter's arrival starts
                 # the clock; stop early once a full batch is waiting
                 if self.max_wait_s > 0:
-                    first = self._q[0]
-                    give_up = first.t_enq + self.max_wait_s
+                    give_up = self._first_enq_locked() + self.max_wait_s
                     while (not self._draining
-                           and sum(len(r.records) for r in self._q)
-                           < self.max_batch):
+                           and self._reads_locked() < self.max_batch):
                         left = give_up - time.perf_counter()
                         if left <= 0:
                             break
                         self._work.wait(timeout=left)
-                        if not self._q:
+                        if not self._qlen_locked():
                             break
-                    if not self._q:
+                    if not self._qlen_locked():
                         continue
                 taken = self._take_locked()
             try:
@@ -279,8 +414,9 @@ class DynamicBatcher:
         err = RuntimeError("quorum-serve dispatcher exited")
         with self._lock:
             self._dead = True
-            stranded = list(self._q)
-            self._q.clear()
+            stranded = [r for q in self._lanes.values() for r in q]
+            for q in self._lanes.values():
+                q.clear()
         n = 0
         for req in stranded:
             if _deliver_exception(req.future, err):
@@ -301,9 +437,104 @@ class DynamicBatcher:
             reg.counter("engine_step_failures").inc()
         reg.gauge("consecutive_failures").set(n)
 
+    # -- the watchdog -----------------------------------------------------
+    def _timed_step(self, eng, records) -> list:
+        """One engine step under the watchdog. Without a timeout this
+        is a direct call; with one, the step runs on a monitor thread
+        and a budget overrun abandons it (the hung thread is daemon
+        and holds only the OLD engine's lock), rebuilds the engine,
+        and raises EngineStepTimeout for this batch."""
+        if self.step_timeout_s is None:
+            return eng.step(records)
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["res"] = eng.step(records)
+            except BaseException as e:  # noqa: BLE001 - relayed below
+                box["err"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, name="quorum-serve-step",
+                             daemon=True)
+        t.start()
+        if not done.wait(self.step_timeout_s):
+            self._handle_step_timeout(eng)
+            raise EngineStepTimeout(
+                f"engine step exceeded {self.step_timeout_s * 1e3:.0f}"
+                " ms (watchdog)")
+        err = box.get("err")
+        if err is not None:
+            raise err
+        return box["res"]
+
+    def _handle_step_timeout(self, hung_engine) -> None:
+        """A step blew its budget: count it and rebuild a warm engine
+        so the NEXT step runs on a live one. The rebuild ITSELF runs
+        under a (larger) budget — if the device/compiler is wedged
+        enough that even a fresh engine's warmup hangs, the dispatcher
+        must not re-wedge on the cure: the rebuild thread is abandoned
+        too, the old engine stays, every later step times out, the
+        failure streak grows, and /healthz flips — the correct signal
+        when a rebuild cannot save the replica."""
+        reg = self.registry
+        reg.counter("engine_step_timeouts").inc()
+        vlog("quorum-serve watchdog: abandoning engine step after ",
+             self.step_timeout_s, " s")
+        if self.engine_factory is None:
+            return
+        gen_at_timeout = self.generation
+        box: dict = {}
+        done = threading.Event()
+
+        def build():
+            try:
+                box["eng"] = self.engine_factory(hung_engine)
+            except BaseException as e:  # noqa: BLE001 - relayed below
+                box["err"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=build, name="quorum-serve-rebuild",
+                             daemon=True)
+        t.start()
+        if not done.wait(self.rebuild_timeout_s):
+            reg.counter("engine_rebuild_failures").inc()
+            vlog("quorum-serve watchdog: engine rebuild itself wedged;"
+                 " keeping the old engine")
+            return
+        try:
+            if "err" in box:
+                raise box["err"]
+            # conditional on the generation seen at timeout: a
+            # /reload that landed while this rebuild ran installed a
+            # FRESHER engine (possibly a new config) — never clobber
+            # it with this stale-config replacement
+            gen = self.swap_engine(box["eng"],
+                                   expected_generation=gen_at_timeout)
+        except BaseException as e:  # noqa: BLE001 - best-effort
+            reg.counter("engine_rebuild_failures").inc()
+            vlog("quorum-serve watchdog: engine rebuild failed: ", e)
+            return
+        if gen < 0:
+            vlog("quorum-serve watchdog: rebuild superseded by a "
+                 "concurrent engine swap; dropping it")
+            return
+        reg.counter("engine_restarts_total").inc()
+        reg.event("engine_restart", generation=gen)
+        vlog("quorum-serve watchdog: warm engine rebuilt "
+             "(generation ", gen, ")")
+
     def _step_requests(self, reqs: list[_Request]) -> list[list]:
         """One coalesced engine pass over `reqs`: flatten, step in
-        max_batch chunks, return each request's slice of results."""
+        max_batch chunks, return each request's slice of results.
+        Captures the CURRENT engine once per attempt — a bisect or
+        hedge retry after a watchdog restart runs on the rebuilt
+        engine, while a batch already stepping finishes on the old
+        one."""
+        eng = self.current_engine()
         flat: list = []
         slices: list[tuple[int, int]] = []
         for req in reqs:
@@ -312,7 +543,7 @@ class DynamicBatcher:
         results: list = []
         for off in range(0, len(flat), self.max_batch):
             results.extend(
-                self.engine.step(flat[off:off + self.max_batch]))
+                self._timed_step(eng, flat[off:off + self.max_batch]))
         return [results[s:e] for s, e in slices]
 
     def _resolve(self, reqs: list[_Request], per_req: list[list],
@@ -355,13 +586,16 @@ class DynamicBatcher:
 
     def _bisect_retry(self, live: list[_Request], reg) -> None:
         """A failed multi-request batch is bisect-retried ONCE: each
-        half runs its own engine pass, so a single poisoned request
-        fails only its half's futures (with one more split it would
-        be exactly isolated; one level keeps worst-case extra device
-        steps at two) while innocent batchmates still get answers. A
-        half succeeding also proves the device is alive, resetting
+        half runs its own engine pass, so a poisoned request drags
+        down at most its half. A half that fails AGAIN with several
+        requests aboard is ambiguous — those requests are *hedged*:
+        re-run solo (bounded by `max_hedges` per failed batch,
+        `hedges_total`), so an innocent batchmate never eats a 500 and
+        its response stays byte-identical to the offline CLI. A half
+        or hedge succeeding also proves the device is alive, resetting
         the consecutive-failure streak."""
         reg.counter("batch_bisections").inc()
+        budget = self.max_hedges
         mid = (len(live) + 1) // 2
         for half in (live[:mid], live[mid:]):
             if not half:
@@ -370,9 +604,44 @@ class DynamicBatcher:
                 per_req = self._step_requests(half)
             except BaseException as e:  # noqa: BLE001 - per half
                 self._record_step(reg, ok=False)
-                reg.counter("requests_failed").inc(len(half))
-                for req in half:
-                    _deliver_exception(req.future, e)
+                # no solo hedging after a watchdog timeout: each hedge
+                # of a deterministically-hanging request would cost a
+                # FULL step-timeout + engine rebuild with the
+                # dispatcher blocked — fail the ambiguous half fast
+                # and let the health flip handle a truly wedged device
+                if (len(half) > 1 and budget > 0
+                        and not isinstance(e, EngineStepTimeout)):
+                    budget = self._hedge_solo(half, e, reg, budget)
+                else:
+                    reg.counter("requests_failed").inc(len(half))
+                    for req in half:
+                        _deliver_exception(req.future, e)
                 continue
             self._record_step(reg, ok=True)
             self._resolve(half, per_req, reg)
+
+    def _hedge_solo(self, half: list[_Request], err: BaseException,
+                    reg, budget: int) -> int:
+        """Re-run each request of an ambiguously-failed half alone,
+        spending one hedge per solo step; requests past the budget
+        fail with the half's original error. Returns the remaining
+        budget."""
+        for i, req in enumerate(half):
+            if budget <= 0:
+                rest = half[i:]
+                reg.counter("requests_failed").inc(len(rest))
+                for r in rest:
+                    _deliver_exception(r.future, err)
+                return 0
+            budget -= 1
+            reg.counter("hedges_total").inc()
+            try:
+                per_req = self._step_requests([req])
+            except BaseException as e:  # noqa: BLE001 - per request
+                self._record_step(reg, ok=False)
+                reg.counter("requests_failed").inc(1)
+                _deliver_exception(req.future, e)
+                continue
+            self._record_step(reg, ok=True)
+            self._resolve([req], per_req, reg)
+        return budget

@@ -64,15 +64,23 @@ class ServeClient:
 
     def correct(self, fastq_text: str | bytes,
                 deadline_ms: float | None = None,
-                want_log: bool = False) -> ServeResult:
+                want_log: bool = False,
+                priority: str | None = None,
+                client_id: str | None = None) -> ServeResult:
         """POST /correct. Returns a ServeResult whatever the status —
-        callers branch on `.status` (200/429/503/504/...)."""
+        callers branch on `.status` (200/429/503/504/...).
+        `priority` stamps X-Quorum-Priority (interactive|bulk) and
+        `client_id` stamps X-Quorum-Client (the quota identity)."""
         body = (fastq_text.encode()
                 if isinstance(fastq_text, str) else fastq_text)
         path = "/correct" + ("?log=1" if want_log else "")
         headers = {"Content-Type": "text/plain"}
         if deadline_ms is not None:
             headers["X-Quorum-Deadline-Ms"] = str(deadline_ms)
+        if priority is not None:
+            headers["X-Quorum-Priority"] = priority
+        if client_id is not None:
+            headers["X-Quorum-Client"] = client_id
         resp, data = self._request("POST", path, body, headers)
         if resp.status != 200:
             retry = float(resp.headers.get("Retry-After", 0) or 0)
@@ -94,6 +102,51 @@ class ServeClient:
             reads=int(resp.headers.get("X-Quorum-Reads", 0)),
             corrected=int(resp.headers.get("X-Quorum-Corrected", 0)),
             skipped=int(resp.headers.get("X-Quorum-Skipped", 0)))
+
+    def correct_with_retry(self, fastq_text: str | bytes,
+                           deadline_ms: float | None = None,
+                           want_log: bool = False,
+                           max_attempts: int = 6,
+                           base_backoff_s: float = 0.1,
+                           max_backoff_s: float = 5.0,
+                           retry_statuses=(429, 503),
+                           priority: str | None = None,
+                           client_id: str | None = None,
+                           sleep=time.sleep) -> ServeResult:
+        """`correct()` with polite retries on 429/503: the server's
+        already-parsed Retry-After is honored when present, combined
+        with capped-exponential backoff (the sleep is the larger of
+        the two, capped at `max_backoff_s`) so a missing or tiny hint
+        still backs off, and a huge one cannot stall the client past
+        the cap. Any other status (200, 400, 500, 504, ...) returns
+        immediately; after `max_attempts` the last rejection is
+        returned as-is. `sleep` is injectable for tests."""
+        backoff = base_backoff_s
+        res = self.correct(fastq_text, deadline_ms=deadline_ms,
+                           want_log=want_log, priority=priority,
+                           client_id=client_id)
+        for _ in range(max_attempts - 1):
+            if res.status not in retry_statuses:
+                return res
+            sleep(min(max(res.retry_after_s, backoff), max_backoff_s))
+            backoff = min(backoff * 2, max_backoff_s)
+            res = self.correct(fastq_text, deadline_ms=deadline_ms,
+                               want_log=want_log, priority=priority,
+                               client_id=client_id)
+        return res
+
+    def reload(self, params: dict | None = None) -> tuple[int, dict]:
+        """POST /reload — (status_code, body). 200 carries the new
+        engine generation; any failure left the old engine serving."""
+        body = json.dumps(params or {}).encode()
+        resp, data = self._request(
+            "POST", "/reload", body,
+            {"Content-Type": "application/json"})
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {}
+        return resp.status, doc
 
     def healthz(self) -> dict:
         resp, data = self._request("GET", "/healthz")
@@ -159,6 +212,17 @@ def bench_main(argv=None) -> int:
     p.add_argument("--retry-429", action="store_true",
                    help="Honor Retry-After and retry rejected "
                         "requests instead of counting and moving on")
+    p.add_argument("--retry", action="store_true",
+                   help="Use ServeClient.correct_with_retry: retry "
+                        "429 AND 503 with Retry-After honored under "
+                        "capped-exponential backoff (supersedes "
+                        "--retry-429)")
+    p.add_argument("--priority", choices=("interactive", "bulk"),
+                   default=None,
+                   help="Stamp X-Quorum-Priority on every request")
+    p.add_argument("--client-id", default=None,
+                   help="Stamp X-Quorum-Client on every request "
+                        "(the quota identity)")
     p.add_argument("sequence", help="FASTQ/FASTA file to draw reads from")
     args = p.parse_args(argv)
 
@@ -201,8 +265,16 @@ def bench_main(argv=None) -> int:
             while True:
                 t0 = time.perf_counter()
                 try:
-                    res = client.correct(body,
-                                         deadline_ms=args.deadline_ms)
+                    if args.retry:
+                        res = client.correct_with_retry(
+                            body, deadline_ms=args.deadline_ms,
+                            priority=args.priority,
+                            client_id=args.client_id)
+                    else:
+                        res = client.correct(
+                            body, deadline_ms=args.deadline_ms,
+                            priority=args.priority,
+                            client_id=args.client_id)
                 except OSError:
                     with lock:
                         errors[0] += 1
@@ -213,7 +285,8 @@ def bench_main(argv=None) -> int:
                     if res.status == 200:
                         lat.append(dt)
                         reads_done[0] += res.reads
-                if res.status == 429 and args.retry_429:
+                if (res.status == 429 and args.retry_429
+                        and not args.retry):
                     time.sleep(max(0.05, res.retry_after_s))
                     continue
                 break

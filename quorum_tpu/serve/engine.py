@@ -72,6 +72,7 @@ class CorrectionEngine:
         if rows < 1:
             raise ValueError("rows must be >= 1")
         self.rows = int(rows)
+        self.db_path = db_path
         self.registry = registry
         self.tracer = tracer
         opts = ECOptions(cutoff=cutoff,
@@ -103,6 +104,11 @@ class CorrectionEngine:
                 contaminant, self.cfg.k)
         self._lock = threading.Lock()
         self._shapes: set[tuple[int, int]] = set()
+        # immutable snapshot of the column widths seen, reassigned
+        # whole under the lock: `warm_lengths` must be readable
+        # WITHOUT the lock — the watchdog's rebuild consults it while
+        # a wedged step may still hold the lock forever
+        self._warm: tuple[int, ...] = ()
         registry.gauge("cutoff").set(cutoff)
         registry.set_meta(db=db_path, rows=self.rows, cutoff=cutoff)
 
@@ -138,6 +144,8 @@ class CorrectionEngine:
                 # warmup exists to move compiles before traffic, and
                 # the counter must show them.
                 self._shapes.add(shape)
+                self._warm = tuple(sorted(
+                    {cols for _rows, cols in self._shapes}))
                 self.registry.counter("engine_compiles").inc()
                 vlog("Engine compiling shape ", shape)
             t0 = time.perf_counter()
@@ -208,3 +216,15 @@ class CorrectionEngine:
         """Distinct device shapes compiled so far (mirrors the
         `engine_compiles` counter even when telemetry is off)."""
         return len(self._shapes)
+
+    @property
+    def warm_lengths(self) -> tuple[int, ...]:
+        """The column widths (length buckets) this engine has stepped
+        — feed them to a replacement engine's `warmup()` so a
+        watchdog rebuild or hot reload re-pays exactly the compiles
+        the old engine had (a read of length == bucket width maps to
+        the same bucket, fastq.bucket_for). Deliberately lock-free
+        (atomic read of an immutable snapshot): the rebuild path reads
+        it off an engine whose wedged step may hold the lock
+        forever."""
+        return self._warm

@@ -24,6 +24,32 @@ daemon threads, no dependencies), with the service semantics on top:
 * ``POST /quiesce`` — graceful drain: stop admitting, flush in-flight
   batches, then release ``serve_until_drained()`` so the CLI writes
   the final metrics document and exits. SIGTERM takes the same path.
+
+Resilience surface (ISSUE 7):
+
+* **Priority lanes** — the ``X-Quorum-Priority`` header routes a
+  request into the batcher's ``interactive`` (default) or ``bulk``
+  lane; the dispatcher's weighted pop keeps interactive traffic
+  flowing under a bulk backlog.
+* **Per-client quotas** — with a `TokenBucketQuota` attached, each
+  ``X-Quorum-Client`` identity is charged one token per request;
+  an empty bucket answers 429 + Retry-After and
+  ``quota_rejections_total`` before the request touches the shared
+  queue. Requests without the header are not quota-limited (see
+  serve/admission.py).
+* ``POST /reload`` — hot swap of DB/contaminant/config on a running
+  server: the JSON body (``{"db": ..., "contaminant": ...,
+  "cutoff": ...}``, all optional) goes to the CLI-provided
+  ``engine_builder``, which validates the new DB's header/k/bits
+  BEFORE building (the PR-4 reuse check) and returns a warm engine;
+  only then is the batcher's engine swapped (``reload_total``, new
+  ``engine_generation``). ANY failure — unreadable header, k/bits
+  mismatch, build error, injected ``serve.reload`` fault — rolls
+  back: the old engine keeps answering, byte-identical
+  (``reload_failures_total``). In-flight batches finish on the old
+  engine either way.
+* The ``serve.admit`` fault site fires at admission (chaos harness);
+  an injected fault maps to a retryable 503.
 """
 
 from __future__ import annotations
@@ -36,8 +62,9 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from ..io import fastq
 from ..telemetry import NULL
 from ..telemetry import export as export_mod
+from ..utils import faults
 from ..utils.vlog import vlog
-from .batcher import DeadlineExceeded, Draining, QueueFull
+from .batcher import PRIORITIES, DeadlineExceeded, Draining, QueueFull
 
 # a request body bigger than this is refused with 413 before parsing
 # (an unbounded read would let one client exhaust host memory)
@@ -64,18 +91,33 @@ class CorrectionServer:
 
     def __init__(self, batcher, host: str = "127.0.0.1", port: int = 0,
                  deadline_ms: float | None = None, registry=NULL,
-                 drain_grace_s: float = 30.0):
+                 drain_grace_s: float = 30.0, quota=None,
+                 engine_builder=None):
         import http.server
 
         self.batcher = batcher
         self.registry = registry
         self.deadline_ms = deadline_ms
         self.drain_grace_s = drain_grace_s
+        # admission quota (serve/admission.TokenBucketQuota or None)
+        self.quota = quota
+        # engine_builder(params: dict) -> warm engine; validates the
+        # new DB before building. None = /reload answers 501.
+        self.engine_builder = engine_builder
+        self._reload_lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._drained = threading.Event()
         self._drain_started = threading.Event()
         self._requests = 0
         self._req_lock = threading.Lock()
+        # feature counters exist from setup so the final metrics
+        # document carries the surface at value 0 (metrics_check
+        # requires the names when meta declares the feature)
+        if quota is not None:
+            registry.counter("quota_rejections_total")
+        if engine_builder is not None:
+            registry.counter("reload_total")
+            registry.counter("reload_failures_total")
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -102,6 +144,8 @@ class CorrectionServer:
                 route, _, query = self.path.partition("?")
                 if route == "/correct":
                     outer._handle_correct(self, query)
+                elif route == "/reload":
+                    outer._handle_reload(self)
                 elif route == "/quiesce":
                     vlog("Quiesce requested over HTTP")
                     outer.initiate_drain()
@@ -151,6 +195,27 @@ class CorrectionServer:
         vlog("quorum-serve listening on ", host, ":", self.port)
 
     # -- request handling -------------------------------------------------
+    @staticmethod
+    def _read_body(handler, limit: int) -> bytes | None:
+        """Validate Content-Length and read the request body. A bad
+        or negative length (negative means read-to-EOF — it would
+        block the handler thread forever on keep-alive) answers 400,
+        an oversized one 413; both kill the keep-alive connection
+        (body left unread) and return None."""
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0:
+            handler.close_connection = True  # body left unread
+            handler._reply_json(400, {"error": "bad Content-Length"})
+            return None
+        if length > limit:
+            handler.close_connection = True  # body left unread
+            handler._reply_json(413, {"error": "request body too large"})
+            return None
+        return handler.rfile.read(length)
+
     def _handle_correct(self, handler, query: str) -> None:
         reg = self.registry
         params = _parse_query(query)
@@ -161,17 +226,35 @@ class CorrectionServer:
             handler.close_connection = True  # body left unread
             handler._reply_json(411, {"error": "Content-Length required"})
             return
+        body = self._read_body(handler, MAX_BODY_BYTES)
+        if body is None:
+            return
+        priority = (handler.headers.get("X-Quorum-Priority")
+                    or "interactive").strip().lower()
+        if priority not in PRIORITIES:
+            handler._reply_json(
+                400, {"error": f"bad X-Quorum-Priority {priority!r} "
+                               f"(one of {PRIORITIES})"})
+            return
         try:
-            length = int(handler.headers.get("Content-Length", 0))
-        except ValueError:
-            handler.close_connection = True  # body left unread
-            handler._reply_json(400, {"error": "bad Content-Length"})
+            # chaos-harness site: a plan can fail the Nth admission to
+            # prove overload/fault handling at the door (utils/faults)
+            faults.inject("serve.admit")
+        except Exception as e:  # noqa: BLE001 - injected faults only
+            reg.counter("requests_rejected_admission").inc()
+            handler._reply_json(503, {"error": str(e)},
+                                extra={"Retry-After": 1})
             return
-        if length > MAX_BODY_BYTES:
-            handler.close_connection = True  # body left unread
-            handler._reply_json(413, {"error": "request body too large"})
-            return
-        body = handler.rfile.read(length)
+        client_id = handler.headers.get("X-Quorum-Client")
+        if self.quota is not None and client_id:
+            ok, retry_in = self.quota.admit(client_id)
+            if not ok:
+                reg.counter("quota_rejections_total").inc()
+                handler._reply_json(
+                    429, {"error": "client quota exceeded",
+                          "retry_after_s": round(retry_in, 3)},
+                    extra={"Retry-After": max(1, int(retry_in + 0.999))})
+                return
         deadline_ms = self.deadline_ms
         hdr_deadline = (params.get("deadline_ms")
                         or handler.headers.get("X-Quorum-Deadline-Ms"))
@@ -192,7 +275,8 @@ class CorrectionServer:
             fut = self.batcher.submit(
                 records,
                 deadline_s=(deadline_ms / 1000.0
-                            if deadline_ms is not None else None))
+                            if deadline_ms is not None else None),
+                priority=priority)
         except QueueFull as e:
             handler._reply_json(
                 429, {"error": "queue full",
@@ -242,6 +326,62 @@ class CorrectionServer:
             handler._reply(200, fa.encode(), "text/plain; charset=utf-8",
                            extra=counts)
 
+    # -- hot reload --------------------------------------------------------
+    def _handle_reload(self, handler) -> None:
+        """POST /reload: build a replacement engine from the JSON body
+        (via the CLI's engine_builder, which validates the new DB's
+        header/k/bits first), then atomically swap it in. The swap is
+        all-or-nothing: any failure before it leaves the OLD engine
+        serving byte-identical answers (rollback is the absence of the
+        swap), and in-flight batches finish on the old engine even
+        when it succeeds (the dispatcher captures the engine per step
+        attempt)."""
+        reg = self.registry
+        # a reload body is a small JSON object — 1 MiB is generous
+        body = self._read_body(handler, 1 << 20)
+        if body is None:
+            return
+        try:
+            params = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            handler._reply_json(400, {"error": f"bad JSON body: {e}"})
+            return
+        if not isinstance(params, dict):
+            handler._reply_json(400, {"error": "reload body must be "
+                                               "a JSON object"})
+            return
+        if self.engine_builder is None:
+            handler._reply_json(501, {"error": "reload not configured"})
+            return
+        if self._drain_started.is_set():
+            handler._reply_json(503, {"error": "draining"},
+                                extra={"Retry-After": 1})
+            return
+        with self._reload_lock:
+            old_gen = self.batcher.generation
+            try:
+                # chaos-harness site: an injected fault between
+                # validation and swap must roll back (utils/faults.py)
+                faults.inject("serve.reload")
+                new_engine = self.engine_builder(params)
+                gen = self.batcher.swap_engine(new_engine)
+            except Exception as e:  # noqa: BLE001 - rollback umbrella
+                reg.counter("reload_failures_total").inc()
+                reg.event("reload_failed", error=str(e),
+                          generation=old_gen)
+                vlog("Reload failed (old engine keeps serving): ", e)
+                code = 400 if isinstance(e, ValueError) else 500
+                handler._reply_json(code, {"error": str(e),
+                                           "rolled_back": True,
+                                           "generation": old_gen})
+                return
+        reg.counter("reload_total").inc()
+        reg.set_meta(engine_generation=gen)
+        reg.event("reload", old_generation=old_gen, new_generation=gen)
+        vlog("Reloaded engine: generation ", old_gen, " -> ", gen)
+        handler._reply_json(200, {"status": "reloaded",
+                                  "generation": gen})
+
     # -- health / lifecycle -----------------------------------------------
     def health(self) -> dict:
         with self._req_lock:
@@ -261,6 +401,8 @@ class CorrectionServer:
             "queue_depth": self.batcher.depth,
             "requests_served": served,
             "engine_compiles": self.batcher.engine.compiles,
+            "engine_generation": int(getattr(
+                self.batcher, "generation", 0)),
             "port": self.port,
         }
 

@@ -40,7 +40,12 @@ Fields per spec:
   - ``exit``: ``os._exit(code)`` (default 41) — a hard kill, the
     checkpoint/resume acceptance case,
   - ``sleep``: ``time.sleep(seconds)`` (default 0.05) then continue —
-    artificial slowness for deadline/backpressure tests.
+    artificial slowness for deadline/backpressure tests,
+  - ``hang``: block forever (a wedged compile/device step — the serve
+    watchdog acceptance case). Interruptible: the blocked thread is
+    released by ``release_hangs()``, or automatically when another
+    plan is installed / the plan is reset, so tests and the chaos
+    soak never leak a permanently stuck thread.
 * ``message`` / ``code`` / ``seconds`` — action parameters.
 
 Known sites (each is one ``faults.inject(...)`` call on a hot path;
@@ -51,7 +56,14 @@ the disabled cost is a module-global None check):
 * ``stage2.correct`` (``batch=``) — before each stage-2 device step
   (models/error_correct.py).
 * ``serve.engine.step`` — at the top of CorrectionEngine.step
-  (serve/engine.py).
+  (serve/engine.py); ``hang`` here is contained by the batcher's
+  ``--step-timeout-ms`` watchdog.
+* ``serve.admit`` — at HTTP admission in the correction server
+  (serve/server.py), before quota/queue checks; an injected error
+  maps to a 503 the client can retry.
+* ``serve.reload`` — inside the ``POST /reload`` swap path
+  (serve/server.py), between validation and the engine swap; an
+  injected error must roll back to the old engine.
 * ``fastq.read`` — per parsed record in the pure-Python FASTQ reader
   (io/fastq.py).
 
@@ -76,7 +88,7 @@ class FaultError(RuntimeError):
     device-step failure."""
 
 
-_ACTIONS = ("io_error", "error", "exit", "sleep")
+_ACTIONS = ("io_error", "error", "exit", "sleep", "hang")
 
 ENV_VAR = "QUORUM_FAULT_PLAN"
 
@@ -146,6 +158,10 @@ class FaultPlan:
     def __init__(self, specs: list[FaultSpec]):
         self.specs = specs
         self._lock = threading.Lock()
+        # "hang" actions block on this event: set it (release_hangs,
+        # or installing/resetting the plan) and every hung thread
+        # resumes — interruptible sleep-forever, not a thread leak
+        self._hang_release = threading.Event()
 
     @classmethod
     def parse(cls, obj) -> "FaultPlan":
@@ -176,12 +192,24 @@ class FaultPlan:
         for spec in due:
             self._act(spec, site, batch)
 
-    @staticmethod
-    def _act(spec: FaultSpec, site: str, batch) -> None:
+    def release_hangs(self) -> None:
+        """Wake every thread blocked in a `hang` action. After this,
+        further `hang` actions on THIS plan return immediately — a
+        released plan stays released."""
+        self._hang_release.set()
+
+    def _act(self, spec: FaultSpec, site: str, batch) -> None:
         where = site if batch is None else f"{site}@batch={batch}"
         msg = spec.message or f"injected fault at {where}"
         if spec.action == "sleep":
             time.sleep(spec.seconds)
+            return
+        if spec.action == "hang":
+            # a wedged device step: block until released (new plan
+            # install, reset(), or release_hangs()), then continue —
+            # by then the watchdog has long since abandoned this
+            # thread and restarted the engine
+            self._hang_release.wait()
             return
         if spec.action == "io_error":
             raise OSError(msg)
@@ -215,12 +243,22 @@ _SPEC: str | None = None
 
 def install(plan: FaultPlan | None, spec: str | None = None) -> None:
     global _PLAN, _SPEC
+    if _PLAN is not None and _PLAN is not plan:
+        # threads hung by the outgoing plan must not outlive it
+        _PLAN.release_hangs()
     _PLAN = plan
     _SPEC = spec
 
 
 def reset() -> None:
     install(None)
+
+
+def release_hangs() -> None:
+    """Wake any threads blocked in the active plan's `hang` actions
+    (teardown hook for tests and the chaos soak)."""
+    if _PLAN is not None:
+        _PLAN.release_hangs()
 
 
 def active() -> bool:

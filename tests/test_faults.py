@@ -654,3 +654,69 @@ def test_metrics_check_fault_names(tmp_path):
     assert any("stage_retries_total" in e for e in errs)
     # undeclared features require nothing
     assert mc._check_fault_names({"meta": {}, "counters": {}}) == []
+
+
+# ---------------------------------------------------------------------------
+# the hang action + serve sites (ISSUE 7): interruptible sleep-forever
+# ---------------------------------------------------------------------------
+
+def test_hang_action_blocks_until_released():
+    faults.install(faults.FaultPlan.parse(
+        {"site": "serve.engine.step", "action": "hang"}), "hang-t1")
+    entered = threading.Event()
+    done = threading.Event()
+
+    def victim():
+        entered.set()
+        faults.inject("serve.engine.step")
+        done.set()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    assert not done.wait(0.2), "hang action did not block"
+    faults.release_hangs()
+    assert done.wait(5), "release_hangs did not wake the thread"
+    t.join(timeout=5)
+
+
+def test_hang_released_by_next_plan_install():
+    faults.install(faults.FaultPlan.parse(
+        {"site": "x", "action": "hang"}), "hang-t2")
+    done = threading.Event()
+
+    def victim():
+        faults.inject("x")
+        done.set()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    assert not done.wait(0.2)
+    # installing the NEXT plan must not leak the old plan's threads
+    faults.install(faults.FaultPlan.parse(
+        {"site": "y", "action": "error"}), "hang-t3")
+    assert done.wait(5), "new install did not release hung threads"
+    t.join(timeout=5)
+
+
+def test_hang_spec_at_count_semantics():
+    """hang participates in at/count matching like any other action;
+    a released plan's further hangs return immediately (released
+    stays released)."""
+    plan = faults.FaultPlan.parse({"site": "s", "at": 2, "action": "hang"})
+    plan.fire("s")                # hit 1: below at -> no action
+    assert plan.specs[0].fired == 0
+    plan.release_hangs()
+    plan.fire("s")                # hit 2: fires, returns at once
+    assert plan.specs[0].fired == 1
+
+
+def test_serve_admit_and_reload_sites_fire():
+    faults.install(faults.FaultPlan.parse([
+        {"site": "serve.admit", "action": "error"},
+        {"site": "serve.reload", "action": "io_error"},
+    ]), "sites-t")
+    with pytest.raises(faults.FaultError):
+        faults.inject("serve.admit")
+    with pytest.raises(OSError):
+        faults.inject("serve.reload")

@@ -26,9 +26,11 @@ from quorum_tpu.cli import error_correct_reads as ec_cli
 from quorum_tpu.cli import serve as serve_cli
 from quorum_tpu.serve import (CorrectionEngine, CorrectionServer,
                               DeadlineExceeded, Draining,
-                              DynamicBatcher, QueueFull)
-from quorum_tpu.serve.client import ServeClient, bench_main
+                              DynamicBatcher, EngineStepTimeout,
+                              QueueFull, TokenBucketQuota)
+from quorum_tpu.serve.client import ServeClient, ServeResult, bench_main
 from quorum_tpu.telemetry import registry_for, validate_metrics
+from quorum_tpu.utils import faults
 
 HERE = os.path.dirname(__file__)
 GOLDEN = os.path.join(HERE, "golden")
@@ -68,7 +70,22 @@ def warm_stack(golden_db):
     engine = CorrectionEngine(golden_db, cutoff=4, rows=64, registry=reg)
     batcher = DynamicBatcher(engine, max_batch=64, max_wait_ms=2,
                              queue_requests=8, registry=reg)
-    server = CorrectionServer(batcher, port=0, registry=reg)
+
+    def builder(params):
+        # the same validate-then-build shape cli/serve.py wires up
+        from quorum_tpu.io import db_format
+        cur = batcher.current_engine()
+        db = params.get("db") or cur.db_path
+        header = db_format.read_header(db)
+        if (header.get("key_len") != 2 * cur.cfg.k
+                or header.get("bits") != cur.meta.bits):
+            raise ValueError(f"reload refused: k/bits mismatch in {db}")
+        eng = CorrectionEngine(db, cutoff=4, rows=64, registry=reg)
+        eng.warmup(cur.warm_lengths)
+        return eng
+
+    server = CorrectionServer(batcher, port=0, registry=reg,
+                              engine_builder=builder)
     yield reg, engine, server
     server.close()
 
@@ -140,6 +157,36 @@ def test_serve_empty_and_bad_input(warm_stack):
     assert r.status == 200 and r.fa == "" and r.reads == 0
     r = client.correct("@h\nACGT\n+\nzzz\n")  # qual/seq length mismatch
     assert r.status == 400
+
+
+def test_reload_rollback_and_swap_real_engine(warm_stack, offline,
+                                              tmp_path):
+    """Acceptance (ISSUE 7): POST /reload with a corrupt DB leaves the
+    server answering byte-identical responses from the old engine
+    (rollback); a good reload swaps generations and parity still
+    holds on the rebuilt engine."""
+    reg, _engine, server = warm_stack
+    off_fa, off_log = offline
+    client = ServeClient(port=server.port)
+    body = open(READS).read()
+    gen0 = client.healthz()["engine_generation"]
+
+    corrupt = tmp_path / "corrupt.jf"
+    corrupt.write_bytes(b"\x00\x01 not a database \xff\xfe")
+    code, doc = client.reload({"db": str(corrupt)})
+    assert code == 400 and doc.get("rolled_back") is True
+    assert reg.counter("reload_failures_total").value >= 1
+    r = client.correct(body, want_log=True)
+    assert r.status == 200
+    assert r.fa == off_fa and r.log == off_log   # old engine, byte-same
+
+    code, doc = client.reload({})   # same DB: validate, rebuild, swap
+    assert code == 200 and doc["generation"] == gen0 + 1
+    assert client.healthz()["engine_generation"] == gen0 + 1
+    r = client.correct(body, want_log=True)
+    assert r.status == 200
+    assert r.fa == off_fa and r.log == off_log   # new engine, byte-same
+    assert reg.counter("reload_total").value >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -619,3 +666,584 @@ def test_observability_null_when_disabled():
         assert not obs.registry.enabled
         assert not getattr(obs.tracer, "enabled", False)
         assert obs.server is None
+
+
+# ---------------------------------------------------------------------------
+# serve resilience (ISSUE 7): watchdog, hedging, priority lanes,
+# quotas, hot reload, and the races between them
+# ---------------------------------------------------------------------------
+
+class HangEngine(FakeEngine):
+    """Engine-shaped stub whose step wedges forever (until `release`)
+    when any record's header is 'hang' — the watchdog acceptance
+    case."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.release = threading.Event()
+
+    def step(self, records):
+        self.entered.set()
+        if any(h == "hang" for h, _s, _q in records):
+            self.release.wait(timeout=60)
+            raise RuntimeError("hung step released by test teardown")
+        self.stepped += 1
+        return [(f">{h}\n{s.decode()}\n", "") for h, s, _q in records]
+
+
+def test_watchdog_contains_hung_step_and_restarts_engine():
+    """Acceptance: a hung engine step fails only its batch
+    (EngineStepTimeout), engine_restarts_total increments, and the
+    next request succeeds on the rebuilt engine."""
+    reg = registry_for(None, force=True)
+    hung = HangEngine()
+    fresh = FakeEngine()
+    bat = DynamicBatcher(hung, max_batch=8, max_wait_ms=0,
+                         queue_requests=8, step_timeout_ms=150,
+                         engine_factory=lambda old: fresh, registry=reg)
+    try:
+        f = bat.submit([("hang", b"ACGT", b"IIII")])
+        with pytest.raises(EngineStepTimeout):
+            f.result(timeout=10)
+        assert reg.counter("engine_step_timeouts").value == 1
+        assert reg.counter("engine_restarts_total").value == 1
+        assert bat.current_engine() is fresh
+        assert bat.generation == 1
+        ok = bat.submit([("ok", b"AC", b"II")])
+        assert ok.result(timeout=10) == [(">ok\nAC\n", "")]
+        assert bat.healthy
+    finally:
+        hung.release.set()
+        bat.drain(timeout=5)
+
+
+def test_watchdog_survives_wedged_rebuild():
+    """Review hardening: if even the engine REBUILD hangs (the
+    device/compiler is truly wedged), the dispatcher abandons it too
+    instead of re-wedging on the cure — the old engine stays, later
+    steps keep timing out, and the failure streak flips /healthz."""
+    reg = registry_for(None, force=True)
+    hung = HangEngine()
+    release_build = threading.Event()
+
+    def wedged_factory(_old):
+        release_build.wait(timeout=60)
+        return FakeEngine()
+
+    bat = DynamicBatcher(hung, max_batch=8, max_wait_ms=0,
+                         queue_requests=8, step_timeout_ms=100,
+                         max_consecutive_failures=2,
+                         engine_factory=wedged_factory, registry=reg)
+    bat.rebuild_timeout_s = 0.2
+    try:
+        for _ in range(2):
+            f = bat.submit([("hang", b"ACGT", b"IIII")])
+            with pytest.raises(EngineStepTimeout):
+                f.result(timeout=10)
+        assert reg.counter("engine_rebuild_failures").value == 2
+        assert reg.counter("engine_restarts_total").value == 0
+        assert bat.current_engine() is hung   # old engine kept
+        assert not bat.healthy                # streak flipped healthz
+    finally:
+        release_build.set()
+        hung.release.set()
+        bat.drain(timeout=5)
+
+
+def test_watchdog_fires_during_bisection_retry():
+    """Race satellite: the batch step hangs (watchdog restart #1),
+    the bisect solo retry of the hung request hangs AGAIN on the
+    rebuilt engine (watchdog restart #2), and the innocent batchmate
+    still gets its answer from the latest engine."""
+    reg = registry_for(None, force=True)
+    first = HangEngine()
+    spawned: list[HangEngine] = []
+
+    def factory(_old):
+        e = HangEngine()
+        spawned.append(e)
+        return e
+
+    bat = DynamicBatcher(first, max_batch=8, max_wait_ms=200,
+                         queue_requests=8, step_timeout_ms=150,
+                         engine_factory=factory, registry=reg)
+    try:
+        bad = bat.submit([("hang", b"ACGT", b"IIII")])
+        good = bat.submit([("good", b"AC", b"II")])
+        # coalesced batch hangs -> restart; bisect: [hang] hangs ->
+        # restart again; [good] succeeds on the newest engine
+        assert good.result(timeout=20) == [(">good\nAC\n", "")]
+        with pytest.raises(EngineStepTimeout):
+            bad.result(timeout=20)
+        assert reg.counter("engine_restarts_total").value == 2
+        assert reg.counter("batch_bisections").value == 1
+        assert bat.generation == 2
+    finally:
+        first.release.set()
+        for e in spawned:
+            e.release.set()
+        bat.drain(timeout=5)
+
+
+def test_hedging_isolates_innocent_batchmates():
+    """Acceptance: when a failed batch bisects ambiguously (a failing
+    half with >1 request), the survivors are re-run solo — the
+    innocent batchmate of a poisoned request never eats a 500."""
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(PoisonEngine(), max_batch=8, max_wait_ms=200,
+                         queue_requests=8, max_hedges=8, registry=reg)
+    try:
+        a = bat.submit([("a", b"AC", b"II")])
+        b = bat.submit([("b", b"AC", b"II")])
+        c = bat.submit([("poison", b"AC", b"II")])
+        d = bat.submit([("d", b"AC", b"II")])
+        # one coalesced batch [a,b,poison,d]: fails; half [a,b] ok;
+        # half [poison,d] fails again -> hedge solo: poison fails,
+        # d succeeds
+        assert a.result(timeout=10) == [(">a\nAC\n", "")]
+        assert b.result(timeout=10) == [(">b\nAC\n", "")]
+        with pytest.raises(RuntimeError, match="poisoned"):
+            c.result(timeout=10)
+        assert d.result(timeout=10) == [(">d\nAC\n", "")]
+        assert reg.counter("batch_bisections").value == 1
+        assert reg.counter("hedges_total").value == 2
+        assert reg.counter("requests_failed").value == 1
+    finally:
+        bat.drain(timeout=5)
+
+
+def test_hedge_budget_exhausted_fails_remainder():
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(PoisonEngine(), max_batch=8, max_wait_ms=200,
+                         queue_requests=8, max_hedges=1, registry=reg)
+    try:
+        a = bat.submit([("a", b"AC", b"II")])
+        b = bat.submit([("b", b"AC", b"II")])
+        c = bat.submit([("poison", b"AC", b"II")])
+        d = bat.submit([("d", b"AC", b"II")])
+        assert a.result(timeout=10) and b.result(timeout=10)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            c.result(timeout=10)
+        # d was innocent but the single hedge went to the poisoned
+        # request: d fails with the half's original error
+        with pytest.raises(RuntimeError, match="poisoned"):
+            d.result(timeout=10)
+        assert reg.counter("hedges_total").value == 1
+        assert reg.counter("requests_failed").value == 2
+    finally:
+        bat.drain(timeout=5)
+
+
+class OrderEngine(FakeEngine):
+    """FakeEngine that records the header order of stepped reads."""
+
+    def __init__(self, gate=None, **kw):
+        super().__init__(gate=gate, **kw)
+        self.order: list[str] = []
+
+    def step(self, records):
+        res = super().step(records)
+        self.order.extend(h for h, _s, _q in records)
+        return res
+
+
+def test_priority_lanes_weighted_pop_under_full_queue():
+    """Race satellite: with both lanes full, interactive requests pop
+    ahead of a bulk backlog at `interactive_weight` per bulk pop —
+    bulk drains at a guaranteed floor, interactive never starves."""
+    reg = registry_for(None, force=True)
+    gate = threading.Event()
+    eng = OrderEngine(gate)
+    bat = DynamicBatcher(eng, max_batch=1, max_wait_ms=0,
+                         queue_requests=32, interactive_weight=2,
+                         registry=reg)
+    try:
+        r0 = bat.submit([("r0", b"A", b"I")])   # occupies the engine
+        assert eng.entered.wait(5)
+        _drain_to_depth(bat, 0)
+        bulk = [bat.submit([(f"b{i}", b"A", b"I")], priority="bulk")
+                for i in range(4)]
+        inter = [bat.submit([(f"i{i}", b"A", b"I")]) for i in range(4)]
+        gate.set()
+        for f in [r0] + bulk + inter:
+            assert f.result(timeout=10)
+        # pops 1..8 with weight 2 (pop 0 was r0):
+        # i0, b0, i1, i2, b1, i3, b2, b3
+        assert eng.order == ["r0", "i0", "b0", "i1", "i2", "b1",
+                             "i3", "b2", "b3"]
+        with pytest.raises(ValueError, match="unknown priority"):
+            bat.submit([("x", b"A", b"I")], priority="urgent")
+    finally:
+        bat.drain(timeout=5)
+
+
+def test_swap_engine_conditional_on_generation():
+    """Review hardening: a watchdog rebuild that raced a /reload must
+    not clobber the reload's fresher engine — the conditional swap
+    drops the stale replacement."""
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    try:
+        e1, e2 = FakeEngine(), FakeEngine()
+        gen0 = bat.generation
+        assert bat.swap_engine(e1) == gen0 + 1    # the /reload lands
+        # the watchdog rebuild captured gen0 before the reload: stale
+        assert bat.swap_engine(e2, expected_generation=gen0) == -1
+        assert bat.current_engine() is e1
+        assert bat.generation == gen0 + 1
+    finally:
+        bat.drain(timeout=5)
+
+
+def test_no_hedging_after_watchdog_timeout():
+    """Review hardening: a half that fails with EngineStepTimeout is
+    NOT hedged — each solo hedge of a deterministically-hanging
+    request would cost a full step-timeout + rebuild with the
+    dispatcher blocked. The half fails fast instead."""
+    reg = registry_for(None, force=True)
+    first = HangEngine()
+    spawned: list[HangEngine] = []
+
+    def factory(_old):
+        e = HangEngine()
+        spawned.append(e)
+        return e
+
+    bat = DynamicBatcher(first, max_batch=8, max_wait_ms=200,
+                         queue_requests=8, step_timeout_ms=150,
+                         engine_factory=factory, max_hedges=8,
+                         registry=reg)
+    try:
+        a = bat.submit([("a", b"AC", b"II")])
+        b = bat.submit([("b", b"AC", b"II")])
+        c = bat.submit([("hang", b"AC", b"II")])
+        d = bat.submit([("d", b"AC", b"II")])
+        # batch [a,b,hang,d] times out; half [a,b] succeeds; half
+        # [hang,d] times out AGAIN -> fails fast, NO solo hedging
+        assert a.result(timeout=20) and b.result(timeout=20)
+        with pytest.raises(EngineStepTimeout):
+            c.result(timeout=20)
+        with pytest.raises(EngineStepTimeout):
+            d.result(timeout=20)
+        assert reg.counter("hedges_total").value == 0
+        assert reg.counter("engine_restarts_total").value == 2
+    finally:
+        first.release.set()
+        for e in spawned:
+            e.release.set()
+        bat.drain(timeout=5)
+
+
+def test_token_bucket_quota_lru_eviction():
+    clock = [0.0]
+    q = TokenBucketQuota(1.0, burst=2, max_clients=3,
+                         clock=lambda: clock[0])
+    for name in ("a", "b", "c"):
+        assert q.admit(name)[0]
+    assert q.admit("a")[0]        # refreshes a's LRU position
+    assert q.admit("d")[0]        # evicts the oldest (b), not a
+    assert q.clients == 3
+    assert not q.admit("a")[0]    # a kept its drained bucket (0 left)
+    assert q.admit("b")[0]        # b re-enters with a FRESH bucket
+    with pytest.raises(ValueError):
+        TokenBucketQuota(1.0, burst=0.5)
+
+
+def test_token_bucket_quota_semantics():
+    clock = [0.0]
+    q = TokenBucketQuota(2.0, burst=2, clock=lambda: clock[0])
+    assert q.admit("a") == (True, 0.0)
+    assert q.admit("a") == (True, 0.0)
+    ok, retry = q.admit("a")
+    assert not ok and retry == pytest.approx(0.5)  # 1 token at 2/s
+    assert q.admit("b")[0]          # other clients unaffected
+    clock[0] += 0.6
+    assert q.admit("a")[0]          # refilled
+    with pytest.raises(ValueError):
+        TokenBucketQuota(0)
+
+
+def test_quota_rejects_greedy_client_and_refills():
+    clock = [0.0]
+    quota = TokenBucketQuota(1.0, burst=2, clock=lambda: clock[0])
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg, quota=quota)
+    try:
+        client = ServeClient(port=srv.port)
+        body = "@r\nACGT\n+\nIIII\n"
+        assert client.correct(body, client_id="alice").status == 200
+        assert client.correct(body, client_id="alice").status == 200
+        r = client.correct(body, client_id="alice")
+        assert r.status == 429
+        assert r.retry_after_s >= 1          # Retry-After header
+        assert "quota" in r.error
+        assert reg.counter("quota_rejections_total").value == 1
+        # a different client and an anonymous request are unaffected
+        assert client.correct(body, client_id="bob").status == 200
+        assert client.correct(body).status == 200
+        clock[0] += 1.5                      # tokens refill
+        assert client.correct(body, client_id="alice").status == 200
+    finally:
+        srv.close()
+
+
+def test_reload_swaps_engine_and_rolls_back_stub():
+    """The /reload orchestration with a stub builder: a good reload
+    swaps generations; ValueError -> 400, any other failure -> 500,
+    and both leave the old engine answering."""
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+
+    class Tagged(FakeEngine):
+        def __init__(self, tag):
+            super().__init__()
+            self.tag = tag
+
+        def step(self, records):
+            self.stepped += 1
+            return [(f">{self.tag}:{h}\n", "") for h, _s, _q in records]
+
+    def builder(params):
+        if params.get("boom"):
+            raise ValueError("bad db header")
+        if params.get("crash"):
+            raise RuntimeError("build exploded")
+        return Tagged(params.get("tag", "new"))
+
+    srv = CorrectionServer(bat, port=0, registry=reg,
+                           engine_builder=builder)
+    try:
+        client = ServeClient(port=srv.port)
+        body = "@r\nACGT\n+\nIIII\n"
+        assert client.correct(body).fa == ">r\nACGT\n"   # boot engine
+        code, doc = client.reload({"tag": "g1"})
+        assert code == 200 and doc["generation"] == 1
+        assert client.correct(body).fa == ">g1:r\n"      # new engine
+        code, doc = client.reload({"boom": 1})
+        assert code == 400 and doc["rolled_back"] is True
+        assert doc["generation"] == 1
+        code, doc = client.reload({"crash": 1})
+        assert code == 500 and doc["rolled_back"] is True
+        assert client.correct(body).fa == ">g1:r\n"      # still g1
+        assert reg.counter("reload_total").value == 1
+        assert reg.counter("reload_failures_total").value == 2
+        # an injected serve.reload fault rolls back the same way
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.reload", "action": "error"}), "t-reload")
+        try:
+            code, doc = client.reload({"tag": "g2"})
+        finally:
+            faults.reset()
+        assert code == 500 and doc["rolled_back"] is True
+        assert client.correct(body).fa == ">g1:r\n"
+    finally:
+        faults.reset()
+        srv.close()
+
+
+def test_reload_unconfigured_answers_501():
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        code, doc = ServeClient(port=srv.port).reload({})
+        assert code == 501 and "not configured" in doc["error"]
+    finally:
+        srv.close()
+
+
+def test_reload_races_sigterm_drain():
+    """Race satellite: /reload mid-build while a SIGTERM drain starts.
+    Both complete without deadlock; the reload answers 200 (swap won
+    the race) or 503 (drain won), and the server drains cleanly
+    either way."""
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    building = threading.Event()
+
+    def slow_builder(_params):
+        building.set()
+        time.sleep(0.3)
+        return FakeEngine()
+
+    srv = CorrectionServer(bat, port=0, registry=reg,
+                           drain_grace_s=5.0,
+                           engine_builder=slow_builder)
+    try:
+        client = ServeClient(port=srv.port)
+        box = {}
+
+        def do_reload():
+            box["code"], box["doc"] = client.reload({})
+
+        t = threading.Thread(target=do_reload, daemon=True)
+        t.start()
+        assert building.wait(5)          # reload is mid-build
+        srv.initiate_drain()             # the SIGTERM path
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert box["code"] in (200, 503)
+        assert srv._drained.wait(5)
+        # post-drain: both endpoints refuse politely
+        code, _doc = client.reload({})
+        assert code == 503
+        assert client.correct("@r\nAC\n+\nII\n").status == 503
+    finally:
+        srv.close()
+
+
+def test_admit_fault_site_maps_to_retryable_503():
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.admit", "action": "error"}), "t-admit")
+        r = ServeClient(port=srv.port).correct("@r\nAC\n+\nII\n")
+        assert r.status == 503 and r.retry_after_s >= 1
+        assert reg.counter("requests_rejected_admission").value == 1
+        faults.reset()
+        r = ServeClient(port=srv.port).correct("@r\nAC\n+\nII\n")
+        assert r.status == 200
+    finally:
+        faults.reset()
+        srv.close()
+
+
+def test_correct_with_retry_honors_retry_after(monkeypatch):
+    client = ServeClient(port=1)
+    replies = [ServeResult(status=429, retry_after_s=2.0),
+               ServeResult(status=503, retry_after_s=0.0),
+               ServeResult(status=200, fa="ok")]
+    calls = []
+
+    def fake_correct(_body, deadline_ms=None, want_log=False,
+                     priority=None, client_id=None):
+        calls.append(1)
+        return replies[len(calls) - 1]
+
+    monkeypatch.setattr(client, "correct", fake_correct)
+    sleeps: list[float] = []
+    res = client.correct_with_retry("x", base_backoff_s=0.1,
+                                    sleep=sleeps.append)
+    assert res.status == 200 and res.fa == "ok"
+    assert sleeps[0] == 2.0   # the server's Retry-After hint wins
+    assert sleeps[1] == pytest.approx(0.2)  # no hint -> exponential
+
+
+def test_correct_with_retry_caps_and_gives_up(monkeypatch):
+    client = ServeClient(port=1)
+    monkeypatch.setattr(
+        client, "correct",
+        lambda *_a, **_k: ServeResult(status=429, retry_after_s=0.0))
+    sleeps: list[float] = []
+    res = client.correct_with_retry("x", max_attempts=3,
+                                    base_backoff_s=0.5,
+                                    max_backoff_s=0.6,
+                                    sleep=sleeps.append)
+    assert res.status == 429
+    assert sleeps == [0.5, 0.6]   # capped exponential: 0.5 then 0.6
+
+
+def test_serve_bench_retry_flag(capsys):
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=32, max_wait_ms=1,
+                         queue_requests=16, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        rc = bench_main(["--port", str(srv.port), "-c", "2", "-n", "6",
+                         "-r", "3", "--retry", "--priority", "bulk",
+                         "--client-id", "bench", READS])
+    finally:
+        srv.close()
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    obj = json.loads(line)
+    assert obj["ok"] == 6 and obj["reads"] == 18
+
+
+def test_serve_cli_resilience_flags_and_meta(tmp_path, monkeypatch):
+    """The quorum-serve resilience flags land in the final metrics
+    document's meta (what metrics_check dispatches on) with the
+    feature counters present at 0 from setup."""
+    import quorum_tpu.serve as serve_pkg
+
+    monkeypatch.setattr(serve_pkg, "CorrectionEngine",
+                        lambda db, **kw: FakeEngine(
+                            rows=kw.get("rows", 1024)))
+    port = _free_port()
+    metrics_path = str(tmp_path / "serve.json")
+    rc_box = {}
+
+    def run():
+        rc_box["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-wait-ms", "0",
+             "--max-batch", "8", "--step-timeout-ms", "5000",
+             "--quota-rps", "100", "--metrics", metrics_path,
+             "ignored.jf"])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    client = ServeClient(port=port)
+    deadline = time.perf_counter() + 10
+    while True:
+        try:
+            client.healthz()
+            break
+        except OSError:
+            assert time.perf_counter() < deadline, "never came up"
+            time.sleep(0.05)
+    r = client.correct("@a\nAC\n+\nII\n", priority="bulk",
+                       client_id="c1")
+    assert r.status == 200 and r.reads == 1
+    assert client.correct("@a\nAC\n+\nII\n",
+                          priority="urgent").status == 400
+    client.quiesce()
+    t.join(timeout=15)
+    assert rc_box["rc"] == 0
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    assert validate_metrics(doc) == []
+    assert doc["meta"]["step_timeout_ms"] == 5000
+    assert doc["meta"]["max_hedges"] == 8
+    assert doc["meta"]["quota_rps"] == 100
+    assert doc["meta"]["reload"] is True
+    for c in ("engine_restarts_total", "hedges_total", "reload_total",
+              "quota_rejections_total"):
+        assert doc["counters"].get(c) == 0, c
+
+
+def test_metrics_check_serve_feature_names():
+    import importlib.util
+    repo = os.path.dirname(HERE)
+    spec = importlib.util.spec_from_file_location(
+        "metrics_check", os.path.join(repo, "tools", "metrics_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+
+    counters = {c: 0 for c in mc.SERVE_REQUIRED_COUNTERS}
+    hists = {h: {"count": 0, "sum": 0, "counts": {}}
+             for h in mc.SERVE_REQUIRED_HISTOGRAMS}
+    doc = {"meta": {"stage": "serve", "step_timeout_ms": 500,
+                    "max_hedges": 8, "reload": True, "quota_rps": 10},
+           "counters": dict(counters), "histograms": hists}
+    errs = mc._check_serve_names(doc)
+    assert len(errs) == 4
+    for name in ("engine_restarts_total", "hedges_total",
+                 "reload_total", "quota_rejections_total"):
+        assert any(name in e for e in errs), name
+    doc["counters"].update({"engine_restarts_total": 0,
+                            "hedges_total": 2, "reload_total": 1,
+                            "quota_rejections_total": 0})
+    assert mc._check_serve_names(doc) == []
+    # undeclared or zero-valued features require nothing
+    off = {"meta": {"stage": "serve", "max_hedges": 0},
+           "counters": dict(counters), "histograms": hists}
+    assert mc._check_serve_names(off) == []

@@ -1,0 +1,475 @@
+#!/usr/bin/env python
+"""Seeded chaos soak for the serve resilience tier (ci/tier1.sh gate,
+ISSUE 7): drive a LIVE quorum-serve through every failure path the
+fault-containment layer claims to survive, under a deterministic
+fault plan, and assert the invariants that define the tier:
+
+  * every request terminates (no future ever hangs to the wall),
+  * every 200 body is byte-identical to the offline CLI's output for
+    the same reads (per-read parity against tests/golden/expected.fa),
+  * a `hang` fault in the engine step is contained by the watchdog:
+    only that request fails, the engine generation bumps
+    (`engine_restarts_total`), and the next request succeeds on the
+    rebuilt engine,
+  * consecutive injected step failures flip /healthz to 503 and a
+    clean request heals it back to 200,
+  * an ambiguous batch failure is hedged: innocent batchmates of a
+    poisoned request still answer 200 with byte parity
+    (`hedges_total`),
+  * POST /reload hot-swaps the engine (generation bump, parity on the
+    new engine) and rolls back on a corrupt DB or an injected
+    `serve.reload` fault (parity from the OLD engine),
+  * per-client quotas shed a greedy client with 429 + Retry-After
+    (`quota_rejections_total`) while anonymous traffic flows,
+  * a seeded randomized fault storm (sleep/error at
+    `serve.engine.step`) under retrying closed-loop load terminates
+    with nothing but known statuses and byte-identical 200s,
+  * the final metrics document passes tools/metrics_check.py
+    (including the resilience feature counters) and the /metrics
+    scrape lints clean with --prom.
+
+Artifacts land in --out-dir:
+  chaos_metrics.json — the final serve document (metrics_check gates
+                       it, including SERVE_FEATURE_COUNTERS)
+  chaos_scrape.prom  — a /metrics scrape taken mid-soak
+                       (metrics_check --prom gates it)
+
+Exit 0 = all invariants held. Deterministic for a fixed --seed: the
+phase plans are fixed and the storm's fault plan derives from the
+seed. Run by ci/tier1.sh after the tier-1 pytest pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import random
+import re
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def _fail(msg: str) -> int:
+    print(f"[chaos_soak] FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _parse_golden():
+    """Per-read parity oracle from the committed golden artifacts:
+    header -> (fastq_record_text, expected_fa, expected_log)."""
+    with open(os.path.join(GOLDEN, "reads.fastq")) as f:
+        fq_lines = f.read().splitlines(keepends=True)
+    fq = {}
+    for i in range(0, len(fq_lines), 4):
+        hdr = fq_lines[i][1:].strip()
+        fq[hdr] = "".join(fq_lines[i:i + 4])
+    with open(os.path.join(GOLDEN, "expected.fa")) as f:
+        fa_text = f.read()
+    fa = {}
+    for block in fa_text.split(">"):
+        if not block:
+            continue
+        name = block.split(None, 1)[0].strip()
+        fa[name] = ">" + block
+    with open(os.path.join(GOLDEN, "expected.log")) as f:
+        log_lines = f.read().splitlines(keepends=True)
+    logs = {}
+    for line in log_lines:
+        m = re.match(r"Skipped (\S+):", line)
+        if m:
+            logs[m.group(1)] = line
+    oracle = {}
+    for hdr, rec in fq.items():
+        oracle[hdr] = (rec, fa.get(hdr, ""), logs.get(hdr, ""))
+    return oracle, fa_text
+
+
+def _scrape_counter(text: str, name: str) -> float:
+    """Sum a counter's samples out of a Prometheus scrape (the
+    exposition suffixes counters with _total)."""
+    total = 0.0
+    for m in re.finditer(
+            rf"^quorum_tpu_{re.escape(name)}_total(?:{{[^}}]*}})? "
+            r"([0-9.eE+-]+)$", text, re.M):
+        total += float(m.group(1))
+    return total
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Seeded chaos soak: watchdog, health flip, "
+                    "hedging, reload, quotas, and a randomized fault "
+                    "storm against a live quorum-serve (ci/tier1.sh "
+                    "gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where chaos_metrics.json / chaos_scrape.prom "
+                        "land (default: a temp dir)")
+    p.add_argument("--seed", type=int, default=7,
+                   help="Storm fault-plan seed (default 7; CI pins it)")
+    p.add_argument("--rows", type=int, default=64,
+                   help="Engine batch rows (default 64)")
+    p.add_argument("--step-timeout-ms", type=float, default=20000,
+                   help="Watchdog budget; must exceed the FIRST real "
+                        "step's lazy compiles (the all-A warmup read "
+                        "cannot reach the deeper extension-loop "
+                        "levels, ~4s warm-cache on CPU), and the hang "
+                        "phase costs this much wall time (default "
+                        "20000)")
+    p.add_argument("--storm-requests", type=int, default=24,
+                   help="Requests in the randomized storm (default 24)")
+    p.add_argument("--storm-workers", type=int, default=4,
+                   help="Closed-loop storm workers (default 4)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="chaos_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import serve as serve_cli
+    from quorum_tpu.serve.client import ServeClient
+    from quorum_tpu.utils import faults
+
+    oracle, expected_fa = _parse_golden()
+    # reads whose parity we probe individually: the skipped read plus
+    # a deterministic handful of corrected ones
+    probe_headers = ["read0", "read1", "read7", "skip_no_anchor"]
+    for h in probe_headers:
+        assert h in oracle, f"golden fixture lost {h}"
+
+    db = os.path.join(out_dir, "db.jf")
+    metrics_path = os.path.join(out_dir, "chaos_metrics.json")
+    scrape_path = os.path.join(out_dir, "chaos_scrape.prom")
+    print(f"[chaos_soak] building golden database -> {db}")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, os.path.join(GOLDEN, "reads.fastq")])
+    if rc != 0:
+        return _fail("database build")
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc_box: dict = {}
+
+    def run_server():
+        rc_box["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-batch", str(args.rows),
+             "--max-wait-ms", "2", "-p", "4",
+             "--warmup-lengths", "60",
+             "--step-timeout-ms", str(args.step_timeout_ms),
+             "--max-consecutive-failures", "3",
+             "--max-hedges", "8",
+             "--quota-rps", "2", "--quota-burst", "2",
+             "--metrics", metrics_path, db])
+
+    srv_thread = threading.Thread(target=run_server, daemon=True)
+    srv_thread.start()
+    client = ServeClient(port=port, timeout=900.0)
+    deadline = time.perf_counter() + 120
+    while True:
+        try:
+            client.healthz()
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                return _fail("server never came up")
+            time.sleep(0.1)
+
+    def probe_parity(tag: str, hdr: str = "read0",
+                     retry: bool = False) -> int:
+        rec, want_fa, want_log = oracle[hdr]
+        if retry:
+            r = client.correct_with_retry(rec, want_log=True)
+        else:
+            r = client.correct(rec, want_log=True)
+        if r.status != 200:
+            return _fail(f"{tag}: probe {hdr} -> {r.status} {r.error}")
+        if r.fa != want_fa or r.log != want_log:
+            return _fail(f"{tag}: probe {hdr} parity DRIFT")
+        return 0
+
+    try:
+        # -- phase 1: clean parity -----------------------------------------
+        print("[chaos_soak] phase 1: clean parity (cold + warm)")
+        with open(os.path.join(GOLDEN, "reads.fastq")) as f:
+            full_body = f.read()
+        r = client.correct(full_body)
+        if r.status != 200 or r.fa != expected_fa:
+            return _fail(f"phase 1: full-file status={r.status} "
+                         f"parity="
+                         f"{'ok' if r.fa == expected_fa else 'DRIFT'}")
+        for hdr in probe_headers:
+            if probe_parity("phase 1", hdr):
+                return 1
+        gen0 = client.healthz()["engine_generation"]
+
+        # -- phase 2: hang contained by the watchdog -----------------------
+        print("[chaos_soak] phase 2: hang -> watchdog engine restart "
+              f"(~{args.step_timeout_ms / 1000:.0f}s)")
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.engine.step", "action": "hang"}), "soak-hang")
+        r = client.correct(oracle["read1"][0])
+        if r.status != 500:
+            return _fail(f"phase 2: hung request -> {r.status} "
+                         "(want 500)")
+        gen1 = client.healthz()["engine_generation"]
+        if gen1 != gen0 + 1:
+            return _fail(f"phase 2: generation {gen0} -> {gen1} "
+                         "(want +1: watchdog engine restart)")
+        # the very next request must succeed on the rebuilt engine
+        if probe_parity("phase 2 (rebuilt engine)", "read1"):
+            return 1
+        faults.release_hangs()
+
+        # -- phase 3: health flips under consecutive failures, heals -------
+        print("[chaos_soak] phase 3: consecutive failures flip "
+              "/healthz, success heals")
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.engine.step", "action": "error",
+             "count": 3}), "soak-flip")
+        for i in range(3):
+            r = client.correct(oracle["read2" if "read2" in oracle
+                                      else "read0"][0])
+            if r.status != 500:
+                return _fail(f"phase 3: injected failure {i} -> "
+                             f"{r.status} (want 500)")
+        code, h = client.healthz_full()
+        if code != 503 or h["status"] != "unhealthy":
+            return _fail(f"phase 3: healthz {code}/{h['status']} "
+                         "(want 503/unhealthy)")
+        if probe_parity("phase 3 (heal)"):
+            return 1
+        code, h = client.healthz_full()
+        if code != 200 or h["status"] != "ok":
+            return _fail(f"phase 3: healthz did not heal ({code})")
+
+        # -- phase 4: hedging saves innocent batchmates --------------------
+        print("[chaos_soak] phase 4: ambiguous batch failure -> "
+              "solo hedges")
+        hdrs = ["read3", "read4", "read5", "read6"]
+        hedged = False
+        for attempt in range(3):
+            before = _scrape_counter(client.metrics_text(),
+                                     "hedges_total")
+            faults.install(faults.FaultPlan.parse([
+                {"site": "serve.engine.step", "action": "sleep",
+                 "seconds": 0.5},
+                {"site": "serve.engine.step", "at": 2, "count": 2,
+                 "action": "error"},
+            ]), f"soak-hedge-{attempt}")
+            occupier: dict = {}
+
+            def occupy():
+                occupier["r"] = client.correct(oracle["read0"][0])
+
+            t0 = threading.Thread(target=occupy, daemon=True)
+            t0.start()
+            time.sleep(0.15)  # occupier's step is sleeping in-engine
+            results: list = [None] * len(hdrs)
+            ths = []
+            for i, hdr in enumerate(hdrs):
+                cl = ServeClient(port=port, timeout=900.0)
+
+                def post(i=i, hdr=hdr, cl=cl):
+                    results[i] = cl.correct(oracle[hdr][0],
+                                            want_log=True)
+
+                th = threading.Thread(target=post, daemon=True)
+                th.start()
+                ths.append(th)
+            for th in ths + [t0]:
+                th.join(timeout=60)
+                if th.is_alive():
+                    return _fail("phase 4: a request never terminated")
+            faults.reset()
+            delta = _scrape_counter(client.metrics_text(),
+                                    "hedges_total") - before
+            all_ok = all(r is not None and r.status == 200
+                         for r in results)
+            parity = all(
+                r.fa == oracle[hdr][1] and r.log == oracle[hdr][2]
+                for r, hdr in zip(results, hdrs)
+                if r is not None and r.status == 200)
+            if not parity:
+                return _fail("phase 4: hedged responses lost parity")
+            if all_ok and delta >= 2:
+                hedged = True
+                break
+            print(f"[chaos_soak] phase 4: attempt {attempt} did not "
+                  f"coalesce (delta={delta}); retrying")
+        if not hedged:
+            return _fail("phase 4: hedging never engaged in 3 attempts")
+
+        # -- phase 5: hot reload + rollback --------------------------------
+        print("[chaos_soak] phase 5: /reload swap, corrupt-DB "
+              "rollback, injected-fault rollback")
+        gen = client.healthz()["engine_generation"]
+        code, doc = client.reload({})
+        if code != 200 or doc.get("generation") != gen + 1:
+            return _fail(f"phase 5: good reload -> {code} {doc}")
+        if probe_parity("phase 5 (new generation)"):
+            return 1
+        corrupt = os.path.join(out_dir, "corrupt.jf")
+        with open(corrupt, "wb") as f:
+            f.write(b"\x00\x01 not a database \xff")
+        code, doc = client.reload({"db": corrupt})
+        if code != 400 or not doc.get("rolled_back"):
+            return _fail(f"phase 5: corrupt reload -> {code} {doc}")
+        if probe_parity("phase 5 (rollback)"):
+            return 1
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.reload", "action": "error"}), "soak-reload")
+        code, doc = client.reload({})
+        faults.reset()
+        if code != 500 or not doc.get("rolled_back"):
+            return _fail(f"phase 5: injected reload fault -> {code}")
+        if probe_parity("phase 5 (fault rollback)"):
+            return 1
+
+        # -- phase 6: quotas + admission fault -----------------------------
+        print("[chaos_soak] phase 6: greedy client quota, admission "
+              "fault")
+        # empty-body probes: the quota charges at ADMISSION (before
+        # the engine), so a burst of 5 against burst=2 deterministically
+        # splits 2x200 / 3x429 however slow the device is
+        statuses = [client.correct("", client_id="greedy").status
+                    for _ in range(5)]
+        if statuses[:2] != [200, 200] or statuses.count(429) < 2:
+            return _fail(f"phase 6: greedy statuses {statuses} "
+                         "(want the burst admitted, then 429s)")
+        if probe_parity("phase 6 (anonymous unaffected)"):
+            return 1
+        time.sleep(1.1)  # tokens refill at 2/s
+        r = client.correct("", client_id="greedy")
+        if r.status != 200:
+            return _fail(f"phase 6: refilled greedy -> {r.status}")
+        faults.install(faults.FaultPlan.parse(
+            {"site": "serve.admit", "action": "error"}), "soak-admit")
+        r = client.correct(oracle["read0"][0])
+        faults.reset()
+        if r.status != 503:
+            return _fail(f"phase 6: admit fault -> {r.status} "
+                         "(want 503)")
+        if probe_parity("phase 6 (after admit fault)"):
+            return 1
+
+        # -- phase 7: seeded randomized fault storm ------------------------
+        print(f"[chaos_soak] phase 7: randomized storm (seed "
+              f"{args.seed}, {args.storm_requests} requests)")
+        rng = random.Random(args.seed)
+        specs = []
+        for _ in range(6):
+            if rng.random() < 0.5:
+                specs.append({"site": "serve.engine.step",
+                              "action": "sleep",
+                              "at": rng.randint(1, args.storm_requests),
+                              "seconds": round(rng.uniform(0.01, 0.2),
+                                               3)})
+            else:
+                specs.append({"site": "serve.engine.step",
+                              "action": "error",
+                              "at": rng.randint(1, args.storm_requests),
+                              "count": rng.randint(1, 2)})
+        faults.install(faults.FaultPlan.parse(specs), "soak-storm")
+        storm_hdrs = [h for h in oracle if h != "skip_no_anchor"]
+        jobs = [rng.choice(storm_hdrs)
+                for _ in range(args.storm_requests)]
+        next_i = [0]
+        lock = threading.Lock()
+        outcomes: dict[int, int] = {}
+        bad: list[str] = []
+
+        def storm_worker():
+            cl = ServeClient(port=port, timeout=900.0)
+            while True:
+                with lock:
+                    i = next_i[0]
+                    if i >= len(jobs):
+                        return
+                    next_i[0] += 1
+                hdr = jobs[i]
+                rec, want_fa, want_log = oracle[hdr]
+                r = cl.correct_with_retry(rec, want_log=True,
+                                          max_attempts=4,
+                                          max_backoff_s=0.5)
+                with lock:
+                    outcomes[r.status] = outcomes.get(r.status, 0) + 1
+                    if r.status == 200 and (r.fa != want_fa
+                                            or r.log != want_log):
+                        bad.append(f"{hdr}: parity drift")
+                    elif r.status not in (200, 429, 500, 503, 504):
+                        bad.append(f"{hdr}: status {r.status}")
+
+        workers = [threading.Thread(target=storm_worker, daemon=True)
+                   for _ in range(max(1, args.storm_workers))]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=300)
+            if w.is_alive():
+                return _fail("phase 7: a storm request never "
+                             "terminated")
+        faults.reset()
+        if bad:
+            return _fail(f"phase 7: {bad[:5]}")
+        if outcomes.get(200, 0) == 0:
+            return _fail(f"phase 7: no successes at all ({outcomes})")
+        print(f"[chaos_soak] phase 7 outcomes: {outcomes}")
+        if probe_parity("phase 7 (after storm)", retry=True):
+            return 1
+
+        # -- drain + artifact gates ----------------------------------------
+        with open(scrape_path, "w") as f:
+            f.write(client.metrics_text())
+        print(f"[chaos_soak] scraped /metrics -> {scrape_path}")
+        print("[chaos_soak] draining via /quiesce")
+        client.quiesce()
+        srv_thread.join(timeout=120)
+        if srv_thread.is_alive() or rc_box.get("rc") != 0:
+            return _fail(f"drain (alive={srv_thread.is_alive()} "
+                         f"rc={rc_box.get('rc')})")
+    finally:
+        faults.reset()  # releases any still-hung threads
+
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    counters = doc.get("counters", {})
+    for name, floor in (("engine_restarts_total", 1),
+                        ("hedges_total", 2), ("reload_total", 1),
+                        ("reload_failures_total", 2),
+                        ("quota_rejections_total", 1),
+                        ("requests_rejected_admission", 1),
+                        ("batch_bisections", 1),
+                        ("engine_step_failures", 1)):
+        if counters.get(name, 0) < floor:
+            return _fail(f"final doc: counter {name}="
+                         f"{counters.get(name)} < {floor}")
+    if doc.get("meta", {}).get("drained") is not True:
+        return _fail("final doc: meta.drained is not True")
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_check", os.path.join(REPO, "tools", "metrics_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+    if mc.main([metrics_path]) != 0:
+        return _fail("metrics_check rejected the final document")
+    if mc.main(["--prom", scrape_path]) != 0:
+        return _fail("metrics_check --prom rejected the scrape")
+
+    print(f"[chaos_soak] OK: all invariants held (seed {args.seed}); "
+          f"final metrics -> {metrics_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

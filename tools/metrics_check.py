@@ -22,7 +22,9 @@ and dispatches on content, not extension:
 A final document whose `meta.stage` is "serve" (quorum-serve's
 `--metrics` output) is additionally required to carry the serve
 request/batch metric names (SERVE_REQUIRED_*), so a golden serve run
-in CI fails loudly if the serving telemetry regresses.
+in CI fails loudly if the serving telemetry regresses — and, when its
+meta declares a resilience feature enabled (watchdog, hedging,
+reload, quotas), the feature's counter too (SERVE_FEATURE_COUNTERS).
 
 `--prom` switches to linting Prometheus text exposition output
 (`--metrics-textfile` files or a saved `/metrics` scrape) through the
@@ -65,6 +67,21 @@ SERVE_REQUIRED_HISTOGRAMS = (
     "request_reads",
     "serve_dispatch_us",
     "serve_wait_us",
+)
+
+# The serve resilience surface (ISSUE 7): a serve document whose meta
+# declares one of these features enabled must carry its counter (the
+# serve layers create them at setup, so value 0 counts — a missing
+# name means the watchdog/hedging/reload/quota telemetry regressed).
+#   meta.step_timeout_ms > 0 -> engine_restarts_total (watchdog)
+#   meta.max_hedges > 0      -> hedges_total
+#   meta.reload truthy       -> reload_total
+#   meta.quota_rps > 0       -> quota_rejections_total
+SERVE_FEATURE_COUNTERS = (
+    ("step_timeout_ms", "engine_restarts_total"),
+    ("max_hedges", "hedges_total"),
+    ("reload", "reload_total"),
+    ("quota_rps", "quota_rejections_total"),
 )
 
 # The fault-tolerance metric surface (ISSUE 4): documents that declare
@@ -171,6 +188,17 @@ def _check_serve_names(doc: dict) -> list[str]:
     for name in SERVE_REQUIRED_HISTOGRAMS:
         if name not in doc.get("histograms", {}):
             errs.append(f"serve document missing histogram {name!r}")
+    meta = doc.get("meta", {})
+    counters = doc.get("counters", {})
+    for key, name in SERVE_FEATURE_COUNTERS:
+        val = meta.get(key)
+        if isinstance(val, (int, float)):
+            declared = val > 0
+        else:
+            declared = bool(val)
+        if declared and name not in counters:
+            errs.append(f"serve document declaring meta.{key}="
+                        f"{val!r} missing counter {name!r}")
     return errs
 
 
