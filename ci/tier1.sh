@@ -33,12 +33,21 @@
 # resilience feature counters) and its /metrics scrape are gated
 # through tools/metrics_check.py (--prom for the scrape).
 #
+# ISSUE 8 adds the data-integrity gate: tools/fsck_smoke.py —
+# quorum-fsck clean on golden-pipeline artifacts (v5 database,
+# stage-1 snapshot, stage-2 journal), one seeded `corrupt`-fault run
+# asserting fsck flags the damage AND the loader refuses it (rc 3 +
+# integrity_errors_total), and the journal --repair torn-tail path;
+# its metrics document is gated through metrics_check (which requires
+# the integrity counters when meta declares db_version >= 5).
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
 #        SKIP_MULTICHIP_SMOKE=1  skips the 2-device mesh gate.
 #        SKIP_BENCH_AB=1      skips the bench A/B gate.
 #        SKIP_CHAOS_SOAK=1    skips the serve-resilience chaos gate.
+#        SKIP_FSCK_SMOKE=1    skips the data-integrity fsck gate.
 set -o pipefail
 set -u
 
@@ -188,10 +197,35 @@ else
     fi
 fi
 
+fsck_rc=0
+if [ "${SKIP_FSCK_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: fsck smoke skipped (SKIP_FSCK_SMOKE=1)"
+else
+    # the data-integrity gate (ISSUE 8): quorum-fsck clean on golden
+    # artifacts, seeded corruption detected by fsck AND refused by
+    # the loader (rc 3 + integrity counters), journal --repair path
+    echo "== golden fsck run =="
+    FSCK_DIR=$(mktemp -d /tmp/fsck_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "$FSCK_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/fsck_smoke.py \
+        --out-dir "$FSCK_DIR" || fsck_rc=$?
+    if [ "$fsck_rc" -eq 0 ]; then
+        echo "== metrics_check gate (fsck) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$FSCK_DIR/fsck_metrics.json" || fsck_rc=1
+    fi
+    if [ "$fsck_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: fsck gate FAILED (rc=$fsck_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
 if [ "$multichip_rc" -ne 0 ]; then exit "$multichip_rc"; fi
 if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
 if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
+if [ "$fsck_rc" -ne 0 ]; then exit "$fsck_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

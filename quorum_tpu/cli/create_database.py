@@ -46,6 +46,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ref-format", action="store_true",
                    help="Write the reference's binary/quorum_db format "
                         "instead of the native format")
+    p.add_argument("--db-version", type=int, choices=(4, 5), default=5,
+                   help="Native export version: 5 (default) carries "
+                        "per-section CRC32C digests and a whole-file "
+                        "trailer digest so loaders and quorum-fsck "
+                        "detect silent corruption; 4 is the bare "
+                        "round-5 layout (same payload bytes)")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("--metrics", metavar="path", default=None,
@@ -128,6 +134,7 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         on_bad_read=args.on_bad_read,
+        db_version=args.db_version,
         quarantine_path=(args.output + ".quarantine.fastq"
                          if args.on_bad_read == "quarantine" else None),
     )
@@ -153,12 +160,14 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
             obs.registry.set_meta(output=args.output)
         except (RuntimeError, OSError, ValueError) as e:
             # RuntimeError: hash-full / checkpoint mismatch; OSError:
-            # real (or injected) IO failures. A CheckpointError is
-            # deterministic — rc 3 tells the driver's retry loop not
-            # to back off and re-run a doomed attempt
+            # real (or injected) IO failures. A CheckpointError or
+            # IntegrityError is deterministic — rc 3 tells the
+            # driver's retry loop not to back off and re-run a doomed
+            # attempt
             from ..io.checkpoint import (CheckpointError,
                                          NON_RETRYABLE_RC)
-            if isinstance(e, CheckpointError):
+            from ..io.integrity import IntegrityError
+            if isinstance(e, (CheckpointError, IntegrityError)):
                 rc = NON_RETRYABLE_RC
             print(str(e), file=sys.stderr)
             obs.status = "error"

@@ -49,6 +49,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gzip", action="store_true", help="Gzip output file")
     p.add_argument("-M", "--no-mmap", action="store_true",
                    help="Do not memory map the input mer database")
+    p.add_argument("--verify-db", choices=("full", "sample", "off"),
+                   default="full",
+                   help="Checksum verification of v5 databases at "
+                        "load: full (default) checks every section "
+                        "and the whole-file digest, sample scrubs a "
+                        "random subset of entry chunks, off skips. "
+                        "A bad digest refuses the load (rc 3, "
+                        "integrity_errors_total)")
     p.add_argument("--apriori-error-rate", type=float, default=0.01,
                    help="Probability of a base being an error")
     p.add_argument("--poisson-threshold", type=float, default=1e-6,
@@ -165,6 +173,7 @@ def main(argv=None, db=None, prepacked=None) -> int:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         on_bad_read=args.on_bad_read,
+        verify_db=args.verify_db,
     )
     try:
         run_error_correct(
@@ -178,9 +187,13 @@ def main(argv=None, db=None, prepacked=None) -> int:
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)
         from ..io.checkpoint import CheckpointError, NON_RETRYABLE_RC
-        # deterministic refusal (journal/config mismatch): rc 3 so
-        # the driver's retry loop fails fast instead of backing off
-        return NON_RETRYABLE_RC if isinstance(e, CheckpointError) else 1
+        from ..io.integrity import IntegrityError
+        # deterministic refusal (journal/config mismatch, or an
+        # artifact that failed its digests): rc 3 so the driver's
+        # retry loop fails fast instead of backing off
+        return (NON_RETRYABLE_RC
+                if isinstance(e, (CheckpointError, IntegrityError))
+                else 1)
     return 0
 
 

@@ -1,0 +1,304 @@
+"""quorum-fsck — offline integrity verifier for every artifact the
+pipeline persists (ISSUE 8).
+
+KMC 3 ships `kmc_tools` as a first-class verifier/manipulator for its
+on-disk k-mer databases (PAPERS.md); this is quorum-tpu's equivalent
+over the artifacts io/ writes:
+
+* **Databases** — native v5 files get the full checksum walk (header
+  digest, bucket index, every entry chunk, derived section and
+  whole-file digests), reported PER SECTION with byte offsets so an
+  operator knows which 4 MiB of a 10 GiB table rotted; v4/v3/v2/v1
+  files get the structural host load (counts, bucket addresses,
+  truncation); reference `binary/quorum_db` files get the geometry +
+  full-decode check (the digest-less format's maximum).
+* **Checkpoint directories** — the stage-1 snapshot (header seal +
+  payload digest), the sharded manifest + every shard payload, and
+  the driver's replay capture (manifest seal + per-batch digests).
+* **Stage-2 journals** (`PREFIX.resume.json`) — document seal,
+  partial-output presence, committed-range digests, and torn-tail
+  detection. `--repair` truncates a torn tail back to the last
+  committed byte — the ONE safe repair (it is exactly what `--resume`
+  does); everything else is refuse-loudly: damaged bytes cannot be
+  reconstructed, only detected before they flow into corrections.
+
+Exit status: 0 = every artifact clean (or repaired under `--repair`),
+1 = damage found (or left unrepaired), 2 = a path that is no known
+artifact kind.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..io import checkpoint as ckpt_mod
+from ..io import db_format, integrity, quorum_db
+
+
+class _Report:
+    """Collects per-section lines and the damage verdict."""
+
+    def __init__(self, quiet: bool = False):
+        self.quiet = quiet
+        self.bad = 0
+        self.repaired = 0
+        self.checked = 0
+
+    def ok(self, path: str, section: str, detail: str = "") -> None:
+        self.checked += 1
+        if not self.quiet:
+            print(f"{path}: {section}: OK"
+                  + (f" ({detail})" if detail else ""))
+
+    def fail(self, path: str, section: str, detail: str,
+             offset=None) -> None:
+        self.checked += 1
+        self.bad += 1
+        at = f" @ offset {offset}" if offset is not None else ""
+        print(f"{path}: {section}: BAD{at}: {detail}",
+              file=sys.stderr)
+
+    def fixed(self, path: str, section: str, detail: str) -> None:
+        self.checked += 1
+        self.repaired += 1
+        print(f"{path}: {section}: REPAIRED: {detail}")
+
+
+# ---------------------------------------------------------------------------
+# Databases
+# ---------------------------------------------------------------------------
+
+
+def check_db(path: str, mode: str, rep: _Report) -> None:
+    if quorum_db.is_ref_db(path):
+        problems = quorum_db.verify_ref_db(path)
+        if problems:
+            for sec, off, msg in problems:
+                rep.fail(path, f"ref-format {sec}", msg, off)
+        else:
+            rep.ok(path, "ref-format database",
+                   "header geometry + full decode")
+        return
+    try:
+        header, problems = db_format.verify_db_file(path, mode)
+    except (OSError, ValueError) as e:
+        rep.fail(path, "header", str(e))
+        return
+    version = header.get("version", 1)
+    if problems:
+        for sec, off, msg in problems:
+            rep.fail(path, sec, msg, off)
+        return
+    if version >= 5:
+        n = header.get("n_entries", "?")
+        rep.ok(path, "v5 checksums",
+               f"header + bucket index + entries ({n} entries), "
+               f"{mode} mode")
+    else:
+        rep.ok(path, f"v{version} structure",
+               "no digests in this version — structural checks only; "
+               "re-export with --db-version 5 for checksums")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint directories
+# ---------------------------------------------------------------------------
+
+
+def check_checkpoint_dir(d: str, rep: _Report) -> None:
+    found = False
+    single = os.path.join(d, "stage1.ckpt")
+    if os.path.exists(single):
+        found = True
+        try:
+            snap = ckpt_mod.Stage1Checkpoint(d).load()
+            rep.ok(single, "stage-1 snapshot",
+                   f"cursor {snap.cursor}, header seal + payload "
+                   "digest")
+        except ckpt_mod.CheckpointError as e:
+            rep.fail(single, "stage-1 snapshot", str(e))
+    manifest = os.path.join(d, ckpt_mod.Stage1ShardedCheckpoint.MANIFEST)
+    if os.path.exists(manifest):
+        found = True
+        try:
+            snap = ckpt_mod.Stage1ShardedCheckpoint(d).load()
+            rep.ok(manifest, "sharded stage-1 snapshot",
+                   f"{snap.n_shards} shards at cursor {snap.cursor}, "
+                   "manifest seal + per-shard digests")
+        except ckpt_mod.CheckpointError as e:
+            rep.fail(manifest, "sharded stage-1 snapshot", str(e))
+    replay = ckpt_mod.ReplayCache(d)
+    if os.path.exists(replay.manifest_path):
+        found = True
+        _check_replay(replay, rep)
+    if not found:
+        rep.fail(d, "checkpoint directory",
+                 "no stage-1 snapshot, sharded manifest, or replay "
+                 "capture found")
+
+
+def _check_replay(replay: ckpt_mod.ReplayCache, rep: _Report) -> None:
+    path = replay.manifest_path
+    try:
+        doc = replay.manifest()
+    except ckpt_mod.CheckpointError as e:
+        rep.fail(path, "replay manifest", str(e))
+        return
+    if doc is None:
+        rep.fail(path, "replay manifest", "unreadable or wrong format")
+        return
+    payloads = doc.get("payloads") or []
+    n = int(doc.get("n_batches", 0))
+    bad = 0
+    for i in range(n):
+        bp = replay._batch_path(i)
+        if not os.path.exists(bp):
+            rep.fail(bp, "replay batch", "missing")
+            bad += 1
+            continue
+        if i < len(payloads):
+            want = payloads[i]
+            size = os.path.getsize(bp)
+            if size != int(want.get("bytes", -1)):
+                rep.fail(bp, "replay batch",
+                         f"{size} bytes, manifest recorded "
+                         f"{want.get('bytes')}")
+                bad += 1
+                continue
+            got = integrity.crc32c_file(bp)
+            if got != int(want.get("crc32c", -1)):
+                rep.fail(bp, "replay batch",
+                         f"digest mismatch (crc32c {got:#010x} != "
+                         f"manifest {int(want.get('crc32c', -1)):#010x})")
+                bad += 1
+    if not bad:
+        detail = (f"{n} batches, per-batch digests"
+                  if payloads else f"{n} batches (no digests — "
+                  "pre-ISSUE-8 capture)")
+        rep.ok(path, "replay capture", detail)
+
+
+# ---------------------------------------------------------------------------
+# Stage-2 journals
+# ---------------------------------------------------------------------------
+
+
+def check_journal(path: str, rep: _Report, repair: bool = False) -> None:
+    prefix = path[:-len(".resume.json")]
+    j = ckpt_mod.Stage2Journal(prefix)
+    try:
+        st = j.load()
+    except ckpt_mod.CheckpointError as e:
+        rep.fail(path, "journal document", str(e))
+        return
+    if st is None:
+        rep.ok(path, "journal",
+               "no partial outputs (a fresh run starts over; nothing "
+               "to verify)")
+        return
+    rep.ok(path, "journal document",
+           f"seal OK, {st['batches']} batches committed")
+    for p, committed, key in (
+            (j.fa_partial, int(st["fa_bytes"]), "fa_crc32c"),
+            (j.log_partial, int(st["log_bytes"]), "log_crc32c")):
+        size = os.path.getsize(p)
+        if size < committed:
+            rep.fail(p, "committed range",
+                     f"{size} bytes, journal committed {committed} — "
+                     "the partial lost committed data")
+            continue
+        want = st.get(key)
+        if want is not None:
+            got = integrity.crc32c_file(p, 0, committed)
+            if got != int(want):
+                rep.fail(p, "committed range",
+                         f"digest mismatch inside the committed "
+                         f"{committed} bytes (crc32c {got:#010x} != "
+                         f"journaled {int(want):#010x})")
+                continue
+            rep.ok(p, "committed range",
+                   f"{committed} bytes, digest OK")
+        else:
+            rep.ok(p, "committed range",
+                   f"{committed} bytes (no digest — pre-ISSUE-8 "
+                   "journal)")
+        if size > committed:
+            if repair:
+                with open(p, "r+b") as f:
+                    f.truncate(committed)
+                rep.fixed(p, "torn tail",
+                          f"truncated {size - committed} bytes past "
+                          f"the last committed record (what --resume "
+                          "does)")
+            else:
+                rep.fail(p, "torn tail",
+                         f"{size - committed} bytes past the commit "
+                         "point (expected after a crash; --repair "
+                         "truncates to the last valid record)")
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def _looks_like_db(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            head = f.read(1)
+        return head == b"{"
+    except OSError:
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="quorum-fsck",
+        description="Verify the integrity of quorum-tpu on-disk "
+                    "artifacts: databases (native v1-v5 and reference "
+                    "format), checkpoint directories, and stage-2 "
+                    "resume journals. Exits non-zero on damage.")
+    p.add_argument("paths", nargs="+", metavar="PATH",
+                   help="Database files, checkpoint directories, or "
+                        "PREFIX.resume.json journals")
+    p.add_argument("--verify", choices=("full", "sample"),
+                   default="full",
+                   help="Database checksum depth: full (default) or "
+                        "sample (random entry-chunk scrub)")
+    p.add_argument("--repair", action="store_true",
+                   help="Truncate torn journal tails back to the last "
+                        "committed record — the only safe repair; "
+                        "all other damage is report-only")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Suppress per-section OK lines")
+    args = p.parse_args(argv)
+
+    rep = _Report(quiet=args.quiet)
+    unknown = 0
+    for path in args.paths:
+        if os.path.isdir(path):
+            check_checkpoint_dir(path, rep)
+        elif path.endswith(".resume.json") and os.path.exists(path):
+            check_journal(path, rep, repair=args.repair)
+        elif os.path.exists(path) and (_looks_like_db(path)
+                                       or quorum_db.is_ref_db(path)):
+            check_db(path, args.verify, rep)
+        else:
+            print(f"{path}: not a recognized quorum-tpu artifact "
+                  "(database, checkpoint directory, or .resume.json)",
+                  file=sys.stderr)
+            unknown += 1
+    if not args.quiet or rep.bad or rep.repaired:
+        verdict = ("clean" if not rep.bad else
+                   f"{rep.bad} damaged section(s)")
+        extra = (f", {rep.repaired} repaired" if rep.repaired else "")
+        print(f"quorum-fsck: {rep.checked} check(s): {verdict}{extra}")
+    if unknown:
+        return 2
+    return 1 if rep.bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,7 @@ def observability(metrics: str | None = None, interval: float = 0.0,
             if rc != 0:
                 obs.status = "error"
     """
+    from ..io import integrity
     from ..telemetry import registry_for, tracer_for
     from ..telemetry import export as export_mod
 
@@ -149,6 +150,13 @@ def observability(metrics: str | None = None, interval: float = 0.0,
         reg.set_meta(**meta)
     tracer = tracer_for(trace_spans)
     obs = ObservabilitySession(reg, tracer)
+    # artifact loaders (db_format/checkpoint) run far below the entry
+    # points, so the run's registry is installed ambiently for their
+    # verification telemetry (integrity_errors_total / bytes-verified
+    # counters + integrity_error events); nested observability()
+    # blocks — the driver's stage children — stack and restore
+    prev_integrity = integrity.install_registry(
+        reg if reg.enabled else None)
     try:
         try:
             obs.server = export_mod.start_exposition(
@@ -159,6 +167,7 @@ def observability(metrics: str | None = None, interval: float = 0.0,
             raise
         obs._finalize(ok=True)
     finally:
+        integrity.install_registry(prev_integrity)
         # span + endpoint teardown on EVERY exit: the Chrome trace of
         # an interrupted run is exactly when it's needed, and the
         # port must free for the next stage/run

@@ -21,6 +21,7 @@ import time
 import dataclasses
 
 from ..io import checkpoint as ckpt_mod
+from ..io import integrity as integrity_mod
 from ..io import fastq, packing
 from ..utils import faults
 from ..models.error_correct import ECOptions, run_error_correct
@@ -91,10 +92,11 @@ def _run_stage_with_retries(reg, stage: str, attempt_fn, retries: int,
             rc = attempt_fn(attempt)
             if rc != 0:
                 cause = f"exit status {rc}"
-        except ckpt_mod.CheckpointError as e:
-            # deterministic refusal (config mismatch, corrupt
-            # artifact): retrying with backoff just re-raises it —
-            # surface immediately
+        except (ckpt_mod.CheckpointError,
+                integrity_mod.IntegrityError) as e:
+            # deterministic refusal (config mismatch, corrupt or
+            # digest-failing artifact): retrying with backoff just
+            # re-raises it — surface immediately
             rc = ckpt_mod.NON_RETRYABLE_RC
             cause = f"{type(e).__name__}: {e}"
         except (RuntimeError, ValueError, OSError) as e:
@@ -217,6 +219,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Malformed-record policy: abort (default), "
                         "skip and count, or quarantine to "
                         "<prefix>.quarantine.fastq")
+    # data integrity (ISSUE 8)
+    p.add_argument("--db-version", type=int, choices=(4, 5), default=5,
+                   help="Mer-database export version: 5 (default) "
+                        "carries per-section CRC32C digests + a "
+                        "whole-file trailer digest; 4 is the bare "
+                        "layout (same payload bytes)")
+    p.add_argument("--verify-db", choices=("full", "sample", "off"),
+                   default="full",
+                   help="Checksum verification when stage 2 loads a "
+                        "v5 database: full (default), sample "
+                        "(random chunk scrub), or off. A bad digest "
+                        "refuses the run (rc 3)")
     faults.add_fault_args(p)
     p.add_argument("--debug", action="store_true",
                    help="Display debugging information")
@@ -412,7 +426,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                 "-q", str(min_q_char + args.min_quality), "-b", "7",
                 "-t", str(threads),
                 "-o", db_file, "--batch-size", str(args.batch_size),
-                "--devices", str(n_devices)]
+                "--devices", str(n_devices),
+                "--db-version", str(args.db_version)]
     if args.checkpoint_dir:
         cdb_argv.extend(["--checkpoint-dir", args.checkpoint_dir,
                          "--checkpoint-every",
@@ -577,6 +592,25 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                   f" (this run: k={args.kmer_len}/bits=7); rebuilding",
                   file=sys.stderr)
             return False
+        if h.get("version", 1) >= 5 and args.verify_db != "off":
+            # the reuse decision is the one place a corrupt database
+            # can be CURED instead of refused: verify its digests per
+            # --verify-db and rebuild on damage rather than handing
+            # stage 2 a file it will refuse (ISSUE 8)
+            try:
+                _, problems = _dbf.verify_db_file(db_file,
+                                                  args.verify_db)
+            except (OSError, ValueError) as e:
+                problems = [("file", None, str(e))]
+            if problems:
+                sec, _off, msg = problems[0]
+                print(f"quorum: --resume found {db_file} but it "
+                      f"failed verification ({sec}: {msg}); "
+                      "rebuilding", file=sys.stderr)
+                reg.counter("integrity_errors_total").inc()
+                reg.event("integrity_error", file=db_file,
+                          section=sec, detail=msg)
+                return False
         return True
 
     # driver --resume with stage 1 already durable (its database file
@@ -608,8 +642,15 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     prepacked_factory = (lambda: prepacked) if prepacked else None
     if prepacked_factory is None and replay_store is not None:
         # resumed run with stage 1 skipped (or its RAM cache lost):
-        # replay the on-disk capture instead of re-parsing the FASTQ
-        replay = replay_store.load(replay_identity)
+        # replay the on-disk capture instead of re-parsing the FASTQ.
+        # A capture that EXISTS but fails its digests is a loud
+        # refusal (rc 3) — silently replaying corrupted reads would
+        # corrupt the output while looking clean (ISSUE 8).
+        try:
+            replay = replay_store.load(replay_identity)
+        except ckpt_mod.CheckpointError as e:
+            print(f"quorum: {e}", file=sys.stderr)
+            return ckpt_mod.NON_RETRYABLE_RC
         if replay is not None:
             vlog("Resume: replaying ", replay.n_batches,
                  " cached batches from ", replay_store.dir,
@@ -621,7 +662,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
 
     # Stage 2: error correction (quorum.in:162-231)
     ec_common = ["--batch-size", str(args.batch_size),
-                 "-t", str(threads), "--devices", str(n_devices)]
+                 "-t", str(threads), "--devices", str(n_devices),
+                 "--verify-db", args.verify_db]
     for flag, val in (("--min-count", args.min_count),
                       ("--skip", args.skip),
                       ("--good", args.anchor),
@@ -710,7 +752,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
               file=sys.stderr)
     opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
                      batch_size=args.batch_size, threads=threads,
-                     devices=n_devices,
+                     devices=n_devices, verify_db=args.verify_db,
                      profile=p2, metrics=m2,
                      metrics_interval=args.metrics_interval,
                      metrics_textfile=args.metrics_textfile,

@@ -51,6 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Trim homo-polymer run at the 3' end")
     p.add_argument("-M", "--no-mmap", action="store_true",
                    help="Do not memory map the input mer database")
+    p.add_argument("--verify-db", choices=("full", "sample", "off"),
+                   default="full",
+                   help="Checksum verification when loading v5 "
+                        "databases (boot, POST /reload, watchdog "
+                        "rebuilds): full (default) checks every "
+                        "section, sample scrubs a random subset of "
+                        "entry chunks (latency-bounded reloads), off "
+                        "skips. A bad digest fails the build — a "
+                        "reload rolls back to the old engine")
     p.add_argument("--apriori-error-rate", type=float, default=0.01,
                    help="Probability of a base being an error")
     p.add_argument("--poisson-threshold", type=float, default=1e-6,
@@ -215,7 +224,8 @@ def _make_engine(args, qual_cutoff: int, reg, tracer,
         contaminant=over.get("contaminant", args.contaminant),
         apriori_error_rate=args.apriori_error_rate,
         poisson_threshold=args.poisson_threshold, no_mmap=args.no_mmap,
-        rows=args.max_batch, registry=reg, tracer=tracer)
+        rows=args.max_batch, verify_db=args.verify_db,
+        registry=reg, tracer=tracer)
 
 
 def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:

@@ -29,6 +29,19 @@ artifacts fix it:
 Both artifacts validate geometry/config on load: resuming with a
 different k, batch size, or input set is a hard error, not silent
 corruption.
+
+Integrity (ISSUE 8): every artifact here carries CRC32C digests —
+snapshot/shard payloads digest their table planes, headers and
+manifests are self-sealed (io/integrity.seal), and the stage-2
+journal digests the committed byte ranges of its partial outputs
+(tracked incrementally by the CRC streams open_outputs returns, so a
+commit costs no extra data pass). A digest mismatch on load is a
+CheckpointError (rc 3) counted in `integrity_errors_total` — resuming
+from silently corrupted state must refuse, never splice bad bytes
+into an output that looks clean. The `checkpoint.commit` and
+`journal.append` fault sites fire after each commit with the
+committed path, so `corrupt` fault plans damage real artifacts in
+tests.
 """
 
 from __future__ import annotations
@@ -39,6 +52,8 @@ import os
 import numpy as np
 
 from ..telemetry.registry import atomic_write
+from ..utils import faults
+from . import integrity
 
 STAGE1_FORMAT = "quorum_tpu_stage1_ckpt/1"
 STAGE1_SHARDED_FORMAT = "quorum_tpu_stage1_sharded/1"
@@ -56,6 +71,38 @@ class CheckpointError(RuntimeError):
 # retry loop can tell a deterministic refusal from a transient failure
 # across the main()-returns-int boundary
 NON_RETRYABLE_RC = 3
+
+
+def _check_seal_ckpt(doc: dict, what: str, path: str) -> None:
+    """Header self-digest check, surfaced as CheckpointError (the
+    refusal every checkpoint consumer already maps to rc 3). The
+    detection is still counted/evented by the integrity layer."""
+    try:
+        integrity.check_seal(doc, what, path)
+    except integrity.IntegrityError as e:
+        raise CheckpointError(str(e)) from None
+
+
+def _check_payload_crc(payload, header: dict, what: str,
+                       path: str) -> None:
+    """Verify a snapshot payload against its recorded digest (absent
+    on pre-ISSUE-8 artifacts: they keep loading on the length check
+    alone)."""
+    want = header.get("payload_crc32c")
+    if want is None:
+        return
+    got = integrity.crc32c(payload)
+    if got != int(want):
+        integrity.record_error(
+            f"{what} '{path}': payload digest mismatch (crc32c "
+            f"{got:#010x} != recorded {int(want):#010x})",
+            path=path, section="payload")
+        raise CheckpointError(
+            f"{what} '{path}' failed its payload digest (crc32c "
+            f"{got:#010x} != recorded {int(want):#010x}); the "
+            "snapshot is silently corrupted — refusing to resume "
+            "from it (delete it to start over)")
+    integrity.record_verified(len(payload))
 
 
 # ---------------------------------------------------------------------------
@@ -117,7 +164,13 @@ class Stage1Checkpoint:
         tag = np.ascontiguousarray(np.asarray(bstate.tag, dtype=np.uint32))
         hq = np.ascontiguousarray(np.asarray(bstate.hq, dtype=np.uint32))
         lq = np.ascontiguousarray(np.asarray(bstate.lq, dtype=np.uint32))
-        header = {
+        # payload digest: incremental CRC over the planes in write
+        # order, so load can refuse silent corruption (bit rot, torn
+        # sectors) — the length check alone only catches truncation
+        pcrc = integrity.crc32c(tag)
+        pcrc = integrity.crc32c(hq, pcrc)
+        pcrc = integrity.crc32c(lq, pcrc)
+        header = integrity.seal({
             "format": STAGE1_FORMAT,
             "k": meta.k,
             "bits": meta.bits,
@@ -132,7 +185,8 @@ class Stage1Checkpoint:
             "paths": list(paths),
             "tag_shape": list(tag.shape),
             "acc_len": int(hq.shape[0]),
-        }
+            "payload_crc32c": pcrc,
+        })
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(json.dumps(header).encode() + b"\n")
@@ -142,6 +196,8 @@ class Stage1Checkpoint:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        integrity.fsync_dir(self.path)
+        faults.inject("checkpoint.commit", path=self.path)
 
     def load(self) -> Stage1Snapshot | None:
         """The last valid snapshot, or None when there is none. A
@@ -161,6 +217,7 @@ class Stage1Checkpoint:
                 raise CheckpointError(
                     f"'{self.path}' is not a stage-1 checkpoint "
                     f"(format={header.get('format')!r})")
+            _check_seal_ckpt(header, "stage-1 checkpoint", self.path)
             rows, tile = header["tag_shape"]
             acc = header["acc_len"]
             want = (rows * tile + 2 * acc) * 4
@@ -169,6 +226,8 @@ class Stage1Checkpoint:
             raise CheckpointError(
                 f"corrupt stage-1 checkpoint '{self.path}': payload "
                 f"{len(payload)} bytes, want {want}")
+        _check_payload_crc(payload, header, "stage-1 checkpoint",
+                           self.path)
         arr = np.frombuffer(payload, dtype=np.uint32)
         tag = arr[:rows * tile].reshape(rows, tile)
         hq = arr[rows * tile:rows * tile + acc]
@@ -289,6 +348,7 @@ class Stage1ShardedCheckpoint:
             raise CheckpointError(
                 f"'{self.path}' is not a sharded stage-1 manifest "
                 f"(format={header.get('format')!r})")
+        _check_seal_ckpt(header, "sharded stage-1 manifest", self.path)
         return header
 
     def save(self, bstate, meta, cfg, cursor: int, stats, paths) -> None:
@@ -309,26 +369,36 @@ class Stage1ShardedCheckpoint:
         acc_local = rows_local * TSLOTS
         shards = _addressable_row_shards(bstate, S, meta.rows)
         for s, (tag_s, hq_s, lq_s) in shards.items():
-            header = {
+            tag_s = np.ascontiguousarray(tag_s)
+            hq_s = np.ascontiguousarray(hq_s)
+            lq_s = np.ascontiguousarray(lq_s)
+            pcrc = integrity.crc32c(tag_s)
+            pcrc = integrity.crc32c(hq_s, pcrc)
+            pcrc = integrity.crc32c(lq_s, pcrc)
+            header = integrity.seal({
                 "format": STAGE1_SHARD_FORMAT, "shard": s,
                 "n_shards": S, "gen": gen, "cursor": int(cursor),
                 "rb_log2": meta.rb_log2,
                 "rows_local": rows_local, "acc_local": acc_local,
-            }
+                "payload_crc32c": pcrc,
+            })
             tmp = self._shard_path(s, gen) + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(json.dumps(header).encode() + b"\n")
-                f.write(np.ascontiguousarray(tag_s).tobytes())
-                f.write(np.ascontiguousarray(hq_s).tobytes())
-                f.write(np.ascontiguousarray(lq_s).tobytes())
+                f.write(tag_s.tobytes())
+                f.write(hq_s.tobytes())
+                f.write(lq_s.tobytes())
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._shard_path(s, gen))
+            faults.inject("checkpoint.commit",
+                          path=self._shard_path(s, gen))
+        integrity.fsync_dir(self.dir)
         # every host's shards must be durable BEFORE the manifest
         # commits to this generation
         barrier("stage1_sharded_ckpt_save")
         if process_index() == 0:
-            atomic_write(self.path, json.dumps({
+            atomic_write(self.path, json.dumps(integrity.seal({
                 "format": STAGE1_SHARDED_FORMAT,
                 "gen": gen,
                 "cursor": int(cursor),
@@ -340,7 +410,8 @@ class Stage1ShardedCheckpoint:
                 "qual_thresh": int(cfg.qual_thresh),
                 "batch_size": int(cfg.batch_size),
                 "paths": list(paths),
-            }) + "\n")
+            })) + "\n")
+            faults.inject("checkpoint.commit", path=self.path)
         barrier("stage1_sharded_ckpt_commit")
         # the old generation is dead only now that the manifest moved on
         if old:
@@ -394,6 +465,8 @@ class Stage1ShardedCheckpoint:
                 raise CheckpointError(
                     f"corrupt shard snapshot '{p}': payload "
                     f"{len(payload)} bytes, want {want_payload}")
+            _check_seal_ckpt(h, "shard snapshot", p)
+            _check_payload_crc(payload, h, "shard snapshot", p)
             arr = np.frombuffer(payload, dtype=np.uint32)
             tags.append(arr[:rows_local * TILE].reshape(rows_local,
                                                         TILE))
@@ -464,6 +537,32 @@ def _addressable_row_shards(bstate, S: int, rows_total: int) -> dict:
 # ---------------------------------------------------------------------------
 
 
+class _CrcStream:
+    """A partial-output stream that tracks the running CRC32C of every
+    byte written (str writes are utf-8 encoded), so a journal commit
+    digests the committed ranges for free — no re-read pass. Binary
+    under the hood: `tell()` is a real byte offset, which is what the
+    journal records."""
+
+    def __init__(self, path: str, mode: str, crc: int = 0):
+        self._f = open(path, mode)
+        self.crc = crc
+
+    def write(self, data) -> int:
+        b = data.encode() if isinstance(data, str) else data
+        self.crc = integrity.crc32c(b, self.crc)
+        return self._f.write(b)
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class Stage2Journal:
     """Journal + partial-output lifecycle for one `-o PREFIX` run."""
 
@@ -474,6 +573,10 @@ class Stage2Journal:
         self.fa_partial = self.fa_final + ".partial"
         self.log_partial = self.log_final + ".partial"
         self.path = prefix + ".resume.json"
+        # the live CRC streams (set by open_outputs) whose running
+        # digests commit() records
+        self._out: _CrcStream | None = None
+        self._log: _CrcStream | None = None
 
     def load(self) -> dict | None:
         """The committed journal state, or None when there is nothing
@@ -492,6 +595,9 @@ class Stage2Journal:
             raise CheckpointError(
                 f"'{self.path}' is not a stage-2 journal "
                 f"(format={doc.get('format')!r})")
+        # self-digest: a flipped digit in a cursor or byte count can
+        # still parse as valid JSON — the seal catches it
+        _check_seal_ckpt(doc, "stage-2 journal", self.path)
         if not (os.path.exists(self.fa_partial)
                 and os.path.exists(self.log_partial)):
             return None
@@ -517,25 +623,58 @@ class Stage2Journal:
                     "to resume (remove the journal to start over)")
 
     def open_outputs(self, st: dict | None):
-        """Open the partial output streams. With a journal state,
-        truncate each partial back to its last committed byte length
-        first (a kill mid-write leaves a torn tail past the commit;
-        the truncate discards exactly that) and append; without one,
-        start fresh."""
+        """Open the partial output streams (CRC-tracking; see
+        _CrcStream). With a journal state, verify each partial's
+        committed byte range against the journaled digest (silent
+        corruption inside the committed range must refuse, not splice
+        into a clean-looking output), truncate back to the committed
+        length (a kill mid-write leaves a torn tail past the commit;
+        the truncate discards exactly that), and append with the CRC
+        state restored from the journal; without one, start fresh."""
         if st is not None:
-            for p, committed in ((self.fa_partial, st["fa_bytes"]),
-                                 (self.log_partial, st["log_bytes"])):
+            crcs = {}
+            for p, committed, key in (
+                    (self.fa_partial, st["fa_bytes"], "fa_crc32c"),
+                    (self.log_partial, st["log_bytes"], "log_crc32c")):
                 size = os.path.getsize(p)
+                committed = int(committed)
                 if size < committed:
                     raise CheckpointError(
                         f"'{p}' is {size} bytes but the journal "
                         f"committed {committed}; cannot resume")
+                want = st.get(key)
+                got = integrity.crc32c_file(p, 0, committed)
+                if want is not None:
+                    if got != int(want):
+                        integrity.record_error(
+                            f"'{p}': committed range digest mismatch "
+                            f"(crc32c {got:#010x} != journaled "
+                            f"{int(want):#010x})", path=p,
+                            section="committed", offset=0)
+                        raise CheckpointError(
+                            f"'{p}' is corrupted INSIDE the committed "
+                            f"{committed} bytes (crc32c {got:#010x} != "
+                            f"journaled {int(want):#010x}); resuming "
+                            "would splice damaged output — refusing "
+                            "(remove the partials and journal to "
+                            "start over)")
+                    integrity.record_verified(committed)
+                # seed the stream with the COMPUTED digest either way:
+                # a pre-upgrade journal carries no digest, and seeding
+                # 0 there would make the next commit journal a CRC
+                # covering only the post-resume bytes — a later resume
+                # would then refuse an undamaged file
+                crcs[key] = got
                 with open(p, "r+b") as f:
-                    f.truncate(int(committed))
-            mode = "a"
+                    f.truncate(committed)
+            self._out = _CrcStream(self.fa_partial, "ab",
+                                   crc=crcs.get("fa_crc32c", 0))
+            self._log = _CrcStream(self.log_partial, "ab",
+                                   crc=crcs.get("log_crc32c", 0))
         else:
-            mode = "w"
-        return open(self.fa_partial, mode), open(self.log_partial, mode)
+            self._out = _CrcStream(self.fa_partial, "wb")
+            self._log = _CrcStream(self.log_partial, "wb")
+        return self._out, self._log
 
     def commit(self, batches: int, stats, fa_bytes: int,
                log_bytes: int, batch_size: int,
@@ -544,8 +683,11 @@ class Stage2Journal:
         written, and flushed. Caller guarantees the flush happened
         BEFORE this call — the journal must never claim bytes the
         partials might not have. `context` (db path, input paths,
-        config fingerprint) is what check_config holds a resume to."""
-        atomic_write(self.path, json.dumps({
+        config fingerprint) is what check_config holds a resume to.
+        The committed ranges' running digests (from the CRC streams)
+        and the document's self-seal ride along, so both torn-write
+        corruption and journal tampering refuse on resume."""
+        doc = {
             "format": STAGE2_FORMAT,
             "batches": int(batches),
             "fa_bytes": int(fa_bytes),
@@ -557,7 +699,12 @@ class Stage2Journal:
             "skipped": int(stats.skipped),
             "bases_in": int(stats.bases_in),
             "bases_out": int(stats.bases_out),
-        }) + "\n")
+        }
+        if self._out is not None and self._log is not None:
+            doc["fa_crc32c"] = self._out.crc
+            doc["log_crc32c"] = self._log.crc
+        atomic_write(self.path, json.dumps(integrity.seal(doc)) + "\n")
+        faults.inject("journal.append", path=self.path)
 
     def batches_done(self) -> int | None:
         """Peek at the journaled batch cursor (driver retry events)."""
@@ -579,6 +726,9 @@ class Stage2Journal:
             os.remove(self.path)
         except FileNotFoundError:
             pass
+        # the promoted outputs must survive power loss, not just
+        # process death: sync the directory entries the renames moved
+        integrity.fsync_dir(self.fa_final)
 
 
 # ---------------------------------------------------------------------------
@@ -629,13 +779,21 @@ class ReplayCache:
             return None
         if doc.get("format") != REPLAY_FORMAT:
             return None
+        # a tampered/bit-rotted manifest is CORRUPTION, not a missing
+        # capture: refuse loudly (rc 3) rather than silently reusing
+        # byte counts and digests that no longer describe the payloads
+        _check_seal_ckpt(doc, "replay-cache manifest",
+                         self.manifest_path)
         return doc
 
     def load(self, identity: dict):
         """A complete, identity-matched capture, or None (caller falls
         back to the disk re-parse). Returns an object whose
         `.batches()` yields fresh (ReadBatch, PackedReads) pairs per
-        call (driver retries need a new iterator per attempt)."""
+        call (driver retries need a new iterator per attempt). A
+        capture that exists but fails its digests raises
+        CheckpointError — damaged bytes must never be silently
+        replayed into stage 2."""
         doc = self.manifest()
         if doc is None or doc.get("identity") != identity:
             return None
@@ -643,7 +801,7 @@ class ReplayCache:
         if n < 0 or not all(os.path.exists(self._batch_path(i))
                             for i in range(n)):
             return None
-        return _ReplayReader(self, n)
+        return _ReplayReader(self, n, doc.get("payloads"))
 
     def clear(self) -> None:
         import shutil
@@ -663,6 +821,7 @@ class _ReplayWriter:
         self.bytes = 0
         self.n = 0
         self.ok = True
+        self.payloads: list[dict] = []  # per-batch {bytes, crc32c}
 
     def add(self, batch, pk) -> None:
         if not self.ok:
@@ -687,7 +846,12 @@ class _ReplayWriter:
             with open(path + ".tmp", "wb") as f:
                 np.savez(f, **arrays)
             os.replace(path + ".tmp", path)
-            self.bytes += os.path.getsize(path)
+            size = os.path.getsize(path)
+            # npz writes seek (zip central directory), so the digest
+            # is a read-back — page-cache-hot, one pass per batch
+            self.payloads.append(
+                {"bytes": size, "crc32c": integrity.crc32c_file(path)})
+            self.bytes += size
         except OSError:
             self.abort()
             return
@@ -704,19 +868,51 @@ class _ReplayWriter:
         disk (atomic_write = the commit point)."""
         if not self.ok:
             return False
-        atomic_write(self.cache.manifest_path, json.dumps({
-            "format": REPLAY_FORMAT,
-            "identity": self.identity,
-            "n_batches": self.n,
-            "bytes": self.bytes,
-        }) + "\n")
+        atomic_write(self.cache.manifest_path, json.dumps(
+            integrity.seal({
+                "format": REPLAY_FORMAT,
+                "identity": self.identity,
+                "n_batches": self.n,
+                "bytes": self.bytes,
+                "payloads": self.payloads,
+            })) + "\n")
         return True
 
 
 class _ReplayReader:
-    def __init__(self, cache: ReplayCache, n: int):
+    def __init__(self, cache: ReplayCache, n: int,
+                 payloads: list | None = None):
         self.cache = cache
         self.n_batches = n
+        self.payloads = payloads
+
+    def _check_batch(self, i: int, path: str) -> None:
+        """Verify batch `i` against the manifest's digest before it
+        is decoded — a corrupted capture must refuse (CheckpointError
+        → rc 3), never feed damaged reads into stage 2."""
+        if not self.payloads or i >= len(self.payloads):
+            return  # pre-ISSUE-8 capture: no digests recorded
+        want = self.payloads[i]
+        size = os.path.getsize(path)
+        if size != int(want.get("bytes", -1)):
+            raise CheckpointError(
+                f"replay-cache batch '{path}' is {size} bytes but the "
+                f"manifest recorded {want.get('bytes')}; the capture "
+                "is damaged — delete the replay directory to re-parse")
+        got = integrity.crc32c_file(path)
+        if got != int(want.get("crc32c", -1)):
+            integrity.record_error(
+                f"replay-cache batch '{path}': digest mismatch "
+                f"(crc32c {got:#010x} != manifest "
+                f"{int(want.get('crc32c', -1)):#010x})",
+                path=path, section="batch", offset=0)
+            raise CheckpointError(
+                f"replay-cache batch '{path}' failed its digest "
+                f"(crc32c {got:#010x} != manifest "
+                f"{int(want.get('crc32c', -1)):#010x}); refusing to "
+                "replay corrupted reads — delete the replay "
+                "directory to re-parse from FASTQ")
+        integrity.record_verified(size)
 
     def batches(self):
         """Fresh lazy iterator of (ReadBatch, PackedReads) pairs."""
@@ -724,6 +920,7 @@ class _ReplayReader:
 
         def gen():
             for i in range(self.n_batches):
+                self._check_batch(i, self.cache._batch_path(i))
                 with np.load(self.cache._batch_path(i),
                              allow_pickle=False) as z:
                     pk = packing.PackedReads(

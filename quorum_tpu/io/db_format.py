@@ -6,7 +6,20 @@ JSON header followed by the raw table arrays
 (reference: database_header src/mer_database.hpp:43-63,
 hash_with_quality::write :115-126, reload via database_query :270-278).
 
-Four payload versions:
+Five payload versions:
+
+* version 5 (the default export since ISSUE 8): the v4 payload
+  byte-for-byte, plus an integrity layer — the header carries per-
+  section CRC32C digests (bucket index, entry payload, and per-chunk
+  digests of the entries so serve reloads can scrub a sample instead
+  of the whole file), and a trailer line after the payload carries
+  the header's own digest and the whole-file digest. Loaders verify
+  per `verify={"full","sample","off"}` (full by default); a bad
+  digest is an IntegrityError → rc 3 refusal, counted in
+  `integrity_errors_total`. `quorum-fsck` pinpoints damaged sections
+  offline. All digests are derived in ONE pass over the payload
+  (chunk CRCs folded with the GF(2) combine), so the write cost is
+  one numpy CRC sweep on top of v4.
 
 * version 4 (written by stage 1, round 5): leanest entry-compact
   layout — per-row occupancy counts (u8[rows]) followed by the
@@ -49,8 +62,23 @@ import jax.numpy as jnp
 
 from ..ops import ctable
 from ..ops.ctable import TileMeta, TileState
+from ..utils import faults
+from . import integrity
+from .integrity import IntegrityError  # noqa: F401 (re-export)
 
 FORMAT = "binary/quorum_tpu_db"
+TRAILER_FORMAT = "quorum_tpu_db_trailer/1"
+
+# the default export version (write_db / --db-version); v4 stays
+# readable and byte-compatible (a v5 payload IS the v4 payload)
+DEFAULT_DB_VERSION = 5
+
+# entry-payload digest granularity: small enough that a sampled serve
+# reload scrub touches a bounded slice, big enough that the chunk list
+# stays tiny (a 1 GiB payload carries 256 digests)
+CHECKSUM_CHUNK_BYTES = 4 << 20
+
+VERIFY_MODES = ("full", "sample", "off")
 
 
 def _header_common(cmdline):
@@ -64,25 +92,78 @@ def _header_common(cmdline):
     }
 
 
-def _atomic_db_write(path: str, header: dict, payload: bytes) -> None:
+def _atomic_db_write(path: str, header: dict, payload: bytes,
+                     trailer=None) -> None:
     """tmp-then-rename with fsync: a kill mid-write must never leave
     a torn (or unflushed-then-renamed) file at `path` — the quorum
-    driver's --resume treats an existing database as stage 1 done."""
+    driver's --resume treats an existing database as stage 1 done.
+    The parent directory is fsync'd after the rename so the committed
+    file also survives power loss, not just process death. `trailer`
+    (v5), when given, is called with the serialized header line and
+    returns the trailer bytes appended after the payload."""
     tmp = path + ".tmp"
+    line = json.dumps(header).encode() + b"\n"
     with open(tmp, "wb") as f:
-        f.write(json.dumps(header).encode() + b"\n")
+        f.write(line)
         f.write(payload)
+        if trailer is not None:
+            f.write(trailer(line))
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    integrity.fsync_dir(path)
+    # chaos-harness site: a `corrupt` fault here flips/zeroes bytes in
+    # the file JUST committed, so tests inject real on-disk damage at
+    # the exact artifact boundary instead of hand-editing files
+    faults.inject("db.write", path=path)
+
+
+def _v5_checksums(buf: np.ndarray, rows_n: int) -> tuple[dict, int]:
+    """Per-section CRC32C digests of a v4/v5 payload (`buf` = counts
+    plane + entry planes): the bucket-index digest, per-chunk entry
+    digests, and section/payload digests DERIVED from them with the
+    GF(2) combine — one data pass total. Returns (checksum header
+    dict, payload crc)."""
+    counts_crc = integrity.crc32c(buf[:rows_n])
+    entries = buf[rows_n:]
+    e_len = int(entries.shape[0])
+    chunk = CHECKSUM_CHUNK_BYTES
+    chunks = [integrity.crc32c(entries[i:i + chunk])
+              for i in range(0, e_len, chunk)]
+    entries_crc = 0
+    done = 0
+    for i, c in enumerate(chunks):
+        clen = min(chunk, e_len - i * chunk)
+        entries_crc = integrity.crc32c_combine(entries_crc, c, clen)
+        done += clen
+    payload_crc = integrity.crc32c_combine(counts_crc, entries_crc,
+                                           e_len)
+    return {
+        "algo": "crc32c",
+        "chunk_bytes": chunk,
+        "sections": {
+            "bucket_index": {"offset": 0, "length": rows_n,
+                             "crc32c": counts_crc},
+            "entries": {"offset": rows_n, "length": e_len,
+                        "crc32c": entries_crc,
+                        "chunks": chunks},
+        },
+    }, payload_crc
 
 
 def write_db(path: str, state, meta, cmdline: list[str] | None = None,
-             compact: bool = True, n_entries: int | None = None) -> None:
+             compact: bool = True, n_entries: int | None = None,
+             db_version: int = DEFAULT_DB_VERSION) -> None:
     """`n_entries` (optional) spares the occupancy-counting pass when
-    the caller already knows it (stage 1's tile_seal does)."""
+    the caller already knows it (stage 1's tile_seal does).
+    `db_version` selects the compact export format: 5 (default)
+    writes the v4 payload plus per-section CRC32C digests and a
+    whole-file-digest trailer; 4 writes the bare round-5 layout."""
     if isinstance(meta, TileMeta):
         if compact:
+            if db_version not in (4, 5):
+                raise ValueError(
+                    f"db_version must be 4 or 5, got {db_version}")
             # v4: per-row occupancy counts (u8[rows]) + the occupied
             # entries' lo words + only the LIVE bytes of their hi
             # words, in row-major entry order (the bucket address is
@@ -106,7 +187,7 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
                 + [hi_pl[j, :n] for j in range(hi_bytes)]))
             header = {
                 "format": FORMAT,
-                "version": 4,
+                "version": db_version,
                 "key_len": 2 * meta.k,
                 "bits": meta.bits,
                 "rb_log2": meta.rb_log2,
@@ -116,7 +197,22 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
                 "value_bytes": int(buf.nbytes),
                 **_header_common(cmdline),
             }
-            _atomic_db_write(path, header, buf.tobytes())
+            trailer = None
+            if db_version >= 5:
+                cks, payload_crc = _v5_checksums(buf, meta.rows)
+                header["checksum"] = cks
+
+                def trailer(line: bytes,
+                            _pc=payload_crc, _n=int(buf.nbytes)):
+                    hcrc = integrity.crc32c(line)
+                    fcrc = integrity.crc32c_combine(hcrc, _pc, _n)
+                    return (json.dumps({
+                        "format": TRAILER_FORMAT,
+                        "header_crc32c": hcrc,
+                        "file_crc32c": fcrc,
+                    }) + "\n").encode()
+            _atomic_db_write(path, header, buf.tobytes(),
+                             trailer=trailer)
             return
         rows = np.asarray(state.rows, dtype=np.uint32)
         header = {
@@ -148,7 +244,9 @@ def read_header(path: str) -> dict:
 
         try:
             ref_header, _ = ref_db.read_ref_header(path)
-        except ref_db.RefHeaderError:
+        except (ref_db.RefHeaderError, UnicodeDecodeError):
+            # UnicodeDecodeError: a corrupted byte inside what brace-
+            # matching took for a JSON header — still "not ours"
             raise ValueError(
                 f"'{path}' is not a quorum_tpu database (no JSON header)"
             ) from None
@@ -160,12 +258,156 @@ def read_header(path: str) -> dict:
     return header
 
 
+def _read_trailer(path: str, payload_end: int) -> dict:
+    """The v5 trailer line (after the payload). Raises IntegrityError
+    (recorded) when missing or unparseable — a v5 file without its
+    trailer is a truncated file."""
+    with open(path, "rb") as f:
+        f.seek(payload_end)
+        line = f.readline(1 << 20)
+    try:
+        trailer = json.loads(line)
+    except ValueError:
+        trailer = None
+    if not isinstance(trailer, dict) \
+            or trailer.get("format") != TRAILER_FORMAT:
+        raise integrity.record_error(
+            f"v5 database '{path}' has no valid trailer at offset "
+            f"{payload_end} (truncated or overwritten file)",
+            path=path, section="trailer", offset=payload_end)
+    return trailer
+
+
+def _verify_v5(path: str, header: dict, offset: int, mode: str,
+               no_mmap: bool = False, collect: list | None = None
+               ) -> int:
+    """Verify a v5 database's digests per `mode` ("full" checks every
+    section plus the derived whole-file digest; "sample" scrubs the
+    header, the bucket index, and a random subset of entry chunks —
+    the latency-sensitive serve-reload path). Returns the number of
+    payload/header bytes verified. With `collect` (quorum-fsck), every
+    problem is appended as (section, offset, message) and checking
+    continues instead of raising on the first."""
+    import random
+
+    def bad(section, off, msg):
+        if collect is not None:
+            collect.append((section, off, msg))
+            return None
+        raise integrity.record_error(msg, path=path, section=section,
+                                     offset=off)
+
+    cks = header.get("checksum") or {}
+    sections = cks.get("sections") or {}
+    bi = sections.get("bucket_index") or {}
+    en = sections.get("entries") or {}
+    if cks.get("algo") != "crc32c" or not bi or not en:
+        bad("header", 0, f"v5 database '{path}' header carries no "
+            "usable checksum section")
+        return 0
+    payload_len = int(bi.get("length", 0)) + int(en.get("length", 0))
+    try:
+        trailer = _read_trailer(path, offset + payload_len)
+    except integrity.IntegrityError as e:
+        if collect is None:
+            raise
+        collect.append((e.section or "trailer", e.offset, str(e)))
+        trailer = {}
+
+    with open(path, "rb") as f:
+        line = f.readline(1 << 20)
+    verified = len(line)
+    hcrc = integrity.crc32c(line)
+    if hcrc != int(trailer.get("header_crc32c", -1)):
+        bad("header", 0,
+            f"v5 database '{path}': header digest mismatch (crc32c "
+            f"{hcrc:#010x} != trailer "
+            f"{int(trailer.get('header_crc32c', -1)):#010x})")
+
+    if no_mmap:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            payload = np.frombuffer(f.read(payload_len), np.uint8)
+    else:
+        size = os.path.getsize(path)
+        avail = max(0, min(payload_len, size - offset))
+        payload = np.memmap(path, dtype=np.uint8, mode="r",
+                            offset=offset, shape=(avail,))
+    if payload.shape[0] != payload_len:
+        bad("entries", offset,
+            f"v5 database '{path}': payload truncated "
+            f"({payload.shape[0]} of {payload_len} bytes)")
+        return verified
+
+    bi_len = int(bi["length"])
+    got = integrity.crc32c(payload[:bi_len])
+    verified += bi_len
+    if got != int(bi.get("crc32c", -1)):
+        bad("bucket_index", offset,
+            f"v5 database '{path}': bucket index digest mismatch "
+            f"(crc32c {got:#010x} != header "
+            f"{int(bi.get('crc32c', -1)):#010x})")
+
+    chunk = int(cks.get("chunk_bytes", CHECKSUM_CHUNK_BYTES))
+    chunks = list(en.get("chunks", []))
+    e_len = int(en["length"])
+    want_chunks = -(-e_len // chunk) if e_len else 0
+    if len(chunks) != want_chunks:
+        bad("entries", offset + bi_len,
+            f"v5 database '{path}': {len(chunks)} chunk digests for "
+            f"{want_chunks} chunks")
+        return verified
+    idxs = list(range(len(chunks)))
+    if mode == "sample" and len(chunks) > 4:
+        seed = os.environ.get("QUORUM_VERIFY_SAMPLE_SEED")
+        rng = random.Random(int(seed)) if seed else random.Random()
+        idxs = sorted(rng.sample(range(len(chunks)),
+                                 max(4, len(chunks) // 16)))
+    entries = payload[bi_len:]
+    for i in idxs:
+        lo, hi = i * chunk, min((i + 1) * chunk, e_len)
+        got = integrity.crc32c(entries[lo:hi])
+        verified += hi - lo
+        if got != int(chunks[i]):
+            bad("entries", offset + bi_len + lo,
+                f"v5 database '{path}': entry chunk {i} digest "
+                f"mismatch at payload offset {bi_len + lo} (crc32c "
+                f"{got:#010x} != header {int(chunks[i]):#010x})")
+    if mode == "full" and len(idxs) == len(chunks):
+        # the section and whole-file digests are derivable from the
+        # verified chunks — checking them costs only combines and
+        # catches header/trailer tampering that kept the chunks valid
+        ecrc = 0
+        for i, c in enumerate(chunks):
+            clen = min(chunk, e_len - i * chunk)
+            ecrc = integrity.crc32c_combine(ecrc, int(c), clen)
+        if ecrc != int(en.get("crc32c", -1)):
+            bad("entries", offset + bi_len,
+                f"v5 database '{path}': entries section digest "
+                "disagrees with its chunk digests")
+        pcrc = integrity.crc32c_combine(int(bi["crc32c"]), ecrc, e_len)
+        fcrc = integrity.crc32c_combine(hcrc, pcrc, payload_len)
+        if fcrc != int(trailer.get("file_crc32c", -1)):
+            bad("trailer", offset + payload_len,
+                f"v5 database '{path}': whole-file digest mismatch "
+                f"(crc32c {fcrc:#010x} != trailer "
+                f"{int(trailer.get('file_crc32c', -1)):#010x})")
+    return verified
+
+
 def read_db(path: str, to_device: bool = True,
-            no_mmap: bool = False):
+            no_mmap: bool = False, verify: str | None = None):
     """Load a database file. Returns (state, meta, header) — always
     (TileState, TileMeta); legacy version-1 (wide full-key) files are
     converted to the tile layout at load. With to_device the arrays
     are jnp (HBM); else host numpy views.
+
+    `verify` ("full" by default, "sample", "off") controls checksum
+    verification of v5 files BEFORE any array is trusted: a digest
+    mismatch raises IntegrityError (rc 3 at the CLIs) and lands in
+    `integrity_errors_total` plus an `integrity_error` event — never
+    a silent load of damaged bytes. Pre-v5 files carry no digests;
+    their structural checks below still run.
 
     The reference mmaps by default with a --no-mmap escape hatch
     (map_or_read_file, src/mer_database.hpp:228-248); we always memmap
@@ -201,27 +443,45 @@ def read_db(path: str, to_device: bool = True,
         return np.memmap(path, dtype=dtype, mode="r", offset=off,
                          shape=shape)
 
-    if header.get("version", 1) == 4:
+    version = header.get("version", 1)
+    if version in (4, 5):
+        mode = verify or "full"
+        if mode not in VERIFY_MODES:
+            raise ValueError(f"verify must be one of {VERIFY_MODES}, "
+                             f"got {mode!r}")
+        if version == 5:
+            nbytes = 0
+            if mode != "off":
+                nbytes = _verify_v5(path, header, offset, mode,
+                                    no_mmap=no_mmap)
+            # declare the feature (and land the counters at 0 even
+            # for mode=off) so metrics_check holds the document to it
+            integrity.record_verified(nbytes, db_version=5,
+                                      verify_db=mode)
         n = header["n_entries"]
         meta = TileMeta(k=header["key_len"] // 2, bits=header["bits"],
                         rb_log2=header["rb_log2"])
         hi_bytes = header["hi_bytes"]
         want_hb = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
         if hi_bytes != want_hb:
-            raise ValueError(
-                f"corrupt v4 database '{path}': hi_bytes {hi_bytes} != "
-                f"{want_hb} for this geometry")
+            raise integrity.record_error(
+                f"corrupt v{version} database '{path}': hi_bytes "
+                f"{hi_bytes} != {want_hb} for this geometry",
+                path=path, section="header", offset=0)
         rows_n = meta.rows
         payload = plane(np.uint8, offset, (rows_n + (4 + hi_bytes) * n,))
         counts = np.asarray(payload[:rows_n])
         if n and counts.max() > ctable.TILE // 2:
-            raise ValueError(
-                f"corrupt v4 database '{path}': {int(counts.max())} "
-                f"entries in one bucket (capacity {ctable.TILE // 2})")
+            raise integrity.record_error(
+                f"corrupt v{version} database '{path}': "
+                f"{int(counts.max())} entries in one bucket "
+                f"(capacity {ctable.TILE // 2})",
+                path=path, section="bucket_index", offset=offset)
         if int(counts.sum()) != n:
-            raise ValueError(
-                f"corrupt v4 database '{path}': row counts sum "
-                f"{int(counts.sum())} != n_entries {n}")
+            raise integrity.record_error(
+                f"corrupt v{version} database '{path}': row counts "
+                f"sum {int(counts.sum())} != n_entries {n}",
+                path=path, section="bucket_index", offset=offset)
         lo = np.ascontiguousarray(
             payload[rows_n:rows_n + 4 * n]).view(np.uint32)
         hi = np.zeros((n,), np.uint32)
@@ -305,6 +565,47 @@ def read_db(path: str, to_device: bool = True,
     if not to_device:
         state = TileState(np.asarray(state.rows))
     return state, meta, header
+
+
+def db_payload_bytes(path: str) -> bytes:
+    """Exactly the table payload of a native database file — what the
+    byte-parity guarantees (--devices N vs 1, kill→resume) are stated
+    over. Before v5 this was simply 'everything after the header
+    line'; v5 appends a trailer whose digests cover the (timestamped,
+    legitimately run-varying) header, so parity checks must slice the
+    payload proper."""
+    with open(path, "rb") as f:
+        header = json.loads(f.readline(1 << 20))
+        return f.read(int(header["value_bytes"]))
+
+
+def verify_db_file(path: str, mode: str = "full"
+                   ) -> tuple[dict, list[tuple]]:
+    """Offline verification for quorum-fsck: returns (header,
+    problems), each problem a (section, offset, message) tuple —
+    empty list = clean. v5 files get the checksum walk in collect-all
+    mode (every damaged section reported, not just the first); pre-v5
+    files get the structural host load (counts/addresses/truncation),
+    reported under one "payload" section."""
+    header = read_header(path)  # raises on foreign/unparseable files
+    version = header.get("version", 1)
+    with open(path, "rb") as f:
+        offset = len(f.readline())
+    problems: list[tuple] = []
+    if version >= 5:
+        if mode != "off":
+            _verify_v5(path, header, offset, mode, collect=problems)
+        # the digests cover every payload byte — a structural host
+        # load after a clean checksum walk adds passes, not detection
+        # power (pre-v5 files have only the structural checks)
+        return header, problems
+    if mode == "off":
+        return header, []
+    try:
+        read_db(path, to_device=False, verify="off")
+    except (ValueError, AssertionError, KeyError, OSError) as e:
+        problems.append(("payload", None, str(e)))
+    return header, problems
 
 
 # ---------------------------------------------------------------------------

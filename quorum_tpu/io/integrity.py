@@ -1,0 +1,317 @@
+"""Data-integrity primitives: CRC32C digests, sealed JSON headers,
+and the verification telemetry hook (ISSUE 8).
+
+Every artifact the pipeline persists — the mer database, stage-1
+snapshots, the stage-2 resume journal, the driver's replay cache — was
+trusted byte-for-byte after at most a header/geometry check, so silent
+corruption (torn writes the rename race can't catch, bit rot on
+long-lived DBs, truncated shard payloads) flowed straight into wrong
+corrections or undefined resume behavior. KMC 3 ships `kmc_tools` as a
+first-class verifier for its on-disk databases (PAPERS.md); this
+module is the primitive layer under quorum-tpu's equivalent:
+
+* `crc32c()` — CRC-32C (Castagnoli), the checksum every artifact
+  carries. Pure numpy software implementation: small inputs take a
+  scalar table loop, larger ones are split into equal chunks whose
+  CRCs are computed column-vectorized (every chunk advances one byte
+  per numpy step) and folded with the GF(2) combine operator — the
+  classic zlib `crc32_combine` construction — so throughput scales
+  with numpy, not the Python interpreter. Chains like `zlib.crc32`:
+  ``crc32c(b, crc32c(a)) == crc32c(a + b)``.
+* `crc32c_combine()` — CRC of a concatenation from the parts' CRCs,
+  used to derive section/whole-file digests from chunk digests in one
+  data pass (db_format's v5 writer) and to fold the vectorized chunks.
+* `seal()` / `check_seal()` — self-digesting JSON headers: the
+  document's CRC over its own canonical serialization (sort_keys,
+  minus the digest field) rides in a `crc32c` field, so a flipped
+  digit in a cursor or byte count is caught even when the mutation
+  still parses as valid JSON.
+* `IntegrityError` — the refusal. A ValueError subclass (existing
+  corrupt-artifact handlers keep working) that the CLIs map to the
+  non-retryable rc 3: resuming or serving from damaged bytes must
+  fail loudly, never silently reuse them.
+* the registry hook — loaders report what they verified
+  (`integrity_bytes_verified_total`) and what they refused
+  (`integrity_errors_total`, plus a structured `integrity_error`
+  event naming file/section/offset) into the run's ambient metrics
+  registry, installed by cli/observability.observability().
+* `fsync_dir()` — directory durability for the atomic-rename commit
+  protocol: a rename is only power-loss-durable once the parent
+  directory entry is synced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+# CRC-32C (Castagnoli), reflected polynomial — the variant iSCSI/ext4
+# use and SSE4.2/ARMv8 implement in hardware.
+_POLY = np.uint32(0x82F63B78)
+
+# below this many bytes the scalar loop beats the vectorized setup
+_VECTOR_MIN = 1 << 12
+
+
+def _make_table() -> np.ndarray:
+    crc = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+        crc = np.where(crc & 1, (crc >> 1) ^ _POLY, crc >> 1)
+    return crc
+
+
+_TABLE = _make_table()
+_TABLE_PY = [int(x) for x in _TABLE]  # scalar loop avoids numpy boxing
+
+
+class IntegrityError(ValueError):
+    """An artifact failed checksum/digest verification. A ValueError
+    so existing corrupt-file handlers still catch it; the CLIs map it
+    (like CheckpointError) to the non-retryable rc 3 — a damaged
+    artifact is deterministic, retrying cannot help."""
+
+    def __init__(self, message: str, path: str | None = None,
+                 section: str | None = None, offset: int | None = None):
+        super().__init__(message)
+        self.path = path
+        self.section = section
+        self.offset = offset
+
+
+def _as_bytes_view(data) -> np.ndarray:
+    """A uint8 view of bytes/bytearray/memoryview/ndarray without
+    copying (C-contiguous input) or with one copy (non-contiguous)."""
+    if isinstance(data, np.ndarray):
+        return np.frombuffer(
+            memoryview(np.ascontiguousarray(data)).cast("B"), np.uint8)
+    return np.frombuffer(memoryview(data).cast("B"), np.uint8)
+
+
+def _crc_scalar(buf: np.ndarray, c: int) -> int:
+    t = _TABLE_PY
+    for b in buf.tolist():
+        c = t[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c
+
+
+# -- GF(2) combine (zlib crc32_combine, ported to the C polynomial) --------
+
+def _gf2_times(mat: list[int], vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= mat[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_square(mat: list[int]) -> list[int]:
+    return [_gf2_times(mat, mat[n]) for n in range(32)]
+
+
+def _zero_operator(nbytes: int) -> list[int]:
+    """The 32x32 GF(2) matrix advancing a (raw) CRC register past
+    `nbytes` zero bytes — multiplication by x^(8*nbytes) mod P in the
+    reflected domain."""
+    odd = [int(_POLY)] + [1 << (n - 1) for n in range(1, 32)]
+    even = _gf2_square(odd)   # x^2
+    odd = _gf2_square(even)   # x^4
+    # even/odd now alternate as squares; accumulate the bits of 8*n
+    op = None
+    mat = _gf2_square(odd)    # x^8: one zero byte
+    n = nbytes
+    while n:
+        if n & 1:
+            op = mat if op is None else [_gf2_times(mat, r) for r in op]
+        n >>= 1
+        if n:
+            mat = _gf2_square(mat)
+    return op if op is not None else [1 << i for i in range(32)]
+
+
+_OP_CACHE: dict[int, list[int]] = {}
+
+
+def _zero_operator_cached(nbytes: int) -> list[int]:
+    op = _OP_CACHE.get(nbytes)
+    if op is None:
+        if len(_OP_CACHE) > 64:  # bounded: lengths are few in practice
+            _OP_CACHE.clear()
+        op = _OP_CACHE[nbytes] = _zero_operator(nbytes)
+    return op
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC32C of A+B given crc32c(A), crc32c(B), and len(B). Same
+    construction as zlib's crc32_combine: the pre/post-conditioning
+    XORs cancel, leaving one matrix application plus an XOR."""
+    if len2 == 0:
+        return crc1
+    return _gf2_times(_zero_operator_cached(len2), crc1) ^ crc2
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC-32C of `data` (bytes-like or ndarray), chained from `crc`
+    (the finalized CRC of everything before it, like zlib.crc32)."""
+    buf = _as_bytes_view(data)
+    n = buf.shape[0]
+    c = (crc ^ 0xFFFFFFFF) & 0xFFFFFFFF
+    if n < _VECTOR_MIN:
+        return _crc_scalar(buf, c) ^ 0xFFFFFFFF
+    # vectorized: K equal chunks of L bytes advance in lockstep, one
+    # byte per numpy step; per-chunk CRCs fold left-to-right with the
+    # combine operator for L. L ~ sqrt(n) balances the Python loop
+    # (L iterations) against the fold (K applications), rounded to a
+    # power of two so the operator cache hits across calls.
+    L = 1 << max(11, min(24, int(n).bit_length() // 2))
+    K = n // L
+    body = K * L
+    cols = np.ascontiguousarray(buf[:body].reshape(K, L).T)
+    crcs = np.full((K,), 0xFFFFFFFF, np.uint32)
+    for j in range(L):
+        crcs = _TABLE[(crcs ^ cols[j]) & np.uint32(0xFF)] ^ (crcs >> 8)
+    crcs ^= np.uint32(0xFFFFFFFF)
+    total = c ^ 0xFFFFFFFF  # back to finalized form for combine
+    op = _zero_operator_cached(L)
+    for chunk_crc in crcs.tolist():
+        total = _gf2_times(op, total) ^ chunk_crc
+    if body < n:
+        total = _crc_scalar(buf[body:],
+                            (total ^ 0xFFFFFFFF)) ^ 0xFFFFFFFF
+    return total
+
+
+def crc32c_file(path: str, start: int = 0, length: int | None = None,
+                crc: int = 0, block: int = 8 << 20) -> int:
+    """Streaming CRC32C of `length` bytes of `path` from `start`
+    (None = to EOF), chained from `crc`."""
+    with open(path, "rb") as f:
+        f.seek(start)
+        remaining = length
+        while remaining is None or remaining > 0:
+            want = block if remaining is None else min(block, remaining)
+            chunk = f.read(want)
+            if not chunk:
+                if remaining is not None:
+                    raise IntegrityError(
+                        f"'{path}' ends {remaining} bytes short of the "
+                        f"digested range", path=path, offset=start)
+                break
+            crc = crc32c(chunk, crc)
+            if remaining is not None:
+                remaining -= len(chunk)
+    return crc
+
+
+# -- sealed JSON headers ---------------------------------------------------
+
+SEAL_FIELD = "crc32c"
+
+
+def seal(doc: dict) -> dict:
+    """Return `doc` plus its self-digest: the CRC32C of the canonical
+    (sort_keys) serialization minus the digest field itself."""
+    body = json.dumps({k: v for k, v in doc.items() if k != SEAL_FIELD},
+                      sort_keys=True).encode()
+    return {**doc, SEAL_FIELD: crc32c(body)}
+
+
+def check_seal(doc: dict, what: str, path: str) -> None:
+    """Verify a sealed document's self-digest. A document without the
+    field passes (pre-v5 artifacts stay loadable); a mismatch raises
+    IntegrityError and records the detection."""
+    want = doc.get(SEAL_FIELD)
+    if want is None:
+        return
+    body = json.dumps({k: v for k, v in doc.items() if k != SEAL_FIELD},
+                      sort_keys=True).encode()
+    got = crc32c(body)
+    if got != int(want):
+        raise record_error(
+            f"{what} '{path}' failed its header self-digest "
+            f"(crc32c {got:#010x} != recorded {int(want):#010x}) — "
+            "the document was altered after it was written",
+            path=path, section="header", offset=0)
+    record_verified(len(body))
+
+
+# -- verification telemetry hook -------------------------------------------
+# Loaders run deep below the CLIs, so the active run's registry is
+# installed ambiently (cli/observability.observability() does it, the
+# same pattern utils/faults.py uses for the fault plan). NULL-safe:
+# with no registry installed every record call is a no-op.
+
+_REG = None
+
+COUNTER_ERRORS = "integrity_errors_total"
+COUNTER_BYTES = "integrity_bytes_verified_total"
+
+
+def install_registry(reg):
+    """Install the ambient metrics registry for verification
+    telemetry; returns the previous one (nest/restore discipline)."""
+    global _REG
+    prev = _REG
+    _REG = reg
+    return prev
+
+
+def _registry():
+    reg = _REG
+    return reg if reg is not None and getattr(reg, "enabled", False) \
+        else None
+
+
+def record_verified(nbytes: int, **meta) -> None:
+    """Count `nbytes` of artifact bytes that passed verification;
+    `meta` (db_version=..., verify_db=...) declares the feature in the
+    run's document so metrics_check can require these counters."""
+    reg = _registry()
+    if reg is None:
+        return
+    reg.counter(COUNTER_ERRORS)  # lands even at 0
+    reg.counter(COUNTER_BYTES).inc(int(nbytes))
+    if meta:
+        reg.set_meta(**meta)
+
+
+def record_error(message: str, path: str | None = None,
+                 section: str | None = None,
+                 offset: int | None = None) -> IntegrityError:
+    """Count one detection, emit the structured event naming
+    file/section/offset, and RETURN the IntegrityError for the caller
+    to raise — `raise record_error(...)` keeps the telemetry and the
+    refusal in one place."""
+    reg = _registry()
+    if reg is not None:
+        reg.counter(COUNTER_BYTES)  # lands even at 0
+        reg.counter(COUNTER_ERRORS).inc()
+        reg.event("integrity_error", file=path, section=section,
+                  offset=offset, detail=message)
+    return IntegrityError(message, path=path, section=section,
+                          offset=offset)
+
+
+# -- directory durability --------------------------------------------------
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing `path` (or `path` itself when it
+    is a directory): the tmp-then-rename commit protocol is only
+    power-loss-durable once the new directory entry is on disk, not
+    just the renamed file's data. Best-effort — filesystems that
+    cannot open directories (or don't need this) are not an error."""
+    d = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

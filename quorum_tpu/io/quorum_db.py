@@ -47,7 +47,7 @@ import os
 
 import numpy as np
 
-from . import ref_db
+from . import integrity, ref_db
 
 REF_FORMAT = ref_db.REF_FORMAT  # "binary/quorum_db"
 
@@ -289,7 +289,12 @@ def read_ref_db(path: str):
             f"{exp_kbytes} expected, value {vbytes} vs {exp_vbytes}) — "
             "not this codec's layout (see io/ref_db.py)")
     if len(data) < off + kbytes + vbytes:
-        raise ValueError(f"'{path}': truncated payload")
+        # a short ref-format payload is corruption (bit rot can't be
+        # caught — the format carries no digests — but truncation can)
+        raise integrity.record_error(
+            f"'{path}': truncated payload ({len(data) - off} of "
+            f"{kbytes + vbytes} payload bytes)", path=path,
+            section="payload", offset=off)
 
     key_words = np.frombuffer(data, np.uint64, kbytes // 8, off)
     fields = _unpack_fields(key_words, size, kb)
@@ -312,6 +317,22 @@ def read_ref_db(path: str):
     khi = (keys >> np.uint64(32)).astype(np.uint32)
     klo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     return khi, klo, vals.astype(np.uint32), key_len // 2, bits
+
+
+def verify_ref_db(path: str) -> list[tuple]:
+    """Offline verification for quorum-fsck: header geometry
+    consistency plus a full decode (the payload's reprobe indices and
+    occupancy are the only structure the digest-less reference format
+    lets us check). Returns (section, offset, message) problems; empty
+    = as clean as the format can prove."""
+    problems: list[tuple] = []
+    try:
+        read_ref_db(path)
+    except integrity.IntegrityError as e:
+        problems.append((e.section or "payload", e.offset, str(e)))
+    except (ValueError, ref_db.RefHeaderError, OSError) as e:
+        problems.append(("header", None, str(e)))
+    return problems
 
 
 def is_ref_db(path: str) -> bool:

@@ -60,6 +60,10 @@ class BuildConfig:
     # table by leading row bits over a local device mesh
     # (parallel/tile_sharded) and routes observations owner-bucketed
     devices: int = 1
+    # --db-version (ISSUE 8): 5 (default) writes the checksummed
+    # export (per-section CRC32C + whole-file trailer digest); 4 the
+    # bare round-5 layout. The payload bytes are identical.
+    db_version: int = 5
 
 
 # canonical home is ops/ctable (so the fused stage-1 dispatch can use
@@ -524,7 +528,8 @@ def create_database_main(
                                write_meta.bits, cmdline=cmdline)
     else:
         db_format.write_db(output, write_state, write_meta, cmdline,
-                           n_entries=stats.distinct)
+                           n_entries=stats.distinct,
+                           db_version=cfg.db_version)
     if cfg.checkpoint_dir:
         # the finished database IS the durable artifact now; a stale
         # snapshot must not feed a later unrelated --resume

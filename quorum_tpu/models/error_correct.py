@@ -159,6 +159,9 @@ class ECOptions:
     checkpoint_every: int = 0
     resume: bool = False
     on_bad_read: str = "abort"  # malformed-record policy (io/fastq)
+    # --verify-db (ISSUE 8): checksum verification of v5 databases at
+    # load — "full" (default), "sample" (seeded chunk scrub), "off"
+    verify_db: str = "full"
     # --devices (ISSUE 5): 1 = single-chip; >1 runs data-parallel
     # correction over a local device mesh — table replicated below
     # the size threshold, row-sharded with routed lookups above it
@@ -275,7 +278,8 @@ def _run_ec(db_path: str, sequences: Sequence[str],
         state, meta = db
     else:
         state, meta, _header = db_format.read_db(db_path, to_device=True,
-                                                 no_mmap=opts.no_mmap)
+                                                 no_mmap=opts.no_mmap,
+                                                 verify=opts.verify_db)
 
     cutoff = resolve_cutoff(state, meta, opts)
     vlog("Using cutoff of ", cutoff)

@@ -871,23 +871,32 @@ def tile_iterate(state: TileState, meta: TileMeta):
     return np.asarray(khi), np.asarray(klo), val
 
 
-def tile_lookup_np(rows, meta: TileMeta, khi, klo):
-    """Scalar host lookup over a numpy [rows, 128] array."""
-    addr, rlo, rhi = jax.device_get(
-        tile_key_parts(jnp.asarray([np.uint32(khi)]),
-                       jnp.asarray([np.uint32(klo)]), meta))
-    row = rows[int(addr[0])]
+def tile_row_lookup(row, meta: TileMeta, rlo, rhi) -> int:
+    """Match ONE fetched [128] bucket row (host numpy) against
+    precomputed key parts; returns the stored value word or 0. The
+    single home of the entry-layout knowledge for host-side lookups —
+    tile_lookup_np and the serve warmup's k-mer walk
+    (serve/engine.representative_read) both go through here."""
     lo = row[0::2]
     hi = row[1::2]
     count = lo & np.uint32(meta.max_val)
-    match = (count != 0) & ((lo >> np.uint32(meta.bits + 1)) == rlo[0]) & \
-        (hi == rhi[0])
+    match = (count != 0) & ((lo >> np.uint32(meta.bits + 1)) == rlo) & \
+        (hi == rhi)
     idx = np.nonzero(match)[0]
     if len(idx) == 0:
         return 0
     j = idx[0]
     return int((count[j] << np.uint32(1)) |
                ((row[2 * j] >> np.uint32(meta.bits)) & 1))
+
+
+def tile_lookup_np(rows, meta: TileMeta, khi, klo):
+    """Scalar host lookup over a numpy [rows, 128] array."""
+    addr, rlo, rhi = jax.device_get(
+        tile_key_parts(jnp.asarray([np.uint32(khi)]),
+                       jnp.asarray([np.uint32(klo)]), meta))
+    return tile_row_lookup(np.asarray(rows[int(addr[0])]), meta,
+                           rlo[0], rhi[0])
 
 
 # ---------------------------------------------------------------------------

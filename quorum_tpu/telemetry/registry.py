@@ -35,14 +35,33 @@ def atomic_write(path: str, data) -> None:
     """The one atomic-replace idiom every telemetry artifact uses
     (final JSON, Chrome trace, Prometheus textfile, multi-host
     aggregate — and the fault-tolerance layer's checkpoint cursors,
-    io/checkpoint.py): write a sibling tmp, then os.replace — a
-    reader at `path` can never observe a torn file. Accepts str or
-    bytes."""
+    io/checkpoint.py): write a sibling tmp, fsync, then os.replace,
+    then fsync the parent directory — a reader at `path` can never
+    observe a torn file, and a committed artifact survives power
+    loss, not just process death (renames are only durable once the
+    directory entry is down; ISSUE 8). Accepts str or bytes."""
     tmp = path + ".tmp"
     mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
     with open(tmp, mode) as f:
         f.write(data)
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:  # pragma: no cover - exotic filesystems
+            pass
     os.replace(tmp, path)
+    # directory durability, open-coded (telemetry must not import io)
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:  # pragma: no cover - unreadable parent
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
 
 
 def _scalar(v):

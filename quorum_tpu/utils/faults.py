@@ -45,8 +45,17 @@ Fields per spec:
     watchdog acceptance case). Interruptible: the blocked thread is
     released by ``release_hangs()``, or automatically when another
     plan is installed / the plan is reset, so tests and the chaos
-    soak never leak a permanently stuck thread.
+    soak never leak a permanently stuck thread,
+  - ``corrupt``: flip (XOR 0xFF) or zero ``bytes`` bytes of the file
+    the site just committed (the hot path passes its path to
+    ``inject``), at ``offset`` — or a seeded pseudo-random offset —
+    then continue. Real on-disk damage at the exact artifact
+    boundary, so integrity tests (ISSUE 8) inject silent corruption
+    instead of hand-editing files. Deterministic per
+    (``seed``, site, firing index).
 * ``message`` / ``code`` / ``seconds`` — action parameters.
+* ``bytes`` / ``mode`` (``flip``/``zero``) / ``offset`` / ``seed`` —
+  ``corrupt`` parameters.
 
 Known sites (each is one ``faults.inject(...)`` call on a hot path;
 the disabled cost is a module-global None check):
@@ -66,6 +75,13 @@ the disabled cost is a module-global None check):
   injected error must roll back to the old engine.
 * ``fastq.read`` — per parsed record in the pure-Python FASTQ reader
   (io/fastq.py).
+* ``db.write`` (``path=``) — after a database export commits
+  (io/db_format._atomic_db_write); a ``corrupt`` here damages the
+  file stage 2 / serve will load.
+* ``checkpoint.commit`` (``path=``) — after each stage-1 snapshot /
+  shard payload / sharded manifest commits (io/checkpoint.py).
+* ``journal.append`` (``path=``) — after each stage-2 resume-journal
+  commit (io/checkpoint.Stage2Journal.commit).
 
 Determinism: per-spec hit counters under one lock; the same plan over
 the same input fires at exactly the same points, which is what lets
@@ -88,7 +104,9 @@ class FaultError(RuntimeError):
     device-step failure."""
 
 
-_ACTIONS = ("io_error", "error", "exit", "sleep", "hang")
+_ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt")
+
+_CORRUPT_MODES = ("flip", "zero")
 
 ENV_VAR = "QUORUM_FAULT_PLAN"
 
@@ -99,7 +117,8 @@ class FaultSpec:
     """One parsed fault: where, when, and what."""
 
     __slots__ = ("site", "batch", "at", "count", "action", "message",
-                 "code", "seconds", "hits", "fired")
+                 "code", "seconds", "nbytes", "mode", "offset", "seed",
+                 "hits", "fired")
 
     def __init__(self, raw: dict):
         if not isinstance(raw, dict):
@@ -130,6 +149,18 @@ class FaultSpec:
         self.message = raw.get("message")
         self.code = int(raw.get("code", DEFAULT_EXIT_CODE))
         self.seconds = float(raw.get("seconds", 0.05))
+        # corrupt-action parameters (ISSUE 8)
+        self.nbytes = int(raw.get("bytes", 1))
+        if self.nbytes < 1:
+            raise ValueError(f"'bytes' must be >= 1: {raw!r}")
+        self.mode = raw.get("mode", "flip")
+        if self.mode not in _CORRUPT_MODES:
+            raise ValueError(
+                f"unknown corrupt mode {self.mode!r} "
+                f"(one of {_CORRUPT_MODES})")
+        off = raw.get("offset")
+        self.offset = None if off is None else int(off)
+        self.seed = int(raw.get("seed", 0))
         self.hits = 0   # matching calls seen
         self.fired = 0  # actions taken
 
@@ -176,10 +207,11 @@ class FaultPlan:
                 f"fault plan must be a list of specs, got {type(obj)}")
         return cls([FaultSpec(raw) for raw in obj])
 
-    def fire(self, site: str, batch=None) -> None:
+    def fire(self, site: str, batch=None, path=None) -> None:
         """Record one arrival at `site`; execute any due action.
         Raising actions raise from here; `sleep` returns after the
-        delay."""
+        delay; `corrupt` damages `path` (the file the site just
+        committed) and returns."""
         due: list[FaultSpec] = []
         with self._lock:
             for spec in self.specs:
@@ -190,7 +222,7 @@ class FaultPlan:
                     spec.fired += 1
                     due.append(spec)
         for spec in due:
-            self._act(spec, site, batch)
+            self._act(spec, site, batch, path)
 
     def release_hangs(self) -> None:
         """Wake every thread blocked in a `hang` action. After this,
@@ -198,11 +230,14 @@ class FaultPlan:
         released plan stays released."""
         self._hang_release.set()
 
-    def _act(self, spec: FaultSpec, site: str, batch) -> None:
+    def _act(self, spec: FaultSpec, site: str, batch, path=None) -> None:
         where = site if batch is None else f"{site}@batch={batch}"
         msg = spec.message or f"injected fault at {where}"
         if spec.action == "sleep":
             time.sleep(spec.seconds)
+            return
+        if spec.action == "corrupt":
+            _corrupt_file(spec, site, path)
             return
         if spec.action == "hang":
             # a wedged device step: block until released (new plan
@@ -229,6 +264,43 @@ class FaultPlan:
 
     def summary(self) -> str:
         return "; ".join(s.describe() for s in self.specs) or "(empty)"
+
+
+def _corrupt_file(spec: FaultSpec, site: str, path) -> None:
+    """The `corrupt` action: flip/zero `spec.nbytes` bytes of `path`
+    in place (fsync'd, so the damage is really on disk — exactly what
+    bit rot or a torn sector leaves). The offset is explicit or
+    seeded-deterministic per (seed, site, firing index); an explicit
+    offset past EOF is clamped to the last byte."""
+    if path is None:
+        raise FaultError(
+            f"corrupt action fired at site {site!r}, which passes no "
+            "file path — corrupt is only meaningful at artifact-"
+            "commit sites (db.write, checkpoint.commit, "
+            "journal.append)")
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if spec.offset is not None:
+        off = min(spec.offset, size - 1)
+    else:
+        import random
+        off = random.Random(
+            f"{spec.seed}:{site}:{spec.fired}").randrange(size)
+    n = max(1, min(spec.nbytes, size - off))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        cur = f.read(n)
+        f.seek(off)
+        if spec.mode == "zero":
+            f.write(b"\0" * len(cur))
+        else:
+            f.write(bytes(b ^ 0xFF for b in cur))
+        f.flush()
+        os.fsync(f.fileno())
+    print(f"quorum-tpu: fault plan: corrupted {n} byte(s) of {path} "
+          f"at offset {off} ({spec.mode}, site {site})",
+          file=sys.stderr)
 
 
 # -- module-global install point ------------------------------------------
@@ -265,11 +337,13 @@ def active() -> bool:
     return _PLAN is not None
 
 
-def inject(site: str, batch=None) -> None:
-    """THE injection point. No-op (one global check) without a plan."""
+def inject(site: str, batch=None, path=None) -> None:
+    """THE injection point. No-op (one global check) without a plan.
+    Artifact-commit sites pass `path` (the file just committed) so
+    `corrupt` actions can damage it in place."""
     if _PLAN is None:
         return
-    _PLAN.fire(site, batch)
+    _PLAN.fire(site, batch, path)
 
 
 def load_plan(spec: str) -> FaultPlan:

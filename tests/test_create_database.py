@@ -99,7 +99,7 @@ def test_cdb_cli_end_to_end(tmp_path, synthetic_reads, k):
 
     state, meta, header = db_format.read_db(out, to_device=False)
     assert header["key_len"] == 2 * k
-    assert header["version"] == 4  # lean entry-compact default
+    assert header["version"] == 5  # checksummed entry-compact default
     expect = brute_counts(synthetic_reads, k, qual_thresh, bits=7)
     # every brute-force key present with exact value
     for key, (cnt, q) in expect.items():

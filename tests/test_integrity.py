@@ -1,0 +1,571 @@
+"""Data-integrity tier tests (ISSUE 8): the CRC32C primitives, the v5
+checksummed database format (round trip, v4 parity, per-section
+corruption refusal), digest-bearing checkpoint/journal/replay
+artifacts, the `corrupt` fault action, quorum-fsck, the integrity
+metrics gate, and the representative serve warmup read.
+
+The corruption sweep flips real bytes in real artifacts and asserts
+the three-part contract everywhere: the load REFUSES (IntegrityError/
+CheckpointError → rc 3 at the CLIs), the detection is COUNTED
+(integrity_errors_total) and EVENTED (file/section/offset), and a v4
+database — no digests — still loads unchanged.
+"""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.cli import fsck as fsck_cli
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.io import db_format, integrity, packing
+from quorum_tpu.ops import ctable
+from quorum_tpu.telemetry.registry import MetricsRegistry
+from quorum_tpu.utils import faults
+
+from test_error_correct_cli import K, QUAL_THRESH, make_dataset
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_state():
+    faults.reset()
+    prev = integrity.install_registry(None)
+    yield
+    faults.reset()
+    integrity.install_registry(prev)
+
+
+@pytest.fixture()
+def tracking_registry(tmp_path):
+    """A real registry (with an events stream) installed as the
+    ambient integrity sink, so tests can assert counters + events."""
+    reg = MetricsRegistry(str(tmp_path / "m.json"),
+                          events_path=str(tmp_path / "m.events.jsonl"))
+    integrity.install_registry(reg)
+    return reg
+
+
+def _events(reg):
+    reg.write()
+    path = reg.events_path
+    if not os.path.exists(path):
+        return []
+    return [json.loads(l) for l in open(path) if l.strip()]
+
+
+# ---------------------------------------------------------------------------
+# CRC32C primitives
+# ---------------------------------------------------------------------------
+
+def test_crc32c_known_vector_and_chaining():
+    assert integrity.crc32c(b"123456789") == 0xE3069283  # iSCSI vector
+    assert integrity.crc32c(b"") == 0
+    data = np.random.default_rng(3).bytes(50_000)
+    whole = integrity.crc32c(data)
+    # chaining == one pass; vectorized path == scalar path
+    assert integrity.crc32c(data[17:], integrity.crc32c(data[:17])) \
+        == whole
+    small = integrity._crc_scalar(
+        np.frombuffer(data, np.uint8), 0xFFFFFFFF) ^ 0xFFFFFFFF
+    assert small == whole
+    # combine derives the concatenation's CRC from the parts'
+    a, b = data[:20_000], data[20_000:]
+    assert integrity.crc32c_combine(
+        integrity.crc32c(a), integrity.crc32c(b), len(b)) == whole
+    assert integrity.crc32c_combine(whole, 0, 0) == whole
+
+
+def test_crc32c_accepts_ndarrays():
+    arr = np.arange(1000, dtype=np.uint32)
+    assert integrity.crc32c(arr) == integrity.crc32c(arr.tobytes())
+
+
+def test_seal_check_seal_tamper():
+    doc = integrity.seal({"cursor": 7, "bytes": 123})
+    integrity.check_seal(doc, "test doc", "p")  # clean passes
+    integrity.check_seal({"no": "seal"}, "test doc", "p")  # unsealed ok
+    with pytest.raises(integrity.IntegrityError, match="self-digest"):
+        integrity.check_seal(dict(doc, cursor=8), "test doc", "p")
+
+
+# ---------------------------------------------------------------------------
+# v5 database format
+# ---------------------------------------------------------------------------
+
+def _tiny_table(n=64, k=11):
+    rng = np.random.default_rng(5)
+    khi = rng.integers(0, 1 << 22, n).astype(np.uint32)
+    klo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    vals = ((rng.integers(1, 100, n) << 1) | 1).astype(np.uint32)
+    state, meta = ctable.tile_from_entries(khi, klo, vals, k, 7)
+    return ctable.TileState(np.asarray(state.rows)), meta
+
+
+def _entries(state, meta):
+    khi, klo, vals = ctable.tile_iterate(state, meta)
+    return sorted(zip(khi.tolist(), klo.tolist(), vals.tolist()))
+
+
+def test_v5_roundtrip_v4_parity(tmp_path):
+    state, meta = _tiny_table()
+    p5, p4 = str(tmp_path / "a5.qdb"), str(tmp_path / "a4.qdb")
+    db_format.write_db(p5, state, meta)
+    db_format.write_db(p4, state, meta, db_version=4)
+    s5, m5, h5 = db_format.read_db(p5, to_device=False)
+    s4, m4, h4 = db_format.read_db(p4, to_device=False, verify="full")
+    assert (h5["version"], h4["version"]) == (5, 4)
+    assert _entries(s5, m5) == _entries(s4, m4)  # v4 loads unchanged
+
+    # the v5 PAYLOAD is the v4 payload byte-for-byte — checksums ride
+    # in the header and trailer only
+    def payload(p):
+        with open(p, "rb") as f:
+            h = json.loads(f.readline())
+            return f.read(h["value_bytes"])
+    assert payload(p5) == payload(p4)
+    # header carries the section digests; trailer the file digest
+    assert h5["checksum"]["algo"] == "crc32c"
+    assert set(h5["checksum"]["sections"]) == {"bucket_index",
+                                               "entries"}
+    _, problems = db_format.verify_db_file(p5)
+    assert problems == []
+
+
+def _flip(path, off, n=1):
+    with open(path, "r+b") as f:
+        f.seek(off)
+        cur = f.read(n)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in cur))
+
+
+def _layout(path):
+    with open(path, "rb") as f:
+        line = f.readline()
+        h = json.loads(line)
+    return len(line), h
+
+
+def test_v5_corruption_refused_per_section(tmp_path, tracking_registry):
+    state, meta = _tiny_table()
+    src = str(tmp_path / "ok.qdb")
+    db_format.write_db(src, state, meta)
+    hlen, h = _layout(src)
+    rows, vb = h["rows"], h["value_bytes"]
+    spots = {
+        "bucket_index": hlen + rows // 2,
+        "entries": hlen + rows + 7,
+        "trailer": hlen + vb + 20,
+    }
+    import shutil
+    for want_section, off in spots.items():
+        p = str(tmp_path / f"bad_{want_section}.qdb")
+        shutil.copy(src, p)
+        _flip(p, off)
+        with pytest.raises(integrity.IntegrityError) as ei:
+            db_format.read_db(p, to_device=False)
+        assert ei.value.section == want_section
+        # fsck pinpoints the same section
+        _, problems = db_format.verify_db_file(p)
+        assert any(sec == want_section for sec, _o, _m in problems)
+    reg = tracking_registry
+    assert reg.counter("integrity_errors_total").value >= len(spots)
+    evs = [e for e in _events(reg) if e["event"] == "integrity_error"]
+    assert evs and all(e.get("file") and e.get("section") for e in evs)
+
+
+def test_v5_verify_modes(tmp_path, tracking_registry):
+    state, meta = _tiny_table()
+    p = str(tmp_path / "v.qdb")
+    db_format.write_db(p, state, meta)
+    hlen, h = _layout(p)
+    db_format.read_db(p, to_device=False, verify="sample")
+    # corrupt the trailer: full catches it, off skips checksums
+    _flip(p, hlen + h["value_bytes"] + 20)
+    with pytest.raises(integrity.IntegrityError):
+        db_format.read_db(p, to_device=False, verify="full")
+    s, m, _ = db_format.read_db(p, to_device=False, verify="off")
+    assert _entries(s, m) == _entries(state, meta)
+    with pytest.raises(ValueError, match="verify must be"):
+        db_format.read_db(p, to_device=False, verify="paranoid")
+    # verification telemetry: bytes counted, meta declared
+    reg = tracking_registry
+    assert reg.counter("integrity_bytes_verified_total").value > 0
+    assert reg.meta.get("db_version") == 5
+    assert reg.meta.get("verify_db") == "off"  # last load's mode
+
+
+# ---------------------------------------------------------------------------
+# the `corrupt` fault action
+# ---------------------------------------------------------------------------
+
+def test_corrupt_action_explicit_offset_and_modes(tmp_path):
+    p = str(tmp_path / "f.bin")
+    open(p, "wb").write(bytes(range(64)))
+    faults.setup(json.dumps([{"site": "db.write", "action": "corrupt",
+                              "offset": 10, "bytes": 3}]))
+    faults.inject("db.write", path=p)
+    data = open(p, "rb").read()
+    assert data[10:13] == bytes(b ^ 0xFF for b in range(10, 13))
+    assert data[:10] == bytes(range(10))
+    faults.setup(json.dumps([{"site": "db.write", "action": "corrupt",
+                              "offset": 5, "bytes": 2,
+                              "mode": "zero"}]))
+    faults.inject("db.write", path=p)
+    assert open(p, "rb").read()[5:7] == b"\0\0"
+
+
+def test_corrupt_action_seeded_deterministic(tmp_path):
+    offs = []
+    for name in ("a.bin", "b.bin"):
+        p = str(tmp_path / name)
+        open(p, "wb").write(b"\0" * 256)
+        faults.setup(json.dumps([{"site": "db.write",
+                                  "action": "corrupt", "seed": 9}]))
+        faults.inject("db.write", path=p)
+        data = open(p, "rb").read()
+        hit = [i for i, b in enumerate(data) if b != 0]
+        assert len(hit) == 1  # one flipped byte
+        offs.append(hit[0])
+        faults.reset()
+    assert offs[0] == offs[1]  # same (seed, site, firing) -> same spot
+
+
+def test_corrupt_action_requires_path():
+    faults.setup(json.dumps([{"site": "stage1.insert",
+                              "action": "corrupt"}]))
+    with pytest.raises(faults.FaultError, match="no file path"):
+        faults.inject("stage1.insert")
+
+
+def test_corrupt_mode_validation():
+    with pytest.raises(ValueError, match="corrupt mode"):
+        faults.FaultPlan.parse([{"site": "x", "action": "corrupt",
+                                 "mode": "scramble"}])
+    with pytest.raises(ValueError, match="bytes"):
+        faults.FaultPlan.parse([{"site": "x", "action": "corrupt",
+                                 "bytes": 0}])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint artifacts: digests refuse silent corruption
+# ---------------------------------------------------------------------------
+
+class _Stats:
+    reads = bases = batches = grows = 0
+
+
+class _Cfg:
+    qual_thresh = 38
+    batch_size = 64
+
+
+def _save_snapshot(tmp_path):
+    meta = ctable.TileMeta(k=11, bits=7, rb_log2=4)
+    tag = np.arange(meta.rows * ctable.TILE,
+                    dtype=np.uint32).reshape(meta.rows, ctable.TILE)
+    acc = meta.rows * ctable.TSLOTS
+    bstate = ctable.TBuildState(tag, np.ones(acc, np.uint32),
+                                np.zeros(acc, np.uint32))
+    ck = ckpt_mod.Stage1Checkpoint(str(tmp_path))
+    ck.save(bstate, meta, _Cfg(), 5, _Stats(), ["r.fastq"])
+    return ck
+
+
+def test_stage1_snapshot_payload_digest(tmp_path, tracking_registry):
+    ck = _save_snapshot(tmp_path)
+    snap = ck.load()  # clean load passes + counts verified bytes
+    assert snap.cursor == 5
+    assert tracking_registry.counter(
+        "integrity_bytes_verified_total").value > 0
+    # flip one payload byte (past the header line)
+    with open(ck.path, "rb") as f:
+        hlen = len(f.readline())
+    _flip(ck.path, hlen + 1000)
+    with pytest.raises(ckpt_mod.CheckpointError, match="payload digest"):
+        ck.load()
+    assert tracking_registry.counter("integrity_errors_total").value >= 1
+
+
+def test_stage1_snapshot_header_seal(tmp_path):
+    ck = _save_snapshot(tmp_path)
+    # tamper the header's cursor, keeping valid JSON and length: the
+    # payload length check still passes, only the seal catches it
+    with open(ck.path, "rb") as f:
+        line = f.readline()
+        payload = f.read()
+    h = json.loads(line)
+    h["cursor"] = 6  # splice a different resume point
+    with open(ck.path, "wb") as f:
+        f.write(json.dumps(h).encode() + b"\n")
+        f.write(payload)
+    with pytest.raises(ckpt_mod.CheckpointError, match="self-digest"):
+        ck.load()
+
+
+def test_journal_committed_range_digest(tmp_path, tracking_registry):
+    prefix = str(tmp_path / "out")
+    j = ckpt_mod.Stage2Journal(prefix)
+    out, log = j.open_outputs(None)
+    out.write("the committed record\n")
+    out.flush()
+    log.flush()
+    j.commit(1, _ec_stats(), out.tell(), log.tell(), 64, {"db": "a"})
+    out.write("torn tail past the commit")
+    out.close()
+    log.close()
+    st = j.load()
+    assert st["fa_crc32c"] == integrity.crc32c(b"the committed record\n")
+    # torn tail alone resumes fine (truncated away)...
+    out2, log2 = j.open_outputs(st)
+    out2.close()
+    log2.close()
+    assert open(j.fa_partial).read() == "the committed record\n"
+    # ...but corruption INSIDE the committed range refuses
+    _flip(j.fa_partial, 4)
+    with pytest.raises(ckpt_mod.CheckpointError, match="committed"):
+        j.open_outputs(st)
+    assert tracking_registry.counter("integrity_errors_total").value >= 1
+
+
+def test_journal_resume_from_pre_digest_journal(tmp_path):
+    """A journal written BEFORE the digest upgrade (no fa_crc32c)
+    resumes, commits, and resumes AGAIN cleanly: the first resume
+    must seed the CRC streams from the file content, not 0 — else
+    the second resume's digest covers only post-resume bytes and
+    refuses an undamaged file."""
+    prefix = str(tmp_path / "out")
+    j = ckpt_mod.Stage2Journal(prefix)
+    out, log = j.open_outputs(None)
+    out.write("first half\n")
+    j.commit(1, _ec_stats(), out.tell(), log.tell(), 64)
+    out.close()
+    log.close()
+    # strip the digests + seal, as a pre-ISSUE-8 release wrote it
+    doc = json.load(open(j.path))
+    for key in ("fa_crc32c", "log_crc32c", "crc32c"):
+        doc.pop(key, None)
+    with open(j.path, "w") as f:
+        json.dump(doc, f)
+    # resume 1: append + commit (now journals full-range digests)
+    j2 = ckpt_mod.Stage2Journal(prefix)
+    st = j2.load()
+    out, log = j2.open_outputs(st)
+    out.write("second half\n")
+    j2.commit(2, _ec_stats(), out.tell(), log.tell(), 64)
+    out.close()
+    log.close()
+    # resume 2: the full committed range must verify clean
+    j3 = ckpt_mod.Stage2Journal(prefix)
+    st = j3.load()
+    assert st["fa_crc32c"] == integrity.crc32c(
+        b"first half\nsecond half\n")
+    out, log = j3.open_outputs(st)  # must NOT refuse
+    out.close()
+    log.close()
+    assert open(j3.fa_partial).read() == "first half\nsecond half\n"
+
+
+def test_journal_document_seal(tmp_path):
+    prefix = str(tmp_path / "out")
+    j = ckpt_mod.Stage2Journal(prefix)
+    out, log = j.open_outputs(None)
+    out.write("x\n")
+    j.commit(1, _ec_stats(), out.tell(), log.tell(), 64)
+    out.close()
+    log.close()
+    doc = json.load(open(j.path))
+    doc["fa_bytes"] = 999  # a flipped count that still parses
+    with open(j.path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ckpt_mod.CheckpointError, match="self-digest"):
+        j.load()
+
+
+def _ec_stats():
+    class S:
+        reads = corrected = skipped = bases_in = bases_out = 0
+    return S()
+
+
+def test_replay_cache_batch_digest(tmp_path, tracking_registry):
+    from quorum_tpu.io import fastq
+    cache = ckpt_mod.ReplayCache(str(tmp_path))
+    ident = {"inputs": ["r.fastq"], "batch_size": 4}
+    w = cache.start(ident, cap_bytes=1 << 30)
+    codes = np.zeros((4, 20), np.int8)
+    quals = np.full((4, 20), 60, np.uint8)
+    lengths = np.full(4, 20, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths, thresholds=(38,))
+    batch = fastq.ReadBatch(codes=codes, quals=quals, lengths=lengths,
+                            headers=["a", "b", "c", "d"], n=4)
+    w.add(batch, pk.compact())
+    assert w.finish()
+    # clean replay round-trips
+    rd = cache.load(ident)
+    assert rd is not None
+    got = list(rd.batches())
+    assert len(got) == 1 and got[0][0].n == 4
+    # corrupt the batch payload: iteration refuses
+    _flip(cache._batch_path(0), 100)
+    with pytest.raises(ckpt_mod.CheckpointError, match="digest"):
+        list(cache.load(ident).batches())
+    assert tracking_registry.counter("integrity_errors_total").value >= 1
+    # tamper the manifest: load refuses loudly (not a silent re-parse)
+    doc = json.load(open(cache.manifest_path))
+    doc["n_batches"] = 2
+    with open(cache.manifest_path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ckpt_mod.CheckpointError, match="self-digest"):
+        cache.load(ident)
+
+
+# ---------------------------------------------------------------------------
+# end to end: corrupt DB -> stage-2 rc 3 + counters; fsck pinpoints
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("integ")
+    reads_path, reads, quals = make_dataset(tmp)
+    db_path = str(tmp / "db.jf")
+    assert cdb_cli.main(["-s", "64k", "-m", str(K), "-b", "7",
+                         "-q", str(QUAL_THRESH), "-o", db_path,
+                         reads_path]) == 0
+    return str(tmp), reads_path, db_path
+
+
+def test_ec_cli_refuses_corrupt_db_rc3(pipeline, tmp_path):
+    tmp, reads_path, db_path = pipeline
+    import shutil
+    bad = str(tmp_path / "bad.jf")
+    shutil.copy(db_path, bad)
+    hlen, h = _layout(bad)
+    _flip(bad, hlen + h["rows"] + 11)  # inside the entry payload
+    mpath = str(tmp_path / "m.json")
+    rc = ec_cli.main(["-p", "4", "--batch-size", "64", "-o",
+                      str(tmp_path / "o"), "--metrics", mpath,
+                      bad, reads_path])
+    assert rc == ckpt_mod.NON_RETRYABLE_RC  # 3: deterministic refusal
+    doc = json.load(open(mpath))
+    assert doc["counters"]["integrity_errors_total"] >= 1
+    assert doc["meta"]["status"] == "error"
+
+
+def test_ec_cli_verify_off_flag(pipeline, tmp_path):
+    # --verify-db=off on a CLEAN db still corrects (declares the mode)
+    tmp, reads_path, db_path = pipeline
+    mpath = str(tmp_path / "m.json")
+    rc = ec_cli.main(["-p", "4", "--batch-size", "64", "--verify-db",
+                      "off", "-o", str(tmp_path / "o"),
+                      "--metrics", mpath, db_path, reads_path])
+    assert rc == 0
+    doc = json.load(open(mpath))
+    assert doc["meta"]["verify_db"] == "off"
+    assert doc["meta"]["db_version"] == 5
+    assert "integrity_errors_total" in doc["counters"]  # at 0
+    assert doc["counters"]["integrity_errors_total"] == 0
+
+
+def test_fsck_cli(pipeline, tmp_path, capsys):
+    tmp, reads_path, db_path = pipeline
+    assert fsck_cli.main([db_path]) == 0
+    import shutil
+    bad = str(tmp_path / "bad.jf")
+    shutil.copy(db_path, bad)
+    hlen, h = _layout(bad)
+    _flip(bad, hlen + 3)
+    assert fsck_cli.main([bad]) == 1
+    err = capsys.readouterr().err
+    assert "bucket_index" in err and "BAD" in err
+    assert fsck_cli.main([str(tmp_path / "nothing.here")]) == 2
+
+
+def test_fsck_repairs_torn_journal(tmp_path, capsys):
+    prefix = str(tmp_path / "out")
+    j = ckpt_mod.Stage2Journal(prefix)
+    out, log = j.open_outputs(None)
+    out.write("committed\n")
+    j.commit(1, _ec_stats(), out.tell(), log.tell(), 64)
+    out.write("torn")
+    out.close()
+    log.close()
+    assert fsck_cli.main([j.path]) == 1  # torn tail flagged
+    assert fsck_cli.main(["--repair", j.path]) == 0
+    assert open(j.fa_partial).read() == "committed\n"
+    assert fsck_cli.main([j.path]) == 0
+
+
+# ---------------------------------------------------------------------------
+# metrics_check integrity gate (satellite: schema unit test)
+# ---------------------------------------------------------------------------
+
+def _doc(meta=None, counters=None):
+    return {"schema": "quorum-tpu-metrics/1", "meta": meta or {},
+            "counters": counters or {}, "gauges": {},
+            "histograms": {}, "timers": {}}
+
+
+def test_metrics_check_requires_integrity_counters(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import metrics_check
+
+    both = {"integrity_errors_total": 0,
+            "integrity_bytes_verified_total": 123}
+    # declared via db_version >= 5
+    errs = metrics_check._check_integrity_names(
+        _doc(meta={"db_version": 5}))
+    assert len(errs) == 2
+    assert not metrics_check._check_integrity_names(
+        _doc(meta={"db_version": 5}, counters=both))
+    # declared via verify_db
+    errs = metrics_check._check_integrity_names(
+        _doc(meta={"verify_db": "sample"}))
+    assert len(errs) == 2
+    # v4 documents are not held to it
+    assert not metrics_check._check_integrity_names(
+        _doc(meta={"db_version": 4}))
+    assert not metrics_check._check_integrity_names(_doc())
+    # end to end through the file checker
+    p = str(tmp_path / "d.json")
+    json.dump(_doc(meta={"db_version": 5}, counters=both),
+              open(p, "w"))
+    assert metrics_check.main([p, "-q"]) == 0
+    json.dump(_doc(meta={"db_version": 5}), open(p, "w"))
+    assert metrics_check.main([p, "-q"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# representative warmup read (satellite)
+# ---------------------------------------------------------------------------
+
+def test_representative_read_walks_db_kmers(pipeline):
+    from quorum_tpu.ops import mer as mer_mod
+    from quorum_tpu.serve.engine import representative_read
+    tmp, reads_path, db_path = pipeline
+    state, meta, _ = db_format.read_db(db_path, to_device=True)
+    host_state, _, _ = db_format.read_db(db_path, to_device=False)
+    r = representative_read(state, meta, 60)
+    assert len(r) == 60 and set(r) <= set("ACGT")
+    assert r != "A" * 60
+    hits = 0
+    for i in range(60 - K + 1):
+        fh, fl = mer_mod.pack_kmer(r[i:i + K], K)
+        chi, clo = mer_mod.canonical_py(fh, fl, K)
+        if db_format.db_lookup_np(host_state, meta, chi, clo):
+            hits += 1
+    # the walk only leaves the DB when the sampled contigs run out —
+    # the overwhelming majority of its k-mers must be present (the
+    # all-A read this replaces had essentially none)
+    assert hits >= (60 - K + 1) * 3 // 4
+    # deterministic per database
+    assert representative_read(state, meta, 60) == r
+    with pytest.raises(RuntimeError, match="below k"):
+        representative_read(state, meta, K - 1)

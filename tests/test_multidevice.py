@@ -62,8 +62,10 @@ def _correct(reads, db, prefix, devices, extra=()):
 
 
 def _payload(path):
-    """Database bytes past the header line (the header timestamps)."""
-    return open(path, "rb").read().split(b"\n", 1)[1]
+    """The table payload proper (the header timestamps vary per run,
+    and the v5 trailer digests that header)."""
+    from quorum_tpu.io.db_format import db_payload_bytes
+    return db_payload_bytes(path)
 
 
 def test_cli_parity_multidevice(reads_fastq, tmp_path):

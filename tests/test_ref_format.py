@@ -270,7 +270,7 @@ def test_v3_still_readable(tmp_path):
     khi, klo, vals = _rand_entries(rng, 50, k)
     state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
     p4 = str(tmp_path / "v4.qdb")
-    db_format.write_db(p4, state, meta)
+    db_format.write_db(p4, state, meta, db_version=4)
     s4, m4, h4 = db_format.read_db(p4, to_device=False)
     assert h4["version"] == 4
 
@@ -307,7 +307,10 @@ def test_v4_rejects_corrupt_counts(tmp_path):
     khi, klo, vals = _rand_entries(rng, 30, k)
     state, meta = _ct.tile_from_entries(khi, klo, vals, k, 7)
     p = str(tmp_path / "v4.qdb")
-    db_format.write_db(p, state, meta)
+    # v4 explicitly: this test pins the STRUCTURAL check (v5 digests
+    # would catch the same mutation earlier; tests/test_integrity.py
+    # covers that path)
+    db_format.write_db(p, state, meta, db_version=4)
     raw = open(p, "rb").read()
     nl = raw.index(b"\n") + 1
     hdr = _json.loads(raw[:nl])

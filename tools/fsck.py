@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""quorum-fsck from a checkout (no install needed): offline integrity
+verifier for databases, checkpoint directories, and stage-2 resume
+journals. The implementation lives in quorum_tpu/cli/fsck.py (the
+`quorum-fsck` console script); this shim mirrors the other tools/
+entry points for CI and scripted use.
+
+Usage: python tools/fsck.py [--verify full|sample] [--repair] PATH...
+Exit:  0 clean (or repaired), 1 damage, 2 unrecognized artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from quorum_tpu.cli.fsck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Golden data-integrity gate for CI (ci/tier1.sh, ISSUE 8):
+quorum-fsck clean on real golden-pipeline artifacts, plus one
+injected-corruption run proving detection end to end, plus the
+journal --repair path.
+
+1. Build the v5 mer database from the committed golden reads;
+   `quorum-fsck` must report it clean (exit 0).
+2. Run stage 1 again with checkpointing and a fault plan that
+   hard-kills it mid-run — the surviving snapshot must fsck clean.
+3. Run stage 2 with journaling and a hard-kill at batch 2 — the
+   journal + partials must fsck clean EXCEPT the expected torn tail,
+   which `--repair` truncates (after which fsck is clean), and the
+   repaired run must still `--resume` to the byte-identical golden
+   output.
+4. Corruption: build a database under a seeded `corrupt` fault plan
+   (site db.write) — `quorum-fsck` must exit non-zero naming the
+   damaged section, and `quorum_error_correct_reads` must refuse the
+   load with rc 3 while counting `integrity_errors_total`.
+
+Exit 0 = all checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+KILL_CODE = 41
+BATCH_SIZE = 64  # 242 golden reads -> 4 batches
+
+
+def fsck(args: list[str]) -> int:
+    from quorum_tpu.cli.fsck import main as fsck_main
+    return fsck_main(args)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden quorum-fsck gate: clean pipeline "
+                    "artifacts, injected corruption detection, and "
+                    "the journal --repair path (ci/tier1.sh)")
+    p.add_argument("--out-dir", default=None)
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="fsck_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    expected_fa = os.path.join(GOLDEN, "expected.fa")
+    db = os.path.join(out_dir, "db.jf")
+    ckpt = os.path.join(out_dir, "ckpt")
+    prefix = os.path.join(out_dir, "corrected")
+    metrics_path = os.path.join(out_dir, "fsck_metrics.json")
+
+    # -- 1. clean database ----------------------------------------------
+    print("[fsck_smoke] building golden v5 database")
+    if cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                     "-o", db, reads]) != 0:
+        print("[fsck_smoke] FAIL: database build", file=sys.stderr)
+        return 1
+    if fsck([db]) != 0:
+        print("[fsck_smoke] FAIL: clean v5 database flagged",
+              file=sys.stderr)
+        return 1
+
+    # -- 2. killed stage-1 run leaves an fsck-clean snapshot ------------
+    plan = json.dumps([{"site": "stage1.insert", "batch": 3,
+                        "action": "exit", "code": KILL_CODE}])
+    env = dict(os.environ, QUORUM_FAULT_PLAN=plan)
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.create_database",
+         "-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+         "-o", os.path.join(out_dir, "db_killed.jf"),
+         "--batch-size", str(BATCH_SIZE),
+         "--checkpoint-dir", ckpt, "--checkpoint-every", "1", reads],
+        cwd=REPO, env=env)
+    if res.returncode != KILL_CODE:
+        print(f"[fsck_smoke] FAIL: stage-1 kill exited "
+              f"{res.returncode}, want {KILL_CODE}", file=sys.stderr)
+        return 1
+    if fsck([ckpt]) != 0:
+        print("[fsck_smoke] FAIL: clean stage-1 checkpoint flagged",
+              file=sys.stderr)
+        return 1
+
+    # -- 3. killed stage-2 run: journal clean, tail repaired ------------
+    plan = json.dumps([{"site": "stage2.correct", "batch": 2,
+                        "action": "exit", "code": KILL_CODE}])
+    ec_args = ["-p", "4", "--batch-size", str(BATCH_SIZE),
+               "--checkpoint-every", "1", "-o", prefix, db, reads]
+    env = dict(os.environ, QUORUM_FAULT_PLAN=plan)
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.error_correct_reads"]
+        + ec_args, cwd=REPO, env=env)
+    if res.returncode != KILL_CODE:
+        print(f"[fsck_smoke] FAIL: stage-2 kill exited "
+              f"{res.returncode}, want {KILL_CODE}", file=sys.stderr)
+        return 1
+    journal = prefix + ".resume.json"
+    # append a torn tail past the commit point, as a crash mid-write
+    # would leave — fsck must flag it, --repair must truncate it
+    with open(prefix + ".fa.partial", "ab") as f:
+        f.write(b">torn-tail-record\nNNNN")
+    if fsck([journal]) == 0:
+        print("[fsck_smoke] FAIL: torn tail not flagged",
+              file=sys.stderr)
+        return 1
+    if fsck(["--repair", journal]) != 0:
+        print("[fsck_smoke] FAIL: --repair did not clean the torn "
+              "tail", file=sys.stderr)
+        return 1
+    if fsck([journal]) != 0:
+        print("[fsck_smoke] FAIL: journal not clean after --repair",
+              file=sys.stderr)
+        return 1
+    # the repaired journal must still resume to the golden bytes
+    if ec_cli.main(ec_args + ["--resume", "--fault-plan", ""]) != 0:
+        print("[fsck_smoke] FAIL: resume after repair", file=sys.stderr)
+        return 1
+    if open(prefix + ".fa", "rb").read() != open(expected_fa,
+                                                 "rb").read():
+        print("[fsck_smoke] FAIL: repaired resume output differs "
+              "from golden", file=sys.stderr)
+        return 1
+
+    # -- 4. injected corruption: fsck + loader both detect --------------
+    bad_db = os.path.join(out_dir, "db_corrupt.jf")
+    # seeded corrupt fault at the committed database; offset 2000 is
+    # deep in the entry payload for the golden geometry (header ~1 kB,
+    # counts 512 B), so the damage lands in a digested section
+    plan = json.dumps([{"site": "db.write", "action": "corrupt",
+                        "offset": 2000, "bytes": 2, "seed": 7}])
+    env = dict(os.environ, QUORUM_FAULT_PLAN=plan)
+    res = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.create_database",
+         "-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+         "-o", bad_db, reads], cwd=REPO, env=env)
+    if res.returncode != 0:
+        print("[fsck_smoke] FAIL: corrupt-plan build rc",
+              res.returncode, file=sys.stderr)
+        return 1
+    if fsck([bad_db]) == 0:
+        print("[fsck_smoke] FAIL: corrupted database passed fsck",
+              file=sys.stderr)
+        return 1
+    print("[fsck_smoke] corrupted database flagged by fsck")
+    rc = ec_cli.main(["-p", "4", "--batch-size", str(BATCH_SIZE),
+                      "-o", os.path.join(out_dir, "bad_out"),
+                      "--metrics", metrics_path, "--fault-plan", "",
+                      bad_db, reads])
+    if rc != 3:
+        print(f"[fsck_smoke] FAIL: corrupted-db load rc {rc}, want 3",
+              file=sys.stderr)
+        return 1
+    doc = json.load(open(metrics_path))
+    errs = doc["counters"].get("integrity_errors_total", 0)
+    if errs < 1:
+        print(f"[fsck_smoke] FAIL: integrity_errors_total={errs}, "
+              "want >= 1", file=sys.stderr)
+        return 1
+    print(f"[fsck_smoke] OK: clean artifacts pass, corruption "
+          f"refused (rc 3, integrity_errors_total={errs}), torn "
+          f"tail repaired; metrics -> {metrics_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

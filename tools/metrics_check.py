@@ -25,6 +25,9 @@ request/batch metric names (SERVE_REQUIRED_*), so a golden serve run
 in CI fails loudly if the serving telemetry regresses — and, when its
 meta declares a resilience feature enabled (watchdog, hedging,
 reload, quotas), the feature's counter too (SERVE_FEATURE_COUNTERS).
+A document whose meta declares a checksummed database
+(`db_version >= 5`) or a verification mode (`verify_db`) must carry
+the integrity counters (INTEGRITY_COUNTERS, ISSUE 8).
 
 `--prom` switches to linting Prometheus text exposition output
 (`--metrics-textfile` files or a saved `/metrics` scrape) through the
@@ -95,6 +98,14 @@ SERVE_FEATURE_COUNTERS = (
 #   meta.driver == "quorum"    -> stage_retries_total
 FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
                   "bad_reads_total", "stage_retries_total")
+
+# The data-integrity surface (ISSUE 8): a document whose meta declares
+# a checksummed database (db_version >= 5) or a verification mode
+# (verify_db) must carry the integrity counters — the loaders create
+# them at verify time (value 0 counts), so a missing name means the
+# verification telemetry regressed.
+INTEGRITY_COUNTERS = ("integrity_errors_total",
+                      "integrity_bytes_verified_total")
 
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
@@ -180,6 +191,29 @@ def _check_fault_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_integrity_names(doc: dict) -> list[str]:
+    """Integrity-surface requirements (ISSUE 8): dispatch on
+    meta.db_version >= 5 or meta.verify_db."""
+    errs = []
+    meta = doc.get("meta", {})
+    counters = doc.get("counters", {})
+    try:
+        db_version = int(meta.get("db_version") or 0)
+    except (TypeError, ValueError):
+        return ["meta.db_version is not an integer"]
+    declared = db_version >= 5 or bool(meta.get("verify_db"))
+    if not declared:
+        return []
+    why = (f"meta.db_version={meta.get('db_version')!r}"
+           if db_version >= 5
+           else f"meta.verify_db={meta.get('verify_db')!r}")
+    for name in INTEGRITY_COUNTERS:
+        if name not in counters:
+            errs.append(f"document with {why} missing counter "
+                        f"{name!r}")
+    return errs
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -220,6 +254,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_serve_names(doc)
     if "meta" in doc:
         problems = problems + _check_fault_names(doc)
+        problems = problems + _check_integrity_names(doc)
         problems = problems + _check_shard_names(doc)
         problems = problems + _check_hosts_doc(doc)
     return problems

@@ -129,10 +129,11 @@ def main(argv=None) -> int:
         print("[multichip_smoke] FAIL: sharded resume rc", rc,
               file=sys.stderr)
         return 1
-    # headers carry a timestamp; the table payload after the header
-    # line is the invariant
-    ref = open(ref_db, "rb").read().split(b"\n", 1)[1]
-    got = open(db, "rb").read().split(b"\n", 1)[1]
+    # headers carry a timestamp (and the v5 trailer digests them);
+    # the table payload proper is the invariant
+    from quorum_tpu.io.db_format import db_payload_bytes
+    ref = db_payload_bytes(ref_db)
+    got = db_payload_bytes(db)
     if ref != got:
         print("[multichip_smoke] FAIL: resumed sharded database "
               "differs from uninterrupted build", file=sys.stderr)
