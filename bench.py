@@ -401,6 +401,63 @@ def run_ab():
         parity="byte-identical" if par else "MISMATCH"))
     assert par, "round-7 stage-2 variants disagree"
 
+    # -- host tail: render workers 1 vs N (ISSUE 9) -------------------
+    # The parse/render tail (~0.3-0.4 s/batch) is what PERF_NOTES
+    # round 6 measured binding stage-2 scaling past ~4 devices; this
+    # probe streams a batch sequence through the finish/render
+    # pipeline behind the sequence-numbered reorder stage (the
+    # production path) at 1 vs N workers, in-process, with the
+    # reassembled output byte-compared — the attribution numbers
+    # (render_ms per batch, reorder wait) ride along for the ledger.
+    from quorum_tpu.io import fastq as fastq_mod
+    from quorum_tpu.models import error_correct as ec_mod
+    from quorum_tpu.models.corrector import fetch_finish
+    from quorum_tpu.utils.pipeline import ReorderingPool
+
+    res, packed = corrector.correct_batch_packed(
+        state, meta, pk2, cfg, pack_cap=4 * n_reads)
+    buf = fetch_finish(res, packed)
+    rb_, rl_ = res.out.shape
+    maxe = res.fwd_log.pos.shape[1]
+    batch = fastq_mod.ReadBatch(
+        codes=codes, quals=quals, lengths=lengths,
+        headers=[f"r{i}" for i in range(n_reads)], n=n_reads)
+    n_workers = ec_mod.resolve_render_workers(0)
+    n_batches = max(4, 2 * n_workers)
+    rw_out: dict = {}
+    rw_stats: dict = {}
+
+    def render_stream(workers):
+        outs, rends = [], []
+
+        def sink(r):
+            outs.append(r[0] + r[1])
+            rends.append(r[6])
+
+        pool = ReorderingPool(workers, sink)
+        for _ in range(n_batches):
+            pool.submit(ec_mod.render_batch_host, batch, buf, rb_,
+                        rl_, maxe, cfg, False)
+        pool.flush()
+        rw_stats[workers] = (rends, pool.take_reorder_wait())
+        pool.shutdown()
+        rw_out[workers] = "".join(outs)
+
+    rw1_s, rwN_s = bench_pair(lambda: render_stream(1),
+                              lambda: render_stream(n_workers))
+    rw_par = rw_out[1] == rw_out[n_workers]
+    rends, wait_s = rw_stats[n_workers]
+    print(metric_line(
+        "ab_render_workers", workers=n_workers, batches=n_batches,
+        base_ms=round(rw1_s * 1e3, 1),
+        workers_ms=round(rwN_s * 1e3, 1),
+        speedup=round(rw1_s / rwN_s, 3),
+        render_ms_per_batch=round(
+            sum(rends) / max(1, len(rends)) * 1e3, 2),
+        reorder_wait_ms=round(wait_s * 1e3, 2),
+        parity="byte-identical" if rw_par else "MISMATCH"))
+    assert rw_par, "render-worker outputs disagree"
+
 
 def main():
     from quorum_tpu.utils.jaxcache import enable_cache

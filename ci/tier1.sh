@@ -164,6 +164,7 @@ else
         env JAX_PLATFORMS=cpu python tools/metrics_check.py \
             --require-metric ab_stage1_insert \
             --require-metric ab_stage2_device \
+            --require-metric ab_render_workers \
             "$AB_DIR/bench_ab.json" || bench_rc=1
     fi
     if [ "$bench_rc" -ne 0 ]; then
@@ -214,7 +215,8 @@ else
     if [ "$fsck_rc" -eq 0 ]; then
         echo "== metrics_check gate (fsck) =="
         env JAX_PLATFORMS=cpu python tools/metrics_check.py \
-            "$FSCK_DIR/fsck_metrics.json" || fsck_rc=1
+            "$FSCK_DIR/fsck_metrics.json" \
+            "$FSCK_DIR/fsck_sharded_metrics.json" || fsck_rc=1
     fi
     if [ "$fsck_rc" -ne 0 ]; then
         echo "ci/tier1.sh: fsck gate FAILED (rc=$fsck_rc)" >&2

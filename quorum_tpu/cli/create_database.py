@@ -52,6 +52,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "trailer digest so loaders and quorum-fsck "
                         "detect silent corruption; 4 is the bare "
                         "round-5 layout (same payload bytes)")
+    p.add_argument("--db-layout", choices=("single", "sharded"),
+                   default="single",
+                   help="On-disk layout: single (default) gathers a "
+                        "sharded table to one chip and writes one "
+                        "file; sharded streams each shard D2H "
+                        "independently into <output>.shard-K-of-S.qdb "
+                        "files under a sealed manifest at <output> — "
+                        "no cross-device gather, no single-chip "
+                        "geometry cap, same payload bytes")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("--metrics", metavar="path", default=None,
@@ -135,6 +144,7 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         resume=args.resume,
         on_bad_read=args.on_bad_read,
         db_version=args.db_version,
+        db_layout=args.db_layout,
         quarantine_path=(args.output + ".quarantine.fastq"
                          if args.on_bad_read == "quarantine" else None),
     )

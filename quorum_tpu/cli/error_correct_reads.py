@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the size threshold and stays row-sharded "
                         "with routed lookups above it; output is "
                         "byte-identical to --devices 1")
+    p.add_argument("--render-workers", type=int, default=0, metavar="N",
+                   help="Host finish/render workers behind a sequence-"
+                        "numbered reorder stage (0 = auto, min(4, "
+                        "cores)). Output is byte-identical for any N; "
+                        "N > 1 hides the per-batch host tail behind "
+                        "the device")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("--metrics", metavar="path", default=None,
@@ -162,6 +168,7 @@ def main(argv=None, db=None, prepacked=None) -> int:
         batch_size=batch_size,
         threads=args.thread,
         devices=devices,
+        render_workers=args.render_workers,
         no_mmap=args.no_mmap,
         profile=args.profile,
         metrics=args.metrics,

@@ -11,7 +11,11 @@ over the artifacts io/ writes:
   operator knows which 4 MiB of a 10 GiB table rotted; v4/v3/v2/v1
   files get the structural host load (counts, bucket addresses,
   truncation); reference `binary/quorum_db` files get the geometry +
-  full-decode check (the digest-less format's maximum).
+  full-decode check (the digest-less format's maximum). Sharded
+  manifests (`--db-layout=sharded`, ISSUE 9) get the manifest seal,
+  every shard file's own checksum walk, and the manifest's per-shard
+  whole-file digests — problems name `shard-K/<section>` so the
+  damaged shard file is pinpointed, not just "the database".
 * **Checkpoint directories** — the stage-1 snapshot (header seal +
   payload digest), the sharded manifest + every shard payload, and
   the driver's replay capture (manifest seal + per-batch digests).
@@ -90,6 +94,20 @@ def check_db(path: str, mode: str, rep: _Report) -> None:
     if problems:
         for sec, off, msg in problems:
             rep.fail(path, sec, msg, off)
+        return
+    if header.get("format") == db_format.MANIFEST_FORMAT:
+        rep.ok(path, "sharded database manifest",
+               f"{header.get('n_shards')} shard file(s), "
+               f"{header.get('n_entries')} entries — manifest seal, "
+               f"per-shard checksums + whole-file digests, {mode} "
+               "mode")
+        return
+    if header.get("layout") == "shard":
+        rep.ok(path, "database shard",
+               f"shard {header.get('shard')} of "
+               f"{header.get('n_shards')} ({header.get('n_entries')} "
+               f"entries), v{version} checksums, {mode} mode — run "
+               "fsck on the manifest to also check the shard set")
         return
     if version >= 5:
         n = header.get("n_entries", "?")

@@ -225,12 +225,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "carries per-section CRC32C digests + a "
                         "whole-file trailer digest; 4 is the bare "
                         "layout (same payload bytes)")
+    p.add_argument("--db-layout", choices=("single", "sharded"),
+                   default="single",
+                   help="Mer-database on-disk layout: single (default) "
+                        "writes one file (gathering a sharded table "
+                        "to one chip); sharded streams per-shard "
+                        "files under a sealed manifest — no "
+                        "cross-device gather, no single-chip geometry "
+                        "cap, same payload bytes")
     p.add_argument("--verify-db", choices=("full", "sample", "off"),
                    default="full",
                    help="Checksum verification when stage 2 loads a "
                         "v5 database: full (default), sample "
                         "(random chunk scrub), or off. A bad digest "
                         "refuses the run (rc 3)")
+    p.add_argument("--render-workers", type=int, default=0, metavar="N",
+                   help="Stage-2 host finish/render workers behind a "
+                        "sequence-numbered reorder stage (0 = auto, "
+                        "min(4, cores)); output is byte-identical for "
+                        "any N")
     faults.add_fault_args(p)
     p.add_argument("--debug", action="store_true",
                    help="Display debugging information")
@@ -427,7 +440,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                 "-t", str(threads),
                 "-o", db_file, "--batch-size", str(args.batch_size),
                 "--devices", str(n_devices),
-                "--db-version", str(args.db_version)]
+                "--db-version", str(args.db_version),
+                "--db-layout", args.db_layout]
     if args.checkpoint_dir:
         cdb_argv.extend(["--checkpoint-dir", args.checkpoint_dir,
                          "--checkpoint-every",
@@ -663,7 +677,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     # Stage 2: error correction (quorum.in:162-231)
     ec_common = ["--batch-size", str(args.batch_size),
                  "-t", str(threads), "--devices", str(n_devices),
-                 "--verify-db", args.verify_db]
+                 "--verify-db", args.verify_db,
+                 "--render-workers", str(args.render_workers)]
     for flag, val in (("--min-count", args.min_count),
                       ("--skip", args.skip),
                       ("--good", args.anchor),
@@ -753,6 +768,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     opts = ECOptions(output=args.prefix, contaminant=args.contaminant,
                      batch_size=args.batch_size, threads=threads,
                      devices=n_devices, verify_db=args.verify_db,
+                     render_workers=args.render_workers,
                      profile=p2, metrics=m2,
                      metrics_interval=args.metrics_interval,
                      metrics_textfile=args.metrics_textfile,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import gzip
 import io as _io
+import os
 import sys
 import threading
 from typing import Iterable, Iterator, Sequence
@@ -277,6 +278,203 @@ def _make_batch(buf, batch_size) -> ReadBatch:
                      headers=headers, n=n)
 
 
+# ---------------------------------------------------------------------------
+# Single-file span-parallel parse (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+# below this size the span probing + worker setup costs more than the
+# serial parse; tests lower it to exercise the path on tiny inputs
+PARALLEL_SPAN_MIN_BYTES = 4 << 20
+
+# bases a sequence line may contain (IUPAC + lowercase); quality
+# strings essentially never pass this filter, which is what
+# disambiguates '@'-starting quality lines from record headers
+_SEQ_CHARS = frozenset(b"ACGTUNRYSWKMBDHVacgtunryswkmbdhv.-")
+
+
+def _is_seq_line(line: bytes) -> bool:
+    s = line.rstrip(b"\r\n")
+    return bool(s) and all(c in _SEQ_CHARS for c in s)
+
+
+def _rec4_at(lines, i: int) -> bool:
+    """lines[i:i+4] look like one strict 4-line FASTQ record."""
+    return (i + 3 < len(lines)
+            and lines[i].startswith(b"@")
+            and _is_seq_line(lines[i + 1])
+            and lines[i + 2].startswith(b"+")
+            and len(lines[i + 3].rstrip(b"\r\n"))
+            == len(lines[i + 1].rstrip(b"\r\n")))
+
+
+def _probe_record_start(f, offset: int, window: int = 64) -> int | None:
+    """Scan forward from `offset` for a confident 4-line-FASTQ record
+    start: TWO consecutive strict 4-line records (header/'@', sequence,
+    '+', length-matched quality). One record alone is not confident —
+    a WRAPPED (multi-line) FASTQ's quality chunks can impersonate it
+    (an '@'-leading quality chunk + an all-IUPAC chunk + a '+'-leading
+    chunk of matching wrap width), and a cut there would silently
+    corrupt records; two in lockstep closes that. Returns the byte
+    offset of the first header line, or None when no confident
+    boundary lies within `window` lines."""
+    f.seek(offset)
+    if offset:
+        f.readline()  # discard the partial line the cut landed in
+    positions, lines = [], []
+    for _ in range(window):
+        pos = f.tell()
+        line = f.readline()
+        if not line:
+            break
+        positions.append(pos)
+        lines.append(line)
+    for i in range(len(lines) - 7):
+        if _rec4_at(lines, i) and _rec4_at(lines, i + 4):
+            return positions[i]
+    return None
+
+
+def _single_file_spans(path: str, n: int) -> list[tuple[int, int]] | None:
+    """Record-aligned [start, end) spans of ONE uncompressed 4-line
+    FASTQ file, or None when the file can't be split safely (stdin,
+    gzip, FASTA, multi-line records, too small). Span boundaries land
+    exactly between records, so each span parses independently and
+    their record streams concatenate to the serial parse's order."""
+    if path in ("-", "/dev/fd/0", "/dev/stdin") or path.endswith(".gz"):
+        return None
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return None
+    if n <= 1 or size < max(PARALLEL_SPAN_MIN_BYTES, 4 * n):
+        return None
+    with open(path, "rb") as f:
+        if f.read(2) == b"\x1f\x8b":  # gzip by magic, not extension
+            return None
+        f.seek(0)
+        if not f.readline().startswith(b"@"):
+            return None  # FASTA (or junk): the serial parser handles it
+        # the head must itself be strict 4-line FASTQ: a wrapped
+        # (multi-line) file — which _iter_one supports — has no
+        # record-aligned byte cuts, so it stays on the serial parser
+        if _probe_record_start(f, 0) != 0:
+            return None
+        cuts = [0]
+        for i in range(1, n):
+            target = size * i // n
+            pos = _probe_record_start(f, target)
+            if pos is None or pos <= cuts[-1] or pos >= size:
+                continue  # fold this span into its neighbor
+            cuts.append(pos)
+    cuts.append(size)
+    spans = [(cuts[i], cuts[i + 1]) for i in range(len(cuts) - 1)
+             if cuts[i + 1] > cuts[i]]
+    return spans if len(spans) > 1 else None
+
+
+class _SpanReader:
+    """readline()-only view of [start, end) of a binary file. Span
+    boundaries are record starts, so the parser sees a clean EOF
+    exactly between records."""
+
+    def __init__(self, f, start: int, end: int):
+        f.seek(start)
+        self._f = f
+        self._end = end
+        self._pos = start
+
+    def readline(self) -> bytes:
+        if self._pos >= self._end:
+            return b""
+        line = self._f.readline()
+        self._pos += len(line)
+        return line
+
+
+def _iter_sources_pooled(n: int, threads: int, produce) -> Iterator:
+    """The items of `produce(0)`, `produce(1)`, … `produce(n-1)`
+    concatenated in SOURCE ORDER, with the producers running
+    concurrently on a worker pool — the one ordered fan-in protocol
+    behind both the multi-file reader and the single-file span parse.
+    Workers CLAIM source indices in order (not one pre-pinned source
+    each): with fewer workers than sources, pre-pinning could hand
+    every worker a later source while the consumer blocks on source
+    0's queue — an unbreakable cycle. A producer exception is
+    forwarded and re-raised at the consumer in order; abandoning the
+    generator stops the workers (stop-aware bounded puts)."""
+    import itertools
+    import queue
+
+    from ..utils.pipeline import put_or_stop as _put_or_stop
+
+    qs = [queue.Queue(maxsize=4) for _ in range(n)]
+    stop = threading.Event()
+    claim = itertools.count()
+    claim_lock = threading.Lock()
+
+    def worker():
+        while not stop.is_set():
+            with claim_lock:
+                i = next(claim)
+            if i >= n:
+                return
+            try:
+                for item in produce(i):
+                    # 1-tuple wrap: data can never be mistaken for the
+                    # error sentinel or the end-of-source None
+                    if not _put_or_stop(qs[i], (item,), stop):
+                        return
+                if not _put_or_stop(qs[i], None, stop):
+                    return
+            except BaseException as e:  # noqa: BLE001 - forwarded
+                _put_or_stop(qs[i], ("__err__", e), stop)
+                return
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(min(max(1, threads), n))]
+    for t in ts:
+        t.start()
+    try:
+        for i in range(n):
+            while True:
+                item = qs[i].get()
+                if item is None:
+                    break
+                if len(item) == 2:
+                    raise item[1]
+                yield item[0]
+    finally:
+        stop.set()
+
+
+def _iter_records_spans(path: str, spans: list, threads: int,
+                        policy: BadReadPolicy | None,
+                        ) -> Iterator[tuple[str, bytes, bytes]]:
+    """Parse one file's record-aligned spans on a worker pool, yielding
+    records in FILE ORDER (span streams are stitched back in span
+    order, so downstream batching — and therefore batch cursors,
+    resume journals, and output bytes — match the serial parse
+    exactly). Only reached with policy None/abort (read_batches
+    gates): a malformed record aborts the run from whichever worker
+    hits it."""
+    CHUNK = 512  # records per queue item: amortize queue overhead
+
+    def produce(i):
+        with open(path, "rb") as f:
+            rdr = _SpanReader(f, *spans[i])
+            chunk: list = []
+            for rec in _iter_one(rdr, path, policy):
+                chunk.append(rec)
+                if len(chunk) >= CHUNK:
+                    yield chunk
+                    chunk = []
+            if chunk:
+                yield chunk
+
+    for chunk in _iter_sources_pooled(len(spans), threads, produce):
+        yield from chunk
+
+
 def _read_batches_one(paths: Sequence[str], batch_size: int,
                       policy: BadReadPolicy | None = None,
                       ) -> Iterator[ReadBatch]:
@@ -311,9 +509,50 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
     reference's). This is the real host parallelism behind the CLIs'
     `-t` — the decode (gzip inflation especially) overlaps the device
     pipeline the way the reference's N parser threads do
-    (create_database.cc:122, error_correct_reads.cc:738). Single-file
-    inputs decode on one worker regardless (gzip is inherently
-    serial); the prefetch thread still overlaps it with device work."""
+    (create_database.cc:122, error_correct_reads.cc:738).
+
+    A SINGLE uncompressed strict-4-line-FASTQ file also parses in
+    parallel (ISSUE 9): the file splits into record-aligned spans
+    (`_single_file_spans`) that the same worker pool parses
+    concurrently, records stitched back in file order before batching
+    — so batch boundaries are identical to the serial parse. gzip
+    (inherently serial), stdin, FASTA, wrapped multi-line records,
+    and small files fall back to one worker; so do skip/quarantine
+    bad-read policies and active fault plans, whose exact record
+    semantics only the serial parser reproduces (see the gate below);
+    so does the native C++ fast path, which is quicker still."""
+    if threads > 1 and len(paths) == 1:
+        use_native = False
+        if policy is None or policy.mode == "abort":
+            try:
+                from ..native import binding as _nb
+                use_native = _nb.available()
+            except Exception:
+                use_native = False
+        # two callers depend on the SERIAL parser's exact record
+        # semantics, so they opt out of span parallelism: an active
+        # fault plan (`fastq.read` `at=`/`count=` hit indices must be
+        # reproducible, not scheduler-dependent), and any non-abort
+        # bad-read policy — on a damaged file, WHICH records a
+        # skip/quarantine resync swallows depends on parser state
+        # carried across the damage, which a span cut truncates; the
+        # survivor stream (and the quarantine file's order) must match
+        # the serial parse, so triage modes stay serial. Under abort
+        # the first malformed record kills the run either way.
+        deterministic_only = (faults.active()
+                              or (policy is not None
+                                  and policy.mode != "abort"))
+        spans = (None if use_native or deterministic_only
+                 else _single_file_spans(paths[0], threads))
+        if spans:
+            try:
+                yield from batch_records(
+                    _iter_records_spans(paths[0], spans, threads,
+                                        policy), batch_size)
+            finally:
+                if policy is not None:
+                    policy.close()
+            return
     if threads <= 1 or len(paths) <= 1:
         try:
             yield from _read_batches_one(paths, batch_size, policy)
@@ -324,59 +563,10 @@ def read_batches(paths: Sequence[str], batch_size: int = 8192,
             if policy is not None:
                 policy.close()
         return
-    import itertools
-    import queue
-
-    from ..utils.pipeline import put_or_stop as _put_or_stop
-
-    qs = [queue.Queue(maxsize=4) for _ in paths]
-    stop = threading.Event()
-    # workers CLAIM file indices in order (not one pre-pinned file
-    # each): with fewer permits than files, pre-pinning could hand
-    # every permit to later files while the consumer blocks on file
-    # 0's queue — an unbreakable cycle
-    claim = itertools.count()
-    claim_lock = threading.Lock()
-
-    def put_or_stop(i, item) -> bool:
-        """Stop-aware bounded put (the shared pipeline helper); False
-        if the consumer went away — an unbounded put here would
-        strand the worker forever on a full queue after the generator
-        is abandoned."""
-        return _put_or_stop(qs[i], item, stop)
-
-    def worker():
-        while not stop.is_set():
-            with claim_lock:
-                i = next(claim)
-            if i >= len(paths):
-                return
-            try:
-                for b in _read_batches_one([paths[i]], batch_size,
-                                           policy):
-                    if not put_or_stop(i, b):
-                        return
-                if not put_or_stop(i, None):
-                    return
-            except BaseException as e:  # noqa: BLE001 - forwarded
-                put_or_stop(i, ("__err__", e))
-                return
-
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(min(max(1, threads), len(paths)))]
-    for t in ts:
-        t.start()
     try:
-        for i in range(len(paths)):
-            while True:
-                item = qs[i].get()
-                if item is None:
-                    break
-                if isinstance(item, tuple) and len(item) == 2 \
-                        and item[0] == "__err__":
-                    raise item[1]
-                yield item
+        yield from _iter_sources_pooled(
+            len(paths), threads,
+            lambda i: _read_batches_one([paths[i]], batch_size, policy))
     finally:
-        stop.set()
         if policy is not None:
             policy.close()

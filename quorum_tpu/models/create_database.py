@@ -64,6 +64,21 @@ class BuildConfig:
     # export (per-section CRC32C + whole-file trailer digest); 4 the
     # bare round-5 layout. The payload bytes are identical.
     db_version: int = 5
+    # --db-layout (ISSUE 9): "single" gathers a sharded table to one
+    # chip and writes the one-file format (compatibility default);
+    # "sharded" streams each shard D2H independently into
+    # PREFIX.shard-K-of-S.qdb v5 files under a sealed manifest — no
+    # cross-device gather, no single-chip geometry cap
+    db_layout: str = "single"
+
+
+def s1_overlap_default() -> bool:
+    """The sharded build's pack/exchange overlap (ISSUE 9): ON unless
+    QUORUM_S1_OVERLAP=0 — the double-buffered dispatch is bit-exact
+    (resolution order is dispatch order, retries stay synchronous), so
+    the switch exists for A/B measurement, not correctness."""
+    import os
+    return os.environ.get("QUORUM_S1_OVERLAP", "1") != "0"
 
 
 # canonical home is ops/ctable (so the fused stage-1 dispatch can use
@@ -368,92 +383,152 @@ def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
     timer = StageTimer()
     steps: dict = {}
     shard_inserts = np.zeros((S,), np.int64)
-    with trace(cfg.profile):
-        for batch, pk in batches:
-            if skip_batches > 0:
-                skip_batches -= 1
-                reg.counter("resume_skipped_reads").inc(batch.n)
-                continue
-            step_i = stats.batches
-            faults.inject("stage1.insert", batch=step_i)
-            stats.batches += 1
-            stats.reads += batch.n
-            nb = int(batch.lengths.sum())
-            stats.bases += nb
-            timer.add_units("insert_wait", nb)
-            reg.heartbeat(stage="create_database", reads=stats.reads,
-                          bases=stats.bases, batches=stats.batches,
-                          devices=S)
-            reg.counter("shard_batches").inc()
-            reg.counter("shard_reads").inc(batch.n)
-            wire = jnp.asarray(pk.to_wire())
-            b_rows, length = pk.n_reads, pk.length
-            pending = jnp.ones((b_rows * length,), bool)
+    # pack/exchange overlap (ISSUE 9, the ROADMAP carried-over gap):
+    # the first insert pass of batch N dispatches WITHOUT syncing, so
+    # the host packs + H2Ds batch N+1's wire while N's all_to_all
+    # exchange runs on the devices; N resolves (flag sync + any
+    # grow/overflow retries, which are rare and stay synchronous)
+    # right before N+1 dispatches — so the exact-once retry contract
+    # and the checkpoint cursor semantics are untouched.
+    overlap = s1_overlap_default()
+
+    def _get_step(b_rows, length, thresholds):
+        key = (meta.rb_log2, b_rows, length, thresholds)
+        step = steps.get(key)
+        if step is None:
+            step = ts.build_step_wire(mesh, meta, cfg.qual_thresh,
+                                      b_rows, length, thresholds)
+            steps[key] = step
+        return step
+
+    def _dispatch(batch, pk, wire, step_i):
+        """Async first insert pass: returns the in-flight job. The
+        new bstate HANDLE is current immediately (XLA chains the next
+        dispatch on it); only the flag sync waits."""
+        nonlocal bstate
+        pending = jnp.ones((pk.n_reads * pk.length,), bool)
+        t0 = time.perf_counter()
+        with tracer.step("stage1_insert", step_i, reads=batch.n):
+            bstate, full, over, placed, n_ins = _get_step(
+                pk.n_reads, pk.length, pk.thresholds)(
+                    bstate, wire, pending)
+        t1 = time.perf_counter()
+        return (step_i, batch, pk, wire, pending, t0, t1, full, over,
+                placed, n_ins)
+
+    def _resolve(job):
+        """Sync the in-flight pass's flags, run any grow/overflow
+        retries to completion, then account the batch (stats,
+        heartbeat, checkpoint). Called in dispatch order."""
+        nonlocal bstate, meta, shard_inserts
+        (step_i, batch, pk, wire, pending, t0, t1, full, over,
+         placed, n_ins) = job
+        with tracer.span("stage1_batch", step=step_i, reads=batch.n):
+            tw = time.perf_counter()
+            full_b, over_b = bool(full), bool(over)
+            # the host-observed wait is the blocked time HERE — with
+            # the overlap on, the exchange that used to serialize
+            # behind the pack now hides under it
+            observe_dispatch_wait(reg, "insert", t0, t1,
+                                  t1 + (time.perf_counter() - tw),
+                                  timer=timer)
+            shard_inserts += np.asarray(n_ins, np.int64)
             grows = 0
             # overflow-only retries always make progress; the budget
             # per grow LEVEL only guards a wedged loop (see
             # tile_sharded.build_database_tile_sharded)
             level_budget = 2 * S + 8
             passes = 0
-            with tracer.span("stage1_batch", step=step_i,
-                             reads=batch.n):
-                while True:
-                    key = (meta.rb_log2, b_rows, length, pk.thresholds)
-                    step = steps.get(key)
-                    if step is None:
-                        step = ts.build_step_wire(
-                            mesh, meta, cfg.qual_thresh, b_rows, length,
-                            pk.thresholds)
-                        steps[key] = step
-                    t0 = time.perf_counter()
-                    with tracer.step("stage1_insert", step_i,
-                                     reads=batch.n):
-                        bstate, full, over, placed, n_ins = step(
+            while full_b or over_b:
+                pending = jnp.logical_and(pending,
+                                          jnp.logical_not(placed))
+                if full_b:
+                    if grows >= cfg.max_grows:
+                        raise RuntimeError("Hash is full")
+                    grows += 1
+                    passes = 0
+                    rows_before = meta.rows
+                    vlog("Sharded hash full at ", rows_before,
+                         " buckets; doubling")
+                    with timer.stage("grow"), tracer.span(
+                            "hash_grow", rows_before=rows_before):
+                        bstate, meta = ts.grow(bstate, meta, mesh)
+                        stats.grows += 1
+                        reg.counter("hash_grows").inc()
+                        reg.counter("shard_grows").inc()
+                        reg.event("hash_grow",
+                                  rows_before=rows_before,
+                                  rows_after=meta.rows)
+                    steps.clear()  # old geometry's executables
+                else:
+                    passes += 1
+                    reg.counter("shard_overflow_passes").inc()
+                    if passes > level_budget:
+                        raise RuntimeError("Hash is full")
+                t0r = time.perf_counter()
+                with tracer.step("stage1_insert", step_i,
+                                 reads=batch.n):
+                    bstate, full, over, placed, n_ins = _get_step(
+                        pk.n_reads, pk.length, pk.thresholds)(
                             bstate, wire, pending)
-                        t1 = time.perf_counter()
-                        full_b, over_b = bool(full), bool(over)
-                        t2 = time.perf_counter()
-                    observe_dispatch_wait(reg, "insert", t0, t1, t2,
-                                          timer=timer)
-                    shard_inserts += np.asarray(n_ins, np.int64)
-                    if not (full_b or over_b):
-                        break
-                    pending = jnp.logical_and(pending,
-                                              jnp.logical_not(placed))
-                    if full_b:
-                        if grows >= cfg.max_grows:
-                            raise RuntimeError("Hash is full")
-                        grows += 1
-                        passes = 0
-                        rows_before = meta.rows
-                        vlog("Sharded hash full at ", rows_before,
-                             " buckets; doubling")
-                        with timer.stage("grow"), tracer.span(
-                                "hash_grow", rows_before=rows_before):
-                            bstate, meta = ts.grow(bstate, meta, mesh)
-                            stats.grows += 1
-                            reg.counter("hash_grows").inc()
-                            reg.counter("shard_grows").inc()
-                            reg.event("hash_grow",
-                                      rows_before=rows_before,
-                                      rows_after=meta.rows)
-                        steps.clear()  # old geometry's executables
-                    else:
-                        passes += 1
-                        reg.counter("shard_overflow_passes").inc()
-                        if passes > level_budget:
-                            raise RuntimeError("Hash is full")
-            if (ck is not None and cfg.checkpoint_every > 0
-                    and stats.batches % cfg.checkpoint_every == 0):
-                # per-shard snapshots under one manifest; the manifest
-                # swap is the commit point (kill-safe at any instant)
-                with timer.stage("checkpoint"), tracer.span(
-                        "checkpoint", batch=stats.batches):
-                    ck.save(bstate, meta, cfg, stats.batches, stats,
-                            paths)
-                reg.counter("checkpoint_writes_total").inc()
-                reg.event("checkpoint", stage="create_database",
-                          cursor=stats.batches)
+                    t1r = time.perf_counter()
+                    full_b, over_b = bool(full), bool(over)
+                    t2r = time.perf_counter()
+                observe_dispatch_wait(reg, "insert", t0r, t1r, t2r,
+                                      timer=timer)
+                shard_inserts += np.asarray(n_ins, np.int64)
+        # the batch is fully inserted: account it and maybe checkpoint
+        # (cursor = RESOLVED batches, so a kill mid-pipeline resumes
+        # exactly at the last fully-inserted batch)
+        stats.batches += 1
+        stats.reads += batch.n
+        nb = int(batch.lengths.sum())
+        stats.bases += nb
+        timer.add_units("insert_wait", nb)
+        reg.heartbeat(stage="create_database", reads=stats.reads,
+                      bases=stats.bases, batches=stats.batches,
+                      devices=S)
+        reg.counter("shard_batches").inc()
+        reg.counter("shard_reads").inc(batch.n)
+        if (ck is not None and cfg.checkpoint_every > 0
+                and stats.batches % cfg.checkpoint_every == 0):
+            # per-shard snapshots under one manifest; the manifest
+            # swap is the commit point (kill-safe at any instant)
+            with timer.stage("checkpoint"), tracer.span(
+                    "checkpoint", batch=stats.batches):
+                ck.save(bstate, meta, cfg, stats.batches, stats,
+                        paths)
+            reg.counter("checkpoint_writes_total").inc()
+            reg.event("checkpoint", stage="create_database",
+                      cursor=stats.batches)
+
+    inflight = None
+    # global batch index: resumes from the checkpoint cursor so fault
+    # `batch=` matching and trace step ids stay aligned with the
+    # pre-kill run (and with the single-device loop's step_i)
+    step_i = skip_batches
+    with trace(cfg.profile):
+        for batch, pk in batches:
+            if skip_batches > 0:
+                skip_batches -= 1
+                reg.counter("resume_skipped_reads").inc(batch.n)
+                continue
+            t_h0 = time.perf_counter()
+            wire = jnp.asarray(pk.to_wire())  # H2D under N's exchange
+            if inflight is not None:
+                if reg.enabled:
+                    reg.histogram("s1_pack_overlap_us").observe(
+                        round((time.perf_counter() - t_h0) * 1e6))
+                _resolve(inflight)
+                inflight = None
+            faults.inject("stage1.insert", batch=step_i)
+            inflight = _dispatch(batch, pk, wire, step_i)
+            step_i += 1
+            if not overlap:
+                _resolve(inflight)
+                inflight = None
+        if inflight is not None:
+            _resolve(inflight)
     with timer.stage("seal"), tracer.span("seal"):
         state = ts.finalize(bstate, meta, mesh)
         per = ts.shard_occupancy(state, meta)
@@ -498,6 +573,19 @@ def create_database_main(
         # the sharded build hands over the ROW-SHARDED table +
         # TileShardedMeta; stage 2 reshards once per its chosen layout
         handoff["db"] = (state, meta)
+    if not ref_format and cfg.db_layout == "sharded":
+        # the no-gather export (ISSUE 9): each shard's rows compact on
+        # their own device and stream D2H into PREFIX.shard-K-of-S.qdb
+        # under a sealed manifest — gather_table is never called, so
+        # the single-chip geometry cap and the ~13 min cross-device
+        # gather (PR 5 notes) both disappear
+        db_format.write_db_sharded(output, state, meta, cmdline,
+                                   db_version=cfg.db_version)
+        if cfg.checkpoint_dir:
+            cls = (ckpt_mod.Stage1ShardedCheckpoint if cfg.devices > 1
+                   else ckpt_mod.Stage1Checkpoint)
+            cls(cfg.checkpoint_dir).clear()
+        return stats
     write_state, write_meta = state, meta
     if getattr(meta, "n_shards", 1) > 1:
         # the concatenated shard rows ARE the single-chip table
@@ -508,15 +596,15 @@ def create_database_main(
             write_state, write_meta = ts.gather_table(state, meta)
         except ValueError as e:
             # rb_log2 grew past the single-chip cap: the table content
-            # is fine but no on-disk format can hold it yet (ROADMAP:
-            # sharded database format). Fail with the real options —
-            # there is no code path that avoids this write today.
+            # is fine, and the sharded layout holds it without any
+            # gather — point the operator at it
             raise RuntimeError(
                 f"the sharded table grew past the single-file "
-                f"database geometry ({e}); no sharded on-disk format "
-                "exists yet (ROADMAP) — reduce the distinct-mer load "
-                "(smaller input set, larger -m, or a higher -q "
-                "threshold) to fit rb_log2<=24") from None
+                f"database geometry ({e}); export it with "
+                "--db-layout=sharded (per-shard files under a "
+                "manifest, no single-chip cap), or reduce the "
+                "distinct-mer load (smaller input set, larger -m, or "
+                "a higher -q threshold) to fit rb_log2<=24") from None
     if ref_format:
         # the reference's own binary/quorum_db on-disk format
         # (io/quorum_db; mer_database.hpp:115-126)

@@ -37,7 +37,7 @@ from ..io import db_format, fastq, packing
 from ..ops.poisson import compute_poisson_cutoff
 from ..telemetry import observe_dispatch_wait
 from ..utils import faults
-from ..utils.pipeline import AsyncWriter, prefetch
+from ..utils.pipeline import AsyncWriter, ReorderingPool, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
 from .corrector import (correct_batch_packed, fetch_finish,
@@ -99,6 +99,54 @@ def record_outcome(reg, outcome: dict) -> None:
         hist.observe(v, n)
     for slug, n in outcome["skips"].items():
         reg.counter(f"skipped_{slug}").inc(n)
+
+
+def resolve_render_workers(n: int) -> int:
+    """`--render-workers` semantics: 0 (the default) = min(4, cores)
+    — enough to hide the ~0.3-0.4 s/batch host finish/render tail
+    behind the device at multi-device throughputs without oversubscribing
+    the decode/pack threads; an explicit N is taken as-is (1 = the
+    pre-ISSUE-9 serial pipeline)."""
+    import os
+    if n and n > 0:
+        return int(n)
+    return min(4, os.cpu_count() or 1)
+
+
+def render_batch_host(batch, buf, b: int, l: int, maxe: int,
+                      cfg: ECConfig, count_outcomes: bool):
+    """The per-batch HOST tail as one pure function: finish the fetched
+    device buffer and render every read's `.fa`/`.log` text. Runs on a
+    render worker (ISSUE 9: N of these execute concurrently; the
+    sequence-numbered reorder stage in utils/pipeline.ReorderingPool
+    re-serializes the results, so output bytes are identical to the
+    serial pipeline for any worker count). Returns
+    (fa_text, log_text, n_corrected, n_skipped, bases_out, outcome,
+    render_seconds)."""
+    t0 = time.perf_counter()
+    results = finish_batch_host(buf, batch.n, cfg, batch.codes,
+                                b, l, maxe)
+    fa_parts: list[str] = []
+    log_parts: list[str] = []
+    n_corr = n_skip = bases_out = 0
+    # per-read outcome tallies (err_log.hpp semantics, decoded from
+    # the rendered entry strings so counters are exactly what the
+    # .fa/.log outputs record); skipped when metrics are off —
+    # render_result never sees an outcome dict
+    outcome = new_outcome() if count_outcomes else None
+    for hdr, r in zip(batch.headers, results):
+        fa, lg = render_result(hdr, r, cfg, outcome)
+        if r.ok:
+            n_corr += 1
+            bases_out += r.end - r.start
+        else:
+            n_skip += 1
+        if fa:
+            fa_parts.append(fa)
+        if lg:
+            log_parts.append(lg)
+    return ("".join(fa_parts), "".join(log_parts), n_corr, n_skip,
+            bases_out, outcome, time.perf_counter() - t0)
 
 
 def _replay_plane_missing(prepacked, qual_cutoff: int) -> bool:
@@ -167,6 +215,10 @@ class ECOptions:
     # the size threshold, row-sharded with routed lookups above it
     # (parallel/tile_sharded.ShardedCorrector)
     devices: int = 1
+    # --render-workers (ISSUE 9): N host finish/render workers behind
+    # a sequence-numbered reorder stage — output bytes identical to
+    # the serial pipeline for any N. 0 = auto (min(4, cores))
+    render_workers: int = 0
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -277,7 +329,22 @@ def _run_ec(db_path: str, sequences: Sequence[str],
         # costs ~0.1 s/MB; the reference's page-cached re-mmap is free)
         state, meta = db
     else:
-        state, meta, _header = db_format.read_db(db_path, to_device=True,
+        to_dev = True
+        if opts.devices > 1:
+            try:
+                hdr = db_format.read_header(db_path)
+            except (OSError, ValueError):
+                hdr = {}  # ref/v1 formats: read_db handles them
+            if (hdr.get("format") == db_format.MANIFEST_FORMAT
+                    and int(hdr.get("rb_log2", 0)) > 24):
+                # past the single-chip geometry cap: reassemble on the
+                # host — ShardedCorrector device_puts the row planes
+                # itself (routed layout at this size), so a device-
+                # resident single-chip copy would be both impossible
+                # and wasted
+                to_dev = False
+        state, meta, _header = db_format.read_db(db_path,
+                                                 to_device=to_dev,
                                                  no_mmap=opts.no_mmap,
                                                  verify=opts.verify_db)
 
@@ -432,59 +499,48 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                     yield b, pack_for_stage2(b, cfg)
             batches = prefetch(_pack(src), metrics=pipe_metrics,
                                tracer=tracer)
-        # host finish+render pipeline: the D2H (fetch_finish) must stay
-        # on the MAIN thread (the tunnel degrades under concurrent
-        # device access, PERF_NOTES.md r4), but the numpy/str tail is
-        # pure host work — one worker renders batch i while the device
-        # corrects batch i+1 (~0.3-0.4 s/batch hidden). A single
-        # worker + FIFO drain preserves output record order.
-        import collections
-        import concurrent.futures as _cf
-
+        # host finish+render pipeline (ISSUE 9): the D2H (fetch_finish)
+        # must stay on the MAIN thread (the tunnel degrades under
+        # concurrent device access, PERF_NOTES.md r4), but the
+        # numpy/str tail is pure host work — N render workers finish
+        # batches i..i+N-1 while the device corrects batch i+N
+        # (~0.3-0.4 s/batch each, the host roofline PERF_NOTES round 6
+        # measured binding the multi-device scaling). The sequence-
+        # numbered reorder stage (utils/pipeline.ReorderingPool) drains
+        # results in submission order in front of the AsyncWriter, so
+        # `.fa`/`.log` bytes are identical to --render-workers 1 for
+        # any N, and the journal's batch commit order is unchanged
+        # (kill -> resume parity holds).
         count_outcomes = reg.enabled
+        n_render = resolve_render_workers(opts.render_workers)
+        if reg.enabled:
+            reg.set_meta(render_workers=n_render)
+            reg.histogram("render_ms")  # land even for an empty input
+            reg.histogram("reorder_wait_ms")
 
         def _render(batch, buf, b, l, maxe):
             with tracer.span("render", reads=batch.n):
-                return _render_inner(batch, buf, b, l, maxe)
+                return render_batch_host(batch, buf, b, l, maxe, cfg,
+                                         count_outcomes)
 
-        def _render_inner(batch, buf, b, l, maxe):
-            results = finish_batch_host(buf, batch.n, cfg, batch.codes,
-                                        b, l, maxe)
-            fa_parts: list[str] = []
-            log_parts: list[str] = []
-            n_corr = n_skip = bases_out = 0
-            # per-read outcome tallies (err_log.hpp semantics, decoded
-            # from the rendered entry strings so counters are exactly
-            # what the .fa/.log outputs record); skipped when metrics
-            # are off — render_result never sees an outcome dict
-            outcome = new_outcome() if count_outcomes else None
-            for hdr, r in zip(batch.headers, results):
-                fa, lg = render_result(hdr, r, cfg, outcome)
-                if r.ok:
-                    n_corr += 1
-                    bases_out += r.end - r.start
-                else:
-                    n_skip += 1
-                if fa:
-                    fa_parts.append(fa)
-                if lg:
-                    log_parts.append(lg)
-            return ("".join(fa_parts), "".join(log_parts), n_corr,
-                    n_skip, bases_out, outcome)
-
-        def _drain(fut):
-            with timer.stage("drain"):
-                fa, lg, n_corr, n_skip, bases_out, outcome = fut.result()
+        def _drain_sink(res):
+            fa, lg, n_corr, n_skip, bases_out, outcome, render_s = res
+            wait_s = pool.take_reorder_wait()
+            timer.add_time("drain", wait_s)
             stats.corrected += n_corr
             stats.skipped += n_skip
             stats.bases_out += bases_out
             if outcome is not None:
                 record_outcome(reg, outcome)
+            if reg.enabled:
+                reg.histogram("render_ms").observe(
+                    round(render_s * 1e3, 3))
+                reg.histogram("reorder_wait_ms").observe(
+                    round(wait_s * 1e3, 3))
             writer.write(0, fa)
             writer.write(1, lg)
 
-        pool = _cf.ThreadPoolExecutor(1)
-        pending: collections.deque = collections.deque()
+        pool = ReorderingPool(n_render, _drain_sink)
         step_i = 0
         try:
             with trace(opts.profile):
@@ -537,10 +593,7 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                             buf = fetch_finish(res, packed)
                         b, l = res.out.shape
                         maxe = res.fwd_log.pos.shape[1]
-                        while len(pending) >= 2:
-                            _drain(pending.popleft())
-                        pending.append(pool.submit(_render, batch, buf,
-                                                   b, l, maxe))
+                        pool.submit(_render, batch, buf, b, l, maxe)
                         stats.reads += batch.n
                         nb = int(batch.lengths[:batch.n].sum())
                         stats.bases_in += nb
@@ -556,8 +609,7 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                         # [0, step_i) is REALLY in the partials, then
                         # journal the cursor + byte offsets atomically
                         with timer.stage("checkpoint"):
-                            while pending:
-                                _drain(pending.popleft())
+                            pool.flush()
                             writer.flush()
                             journal.commit(step_i, stats, out.tell(),
                                            log.tell(), opts.batch_size,
@@ -565,10 +617,9 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                         reg.counter("checkpoint_writes_total").inc()
                         reg.event("checkpoint", stage="error_correct",
                                   cursor=step_i)
-                while pending:
-                    _drain(pending.popleft())
+                pool.flush()
         finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+            pool.shutdown()
     finally:
         try:
             writer.close()

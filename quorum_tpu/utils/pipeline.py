@@ -13,6 +13,7 @@ reference's endr-delimited records."""
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -115,6 +116,71 @@ def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
         # consumer abandoned (exception / generator close): release the
         # producer, which may be blocked on a full queue
         stop.set()
+
+
+class ReorderingPool:
+    """N render workers plus a sequence-numbered reorder stage (ISSUE
+    9): work is submitted in input order, executes on ANY worker, and
+    the results drain strictly in submission order — so whatever sits
+    downstream (the AsyncWriter feeding `.fa`/`.log`) sees bytes
+    identical to a single-worker pipeline by construction. This is the
+    host half of the stage-2 scale-out: the device corrects batch i+N
+    while N host workers finish/render batches i..i+N-1, and the
+    reorder stage re-serializes them in front of the writer.
+
+    * `submit(fn, *args)` enqueues one item; when `max_pending` items
+      are already in flight it first drains the head (bounded RAM —
+      each pending item holds a fetched D2H buffer).
+    * `flush()` drains everything still pending, in order.
+    * The `sink(result)` callback runs on the CALLER's thread, always
+      in submission order. A worker exception re-raises at the drain
+      point (submit/flush), never silently skipping an item — the
+      writer is closed by the caller's normal error path, not
+      deadlocked waiting for a result that will never come.
+    * `reorder_wait_s` is reset-per-read via `take_reorder_wait()`:
+      the time the drain spent blocked on the head-of-line item (the
+      wait the reorder stage introduces; ~0 when workers keep up).
+    """
+
+    def __init__(self, workers: int, sink, max_pending: int | None = None):
+        import concurrent.futures as _cf
+        self.workers = max(1, int(workers))
+        self._pool = _cf.ThreadPoolExecutor(self.workers)
+        self._pending: collections.deque = collections.deque()
+        self._sink = sink
+        self._max = max_pending if max_pending else 2 * self.workers
+        self._reorder_wait = 0.0
+
+    def submit(self, fn, *args) -> None:
+        while len(self._pending) >= self._max:
+            self._drain_one()
+        self._pending.append(self._pool.submit(fn, *args))
+
+    def _drain_one(self) -> None:
+        fut = self._pending.popleft()
+        t0 = time.perf_counter()
+        result = fut.result()  # re-raises a worker exception IN ORDER
+        self._reorder_wait += time.perf_counter() - t0
+        self._sink(result)
+
+    def flush(self) -> None:
+        """Drain every pending item in submission order."""
+        while self._pending:
+            self._drain_one()
+
+    def take_reorder_wait(self) -> float:
+        """Seconds the drain spent blocked since the last call."""
+        w, self._reorder_wait = self._reorder_wait, 0.0
+        return w
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    def shutdown(self) -> None:
+        """Abandon pending work (error path); flush() first for a
+        clean drain."""
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 class AsyncWriter:

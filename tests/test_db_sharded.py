@@ -1,0 +1,304 @@
+"""ISSUE 9: the no-gather sharded v5 database export — per-shard
+`PREFIX.shard-K-of-S.qdb` files under a sealed manifest, byte parity
+with the single-file layout via db_payload_bytes, loaders and
+quorum-fsck consuming the manifest, and corruption refusing loudly at
+every surface."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from quorum_tpu.io import db_format
+from quorum_tpu.io.integrity import IntegrityError
+from quorum_tpu.ops import ctable
+
+K = 13
+RLEN = 48
+BATCH = 32
+N_READS = 64
+
+
+@pytest.fixture(scope="module")
+def reads_fastq(tmp_path_factory):
+    rng = np.random.default_rng(21)
+    genome = rng.integers(0, 4, size=1200, dtype=np.int8)
+    starts = rng.integers(0, 1200 - RLEN, size=N_READS)
+    codes = genome[starts[:, None] + np.arange(RLEN)[None, :]]
+    codes = codes.astype(np.int8)
+    err = rng.random(codes.shape) < 0.03
+    codes = np.where(err, (codes + rng.integers(1, 4, size=codes.shape))
+                     % 4, codes).astype(np.int8)
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[err] = 34
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    path = tmp_path_factory.mktemp("shdb") / "reads.fastq"
+    with open(path, "wb") as f:
+        for i in range(N_READS):
+            f.write(b"@r%d\n" % i + bases[codes[i]].tobytes()
+                    + b"\n+\n" + quals[i].tobytes() + b"\n")
+    return str(path)
+
+
+def _build(reads, out, devices, extra=()):
+    from quorum_tpu.cli import create_database as cdb_cli
+    rc = cdb_cli.main(["-s", "32k", "-m", str(K), "-b", "7", "-q", "53",
+                       "-o", out, "--batch-size", str(BATCH),
+                       "--devices", str(devices), *extra, reads])
+    assert rc == 0
+    return out
+
+
+@pytest.fixture(scope="module")
+def built_dbs(reads_fastq, tmp_path_factory):
+    """One single-file build and one 2-device sharded-layout build,
+    shared across the read-side tests."""
+    d = tmp_path_factory.mktemp("shdb_out")
+    single = _build(reads_fastq, str(d / "single.jf"), 1)
+    sharded = _build(reads_fastq, str(d / "sharded.jf"), 2,
+                     extra=("--db-layout", "sharded"))
+    return single, sharded
+
+
+def test_sharded_layout_payload_parity(built_dbs):
+    """THE acceptance property: db_payload_bytes over the manifest
+    reassembles exactly the single-file payload — the two layouts are
+    interchangeable representations of the same bytes."""
+    single, sharded = built_dbs
+    assert (db_format.db_payload_bytes(single)
+            == db_format.db_payload_bytes(sharded))
+    # and the shard files exist under the documented names
+    for s in range(2):
+        assert os.path.exists(db_format.shard_file_name(sharded, s, 2))
+
+
+def test_sharded_export_never_gathers(reads_fastq, tmp_path,
+                                      monkeypatch):
+    """--db-layout=sharded must not call gather_table (the gather is
+    the ~13 min cliff the format exists to remove)."""
+    from quorum_tpu.parallel import tile_sharded as ts
+
+    def boom(*a, **kw):
+        raise AssertionError("gather_table called on the sharded "
+                             "export path")
+
+    monkeypatch.setattr(ts, "gather_table", boom)
+    out = _build(reads_fastq, str(tmp_path / "nogather.jf"), 2,
+                 extra=("--db-layout", "sharded"))
+    assert os.path.exists(out)
+
+
+def test_manifest_load_matches_single(built_dbs):
+    """read_db over the manifest reconstructs the identical table."""
+    single, sharded = built_dbs
+    s1, m1, h1 = db_format.read_db(single, to_device=False)
+    s2, m2, h2 = db_format.read_db(sharded, to_device=False)
+    assert (m1.k, m1.bits, m1.rb_log2) == (m2.k, m2.bits, m2.rb_log2)
+    np.testing.assert_array_equal(np.asarray(s1.rows),
+                                  np.asarray(s2.rows))
+    assert h2["format"] == db_format.MANIFEST_FORMAT
+
+
+def test_sharded_correct_byte_parity(built_dbs, reads_fastq, tmp_path):
+    """Stage 2 fed the manifest produces byte-identical output to the
+    single-file database."""
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    single, sharded = built_dbs
+    outs = {}
+    for tag, db in (("s", single), ("m", sharded)):
+        prefix = str(tmp_path / f"out_{tag}")
+        rc = ec_cli.main(["-o", prefix, "-p", "2",
+                          "--batch-size", str(BATCH), db, reads_fastq])
+        assert rc == 0
+        outs[tag] = (open(prefix + ".fa", "rb").read(),
+                     open(prefix + ".log", "rb").read())
+    assert outs["s"] == outs["m"]
+    assert outs["s"][0]  # non-trivial
+
+
+def test_single_shard_roundtrip(tmp_path):
+    """write_db_sharded over a plain single-chip table (S=1) round-
+    trips through the manifest with payload parity vs write_db — the
+    format works without a mesh."""
+    rng = np.random.default_rng(3)
+    n = 500
+    khi = rng.integers(0, 1 << 6, size=n).astype(np.uint32)
+    klo = rng.integers(0, 1 << 32, size=n, dtype=np.uint64).astype(
+        np.uint32)
+    vals = ((rng.integers(1, 100, size=n) << 1) | 1).astype(np.uint32)
+    state, meta = ctable.tile_from_entries(khi, klo, vals, K, 7)
+    single = str(tmp_path / "single.qdb")
+    sharded = str(tmp_path / "sharded.qdb")
+    occ, _d, _t = ctable.tile_stats(state, meta)
+    db_format.write_db(single, state, meta, n_entries=int(occ))
+    db_format.write_db_sharded(sharded, state, meta)
+    assert (db_format.db_payload_bytes(single)
+            == db_format.db_payload_bytes(sharded))
+    s2, m2, _h = db_format.read_db(sharded, to_device=False)
+    a = sorted(zip(*(x.tolist()
+                     for x in ctable.tile_iterate(state, meta))))
+    b = sorted(zip(*(x.tolist() for x in ctable.tile_iterate(s2, m2))))
+    assert a == b
+
+
+def test_corrupt_shard_refuses(built_dbs, tmp_path):
+    """A flipped byte inside one shard refuses at read_db
+    (IntegrityError -> rc 3 at the CLIs) and is pinpointed by
+    verify_db_file with a shard-qualified section."""
+    import shutil
+    _single, sharded = built_dbs
+    d = tmp_path / "corrupt"
+    d.mkdir()
+    man = str(d / os.path.basename(sharded))
+    shutil.copy(sharded, man)
+    for s in range(2):
+        shutil.copy(db_format.shard_file_name(sharded, s, 2),
+                    db_format.shard_file_name(man, s, 2))
+    victim = db_format.shard_file_name(man, 1, 2)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(IntegrityError):
+        db_format.read_db(man, to_device=False)
+    header, problems = db_format.verify_db_file(man)
+    assert problems
+    assert any(sec.startswith("shard-1") for sec, _o, _m in problems)
+    # and quorum-fsck exits damaged, naming the shard
+    from quorum_tpu.cli.fsck import main as fsck_main
+    assert fsck_main([man]) == 1
+    # verify=off loads structurally (digest checks skipped)
+    st, meta, _h = db_format.read_db(man, to_device=False,
+                                     verify="off")
+    assert meta.k == K
+
+
+def test_manifest_tamper_refuses(built_dbs, tmp_path):
+    """Editing the manifest (cursor a shard to a different file, bump
+    a count) breaks its seal — refused even though the JSON still
+    parses."""
+    import shutil
+    _single, sharded = built_dbs
+    d = tmp_path / "tamper"
+    d.mkdir()
+    man = str(d / "m.jf")
+    shutil.copy(sharded, man)
+    for s in range(2):
+        shutil.copy(db_format.shard_file_name(sharded, s, 2),
+                    db_format.shard_file_name(man, s, 2))
+    doc = json.loads(open(man).read())
+    doc["n_entries"] = int(doc["n_entries"]) + 1
+    open(man, "w").write(json.dumps(doc) + "\n")
+    with pytest.raises(IntegrityError, match="self-digest"):
+        db_format.read_db(man, to_device=False)
+
+
+def test_missing_shard_refuses(built_dbs, tmp_path):
+    import shutil
+    _single, sharded = built_dbs
+    d = tmp_path / "missing"
+    d.mkdir()
+    man = str(d / "m.jf")
+    shutil.copy(sharded, man)
+    shutil.copy(db_format.shard_file_name(sharded, 0, 2),
+                db_format.shard_file_name(man, 0, 2))
+    with pytest.raises(IntegrityError, match="missing shard"):
+        db_format.read_db(man, to_device=False)
+    _header, problems = db_format.verify_db_file(man)
+    assert any("missing" in m for _s, _o, m in problems)
+
+
+def test_shard_file_direct_load_refused(built_dbs):
+    """Loading a bare shard file points the operator at the
+    manifest."""
+    _single, sharded = built_dbs
+    shard0 = db_format.shard_file_name(sharded, 0, 2)
+    with pytest.raises(ValueError, match="manifest"):
+        db_format.read_db(shard0, to_device=False)
+
+
+def test_v4_sharded_layout_digests(reads_fastq, tmp_path):
+    """db_version=4 shard files carry no per-section checksums, but
+    the sealed manifest's whole-file digests still catch corruption at
+    load."""
+    man = _build(reads_fastq, str(tmp_path / "v4.jf"), 2,
+                 extra=("--db-layout", "sharded", "--db-version", "4"))
+    st, meta, header = db_format.read_db(man, to_device=False)
+    assert header["version"] == 4
+    victim = db_format.shard_file_name(man, 0, 2)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size - 8)
+        byte = f.read(1)
+        f.seek(size - 8)
+        f.write(bytes([byte[0] ^ 0x55]))
+    with pytest.raises(IntegrityError):
+        db_format.read_db(man, to_device=False)
+
+
+def test_fsck_clean_v4_shard_file(reads_fastq, tmp_path):
+    """quorum-fsck on a standalone UNDAMAGED v4 shard file reports
+    clean (the structural decode runs over the shard's local row
+    range; read_db's load-through-the-manifest refusal must not be
+    mistaken for damage)."""
+    from quorum_tpu.cli import fsck as fsck_cli
+    man = _build(reads_fastq, str(tmp_path / "v4f.jf"), 2,
+                 extra=("--db-layout", "sharded", "--db-version", "4"))
+    shard = db_format.shard_file_name(man, 0, 2)
+    header, problems = db_format.verify_db_file(shard, "full")
+    assert header["layout"] == "shard"
+    assert problems == []
+    assert fsck_cli.main([shard]) == 0
+
+
+def test_rb25_manifest_single_chip_refusal_names_devices(tmp_path):
+    """A manifest past the single-chip geometry cap refuses a
+    to_device load pointing at --devices N, but the HOST-side
+    reassembly (what a routed multi-device run consumes) gets past
+    the gate — proven by it failing later, on the missing shard
+    files, not on the cap."""
+    from quorum_tpu.io import integrity
+    from quorum_tpu.parallel.tile_sharded import TileShardedMeta
+    meta = TileShardedMeta(k=31, bits=7, rb_log2=25, n_shards=2)
+    hb = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+    man = str(tmp_path / "big.jf")
+    doc = integrity.seal({
+        "format": db_format.MANIFEST_FORMAT, "version": 5,
+        "layout": "sharded", "key_len": 62, "bits": 7, "rb_log2": 25,
+        "rows": 1 << 25, "n_shards": 2, "n_entries": 8,
+        "hi_bytes": hb,
+        "shards": [{"path": f"missing-{s}.qdb", "shard": s,
+                    "n_entries": 4, "value_bytes": 0,
+                    "file_crc32c": 0} for s in range(2)]})
+    with open(man, "wb") as f:
+        f.write(json.dumps(doc).encode() + b"\n")
+    with pytest.raises(ValueError, match="--devices N"):
+        db_format.read_db(man, to_device=True)
+    with pytest.raises(IntegrityError, match="missing shard"):
+        db_format.read_db(man, to_device=False)
+
+
+def test_driver_resume_reuses_sharded_db(reads_fastq, tmp_path):
+    """The quorum driver's --resume reuse bar accepts (and verifies)
+    a finished sharded-layout database, so a resumed run skips the
+    rebuild whichever layout stage 1 wrote."""
+    from quorum_tpu.cli import quorum as quorum_cli
+    prefix = str(tmp_path / "drv")
+    argv = ["-s", "32k", "-k", str(K), "-q", "33", "-p", prefix,
+            "--batch-size", str(BATCH), "--devices", "2",
+            "--db-layout", "sharded", reads_fastq]
+    assert quorum_cli.main(argv) == 0
+    db_file = prefix + "_mer_database.jf"
+    header = db_format.read_header(db_file)
+    assert header["format"] == db_format.MANIFEST_FORMAT
+    fa1 = open(prefix + ".fa", "rb").read()
+    # second run with --resume: stage 1 must be skipped, output equal
+    mpath = str(tmp_path / "m.json")
+    assert quorum_cli.main(argv + ["--resume", "--metrics",
+                                   mpath]) == 0
+    doc = json.load(open(mpath))
+    assert doc["meta"].get("stage1_resumed_db") == db_file
+    assert open(prefix + ".fa", "rb").read() == fa1

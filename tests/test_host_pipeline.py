@@ -1,0 +1,338 @@
+"""ISSUE 9: the sharded host pipeline — N finish/render workers behind
+the sequence-numbered reorder stage (utils/pipeline.ReorderingPool),
+byte parity for any worker count (including under kill -> resume
+journal replay), worker-error propagation without deadlocking the
+writer, and the span-parallel single-file FASTQ parse."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from quorum_tpu.io import fastq
+from quorum_tpu.utils.pipeline import ReorderingPool
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+READS = os.path.join(GOLDEN, "reads.fastq")
+BATCH = 64  # 242 golden reads -> 4 batches
+
+
+# ---------------------------------------------------------------------------
+# ReorderingPool: the reorder stage in isolation
+# ---------------------------------------------------------------------------
+
+
+def test_reorder_out_of_order_completion():
+    """Workers finishing out of order must still drain in submission
+    order — the property the `.fa`/`.log` byte-parity guarantee rests
+    on."""
+    release = [threading.Event() for _ in range(6)]
+    done: list = []
+
+    def work(i):
+        release[i].wait(timeout=10)
+        return i
+
+    pool = ReorderingPool(3, done.append, max_pending=6)
+    for i in range(6):
+        pool.submit(work, i)
+    # finish them backwards: 5 first, 0 last
+    for i in reversed(range(6)):
+        release[i].set()
+        time.sleep(0.005)
+    pool.flush()
+    pool.shutdown()
+    assert done == [0, 1, 2, 3, 4, 5]
+    assert pool.take_reorder_wait() >= 0.0
+
+
+def test_reorder_worker_error_propagates():
+    """A worker raising mid-batch re-raises at the drain point, in
+    order — never a silent skip, never a deadlock."""
+    done: list = []
+
+    def work(i):
+        if i == 2:
+            raise ValueError("injected render failure")
+        return i
+
+    pool = ReorderingPool(2, done.append, max_pending=4)
+    try:
+        with pytest.raises(ValueError, match="injected render"):
+            for i in range(8):
+                pool.submit(work, i)
+            pool.flush()
+    finally:
+        pool.shutdown()
+    # items before the failing one drained in order; nothing after it
+    assert done == [0, 1]
+
+
+def test_reorder_backpressure_bounds_pending():
+    """submit() drains the head once max_pending items are in flight —
+    bounded RAM (each pending item holds a fetched D2H buffer)."""
+    gate = threading.Event()
+    done: list = []
+
+    def work(i):
+        gate.wait(timeout=10)
+        return i
+
+    pool = ReorderingPool(2, done.append, max_pending=3)
+    for i in range(3):
+        pool.submit(work, i)
+    assert pool.depth == 3
+    gate.set()
+    pool.submit(work, 3)  # must first drain the head
+    assert pool.depth <= 3
+    pool.flush()
+    pool.shutdown()
+    assert done == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Render workers through the real stage-2 pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def golden_db(tmp_path_factory):
+    from quorum_tpu.cli import create_database as cdb_cli
+    db = str(tmp_path_factory.mktemp("hostpipe") / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, READS])
+    assert rc == 0
+    return db
+
+
+def _correct(db, prefix, extra=()):
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    rc = ec_cli.main(["-o", prefix, "-p", "4",
+                      "--batch-size", str(BATCH), *extra, db, READS])
+    assert rc == 0
+    return prefix
+
+
+def test_render_workers_byte_parity(golden_db, tmp_path):
+    """`.fa`/`.log` bytes identical across --render-workers {1, 3}
+    (the acceptance property), and the host-tail attribution
+    histograms land in the metrics document."""
+    p1 = _correct(golden_db, str(tmp_path / "w1"),
+                  ("--render-workers", "1"))
+    mpath = str(tmp_path / "metrics.json")
+    p3 = _correct(golden_db, str(tmp_path / "w3"),
+                  ("--render-workers", "3", "--metrics", mpath))
+    for suffix in (".fa", ".log"):
+        a = open(p1 + suffix, "rb").read()
+        b = open(p3 + suffix, "rb").read()
+        assert a == b, f"--render-workers 3 {suffix} differs from 1"
+    assert open(p1 + ".fa", "rb").read()  # non-trivial output
+    doc = json.load(open(mpath))
+    assert doc["meta"]["render_workers"] == 3
+    assert "render_ms" in doc["histograms"]
+    assert "reorder_wait_ms" in doc["histograms"]
+    assert doc["histograms"]["render_ms"]["count"] >= 1
+
+
+def test_render_worker_failure_fails_run(golden_db, tmp_path,
+                                         monkeypatch):
+    """A render worker raising mid-run propagates out of the pipeline
+    (the writer closes via the normal error path; the run must not
+    hang waiting on a result that will never come)."""
+    from quorum_tpu.models import error_correct as ec_mod
+
+    real = ec_mod.finish_batch_host
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected finish failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ec_mod, "finish_batch_host", flaky)
+    opts = ec_mod.ECOptions(output=str(tmp_path / "boom"), cutoff=4,
+                            batch_size=BATCH, render_workers=3)
+    with pytest.raises(RuntimeError, match="injected finish"):
+        ec_mod.run_error_correct(golden_db, [READS], None, opts)
+    assert calls["n"] >= 2
+
+
+def test_render_workers_kill_resume_parity(golden_db, tmp_path):
+    """Journal replay under N render workers: a run failed at batch 2
+    and resumed with --render-workers 3 is byte-identical to an
+    uninterrupted single-worker run — the reorder stage preserves the
+    journal's batch commit order."""
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    ref = _correct(golden_db, str(tmp_path / "ref"),
+                   ("--render-workers", "1"))
+    prefix = str(tmp_path / "resumed")
+    plan = json.dumps([{"site": "stage2.correct", "batch": 2,
+                        "action": "error", "message": "injected"}])
+    rc = ec_cli.main(["-o", prefix, "-p", "4",
+                      "--batch-size", str(BATCH),
+                      "--checkpoint-every", "1",
+                      "--render-workers", "3",
+                      "--fault-plan", plan, golden_db, READS])
+    assert rc != 0
+    assert os.path.exists(prefix + ".resume.json")
+    _correct(golden_db, prefix,
+             ("--checkpoint-every", "1", "--resume",
+              "--render-workers", "3", "--fault-plan", ""))
+    for suffix in (".fa", ".log"):
+        assert (open(prefix + suffix, "rb").read()
+                == open(ref + suffix, "rb").read()), suffix
+    assert not os.path.exists(prefix + ".resume.json")
+
+
+def test_resolve_render_workers():
+    from quorum_tpu.models.error_correct import resolve_render_workers
+    assert resolve_render_workers(3) == 3
+    assert resolve_render_workers(1) == 1
+    auto = resolve_render_workers(0)
+    assert 1 <= auto <= 4
+
+
+# ---------------------------------------------------------------------------
+# Span-parallel single-file FASTQ parse
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def span_fastq(tmp_path, monkeypatch):
+    """A FASTQ big enough to split, with '@'-leading quality bytes (the
+    classic mis-sync trap) and varied read lengths; the size threshold
+    is lowered so the span path engages on a test-sized file."""
+    monkeypatch.setattr(fastq, "PARALLEL_SPAN_MIN_BYTES", 1024)
+    rng = np.random.default_rng(11)
+    bases = b"ACGT"
+    path = tmp_path / "big.fastq"
+    with open(path, "wb") as f:
+        for i in range(400):
+            m = int(rng.integers(30, 120))
+            seq = bytes(bases[c] for c in rng.integers(0, 4, m))
+            qual = bytes(int(q) for q in rng.integers(33, 75, m))
+            f.write(b"@r%d desc\n" % i + seq + b"\n+\n" + qual + b"\n")
+    return str(path)
+
+
+def _batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.headers == y.headers
+        assert x.n == y.n
+        np.testing.assert_array_equal(x.codes, y.codes)
+        np.testing.assert_array_equal(x.quals, y.quals)
+        np.testing.assert_array_equal(x.lengths, y.lengths)
+
+
+def test_span_parallel_parity(span_fastq):
+    """threads=4 on ONE file must produce the exact batch stream the
+    serial parse does — headers, codes, quals, lengths, batching."""
+    spans = fastq._single_file_spans(span_fastq, 4)
+    assert spans and len(spans) > 1
+    serial = list(fastq.read_batches([span_fastq], 48, threads=1))
+    par = list(fastq.read_batches([span_fastq], 48, threads=4))
+    _batches_equal(serial, par)
+
+
+def test_span_non_abort_policy_forces_serial(span_fastq, monkeypatch):
+    """skip/quarantine policies opt OUT of span parallelism: on a
+    damaged file, WHICH records a resync swallows depends on parser
+    state carried across the damage — a span cut truncates that, so
+    the survivor stream could diverge from the serial parse. Triage
+    modes stay serial; the counts and batches therefore match the
+    serial parse exactly (they ARE the serial parse)."""
+    data = open(span_fastq, "rb").read()
+    lines = data.split(b"\n")
+    # truncate one quality line mid-file: a classic torn record
+    for i in range(len(lines) // 2, len(lines)):
+        if lines[i] == b"+":
+            lines[i + 1] = lines[i + 1][:-3]
+            break
+    bad_path = span_fastq + ".bad"
+    open(bad_path, "wb").write(b"\n".join(lines))
+
+    def boom(*a, **kw):
+        raise AssertionError("span path used under a non-abort policy")
+
+    monkeypatch.setattr(fastq, "_iter_records_spans", boom)
+    pol_s = fastq.BadReadPolicy("skip")
+    serial = list(fastq.read_batches([bad_path], 48, threads=1,
+                                     policy=pol_s))
+    pol_p = fastq.BadReadPolicy("skip")
+    par = list(fastq.read_batches([bad_path], 48, threads=4,
+                                  policy=pol_p))
+    assert pol_s.bad == pol_p.bad >= 1
+    _batches_equal(serial, par)
+
+
+def test_span_probe_rejects_unsplittable(tmp_path, monkeypatch):
+    """FASTA, gzip, and tiny files fall back to the serial parse
+    (spans = None), never a mis-split."""
+    monkeypatch.setattr(fastq, "PARALLEL_SPAN_MIN_BYTES", 16)
+    fa = tmp_path / "a.fasta"
+    fa.write_bytes(b">r1\nACGTACGTACGTACGT\n>r2\nTTTTACGTACGTAAAA\n" * 50)
+    assert fastq._single_file_spans(str(fa), 4) is None
+    import gzip as gz
+    fq = tmp_path / "a.fastq.gz"
+    with gz.open(fq, "wb") as f:
+        f.write(b"@r1\nACGT\n+\nIIII\n" * 200)
+    assert fastq._single_file_spans(str(fq), 4) is None
+    # WRAPPED (multi-line) FASTQ: _iter_one parses it, but there are
+    # no record-aligned byte cuts — must stay serial, never mis-split
+    wrapped = tmp_path / "wrapped.fastq"
+    with open(wrapped, "wb") as f:
+        for i in range(200):
+            f.write(b"@w%d\nACGTACGT\nACGTACGT\n+\n!!!!!!!!\n!!!!!!!!\n"
+                    % i)
+    assert fastq._single_file_spans(str(wrapped), 4) is None
+    got_w = list(fastq.read_batches([str(wrapped)], 16, threads=4))
+    assert sum(b.n for b in got_w) == 200
+    assert got_w[0].lengths[0] == 16  # both chunks, one record
+    tiny = tmp_path / "tiny.fastq"
+    monkeypatch.setattr(fastq, "PARALLEL_SPAN_MIN_BYTES", 1 << 20)
+    tiny.write_bytes(b"@r1\nACGT\n+\nIIII\n" * 10)
+    assert fastq._single_file_spans(str(tiny), 4) is None
+    # unsplittable input still parses fine through read_batches
+    got = list(fastq.read_batches([str(fa)], 16, threads=4))
+    assert sum(b.n for b in got) == 100
+
+
+def test_span_fault_plan_forces_serial(span_fastq, monkeypatch):
+    """An active fault plan opts OUT of span parallelism (the
+    `fastq.read` `at=`/`count=` hit indices must be reproducible, not
+    scheduler-dependent) — the fault still fires, on the serial
+    parser."""
+    from quorum_tpu.utils import faults
+
+    def boom(*a, **kw):
+        raise AssertionError("span path used under an active plan")
+
+    monkeypatch.setattr(fastq, "_iter_records_spans", boom)
+    plan = [{"site": "fastq.read", "action": "error",
+             "message": "injected parse fault", "count": 1}]
+    faults.install(faults.FaultPlan.parse(plan))
+    try:
+        with pytest.raises(RuntimeError, match="injected parse fault"):
+            list(fastq.read_batches([span_fastq], 48, threads=4))
+    finally:
+        faults.reset()
+
+
+def test_span_quarantine_forces_serial(span_fastq, monkeypatch):
+    """A quarantine policy opts out of span parallelism too: the
+    .quarantine.fastq must hold bad records in FILE ORDER, which only
+    the serial parse guarantees."""
+    def boom(*a, **kw):
+        raise AssertionError("span path used under quarantine policy")
+
+    monkeypatch.setattr(fastq, "_iter_records_spans", boom)
+    qpath = span_fastq + ".quarantine"
+    pol = fastq.BadReadPolicy("quarantine", qpath)
+    got = list(fastq.read_batches([span_fastq], 48, threads=4,
+                                  policy=pol))
+    assert sum(b.n for b in got) == 400

@@ -124,6 +124,40 @@ def test_sharded_build_kill_resume(reads_fastq, tmp_path):
     assert ck.cursor() is None  # cleared with the durable database
 
 
+def test_sharded_resume_batch_index_is_global(reads_fastq, tmp_path):
+    """A resumed sharded build numbers batches from the checkpoint
+    cursor, not from zero: a fault plan pinned to `batch=1` must fire
+    on the batch WITH global index 1 — the one the resume is about to
+    process — exactly as on the single-device loop."""
+    ckdir = str(tmp_path / "ck")
+    # count=-1: the same in-process plan spec keeps its spent hit
+    # counters across the two main() calls, so a count=1 fault would
+    # stay spent on the resume no matter what batch index it sees
+    plan = json.dumps([{"site": "stage1.insert", "batch": 1,
+                        "count": -1, "action": "error",
+                        "message": "injected"}])
+    from quorum_tpu.cli import create_database as cdb_cli
+    args = ["-s", "32k", "-m", str(K), "-b", "7", "-q", "53",
+            "-o", str(tmp_path / "g.jf"), "--batch-size", str(BATCH),
+            "--devices", "2", "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1"]
+    from quorum_tpu.utils import faults
+    try:
+        assert cdb_cli.main(args + ["--fault-plan", plan,
+                                    reads_fastq]) != 0
+        ck = ckpt_mod.Stage1ShardedCheckpoint(ckdir)
+        assert ck.cursor() == 1  # batch 0 committed, batch 1 faulted
+        # resume with the SAME plan: the next processed batch IS
+        # global batch 1, so it must fault again immediately (a
+        # zero-based restart would never reach batch=1 — only one
+        # batch remains — and would wrongly finish the build)
+        assert cdb_cli.main(args + ["--resume", "--fault-plan", plan,
+                                    reads_fastq]) != 0
+        assert ck.cursor() == 1  # nothing new committed
+    finally:
+        faults.reset()  # the count=-1 plan must not outlive the test
+
+
 def test_sharded_checkpoint_consistency(tmp_path):
     """Per-shard snapshots under one manifest: load round-trips the
     planes; a truncated shard, a missing shard, or a config mismatch

@@ -17,6 +17,10 @@ journal --repair path.
    (site db.write) — `quorum-fsck` must exit non-zero naming the
    damaged section, and `quorum_error_correct_reads` must refuse the
    load with rc 3 while counting `integrity_errors_total`.
+5. Sharded manifest (ISSUE 9): build with `--db-layout=sharded`,
+   fsck clean; corrupt one SHARD file — fsck must pinpoint
+   shard+section (`shard-K/...`), and the loader must refuse the
+   manifest with rc 3 + `integrity_errors_total` >= 1.
 
 Exit 0 = all checks passed.
 """
@@ -171,9 +175,69 @@ def main(argv=None) -> int:
         print(f"[fsck_smoke] FAIL: integrity_errors_total={errs}, "
               "want >= 1", file=sys.stderr)
         return 1
+    # -- 5. sharded manifest: fsck pinpoints shard+section --------------
+    import contextlib
+    import io as _io
+
+    from quorum_tpu.io import db_format
+
+    sharded = os.path.join(out_dir, "db_sharded.jf")
+    print("[fsck_smoke] building sharded-layout database")
+    if cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                     "--db-layout", "sharded", "-o", sharded,
+                     reads]) != 0:
+        print("[fsck_smoke] FAIL: sharded build", file=sys.stderr)
+        return 1
+    if db_format.db_payload_bytes(sharded) != db_format.db_payload_bytes(db):
+        print("[fsck_smoke] FAIL: sharded payload differs from the "
+              "single-file layout", file=sys.stderr)
+        return 1
+    if fsck([sharded]) != 0:
+        print("[fsck_smoke] FAIL: clean sharded manifest flagged",
+              file=sys.stderr)
+        return 1
+    n_shards = int(db_format.read_header(sharded)["n_shards"])
+    victim = db_format.shard_file_name(sharded, n_shards - 1, n_shards)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    err_buf = _io.StringIO()
+    with contextlib.redirect_stderr(err_buf):
+        rc = fsck([sharded])
+    if rc == 0:
+        print("[fsck_smoke] FAIL: corrupted shard passed fsck",
+              file=sys.stderr)
+        return 1
+    if f"shard-{n_shards - 1}" not in err_buf.getvalue():
+        print("[fsck_smoke] FAIL: fsck did not pinpoint the damaged "
+              f"shard:\n{err_buf.getvalue()}", file=sys.stderr)
+        return 1
+    print("[fsck_smoke] corrupted shard pinpointed by fsck "
+          f"(shard-{n_shards - 1})")
+    sh_metrics = os.path.join(out_dir, "fsck_sharded_metrics.json")
+    rc = ec_cli.main(["-p", "4", "--batch-size", str(BATCH_SIZE),
+                      "-o", os.path.join(out_dir, "bad_sharded_out"),
+                      "--metrics", sh_metrics, "--fault-plan", "",
+                      sharded, reads])
+    if rc != 3:
+        print(f"[fsck_smoke] FAIL: corrupted-shard load rc {rc}, "
+              "want 3", file=sys.stderr)
+        return 1
+    sh_doc = json.load(open(sh_metrics))
+    sh_errs = sh_doc["counters"].get("integrity_errors_total", 0)
+    if sh_errs < 1:
+        print(f"[fsck_smoke] FAIL: sharded integrity_errors_total="
+              f"{sh_errs}, want >= 1", file=sys.stderr)
+        return 1
+
     print(f"[fsck_smoke] OK: clean artifacts pass, corruption "
           f"refused (rc 3, integrity_errors_total={errs}), torn "
-          f"tail repaired; metrics -> {metrics_path}")
+          f"tail repaired, sharded manifest corruption pinpointed + "
+          f"refused (integrity_errors_total={sh_errs}); metrics -> "
+          f"{metrics_path}")
     return 0
 
 

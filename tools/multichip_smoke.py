@@ -149,6 +149,34 @@ def main(argv=None) -> int:
         print("[multichip_smoke] FAIL: stage-1 document does not "
               "report n_shards=2", file=sys.stderr)
         return 1
+
+    # -- sharded on-disk layout (ISSUE 9): no gather, same payload ----
+    sharded_db = os.path.join(out_dir, "sharded_layout_db.jf")
+    rc = cdb_cli.main(cdb_args + ["-o", sharded_db,
+                                  "--db-layout", "sharded", reads])
+    if rc != 0:
+        print("[multichip_smoke] FAIL: sharded-layout build rc", rc,
+              file=sys.stderr)
+        return 1
+    from quorum_tpu.io.db_format import (MANIFEST_FORMAT, read_header,
+                                         shard_file_name)
+    if read_header(sharded_db).get("format") != MANIFEST_FORMAT:
+        print("[multichip_smoke] FAIL: sharded layout did not write "
+              "a manifest", file=sys.stderr)
+        return 1
+    for s in range(2):
+        if not os.path.exists(shard_file_name(sharded_db, s, 2)):
+            print(f"[multichip_smoke] FAIL: shard file {s} missing",
+                  file=sys.stderr)
+            return 1
+    if db_payload_bytes(sharded_db) != ref:
+        print("[multichip_smoke] FAIL: --db-layout=sharded payload "
+              "differs from the single-file layout (must reassemble "
+              "byte-identical)", file=sys.stderr)
+        return 1
+    print("[multichip_smoke] sharded layout OK: manifest + 2 shards, "
+          "payload byte-identical to single-file")
+
     print("[multichip_smoke] OK: 2-device parity, sharded kill/resume "
           f"byte-identical; metrics -> {out_dir}")
     return 0
