@@ -41,6 +41,17 @@
 # its metrics document is gated through metrics_check (which requires
 # the integrity counters when meta declares db_version >= 5).
 #
+# ISSUE 10 adds the device-truth telemetry gate:
+# tools/telemetry_smoke.py — a profiled golden run whose metrics
+# document must carry real `device_kernel_us` from the profiler trace
+# (CPU traces included) with `trace_summary --device` rendering the
+# host-dispatch/device-execute/device-idle attribution table, plus a
+# push-transport smoke (CLI --metrics-push-url -> tools/
+# push_receiver.py -> aggregated fleet document, with a receiver-down
+# retry + terminal-flush case); the stage document and the fleet
+# document are gated through metrics_check (which requires the
+# devtrace/push names when meta declares profile/metrics_push_url).
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -48,6 +59,7 @@
 #        SKIP_BENCH_AB=1      skips the bench A/B gate.
 #        SKIP_CHAOS_SOAK=1    skips the serve-resilience chaos gate.
 #        SKIP_FSCK_SMOKE=1    skips the data-integrity fsck gate.
+#        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push gate.
 set -o pipefail
 set -u
 
@@ -223,6 +235,31 @@ else
     fi
 fi
 
+telemetry_rc=0
+if [ "${SKIP_TELEMETRY_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: telemetry smoke skipped (SKIP_TELEMETRY_SMOKE=1)"
+else
+    # the device-truth + push-transport gate (ISSUE 10): profiled
+    # golden run -> trace_summary --device attribution table, push
+    # CLI -> receiver -> fleet document, receiver-outage retry/flush
+    echo "== telemetry smoke (devtrace + push) =="
+    TEL_DIR=$(mktemp -d /tmp/telemetry_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "$TEL_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/telemetry_smoke.py \
+        --out-dir "$TEL_DIR" || telemetry_rc=$?
+    if [ "$telemetry_rc" -eq 0 ]; then
+        echo "== metrics_check gates (telemetry) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$TEL_DIR/telemetry_metrics.json" \
+            "$TEL_DIR/telemetry_fleet.json" || telemetry_rc=1
+    fi
+    if [ "$telemetry_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: telemetry gate FAILED (rc=$telemetry_rc)" >&2
+    fi
+fi
+
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi
@@ -230,4 +267,5 @@ if [ "$multichip_rc" -ne 0 ]; then exit "$multichip_rc"; fi
 if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
 if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
 if [ "$fsck_rc" -ne 0 ]; then exit "$fsck_rc"; fi
+if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -159,7 +159,9 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
                        textfile=args.metrics_textfile,
                        live=args.metrics_live,
                        trace_spans=args.trace_spans,
-                       profile=args.profile) as obs:
+                       profile=args.profile,
+                       push_url=args.metrics_push_url,
+                       push_interval=args.metrics_push_interval) as obs:
         try:
             create_database_main(args.reads, args.output, cfg,
                                  cmdline=list(sys.argv),

@@ -177,6 +177,8 @@ def main(argv=None, db=None, prepacked=None) -> int:
         metrics_textfile=args.metrics_textfile,
         metrics_force=args.metrics_live,
         trace_spans=args.trace_spans,
+        metrics_push_url=args.metrics_push_url,
+        metrics_push_interval=args.metrics_push_interval,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         on_bad_read=args.on_bad_read,

@@ -51,6 +51,8 @@ def main(argv=None) -> int:
                        textfile=args.metrics_textfile,
                        live=args.metrics_live,
                        trace_spans=args.trace_spans,
+                       push_url=args.metrics_push_url,
+                       push_interval=args.metrics_push_interval,
                        stage="histo_mer_database") as obs:
         reg, tracer = obs.registry, obs.tracer
         try:

@@ -57,6 +57,19 @@ def add_observability_args(p: argparse.ArgumentParser,
                         "here on each heartbeat"
                         + (" (shared by the driver and both stages)"
                            if driver else ""))
+    p.add_argument("--metrics-push-url", metavar="url", default=None,
+                   help="Periodically POST the Prometheus exposition "
+                        "to this push-gateway URL (plus the final "
+                        "metrics JSON at <url>/final on exit) — the "
+                        "transport for fleets that cannot be scraped; "
+                        "see tools/push_receiver.py"
+                        + (". One stream carries the driver and both "
+                           "stages" if driver else ""))
+    p.add_argument("--metrics-push-interval", metavar="seconds",
+                   type=float, default=0.0,
+                   help="Push period for --metrics-push-url "
+                        "(0 = default 5s); failed pushes back off "
+                        "exponentially, capped at 30s")
     p.add_argument("--trace-spans", metavar="path", default=None,
                    help="Write hierarchical span JSONL here (plus a "
                         "Chrome trace_event twin, .trace.json)"
@@ -89,11 +102,29 @@ class ObservabilitySession:
         self.registry = registry
         self.tracer = tracer
         self.server = None  # exposition endpoint, once started
+        self.pusher = None  # MetricsPusher, with --metrics-push-url
         self.status: str | None = None
         self._at_exit: list = []
+        self._profile: str | None = None
 
     def at_exit(self, fn) -> None:
         self._at_exit.append(fn)
+
+    def _record_devtrace(self) -> bool:
+        """Device-truth telemetry (ISSUE 10): parse the `--profile`
+        directory the run just wrote (the jax.profiler trace exits
+        with the body, so it is complete here) and land the
+        device-kernel attribution in the registry. Returns True when
+        metrics were recorded (the caller may need to re-write an
+        already-written final document)."""
+        if not self._profile or not self.registry.enabled:
+            return False
+        try:
+            from ..telemetry import devtrace
+            return devtrace.record_profile_metrics(self.registry,
+                                                   self._profile)
+        except Exception:  # noqa: BLE001 - telemetry never kills runs
+            return False
 
     def _finalize(self, ok: bool) -> None:
         reg = self.registry
@@ -104,13 +135,19 @@ class ObservabilitySession:
                 fn(reg)
             except Exception:  # noqa: BLE001 - exit hooks never mask exits
                 pass
+        recorded = self._record_devtrace()
         if not ok:
             reg.set_meta(status="error")
             reg.write()
         elif reg.meta.get("status") is None:
             # a run that already stamped + wrote (run_error_correct's
-            # success path) is left alone — no second write
+            # success path) is left alone — no second write...
             reg.set_meta(status=self.status or "ok")
+            reg.write()
+        elif recorded:
+            # ...unless the post-run devtrace parse added metrics the
+            # body's own write predates — refresh the document so the
+            # device attribution lands in it (atomic replace)
             reg.write()
 
 
@@ -119,6 +156,8 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                   port: int | None = None, textfile: str | None = None,
                   live: bool = False, trace_spans: str | None = None,
                   profile: str | None = None,
+                  push_url: str | None = None,
+                  push_interval: float = 0.0,
                   **meta):
     """The one observability lifecycle (ISSUE 3 satellite): registry +
     tracer up front, exposition started inside the umbrella, and a
@@ -126,11 +165,19 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     final write (skipped when the body already wrote), endpoint
     close. `meta` seeds `registry.set_meta` (stage=..., etc.).
 
-    `profile` (the run's `--profile` trace directory, when both flags
-    are set): the span tracer's Chrome-trace twin is ALSO exported
-    into it as `spans.trace.json`, so one directory carries the XLA
-    device timeline and the host span timeline side by side — load
-    both in Perfetto without hunting for the `--trace-spans` path.
+    `profile` (the run's `--profile` trace directory): the span
+    tracer's Chrome-trace twin is ALSO exported into it as
+    `spans.trace.json` (one directory carries the XLA device timeline
+    and the host span timeline side by side — load both in Perfetto),
+    and on exit the trace is parsed for DEVICE-truth kernel
+    attribution (telemetry/devtrace.py): `device_kernel_us` and
+    friends land in the registry, with `meta.profile` declaring the
+    surface for tools/metrics_check.py.
+
+    `push_url` (`--metrics-push-url`): a MetricsPusher periodically
+    POSTs the live exposition there and terminal-flushes the final
+    document on exit (telemetry/push.py) — the transport for fleets
+    that cannot be scraped.
 
     Typical shape::
 
@@ -145,11 +192,17 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     from ..telemetry import export as export_mod
 
     reg = registry_for(metrics, interval,
-                       force=(port is not None or bool(textfile) or live))
+                       force=(port is not None or bool(textfile)
+                              or live or bool(push_url)))
     if meta:
         reg.set_meta(**meta)
+    if profile and reg.enabled:
+        # declares the devtrace surface: metrics_check requires the
+        # device-kernel names whenever a document carries this
+        reg.set_meta(profile=profile)
     tracer = tracer_for(trace_spans)
     obs = ObservabilitySession(reg, tracer)
+    obs._profile = profile
     # artifact loaders (db_format/checkpoint) run far below the entry
     # points, so the run's registry is installed ambiently for their
     # verification telemetry (integrity_errors_total / bytes-verified
@@ -161,6 +214,14 @@ def observability(metrics: str | None = None, interval: float = 0.0,
         try:
             obs.server = export_mod.start_exposition(
                 reg, port, textfile, period=interval)
+            if push_url:
+                from ..telemetry.push import DEFAULT_PERIOD_S
+                from ..telemetry.push import MetricsPusher
+                obs.pusher = MetricsPusher(
+                    reg, push_url,
+                    period_s=(push_interval if push_interval
+                              and push_interval > 0
+                              else DEFAULT_PERIOD_S))
             yield obs
         except BaseException:
             obs._finalize(ok=False)
@@ -178,6 +239,14 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                 tracer.write_chrome_trace(
                     os.path.join(profile, "spans.trace.json"))
             except OSError:  # pragma: no cover - unwritable profile dir
+                pass
+        if obs.pusher is not None:
+            # terminal flush AFTER the status-stamped final write, so
+            # the pushed document is the one on disk; never raises
+            try:
+                obs.pusher.close(
+                    final_doc=reg.as_dict() if reg.enabled else None)
+            except Exception:  # noqa: BLE001 - push never kills runs
                 pass
         if obs.server is not None:
             obs.server.close()

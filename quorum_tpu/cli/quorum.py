@@ -312,12 +312,18 @@ def main(argv=None) -> int:
     # The driver's own span file covers work done in the DRIVER
     # process (the shared read/pack producer) — the stages'
     # in-device loops land in the forwarded .stage1/.stage2 files.
+    # --metrics-push-url rides the DRIVER's pusher only: the stage
+    # registries live in this process, so the pushed exposition
+    # (render_live) already carries driver + stage1 + stage2 — a
+    # per-stage pusher would triple-post the same series
     with observability(args.metrics, args.metrics_interval,
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
                        trace_spans=(_stage_path(args.trace_spans, "driver")
                                     if args.trace_spans else None),
-                       profile=args.profile) as obs:
+                       profile=args.profile,
+                       push_url=args.metrics_push_url,
+                       push_interval=args.metrics_push_interval) as obs:
         reg = obs.registry
         track_jax_compile_cache(reg)
 

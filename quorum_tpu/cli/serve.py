@@ -195,6 +195,8 @@ def main(argv=None) -> int:
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
                        live=True, trace_spans=args.trace_spans,
+                       push_url=args.metrics_push_url,
+                       push_interval=args.metrics_push_interval,
                        stage="serve") as obs:
         try:
             rc = _serve(args, qual_cutoff, warmup_lengths, obs)

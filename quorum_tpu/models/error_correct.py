@@ -200,6 +200,11 @@ class ECOptions:
     metrics_force: bool = False  # --metrics-live: real registry for a
     # parent-owned exposition endpoint (quorum driver --metrics-port)
     trace_spans: str | None = None  # --trace-spans PATH: span JSONL
+    # --metrics-push-url (ISSUE 10): periodic push of the live
+    # exposition + terminal flush of the final document to a
+    # push-gateway (telemetry/push.py) for fleets without a scraper
+    metrics_push_url: str | None = None
+    metrics_push_interval: float = 0.0
     # fault tolerance (ISSUE 4): with checkpoint_every > 0 the output
     # streams to <prefix>.fa/.log.partial with a resume journal
     # committed every N batches; resume=True skips already-corrected
@@ -294,6 +299,8 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                        live=opts.metrics_force,
                        trace_spans=opts.trace_spans,
                        profile=opts.profile,
+                       push_url=opts.metrics_push_url,
+                       push_interval=opts.metrics_push_interval,
                        stage="error_correct", batch_size=opts.batch_size,
                        no_discard=bool(no_discard)) as obs:
         return _run_ec(db_path, sequences, cfg_in, opts, obs.registry,

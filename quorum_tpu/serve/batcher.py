@@ -67,7 +67,7 @@ import threading
 import time
 from concurrent.futures import Future
 
-from ..telemetry import NULL
+from ..telemetry import NULL, labeled
 from ..utils.vlog import vlog
 
 PRIORITIES = ("interactive", "bulk")
@@ -114,13 +114,30 @@ def _deliver_exception(fut: Future, err: BaseException) -> bool:
 
 
 class _Request:
-    __slots__ = ("records", "future", "t_enq", "deadline")
+    """One admitted request plus its phase ledger (ISSUE 10): the
+    dispatcher thread stamps lane wait at pop and accumulates device /
+    hedge step time per attempt; the HTTP layer reads the ledger off
+    the Future (`fut.request`) to build the response's
+    `X-Quorum-Phases` header and the request lifecycle event. Only
+    the dispatcher thread writes the phase fields after admission."""
 
-    def __init__(self, records, future, deadline):
+    __slots__ = ("records", "future", "t_enq", "deadline", "rid",
+                 "lane", "lane_wait_us", "device_us", "hedge_us",
+                 "bisected", "hedged")
+
+    def __init__(self, records, future, deadline, rid=None,
+                 lane="interactive"):
         self.records = records
         self.future = future
         self.t_enq = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter, or None
+        self.rid = rid            # X-Quorum-Request-Id (or None)
+        self.lane = lane
+        self.lane_wait_us = 0     # admission -> dispatch pop
+        self.device_us = 0        # engine step time (incl. bisect)
+        self.hedge_us = 0         # solo re-run time after a bisect
+        self.bisected = False
+        self.hedged = False
 
 
 class DynamicBatcher:
@@ -185,6 +202,13 @@ class DynamicBatcher:
         if self.step_timeout_s is not None:
             registry.counter("engine_restarts_total")
             registry.counter("engine_step_timeouts")
+        # per-lane depth/wait series (ISSUE 10): the summed
+        # `queue_depth` gauge stays for dashboard compatibility, but
+        # one number over two lanes hides interactive starvation —
+        # these exist from setup so a zero-traffic lane still shows
+        for p in PRIORITIES:
+            registry.gauge(labeled("queue_depth", lane=p))
+            registry.histogram(labeled("lane_wait_us", lane=p))
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="quorum-serve-dispatch",
                                         daemon=True)
@@ -192,19 +216,26 @@ class DynamicBatcher:
 
     # -- admission --------------------------------------------------------
     def submit(self, records, deadline_s: float | None = None,
-               priority: str = "interactive") -> Future:
+               priority: str = "interactive",
+               request_id: str | None = None) -> Future:
         """Enqueue one request (list of (header, seq, qual) records)
         into the `priority` lane. Returns a Future resolving to the
-        per-read (fa, log) list. Raises QueueFull (429) or Draining
-        (503) at admission; an expired deadline resolves the Future
-        with DeadlineExceeded."""
+        per-read (fa, log) list, with the request's phase ledger
+        attached as `fut.request` (the HTTP layer reads it for the
+        response's phase header + lifecycle event). Raises QueueFull
+        (429) or Draining (503) at admission; an expired deadline
+        resolves the Future with DeadlineExceeded. `request_id` is
+        the X-Quorum-Request-Id threaded through hedge/bisect
+        telemetry."""
         if priority not in self._lanes:
             raise ValueError(f"unknown priority {priority!r} "
                              f"(one of {PRIORITIES})")
         fut: Future = Future()
         deadline = (time.perf_counter() + deadline_s
                     if deadline_s is not None else None)
-        req = _Request(list(records), fut, deadline)
+        req = _Request(list(records), fut, deadline, rid=request_id,
+                       lane=priority)
+        fut.request = req
         reg = self.registry
         with self._lock:
             if self._draining or self._dead:
@@ -217,6 +248,8 @@ class DynamicBatcher:
             if req.records:
                 self._lanes[priority].append(req)
                 reg.gauge("queue_depth").set_max(self._qlen_locked())
+                reg.gauge(labeled("queue_depth", lane=priority)) \
+                    .set_max(len(self._lanes[priority]))
                 self._work.notify()
         if not req.records:
             # nothing to correct: resolve immediately (never
@@ -527,23 +560,36 @@ class DynamicBatcher:
         vlog("quorum-serve watchdog: warm engine rebuilt "
              "(generation ", gen, ")")
 
-    def _step_requests(self, reqs: list[_Request]) -> list[list]:
+    def _step_requests(self, reqs: list[_Request],
+                       ledger: str = "device_us") -> list[list]:
         """One coalesced engine pass over `reqs`: flatten, step in
         max_batch chunks, return each request's slice of results.
         Captures the CURRENT engine once per attempt — a bisect or
         hedge retry after a watchdog restart runs on the rebuilt
         engine, while a batch already stepping finishes on the old
-        one."""
+        one. The attempt's wall time lands on every rider's phase
+        ledger (`device_us`, or `hedge_us` for a solo hedge re-run) —
+        attempts are disjoint wall intervals, so a bisected request's
+        ledger sums its failed and retried passes. Accumulated even
+        when the step raises: the failed attempt's time is exactly
+        what the 500's lifecycle event should attribute."""
         eng = self.current_engine()
         flat: list = []
         slices: list[tuple[int, int]] = []
         for req in reqs:
             slices.append((len(flat), len(flat) + len(req.records)))
             flat.extend(req.records)
-        results: list = []
-        for off in range(0, len(flat), self.max_batch):
-            results.extend(
-                self._timed_step(eng, flat[off:off + self.max_batch]))
+        t0 = time.perf_counter()
+        try:
+            results: list = []
+            for off in range(0, len(flat), self.max_batch):
+                results.extend(
+                    self._timed_step(eng,
+                                     flat[off:off + self.max_batch]))
+        finally:
+            spent = int((time.perf_counter() - t0) * 1e6)
+            for req in reqs:
+                setattr(req, ledger, getattr(req, ledger) + spent)
         return [results[s:e] for s, e in slices]
 
     def _resolve(self, reqs: list[_Request], per_req: list[list],
@@ -560,14 +606,27 @@ class DynamicBatcher:
         now = time.perf_counter()
         live: list[_Request] = []
         for req in taken:
+            # the pop stamps the request's lane-wait phase (admission
+            # -> dispatch, or -> expiry for a 504): the per-lane
+            # histogram is the starvation signal one summed
+            # queue_wait_us hides, and the worst waits are exactly the
+            # expired ones — omitting them would bias it low when
+            # starvation actually happens
+            req.lane_wait_us = int((now - req.t_enq) * 1e6)
+            if reg.enabled:
+                reg.histogram(labeled("lane_wait_us",
+                                      lane=req.lane)).observe(
+                    req.lane_wait_us)
             if req.deadline is not None and now > req.deadline:
                 reg.counter("requests_deadline_exceeded").inc()
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(DeadlineExceeded())
             else:
+                # the summed series keeps its seed semantics: waits of
+                # DISPATCHED requests only
                 if reg.enabled:
                     reg.histogram("queue_wait_us").observe(
-                        int((now - req.t_enq) * 1e6))
+                        req.lane_wait_us)
                 live.append(req)
         if not live:
             return
@@ -595,6 +654,13 @@ class DynamicBatcher:
         or hedge succeeding also proves the device is alive, resetting
         the consecutive-failure streak."""
         reg.counter("batch_bisections").inc()
+        # the victims' request ids ride the event (ISSUE 10), so a
+        # fleet operator can answer "whose batch bisected?" from the
+        # JSONL stream alone
+        reg.event("batch_bisect", requests=len(live),
+                  request_ids=",".join(r.rid or "-" for r in live))
+        for req in live:
+            req.bisected = True
         budget = self.max_hedges
         mid = (len(live) + 1) // 2
         for half in (live[:mid], live[mid:]):
@@ -635,8 +701,11 @@ class DynamicBatcher:
                 return 0
             budget -= 1
             reg.counter("hedges_total").inc()
+            reg.event("hedge", request_id=req.rid or "-",
+                      reads=len(req.records))
+            req.hedged = True
             try:
-                per_req = self._step_requests([req])
+                per_req = self._step_requests([req], ledger="hedge_us")
             except BaseException as e:  # noqa: BLE001 - per request
                 self._record_step(reg, ok=False)
                 reg.counter("requests_failed").inc(1)

@@ -27,7 +27,11 @@ import time
 @dataclasses.dataclass
 class ServeResult:
     """One /correct exchange. `status` is the HTTP code; `fa`/`log`
-    are the corrected-FASTA and skip-log texts (empty unless 200)."""
+    are the corrected-FASTA and skip-log texts (empty unless 200).
+    `request_id` echoes the server's `X-Quorum-Request-Id` (every
+    response carries one); `phases` is the server-side phase
+    breakdown from `X-Quorum-Phases` (admission/queue/device/hedge/
+    render/total µs, lane, bisected/hedged — 200 responses only)."""
 
     status: int
     fa: str = ""
@@ -37,6 +41,19 @@ class ServeResult:
     skipped: int = 0
     retry_after_s: float = 0.0
     error: str = ""
+    request_id: str = ""
+    phases: dict | None = None
+
+
+def _parse_phases(resp) -> dict | None:
+    raw = resp.headers.get("X-Quorum-Phases")
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
 
 
 class ServeClient:
@@ -66,11 +83,15 @@ class ServeClient:
                 deadline_ms: float | None = None,
                 want_log: bool = False,
                 priority: str | None = None,
-                client_id: str | None = None) -> ServeResult:
+                client_id: str | None = None,
+                request_id: str | None = None) -> ServeResult:
         """POST /correct. Returns a ServeResult whatever the status —
         callers branch on `.status` (200/429/503/504/...).
-        `priority` stamps X-Quorum-Priority (interactive|bulk) and
-        `client_id` stamps X-Quorum-Client (the quota identity)."""
+        `priority` stamps X-Quorum-Priority (interactive|bulk),
+        `client_id` stamps X-Quorum-Client (the quota identity), and
+        `request_id` stamps X-Quorum-Request-Id (the trace identity;
+        the server generates one when absent — either way the
+        response's id lands in `ServeResult.request_id`)."""
         body = (fastq_text.encode()
                 if isinstance(fastq_text, str) else fastq_text)
         path = "/correct" + ("?log=1" if want_log else "")
@@ -81,7 +102,10 @@ class ServeClient:
             headers["X-Quorum-Priority"] = priority
         if client_id is not None:
             headers["X-Quorum-Client"] = client_id
+        if request_id is not None:
+            headers["X-Quorum-Request-Id"] = request_id
         resp, data = self._request("POST", path, body, headers)
+        rid = resp.headers.get("X-Quorum-Request-Id", "")
         if resp.status != 200:
             retry = float(resp.headers.get("Retry-After", 0) or 0)
             err = ""
@@ -90,18 +114,21 @@ class ServeClient:
             except ValueError:
                 pass
             return ServeResult(status=resp.status, retry_after_s=retry,
-                               error=err)
+                               error=err, request_id=rid)
+        phases = _parse_phases(resp)
         if want_log:
             doc = json.loads(data.decode())
             return ServeResult(status=200, fa=doc["fa"], log=doc["log"],
                                reads=doc["reads"],
                                corrected=doc["corrected"],
-                               skipped=doc["skipped"])
+                               skipped=doc["skipped"],
+                               request_id=rid, phases=phases)
         return ServeResult(
             status=200, fa=data.decode(),
             reads=int(resp.headers.get("X-Quorum-Reads", 0)),
             corrected=int(resp.headers.get("X-Quorum-Corrected", 0)),
-            skipped=int(resp.headers.get("X-Quorum-Skipped", 0)))
+            skipped=int(resp.headers.get("X-Quorum-Skipped", 0)),
+            request_id=rid, phases=phases)
 
     def correct_with_retry(self, fastq_text: str | bytes,
                            deadline_ms: float | None = None,
@@ -249,6 +276,7 @@ def bench_main(argv=None) -> int:
     next_i = [0]
     lock = threading.Lock()
     lat: list[float] = []
+    phases: list[dict] = []  # server-side breakdown per 200
     outcomes = {200: 0, 429: 0, 503: 0, 504: 0}
     reads_done = [0]
     errors = [0]
@@ -285,6 +313,8 @@ def bench_main(argv=None) -> int:
                     if res.status == 200:
                         lat.append(dt)
                         reads_done[0] += res.reads
+                        if res.phases:
+                            phases.append(res.phases)
                 if (res.status == 429 and args.retry_429
                         and not args.retry):
                     time.sleep(max(0.05, res.retry_after_s))
@@ -315,6 +345,29 @@ def bench_main(argv=None) -> int:
         latency_p50_ms=round(_percentile(lat, 50) * 1e3, 3),
         latency_p90_ms=round(_percentile(lat, 90) * 1e3, 3),
         latency_p99_ms=round(_percentile(lat, 99) * 1e3, 3)))
+    if phases:
+        # the server-side attribution (ISSUE 10): where each request's
+        # time went INSIDE the server, from the X-Quorum-Phases header
+        # alone — queue wait vs device time is visible client-side,
+        # no server access needed
+        fields = {}
+        for key in ("admission_us", "queue_us", "device_us",
+                    "hedge_us", "render_us", "total_us"):
+            vals = sorted(float(p.get(key, 0)) for p in phases)
+            fields[f"{key.removesuffix('_us')}_mean_ms"] = round(
+                sum(vals) / len(vals) / 1e3, 3)
+            fields[f"{key.removesuffix('_us')}_p90_ms"] = round(
+                _percentile(vals, 90) / 1e3, 3)
+        tot = sum(float(p.get("total_us", 0)) for p in phases)
+        if tot > 0:
+            for key in ("queue_us", "device_us"):
+                share = sum(float(p.get(key, 0)) for p in phases) / tot
+                fields[f"{key.removesuffix('_us')}_share"] = round(
+                    share, 4)
+        fields["bisected"] = sum(1 for p in phases if p.get("bisected"))
+        fields["hedged"] = sum(1 for p in phases if p.get("hedged"))
+        print(metric_line("serve_bench_phases", requests=len(phases),
+                          **fields))
     return 0 if outcomes.get(200, 0) > 0 else 1
 
 

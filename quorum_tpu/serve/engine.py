@@ -113,6 +113,11 @@ class CorrectionEngine:
                 contaminant, self.cfg.k)
         self._lock = threading.Lock()
         self._shapes: set[tuple[int, int]] = set()
+        # monotone device-step index: serve_device regions are
+        # StepTraceAnnotation-tagged with it, so a --profile'd serve
+        # run joins kernels to steps exactly like the batch loops
+        # (telemetry/devtrace.py)
+        self._step_i = 0
         # immutable snapshot of the column widths seen, reassigned
         # whole under the lock: `warm_lengths` must be readable
         # WITHOUT the lock — the watchdog's rebuild consults it while
@@ -157,8 +162,11 @@ class CorrectionEngine:
                     {cols for _rows, cols in self._shapes}))
                 self.registry.counter("engine_compiles").inc()
                 vlog("Engine compiling shape ", shape)
+            step_i = self._step_i
+            self._step_i += 1
             t0 = time.perf_counter()
-            with self.tracer.span("serve_device", reads=batch.n):
+            with self.tracer.step("serve_device", step_i,
+                                  reads=batch.n):
                 cap = 4 * batch.codes.shape[0]
                 res, packed = correct_batch_packed(
                     self.state, self.meta, pk, self.cfg,

@@ -50,6 +50,16 @@ Resilience surface (ISSUE 7):
   engine either way.
 * The ``serve.admit`` fault site fires at admission (chaos harness);
   an injected fault maps to a retryable 503.
+
+Request tracing (ISSUE 10): every response echoes
+``X-Quorum-Request-Id`` (client-stamped or generated), the id is
+threaded through admission → lane → batch → engine step →
+hedge/bisect telemetry, and each terminal status emits ONE
+structured ``request`` lifecycle event with disjoint per-phase
+durations (admission, per-lane queue wait, device step, hedge,
+render — their sum is <= the end-to-end time). Successful responses
+additionally carry the phase breakdown in ``X-Quorum-Phases`` (JSON),
+so clients see queue wait vs device time without server access.
 """
 
 from __future__ import annotations
@@ -57,6 +67,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from ..io import fastq
@@ -69,6 +80,20 @@ from .batcher import PRIORITIES, DeadlineExceeded, Draining, QueueFull
 # a request body bigger than this is refused with 413 before parsing
 # (an unbounded read would let one client exhaust host memory)
 MAX_BODY_BYTES = 256 * 1024 * 1024
+
+
+def request_id_for(headers) -> str:
+    """The request's trace identity (ISSUE 10): an `X-Quorum-Request-
+    Id` the client stamped, sanitized to printable ASCII and bounded —
+    header echo must never become an injection surface — or a fresh
+    16-hex id when absent/unusable. Commas are stripped too: batch
+    events comma-join the victims' ids, so an id containing one would
+    make that field unparseable. Every response carries it back, and
+    the batcher threads it through lane/batch/hedge telemetry."""
+    raw = (headers.get("X-Quorum-Request-Id") or "").strip()
+    rid = "".join(c for c in raw
+                  if 33 <= ord(c) <= 126 and c != ",")[:128]
+    return rid or uuid.uuid4().hex[:16]
 
 
 def parse_fastq_text(body: bytes) -> list[tuple[str, bytes, bytes]]:
@@ -124,6 +149,7 @@ class CorrectionServer:
             protocol_version = "HTTP/1.1"
 
             def do_GET(self):  # noqa: N802 - http.server API
+                self.request_id = request_id_for(self.headers)
                 route = self.path.split("?")[0]
                 if route == "/metrics":
                     body = export_mod.render_live().encode()
@@ -141,6 +167,7 @@ class CorrectionServer:
                     self._reply_json(404, {"error": "not found"})
 
             def do_POST(self):  # noqa: N802 - http.server API
+                self.request_id = request_id_for(self.headers)
                 route, _, query = self.path.partition("?")
                 if route == "/correct":
                     outer._handle_correct(self, query)
@@ -159,6 +186,11 @@ class CorrectionServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # EVERY response echoes the request's trace identity
+                # (generated when the client sent none), so a fleet's
+                # logs and the server's lifecycle events join on it
+                self.send_header("X-Quorum-Request-Id",
+                                 getattr(self, "request_id", "-"))
                 if self.close_connection:
                     # replies sent WITHOUT reading the request body
                     # (413, bad Content-Length) must kill the
@@ -196,12 +228,13 @@ class CorrectionServer:
 
     # -- request handling -------------------------------------------------
     @staticmethod
-    def _read_body(handler, limit: int) -> bytes | None:
+    def _read_body(handler, limit: int) -> bytes | int:
         """Validate Content-Length and read the request body. A bad
         or negative length (negative means read-to-EOF — it would
         block the handler thread forever on keep-alive) answers 400,
         an oversized one 413; both kill the keep-alive connection
-        (body left unread) and return None."""
+        (body left unread) and return the status already sent, so the
+        caller's lifecycle event carries the real code."""
         try:
             length = int(handler.headers.get("Content-Length", 0))
         except ValueError:
@@ -209,15 +242,43 @@ class CorrectionServer:
         if length < 0:
             handler.close_connection = True  # body left unread
             handler._reply_json(400, {"error": "bad Content-Length"})
-            return None
+            return 400
         if length > limit:
             handler.close_connection = True  # body left unread
             handler._reply_json(413, {"error": "request body too large"})
-            return None
+            return 413
         return handler.rfile.read(length)
+
+    def _lifecycle(self, rid: str, lane: str, status: int, t_req0: float,
+                   reads: int = 0, req=None, admission_us: int | None = None,
+                   render_us: int = 0) -> dict:
+        """Emit the request's ONE lifecycle event (ISSUE 10): every
+        terminal status, with the phase ledger when the request got
+        far enough to have one. Phases are disjoint sub-intervals of
+        the request's wall time, so their sum is <= total_us. Returns
+        the phase dict (the 200 path reuses it for the
+        `X-Quorum-Phases` response header)."""
+        total_us = int((time.perf_counter() - t_req0) * 1e6)
+        ph = {"admission_us": (admission_us if admission_us is not None
+                               else total_us),
+              "queue_us": 0, "device_us": 0, "hedge_us": 0,
+              "render_us": render_us, "total_us": total_us,
+              "lane": lane, "bisected": False, "hedged": False}
+        if req is not None:
+            ph.update(queue_us=int(req.lane_wait_us),
+                      device_us=int(req.device_us),
+                      hedge_us=int(req.hedge_us),
+                      lane=req.lane, bisected=bool(req.bisected),
+                      hedged=bool(req.hedged))
+        self.registry.event("request", request_id=rid, status=status,
+                            reads=reads, **ph)
+        return ph
 
     def _handle_correct(self, handler, query: str) -> None:
         reg = self.registry
+        rid = handler.request_id
+        t_req0 = time.perf_counter()
+        lane = "interactive"
         params = _parse_query(query)
         if handler.headers.get("Transfer-Encoding"):
             # we only read Content-Length bodies; silently treating a
@@ -225,9 +286,12 @@ class CorrectionServer:
             # the chunk bytes to desync the keep-alive connection
             handler.close_connection = True  # body left unread
             handler._reply_json(411, {"error": "Content-Length required"})
+            self._lifecycle(rid, lane, 411, t_req0)
             return
         body = self._read_body(handler, MAX_BODY_BYTES)
-        if body is None:
+        if isinstance(body, int):
+            # _read_body already answered (400 or 413)
+            self._lifecycle(rid, lane, body, t_req0)
             return
         priority = (handler.headers.get("X-Quorum-Priority")
                     or "interactive").strip().lower()
@@ -235,7 +299,9 @@ class CorrectionServer:
             handler._reply_json(
                 400, {"error": f"bad X-Quorum-Priority {priority!r} "
                                f"(one of {PRIORITIES})"})
+            self._lifecycle(rid, lane, 400, t_req0)
             return
+        lane = priority
         try:
             # chaos-harness site: a plan can fail the Nth admission to
             # prove overload/fault handling at the door (utils/faults)
@@ -244,6 +310,7 @@ class CorrectionServer:
             reg.counter("requests_rejected_admission").inc()
             handler._reply_json(503, {"error": str(e)},
                                 extra={"Retry-After": 1})
+            self._lifecycle(rid, lane, 503, t_req0)
             return
         client_id = handler.headers.get("X-Quorum-Client")
         if self.quota is not None and client_id:
@@ -254,6 +321,7 @@ class CorrectionServer:
                     429, {"error": "client quota exceeded",
                           "retry_after_s": round(retry_in, 3)},
                     extra={"Retry-After": max(1, int(retry_in + 0.999))})
+                self._lifecycle(rid, lane, 429, t_req0)
                 return
         deadline_ms = self.deadline_ms
         hdr_deadline = (params.get("deadline_ms")
@@ -263,12 +331,14 @@ class CorrectionServer:
                 deadline_ms = float(hdr_deadline)
             except ValueError:
                 handler._reply_json(400, {"error": "bad deadline_ms"})
+                self._lifecycle(rid, lane, 400, t_req0)
                 return
         try:
             records = parse_fastq_text(body)
         except (ValueError, UnicodeDecodeError) as e:
             reg.counter("requests_bad_input").inc()
             handler._reply_json(400, {"error": str(e)})
+            self._lifecycle(rid, lane, 400, t_req0)
             return
         t0 = time.perf_counter()
         try:
@@ -276,17 +346,24 @@ class CorrectionServer:
                 records,
                 deadline_s=(deadline_ms / 1000.0
                             if deadline_ms is not None else None),
-                priority=priority)
+                priority=priority, request_id=rid)
         except QueueFull as e:
             handler._reply_json(
                 429, {"error": "queue full",
                       "retry_after_s": e.retry_after},
                 extra={"Retry-After": max(1, int(round(e.retry_after)))})
+            self._lifecycle(rid, lane, 429, t_req0, reads=len(records))
             return
         except Draining:
             handler._reply_json(503, {"error": "draining"},
                                 extra={"Retry-After": 1})
+            self._lifecycle(rid, lane, 503, t_req0, reads=len(records))
             return
+        # admission phase ends where the queue phase begins: the
+        # ledger's own enqueue stamp, so the phases stay disjoint
+        req = getattr(fut, "request", None)
+        admission_us = int(((req.t_enq if req is not None else t0)
+                            - t_req0) * 1e6)
         # the wall timeout backstops the batcher's deadline handling:
         # a request admitted but stuck behind a wedged device step
         # still gets its 504 (and its late result is discarded)
@@ -296,14 +373,25 @@ class CorrectionServer:
             results = fut.result(timeout=wall)
         except DeadlineExceeded:
             handler._reply_json(504, {"error": "deadline exceeded"})
+            self._lifecycle(rid, lane, 504, t_req0, reads=len(records),
+                            req=req, admission_us=admission_us)
             return
         except FutureTimeout:
             fut.cancel()
             reg.counter("requests_late").inc()
             handler._reply_json(504, {"error": "deadline exceeded"})
+            # unlike every other terminal path, the future is NOT
+            # resolved here — the request may be mid-step, so the
+            # ledger read below is best-effort (single int fields,
+            # safe under the GIL, but device/hedge time still
+            # accruing on the dispatcher thread can lag)
+            self._lifecycle(rid, lane, 504, t_req0, reads=len(records),
+                            req=req, admission_us=admission_us)
             return
         except BaseException as e:  # noqa: BLE001 - surfaced as 500
             handler._reply_json(500, {"error": str(e)})
+            self._lifecycle(rid, lane, 500, t_req0, reads=len(records),
+                            req=req, admission_us=admission_us)
             return
         with self._req_lock:
             self._requests += 1
@@ -311,13 +399,23 @@ class CorrectionServer:
             reg.histogram("request_us").observe(
                 int((time.perf_counter() - t0) * 1e6))
             reg.histogram("request_reads").observe(len(records))
+        t_render = time.perf_counter()
         fa = "".join(r[0] for r in results)
         log = "".join(r[1] for r in results)
         corrected = sum(1 for r in results if r[0] and not r[1])
         skipped = sum(1 for r in results if r[1])
+        render_us = int((time.perf_counter() - t_render) * 1e6)
+        ph = self._lifecycle(rid, lane, 200, t_req0, reads=len(records),
+                             req=req, admission_us=admission_us,
+                             render_us=render_us)
         counts = {"X-Quorum-Reads": len(records),
                   "X-Quorum-Corrected": corrected,
-                  "X-Quorum-Skipped": skipped}
+                  "X-Quorum-Skipped": skipped,
+                  # the server-side phase breakdown, client-readable:
+                  # quorum-serve-bench reports queue wait vs device
+                  # time per request from this header alone
+                  "X-Quorum-Phases": json.dumps(
+                      ph, separators=(",", ":"))}
         if _flag(params, "log"):
             handler._reply_json(200, {
                 "fa": fa, "log": log, "reads": len(records),
@@ -339,7 +437,7 @@ class CorrectionServer:
         reg = self.registry
         # a reload body is a small JSON object — 1 MiB is generous
         body = self._read_body(handler, 1 << 20)
-        if body is None:
+        if isinstance(body, int):
             return
         try:
             params = json.loads(body.decode() or "{}")

@@ -10,8 +10,9 @@ See registry.py for the model and schema.py for the document formats.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       NULL, NullRegistry, observe_dispatch_wait,
-                       registry_for, track_jax_compile_cache)
+                       NULL, NullRegistry, labeled,
+                       observe_dispatch_wait, registry_for,
+                       track_jax_compile_cache)
 from .schema import (SCHEMA_VERSION, check_file, metric_line,
                      validate_bench_line, validate_chrome_trace,
                      validate_events_line, validate_metrics,
@@ -20,7 +21,7 @@ from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
-    "NullRegistry", "observe_dispatch_wait", "registry_for",
+    "NullRegistry", "labeled", "observe_dispatch_wait", "registry_for",
     "track_jax_compile_cache",
     "SCHEMA_VERSION", "check_file", "metric_line",
     "validate_bench_line", "validate_chrome_trace",

@@ -1,0 +1,469 @@
+"""Device-truth telemetry (ISSUE 10 tentpole): parse the `--profile`
+directory jax.profiler already writes and attribute DEVICE kernel time
+to the pipeline's batches and stages.
+
+Every timing the pipeline reports elsewhere is host-observed: the
+dispatch/wait split brackets `block_until_ready`, so "device time"
+silently includes host scheduling jitter. The profiler's own trace is
+the ground truth — XLA stamps each kernel execution on the device (or
+XLA runtime-thread, on CPU) timeline, and the `StepTraceAnnotation`
+every batch loop already emits (`tracer.step(...)`, spans.py) brackets
+each batch with its step id. This module joins the two:
+
+* **Kernel events** are the trace's `X` complete events carrying an
+  `hlo_op` arg (XLA stamps it on every op execution, on every
+  backend), plus — on real accelerators — any event on a process the
+  trace names `/device:...` (whose lanes carry op executions even when
+  an arg set is trimmed). Runtime bookkeeping (`ThreadpoolListener`,
+  the thunk executor's *wait*) is excluded by name.
+* **Step windows** are the `X` events carrying a `step_num` arg — one
+  per `tracer.step(name, step)` call, named after the loop that
+  emitted it (`stage2_device`, `stage1_insert`, `shard_build_step`,
+  `serve_device`...).
+
+A kernel joins the step window covering its midpoint, which yields
+per-batch `device_kernel_us` (one histogram observation per window),
+per-stage totals (one entry per step name), per-window **device idle**
+(window wall minus the union of its kernels — the device waiting on
+the host), and top-K per-kernel totals.
+
+Two sources, same join:
+
+* `plugins/profile/*/​*.trace.json.gz` — the Chrome trace the profiler
+  always writes; the primary source.
+* `*.xplane.pb` — the raw XPlane protobuf, decoded by the minimal
+  wire-format reader below (no tensorflow/protobuf dependency); the
+  fallback when the Chrome trace is missing or unreadable.
+
+`record_profile_metrics(reg, profile_dir)` lands the summary in the
+run's live registry (cli/observability.py calls it post-run on every
+`--profile` CLI), and `tools/trace_summary.py --device` renders the
+host-dispatch / device-execute / device-idle attribution table from
+the recorded metrics.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import glob
+import gzip
+import json
+import os
+
+# runtime bookkeeping that lives on the XLA worker lanes but is not
+# kernel compute: thread-pool region markers and the executor's idle
+# wait-for-completion park
+_NOT_KERNEL_PREFIXES = (
+    "ThreadpoolListener",
+    "ThunkExecutor::Execute (wait",
+)
+
+TOP_K = 10
+
+
+@dataclasses.dataclass
+class StepWindow:
+    """One StepTraceAnnotation occurrence on the trace timeline."""
+
+    name: str
+    step: int
+    ts_us: float
+    dur_us: float
+    kernel_us: float = 0.0
+    idle_us: float = 0.0
+    n_kernels: int = 0
+    _intervals: list = dataclasses.field(default_factory=list, repr=False)
+
+    @property
+    def end_us(self) -> float:
+        return self.ts_us + self.dur_us
+
+
+@dataclasses.dataclass
+class DevtraceSummary:
+    """What a profile directory says about device time."""
+
+    source: str = "none"  # trace_json | xplane | none
+    files: list = dataclasses.field(default_factory=list)
+    steps: list = dataclasses.field(default_factory=list)  # StepWindow
+    kernels: dict = dataclasses.field(default_factory=dict)  # name -> us
+    total_kernel_us: float = 0.0
+    total_step_us: float = 0.0
+    total_idle_us: float = 0.0
+    unattributed_kernel_us: float = 0.0
+
+    def stage_kernel_us(self) -> dict:
+        """Per step-NAME kernel totals (stage attribution): one entry
+        per distinct annotation name the batch loops emitted."""
+        out: dict[str, float] = {}
+        for w in self.steps:
+            out[w.name] = out.get(w.name, 0.0) + w.kernel_us
+        return out
+
+    def stage_idle_us(self) -> dict:
+        out: dict[str, float] = {}
+        for w in self.steps:
+            out[w.name] = out.get(w.name, 0.0) + w.idle_us
+        return out
+
+    def top_kernels(self, k: int = TOP_K) -> list:
+        """[(name, total_us)] sorted by device time, largest first."""
+        return sorted(self.kernels.items(), key=lambda kv: -kv[1])[:k]
+
+
+# ---------------------------------------------------------------------------
+# source discovery
+# ---------------------------------------------------------------------------
+
+def find_trace_files(profile_dir: str) -> list[str]:
+    """Chrome traces under `profile_dir`, recursively: the profiler
+    writes `plugins/profile/<run>/<host>.trace.json.gz`; the quorum
+    driver nests per-stage profile dirs (`stage1/`, `stage2/`) under
+    one root, so the search must recurse."""
+    out: list[str] = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        out.extend(glob.glob(os.path.join(profile_dir, pat),
+                             recursive=True))
+    # spans.trace.json is the HOST span twin observability() exports
+    # into the same directory — host spans are not device truth
+    return sorted(p for p in set(out)
+                  if os.path.basename(p) != "spans.trace.json")
+
+
+def find_xplane_files(profile_dir: str) -> list[str]:
+    return sorted(set(glob.glob(os.path.join(profile_dir,
+                                             "**/*.xplane.pb"),
+                                recursive=True)))
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace source
+# ---------------------------------------------------------------------------
+
+def _load_chrome_events(path: str) -> tuple[list, list]:
+    """(step_events, kernel_events) from one trace.json[.gz]: each
+    entry is (name, ts_us, dur_us, extra) — extra is the step id for
+    steps, nothing for kernels."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        doc = json.loads(f.read().decode())
+    events = doc.get("traceEvents", [])
+    device_pids = set()
+    for e in events:
+        if (e.get("ph") == "M" and e.get("name") == "process_name"
+                and str((e.get("args") or {}).get("name", ""))
+                .startswith("/device:")):
+            device_pids.add(e.get("pid"))
+    steps: list = []
+    kernels: list = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        name = e.get("name", "")
+        if "step_num" in args:
+            try:
+                step = int(args["step_num"])
+            except (TypeError, ValueError):
+                continue
+            steps.append((name, float(e.get("ts", 0.0)),
+                          float(e.get("dur", 0.0)), step))
+        elif "hlo_op" in args or (e.get("pid") in device_pids
+                                  and not name.startswith(
+                                      _NOT_KERNEL_PREFIXES)):
+            dur = float(e.get("dur", 0.0) or 0.0)
+            if dur > 0:
+                kernels.append((name, float(e.get("ts", 0.0)), dur))
+    return steps, kernels
+
+
+# ---------------------------------------------------------------------------
+# XPlane fallback: minimal protobuf wire reader (no proto dependency)
+# ---------------------------------------------------------------------------
+# Field numbers from tsl/profiler/protobuf/xplane.proto:
+#   XSpace.planes = 1
+#   XPlane: id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+#   XLine:  id=1 name=2 timestamp_ns=3 events=4 (display_name=11)
+#   XEvent: metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+#   XEventMetadata: id=1 name=2
+#   XStat: metadata_id=1 (value: one of fields 2-7; ints are varints)
+#   XStatMetadata: id=1 name=2
+# The reader only walks the fields above and skips everything else —
+# enough to recover (line, event name, ts, dur, step_num/hlo_op stats).
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's
+    bytes: varints as ints, length-delimited as bytes, fixed32/64 as
+    raw bytes."""
+    i, end = 0, len(buf)
+    while i < end:
+        key, i = _varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4) never appear in xplane
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _map_entry(buf: bytes) -> tuple[int | None, bytes | None]:
+    k = v = None
+    for fn, _wt, val in _fields(buf):
+        if fn == 1:
+            k = val
+        elif fn == 2:
+            v = val
+    return k, v
+
+
+def _meta_name(buf: bytes) -> str:
+    for fn, wt, v in _fields(buf):
+        if fn == 2 and wt == 2:
+            return v.decode(errors="replace")
+    return ""
+
+
+def _load_xplane_events(path: str) -> tuple[list, list]:
+    """(step_events, kernel_events) from one xplane.pb, in the same
+    shape `_load_chrome_events` returns. Kernel events are the ones
+    carrying an `hlo_op` stat; step events the ones carrying
+    `step_num`; device-plane events (plane name `/device:...`) count
+    as kernels too, minus the runtime-bookkeeping names."""
+    with open(path, "rb") as f:
+        data = f.read()
+    steps: list = []
+    kernels: list = []
+    for fn, wt, plane in _fields(data):
+        if fn != 1 or wt != 2:
+            continue
+        pname = ""
+        lines: list[bytes] = []
+        emeta: dict[int, str] = {}
+        smeta: dict[int, str] = {}
+        for f2, w2, v2 in _fields(plane):
+            if f2 == 2 and w2 == 2:
+                pname = v2.decode(errors="replace")
+            elif f2 == 3 and w2 == 2:
+                lines.append(v2)
+            elif f2 == 4 and w2 == 2:
+                k, v = _map_entry(v2)
+                if k is not None and v is not None:
+                    emeta[k] = _meta_name(v)
+            elif f2 == 5 and w2 == 2:
+                k, v = _map_entry(v2)
+                if k is not None and v is not None:
+                    smeta[k] = _meta_name(v)
+        is_device_plane = pname.startswith("/device:")
+        for line in lines:
+            ts_ns = 0
+            events: list[bytes] = []
+            for f3, w3, v3 in _fields(line):
+                if f3 == 3 and w3 == 0:
+                    ts_ns = v3
+                elif f3 == 4 and w3 == 2:
+                    events.append(v3)
+            for ev in events:
+                mid = off_ps = dur_ps = 0
+                stats: dict[str, int] = {}
+                for f4, w4, v4 in _fields(ev):
+                    if f4 == 1 and w4 == 0:
+                        mid = v4
+                    elif f4 == 2 and w4 == 0:
+                        off_ps = v4
+                    elif f4 == 3 and w4 == 0:
+                        dur_ps = v4
+                    elif f4 == 4 and w4 == 2:
+                        sm = sv = None
+                        for f5, w5, v5 in _fields(v4):
+                            if f5 == 1 and w5 == 0:
+                                sm = v5
+                            elif w5 == 0:
+                                sv = v5
+                        if sm is not None:
+                            stats[smeta.get(sm, str(sm))] = sv
+                name = emeta.get(mid, "")
+                ts_us = ts_ns / 1e3 + off_ps / 1e6
+                dur_us = dur_ps / 1e6
+                if "step_num" in stats:
+                    steps.append((name, ts_us, dur_us,
+                                  int(stats["step_num"] or 0)))
+                elif "hlo_op" in stats or (
+                        is_device_plane
+                        and not name.startswith(_NOT_KERNEL_PREFIXES)):
+                    if dur_us > 0:
+                        kernels.append((name, ts_us, dur_us))
+    return steps, kernels
+
+
+# ---------------------------------------------------------------------------
+# the join
+# ---------------------------------------------------------------------------
+
+def _join(steps_raw: list, kernels_raw: list) -> DevtraceSummary:
+    """Assign each kernel to the step window covering its midpoint
+    and derive per-window kernel/idle time. Windows never overlap on
+    one timeline (the batch loops emit one annotation at a time), so
+    midpoint containment against the window starting at-or-before the
+    midpoint is exact."""
+    s = DevtraceSummary()
+    windows = [StepWindow(name, step, ts, dur)
+               for name, ts, dur, step in steps_raw]
+    windows.sort(key=lambda w: w.ts_us)
+    starts = [w.ts_us for w in windows]
+    for name, ts, dur in kernels_raw:
+        s.kernels[name] = s.kernels.get(name, 0.0) + dur
+        s.total_kernel_us += dur
+        mid = ts + dur / 2.0
+        i = bisect.bisect_right(starts, mid) - 1
+        if i >= 0 and mid <= windows[i].end_us:
+            w = windows[i]
+            w.kernel_us += dur
+            w.n_kernels += 1
+            # clip to the window for the idle union — kernels on
+            # parallel lanes overlap in wall time, so idle needs the
+            # interval UNION, not the sum
+            w._intervals.append((max(ts, w.ts_us),
+                                 min(ts + dur, w.end_us)))
+        else:
+            s.unattributed_kernel_us += dur
+    for w in windows:
+        busy = _union_us(w._intervals)
+        w.idle_us = max(0.0, w.dur_us - busy)
+        s.total_step_us += w.dur_us
+        s.total_idle_us += w.idle_us
+    s.steps = windows
+    return s
+
+
+def _union_us(intervals: list) -> float:
+    if not intervals:
+        return 0.0
+    intervals = sorted(intervals)
+    total = 0.0
+    cur_a, cur_b = intervals[0]
+    for a, b in intervals[1:]:
+        if a > cur_b:
+            total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    return total + (cur_b - cur_a)
+
+
+def summarize_profile(profile_dir: str) -> DevtraceSummary:
+    """Parse every trace under `profile_dir` (Chrome traces first,
+    xplane.pb for directories whose Chrome trace is missing or
+    unreadable) and join kernels to step windows. Files are joined
+    PER SESSION DIRECTORY: each profiler session stamps timestamps
+    against its own epoch, so pooling the driver's nested stage1/ and
+    stage2/ dumps onto one timeline would bisect one stage's kernels
+    into the other stage's windows — the per-group joins are merged
+    afterwards. Returns an empty summary (`source="none"`) when the
+    directory holds no readable trace — callers record zeros rather
+    than failing the run."""
+    groups: dict[str, tuple[list, list]] = {}  # session dir -> events
+    files: list[str] = []
+    source = "none"
+    skip_xplane_dirs = set()
+    for path in find_trace_files(profile_dir):
+        try:
+            st, kn = _load_chrome_events(path)
+        except (OSError, ValueError):
+            continue
+        d = os.path.dirname(path)
+        steps, kernels = groups.setdefault(d, ([], []))
+        steps.extend(st)
+        kernels.extend(kn)
+        files.append(path)
+        skip_xplane_dirs.add(d)
+        source = "trace_json"
+    for path in find_xplane_files(profile_dir):
+        d = os.path.dirname(path)
+        if d in skip_xplane_dirs:
+            continue  # the Chrome twin already covered this dump
+        try:
+            st, kn = _load_xplane_events(path)
+        except (OSError, ValueError, IndexError):
+            continue
+        steps, kernels = groups.setdefault(d, ([], []))
+        steps.extend(st)
+        kernels.extend(kn)
+        files.append(path)
+        if source == "none":
+            source = "xplane"
+    s = DevtraceSummary()
+    for d in sorted(groups):
+        part = _join(*groups[d])
+        s.steps.extend(part.steps)
+        for name, us in part.kernels.items():
+            s.kernels[name] = s.kernels.get(name, 0.0) + us
+        s.total_kernel_us += part.total_kernel_us
+        s.total_step_us += part.total_step_us
+        s.total_idle_us += part.total_idle_us
+        s.unattributed_kernel_us += part.unattributed_kernel_us
+    s.source = source
+    s.files = files
+    return s
+
+
+# ---------------------------------------------------------------------------
+# registry recording (cli/observability.py, post-run)
+# ---------------------------------------------------------------------------
+
+def record_profile_metrics(reg, profile_dir: str,
+                           top_k: int = TOP_K) -> bool:
+    """Land the device-truth summary in the run's registry. The
+    counter/gauge/histogram names exist even when the directory holds
+    no trace (value-0 counts — tools/metrics_check.py requires the
+    names whenever meta declares `profile`). Returns True when the
+    registry is enabled (the caller re-writes an already-written
+    final document so the devtrace section lands in it)."""
+    if not getattr(reg, "enabled", False):
+        return False
+    try:
+        s = summarize_profile(profile_dir)
+    except Exception as e:  # noqa: BLE001 - telemetry never kills runs
+        s = DevtraceSummary()
+        reg.set_meta(devtrace_error=str(e))
+    reg.counter("device_kernel_us_total").inc(int(s.total_kernel_us))
+    reg.counter("device_step_us_total").inc(int(s.total_step_us))
+    reg.counter("device_idle_us_total").inc(int(s.total_idle_us))
+    reg.counter("device_kernel_unattributed_us_total").inc(
+        int(s.unattributed_kernel_us))
+    reg.gauge("devtrace_steps").set(len(s.steps))
+    hist = reg.histogram("device_kernel_us")
+    for w in s.steps:
+        hist.observe(int(w.kernel_us))
+    reg.set_meta(
+        devtrace_source=s.source,
+        devtrace_files=len(s.files),
+        devtrace_stage_kernel_us={k: round(v, 1) for k, v in
+                                  sorted(s.stage_kernel_us().items())},
+        devtrace_stage_idle_us={k: round(v, 1) for k, v in
+                                sorted(s.stage_idle_us().items())},
+        devtrace_top_kernels=[f"{name}={round(us, 1)}"
+                              for name, us in s.top_kernels(top_k)],
+    )
+    return True

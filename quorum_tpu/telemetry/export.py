@@ -100,6 +100,20 @@ def _metric_name(name: str) -> str:
     return name
 
 
+def split_labeled_name(name: str) -> tuple[str, str | None]:
+    """Registry metric names may carry an embedded label set —
+    `lane_wait_us{lane="interactive"}` (telemetry.labeled) — so flat
+    name->value registries can express labelled series without a
+    label-aware metric model. Returns (base_name, label_text or
+    None); the label text is rendered verbatim inside the sample's
+    braces (the producer writes valid `k="v"` pairs; the exposition
+    linter still checks the rendered output)."""
+    if name.endswith("}") and "{" in name:
+        base, _, rest = name.partition("{")
+        return base, rest[:-1]
+    return name, None
+
+
 def _label_value(v: str) -> str:
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
@@ -129,19 +143,29 @@ def prometheus_text(docs: dict[str, dict],
         out[name][1].append(line)
 
     for label, doc in sorted(docs.items()):
-        lab = f'stage="{_label_value(label)}"'
+        stage_lab = f'stage="{_label_value(label)}"'
+
+        def labs(k: str) -> tuple[str, str]:
+            """(prometheus base name, full label text) for a registry
+            key that may carry an embedded label set."""
+            base, extra = split_labeled_name(k)
+            name = PREFIX + _metric_name(base)
+            return name, (stage_lab if extra is None
+                          else f"{stage_lab},{extra}")
+
         for k, v in doc.get("counters", {}).items():
-            name = PREFIX + _metric_name(k) + "_total"
+            name, lab = labs(k)
+            name += "_total"
             add(name, "counter", f"{name}{{{lab}}} {v}")
         for k, v in doc.get("gauges", {}).items():
-            name = PREFIX + _metric_name(k)
+            name, lab = labs(k)
             add(name, "gauge", f"{name}{{{lab}}} {v}")
         if elapsed and label in elapsed:
             name = PREFIX + "elapsed_seconds"
             add(name, "gauge",
-                f"{name}{{{lab}}} {round(elapsed[label], 3)}")
+                f"{name}{{{stage_lab}}} {round(elapsed[label], 3)}")
         for k, h in doc.get("histograms", {}).items():
-            name = PREFIX + _metric_name(k)
+            name, lab = labs(k)
             # exact per-value counts -> cumulative le buckets; the
             # cardinality-guard "overflow" key lands in +Inf only
             numeric = sorted(int(b) for b in h.get("counts", {})

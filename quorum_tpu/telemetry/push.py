@@ -1,0 +1,183 @@
+"""Push/remote-write metrics transport (ISSUE 10 tentpole, ROADMAP
+item 4's "fleets that can't be scraped" gap).
+
+The pull-side exposition (`--metrics-port`, export.py) assumes a
+scraper can reach every host — false for batch fleets behind NAT,
+short-lived CI runs, and serve replicas on ephemeral addresses. The
+pusher inverts the arrow: a daemon thread periodically POSTs the SAME
+Prometheus text `render_live()` serves (so every in-process registry
+— driver plus both stages — rides one push stream) to
+`--metrics-push-url`, and on exit flushes the run's FINAL metrics
+JSON document so the receiver can aggregate per-host finals into one
+fleet document (`tools/push_receiver.py`, via
+`parallel/multihost.merge_host_docs` — the same merge rules
+`aggregate_metrics` uses collectively).
+
+Transport discipline:
+
+* pushes are best-effort and NEVER fail the run — a dead receiver
+  costs a counter (`metrics_push_failures_total`), not an exception;
+* failed pushes retry on the next tick under capped exponential
+  backoff (a flapping receiver is not hammered at the push period);
+* `close()` performs the terminal flush — final exposition text plus
+  the final JSON document — with its own bounded retry loop, so a
+  receiver that was briefly down mid-run still gets the run's last
+  word (`metrics_pushed` meta records whether it landed).
+
+Protocol (stdlib HTTP, mirrored by tools/push_receiver.py):
+
+* ``POST <url>`` — body: Prometheus text exposition
+  (``Content-Type: text/plain; version=0.0.4``);
+* ``POST <url>/final`` — body: the final metrics JSON document
+  (``Content-Type: application/json``).
+
+Both carry ``X-Quorum-Host`` (the per-host identity the receiver
+keys on; default ``<hostname>:<pid>``, override with
+``QUORUM_PUSH_HOST`` for stable fleet identities) and
+``X-Quorum-Stage`` (the registry's stage/driver label).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+from ..utils.vlog import vlog
+
+DEFAULT_PERIOD_S = 5.0
+DEFAULT_TIMEOUT_S = 5.0
+MAX_BACKOFF_S = 30.0
+FINAL_ATTEMPTS = 4
+FINAL_BACKOFF_S = 0.25
+
+
+def default_host_id() -> str:
+    """The per-host push identity: QUORUM_PUSH_HOST when set (stable
+    fleet names), else hostname:pid (unique per process, so two local
+    runs never clobber each other's shard in the fleet document)."""
+    env = os.environ.get("QUORUM_PUSH_HOST")
+    if env:
+        return env
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+class MetricsPusher:
+    """One per observability() lifecycle when `--metrics-push-url` is
+    given. Counters land on the owning registry
+    (`metrics_push_total` / `metrics_push_failures_total`, created at
+    start so a zero-push run still declares the surface)."""
+
+    def __init__(self, registry, url: str,
+                 period_s: float = DEFAULT_PERIOD_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 max_backoff_s: float = MAX_BACKOFF_S,
+                 host_id: str | None = None,
+                 _urlopen=None, _sleep=None):
+        self.registry = registry
+        self.url = url.rstrip("/")
+        self.period_s = max(0.05, float(period_s))
+        self.timeout_s = float(timeout_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.host_id = host_id or default_host_id()
+        # injectable for tests (deterministic failure/backoff)
+        import time
+        self._urlopen = _urlopen or urllib.request.urlopen
+        self._sleep = _sleep or time.sleep
+        self._stop = threading.Event()
+        self._backoff = 0.0
+        registry.counter("metrics_push_total")
+        registry.counter("metrics_push_failures_total")
+        registry.set_meta(metrics_push_url=self.url,
+                          metrics_push_host=self.host_id)
+        self._thread = threading.Thread(target=self._loop,
+                                        name="quorum-metrics-push",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- transport --------------------------------------------------------
+    def _stage_label(self) -> str:
+        meta = getattr(self.registry, "meta", {}) or {}
+        return str(meta.get("stage") or meta.get("driver") or "run")
+
+    def _post(self, url: str, body: bytes, ctype: str) -> None:
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": ctype,
+                     "X-Quorum-Host": self.host_id,
+                     "X-Quorum-Stage": self._stage_label()})
+        with self._urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
+            if resp.status >= 300:
+                raise OSError(f"push receiver answered {resp.status}")
+
+    def _render(self) -> bytes:
+        from . import export
+        return export.render_live().encode()
+
+    def _push_once(self, final_doc: dict | None = None) -> bool:
+        """One push attempt: exposition text, plus the final document
+        when given. Returns True when everything landed."""
+        reg = self.registry
+        try:
+            self._post(self.url, self._render(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            if final_doc is not None:
+                self._post(self.url + "/final",
+                           (json.dumps(final_doc) + "\n").encode(),
+                           "application/json")
+        except (OSError, urllib.error.URLError, ValueError,
+                http.client.HTTPException) as e:
+            # HTTPException covers e.g. BadStatusLine from a non-HTTP
+            # peer — it is NOT an OSError, and an uncaught raise here
+            # would silently kill the daemon push loop
+            reg.counter("metrics_push_failures_total").inc()
+            vlog("metrics push to ", self.url, " failed: ", e)
+            return False
+        reg.counter("metrics_push_total").inc()
+        return True
+
+    # -- the loop ---------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s + self._backoff):
+            if self._push_once():
+                self._backoff = 0.0
+            else:
+                # capped exponential: the next tick waits period +
+                # backoff, so a dead receiver sees a decaying rate
+                # instead of a steady hammer
+                self._backoff = min(
+                    self.max_backoff_s,
+                    max(self.period_s, self._backoff * 2))
+
+    @property
+    def failures(self) -> int:
+        return self.registry.counter("metrics_push_failures_total").value
+
+    def close(self, final_doc: dict | None = None) -> bool:
+        """Stop the periodic loop, then terminal-flush: the final
+        exposition text plus `final_doc` (when given), retried a few
+        times with short backoff so a receiver that hiccuped at run
+        end still gets the document. Returns True when the flush
+        landed; stamps `metrics_pushed` meta either way. Idempotent —
+        a second close just re-attempts the flush."""
+        self._stop.set()
+        self._thread.join(timeout=self.timeout_s + 1.0)
+        ok = False
+        delay = FINAL_BACKOFF_S
+        for attempt in range(FINAL_ATTEMPTS):
+            if self._push_once(final_doc=final_doc):
+                ok = True
+                break
+            if attempt < FINAL_ATTEMPTS - 1:
+                self._sleep(delay)
+                delay = min(delay * 2, 2.0)
+        self.registry.set_meta(metrics_pushed=bool(ok))
+        if not ok:
+            vlog("terminal metrics push to ", self.url,
+                 " failed after ", FINAL_ATTEMPTS, " attempts")
+        return ok

@@ -395,6 +395,18 @@ def registry_for(path: str | None,
     return reg
 
 
+def labeled(name: str, **labels) -> str:
+    """A registry metric name carrying an embedded Prometheus label
+    set — `labeled("lane_wait_us", lane="bulk")` ->
+    `lane_wait_us{lane="bulk"}`. The flat registry stores it as an
+    ordinary key; the exposition renderer (export.split_labeled_name)
+    splits it back into a base name + labels so scrapers see a real
+    labelled series. Label values must not contain `"` or newlines
+    (they are embedded verbatim)."""
+    lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{lab}}}"
+
+
 def observe_dispatch_wait(reg, prefix: str, t0: float, t1: float,
                           t2: float, timer=None) -> None:
     """The per-batch device-time attribution every device loop

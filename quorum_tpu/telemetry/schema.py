@@ -132,8 +132,38 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
     return errs
 
 
+# the serve request lifecycle event (ISSUE 10): one per terminal
+# status, with disjoint phase durations in microseconds
+REQUEST_EVENT_PHASES = ("admission_us", "queue_us", "device_us",
+                        "hedge_us", "render_us", "total_us")
+
+
+def _validate_request_event(obj) -> list[str]:
+    """The `request` lifecycle event's extra contract on top of the
+    generic event shape: a non-empty trace id, an HTTP status, a
+    lane, and every phase duration present and non-negative."""
+    errs: list[str] = []
+    if not isinstance(obj.get("request_id"), str) \
+            or not obj.get("request_id"):
+        errs.append("request event missing/empty 'request_id'")
+    if not isinstance(obj.get("status"), int) \
+            or isinstance(obj.get("status"), bool):
+        errs.append("request event missing/non-int 'status'")
+    if not isinstance(obj.get("lane"), str) or not obj.get("lane"):
+        errs.append("request event missing/empty 'lane'")
+    for k in REQUEST_EVENT_PHASES:
+        v = obj.get(k)
+        if not _is_number(v):
+            errs.append(f"request event missing/non-numeric {k!r}")
+        elif v < 0:
+            errs.append(f"request event {k!r} is negative")
+    return errs
+
+
 def validate_events_line(obj) -> list[str]:
-    """Validate one parsed events-JSONL object."""
+    """Validate one parsed events-JSONL object. `request` lifecycle
+    events (serve request tracing, ISSUE 10) are additionally held to
+    their richer contract."""
     errs: list[str] = []
     if not isinstance(obj, dict):
         return ["event line is not a JSON object"]
@@ -144,6 +174,8 @@ def validate_events_line(obj) -> list[str]:
     for k, v in obj.items():
         if not _is_scalar(v):
             errs.append(f"event field {k!r} is not scalar")
+    if obj.get("event") == "request":
+        errs.extend(_validate_request_event(obj))
     return errs
 
 

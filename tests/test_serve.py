@@ -461,11 +461,21 @@ def test_serve_bench_closed_loop(capsys):
     finally:
         srv.close()
     assert rc == 0
-    line = capsys.readouterr().out.strip().splitlines()[-1]
-    obj = json.loads(line)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    by_metric = {o["metric"]: o for o in lines}
+    obj = by_metric["serve_bench"]
     assert validate_bench_line(obj) == []
     assert obj["ok"] == 9 and obj["reads"] == 36
     assert obj["latency_p50_ms"] > 0
+    # the server-side phase breakdown rides a second metric line
+    # (ISSUE 10): every 200 carried X-Quorum-Phases
+    ph = by_metric["serve_bench_phases"]
+    assert validate_bench_line(ph) == []
+    assert ph["requests"] == 9
+    assert ph["total_mean_ms"] > 0
+    assert ph["device_mean_ms"] >= 0
+    assert 0.0 <= ph.get("device_share", 0.0) <= 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -1164,8 +1174,10 @@ def test_serve_bench_retry_flag(capsys):
     finally:
         srv.close()
     assert rc == 0
-    line = capsys.readouterr().out.strip().splitlines()[-1]
-    obj = json.loads(line)
+    by_metric = {o["metric"]: o for o in
+                 (json.loads(ln) for ln in
+                  capsys.readouterr().out.strip().splitlines())}
+    obj = by_metric["serve_bench"]
     assert obj["ok"] == 6 and obj["reads"] == 18
 
 
@@ -1247,3 +1259,196 @@ def test_metrics_check_serve_feature_names():
     off = {"meta": {"stage": "serve", "max_hedges": 0},
            "counters": dict(counters), "histograms": hists}
     assert mc._check_serve_names(off) == []
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing (ISSUE 10): ids, phases, lifecycle events,
+# per-lane series
+# ---------------------------------------------------------------------------
+
+def test_request_id_echo_unique_and_phase_sums(tmp_path):
+    """Every 200 echoes X-Quorum-Request-Id (unique when the client
+    sent none, verbatim when it did) and carries X-Quorum-Phases whose
+    disjoint phase durations sum to <= the end-to-end latency; each
+    terminal status emits one schema-valid `request` lifecycle
+    event."""
+    from quorum_tpu.telemetry import validate_events_line
+
+    evts = str(tmp_path / "events.jsonl")
+    reg = registry_for(None, events_path=evts)
+    bat = DynamicBatcher(FakeEngine(), max_batch=8, max_wait_ms=1,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        client = ServeClient(port=srv.port)
+        t0 = time.perf_counter()
+        r1 = client.correct("@a\nACGT\n+\nIIII\n")
+        e2e_us = (time.perf_counter() - t0) * 1e6
+        r2 = client.correct("@b\nAC\n+\nII\n")
+        r3 = client.correct("@c\nAC\n+\nII\n", request_id="my-trace-7")
+        assert r1.status == r2.status == r3.status == 200
+        assert r1.request_id and r2.request_id
+        assert r1.request_id != r2.request_id  # unique when absent
+        assert r3.request_id == "my-trace-7"   # verbatim when given
+        ph = r1.phases
+        assert ph is not None and ph["lane"] == "interactive"
+        parts = (ph["admission_us"] + ph["queue_us"] + ph["device_us"]
+                 + ph["hedge_us"] + ph["render_us"])
+        assert 0 <= parts <= ph["total_us"] <= e2e_us
+        assert not ph["bisected"] and not ph["hedged"]
+    finally:
+        srv.close()
+    with open(evts) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    reqs = [o for o in lines if o.get("event") == "request"]
+    assert len(reqs) == 3
+    for o in reqs:
+        assert validate_events_line(o) == []
+        assert o["status"] == 200
+        assert (o["admission_us"] + o["queue_us"] + o["device_us"]
+                + o["hedge_us"] + o["render_us"]) <= o["total_us"]
+    assert ({o["request_id"] for o in reqs}
+            == {r1.request_id, r2.request_id, "my-trace-7"})
+
+
+def test_request_id_echoed_on_429_504_500(tmp_path):
+    """Rejections carry the trace id too: 429 (queue full), 504
+    (deadline), 500 (engine failure) all echo X-Quorum-Request-Id and
+    land lifecycle events with the terminal status."""
+    evts = str(tmp_path / "events.jsonl")
+    reg = registry_for(None, events_path=evts)
+    gate = threading.Event()
+    eng = FakeEngine(gate)
+    bat = DynamicBatcher(eng, max_batch=4, max_wait_ms=0,
+                         queue_requests=1, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg,
+                           drain_grace_s=5.0)
+    body = "@r\nACGT\n+\nIIII\n"
+    try:
+        client = ServeClient(port=srv.port)
+        # occupy the engine so later requests queue behind it
+        t1 = threading.Thread(
+            target=lambda: ServeClient(port=srv.port).correct(
+                body, request_id="rid-held"), daemon=True)
+        t1.start()
+        assert eng.entered.wait(5)
+        _drain_to_depth(bat, 0)
+        # B fills the one-slot queue and expires -> 504, id echoed
+        box = {}
+
+        def post_b():
+            # the deadline is the race window for the 429 probe below:
+            # B must still occupy the slot when the probe's POST lands,
+            # so keep it well above a loaded-machine HTTP round trip
+            box["b"] = ServeClient(port=srv.port).correct(
+                body, deadline_ms=2000, request_id="rid-504")
+
+        t2 = threading.Thread(target=post_b, daemon=True)
+        t2.start()
+        # wait for B to OCCUPY the slot (depth >= 1), not merely for
+        # depth <= 1 — before B's POST lands the depth is 0 and the
+        # 429 probe below would steal the slot instead of bouncing
+        t0 = time.perf_counter()
+        while bat.depth < 1:
+            assert time.perf_counter() - t0 < 5, "B never queued"
+            time.sleep(0.005)
+        r429 = client.correct(body, request_id="rid-429")
+        assert r429.status == 429 and r429.request_id == "rid-429"
+        t2.join(timeout=10)
+        assert not t2.is_alive()
+        assert box["b"].status == 504
+        assert box["b"].request_id == "rid-504"
+        gate.set()
+        t1.join(timeout=10)
+    finally:
+        gate.set()
+        srv.close()
+    with open(evts) as f:
+        by_rid = {o["request_id"]: o for ln in f if ln.strip()
+                  for o in [json.loads(ln)] if o.get("event") == "request"}
+    assert by_rid["rid-429"]["status"] == 429
+    assert by_rid["rid-504"]["status"] == 504
+    assert by_rid["rid-held"]["status"] == 200
+
+
+def test_bisect_hedge_events_carry_victim_request_ids(tmp_path):
+    """A bisected batch's event lists every rider's request id and
+    each solo hedge's event names its victim; the survivors' phase
+    ledgers mark bisected/hedged with the hedge time separated from
+    the device time."""
+    from quorum_tpu.telemetry import validate_events_line
+
+    evts = str(tmp_path / "events.jsonl")
+    reg = registry_for(None, events_path=evts)
+    bat = DynamicBatcher(PoisonEngine(), max_batch=8, max_wait_ms=150,
+                         queue_requests=8, max_hedges=8, registry=reg)
+    try:
+        # one coalesced batch of four: the first bisect half
+        # [poison, a] fails again ambiguously -> both hedged solo;
+        # the second half [b, c] succeeds in one pass
+        fp = bat.submit([("poison", b"ACGT", b"IIII")],
+                        request_id="rid-p")
+        fa = bat.submit([("a", b"AC", b"II")], request_id="rid-a")
+        fb = bat.submit([("b", b"AC", b"II")], request_id="rid-b")
+        fc = bat.submit([("c", b"AC", b"II")], request_id="rid-c")
+        with pytest.raises(RuntimeError, match="poisoned"):
+            fp.result(timeout=15)
+        assert fa.result(timeout=15) == [(">a\nAC\n", "")]
+        assert fb.result(timeout=15) == [(">b\nAC\n", "")]
+        assert fc.result(timeout=15) == [(">c\nAC\n", "")]
+        assert reg.counter("batch_bisections").value == 1
+        assert reg.counter("hedges_total").value == 2
+        # the survivor's ledger: hedged, with hedge time ledgered
+        # apart from the (failed) batch/half device attempts
+        req_a = fa.request
+        assert req_a.bisected and req_a.hedged
+        assert req_a.hedge_us >= 0 and req_a.device_us >= 0
+        req_b = fb.request
+        assert req_b.bisected and not req_b.hedged
+    finally:
+        bat.drain(timeout=5)
+    with open(evts) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    for o in lines:
+        assert validate_events_line(o) == []
+    bisects = [o for o in lines if o["event"] == "batch_bisect"]
+    assert len(bisects) == 1
+    ids = bisects[0]["request_ids"].split(",")
+    assert set(ids) == {"rid-p", "rid-a", "rid-b", "rid-c"}
+    hedges = [o for o in lines if o["event"] == "hedge"]
+    assert {o["request_id"] for o in hedges} == {"rid-p", "rid-a"}
+
+
+def test_per_lane_depth_and_wait_series():
+    """Satellite: queue_depth and lane_wait_us split per lane (the
+    summed queue_depth series stays for dashboards), rendered as REAL
+    Prometheus labels by the exposition layer, lint-clean."""
+    from quorum_tpu.telemetry import export as export_mod
+    from quorum_tpu.telemetry import labeled
+
+    gate = threading.Event()
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(gate), max_batch=4, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    try:
+        f1 = bat.submit([("i", b"AC", b"II")], priority="interactive")
+        _drain_to_depth(bat, 0)  # i popped; engine now blocked on it
+        f2 = bat.submit([("b", b"AC", b"II")], priority="bulk")
+        f3 = bat.submit([("i2", b"AC", b"II")], priority="interactive")
+        gate.set()
+        for f in (f1, f2, f3):
+            assert f.result(timeout=10)
+    finally:
+        bat.drain(timeout=5)
+    doc = reg.as_dict()
+    # per-lane series exist from setup; bulk saw depth 1
+    assert doc["gauges"][labeled("queue_depth", lane="bulk")] >= 1
+    assert labeled("queue_depth", lane="interactive") in doc["gauges"]
+    assert "queue_depth" in doc["gauges"]  # the summed series stays
+    hi = doc["histograms"][labeled("lane_wait_us", lane="interactive")]
+    hb = doc["histograms"][labeled("lane_wait_us", lane="bulk")]
+    assert hi["count"] == 2 and hb["count"] == 1
+    # the embedded label set renders as a real Prometheus label
+    text = export_mod.prometheus_text({"serve": doc})
+    assert 'lane="bulk"' in text and 'lane="interactive"' in text
+    assert export_mod.lint_prometheus_text(text) == []

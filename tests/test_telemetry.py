@@ -666,3 +666,313 @@ def test_busy_metrics_port_still_lands_error_document(tmp_path):
         assert doc["meta"]["status"] == "error"
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: device-truth telemetry — metrics_check schemas, the
+# devtrace parser/join, and the push transport
+# ---------------------------------------------------------------------------
+
+def _mc():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_check
+    return metrics_check
+
+
+def _doc10(meta=None, counters=None, gauges=None, histograms=None,
+           **extra):
+    doc = {"schema": SCHEMA_VERSION, "meta": meta or {},
+           "counters": counters or {}, "gauges": gauges or {},
+           "histograms": histograms or {}, "timers": {}}
+    doc.update(extra)
+    return doc
+
+
+def test_metrics_check_devtrace_and_push_names():
+    """meta.profile demands the devtrace surface, meta.metrics_push_url
+    the pusher's — both recorded at value 0 even when nothing fired,
+    so a missing NAME is a regression, not an idle run."""
+    mc = _mc()
+    errs = mc._check_devtrace_names(_doc10(meta={"profile": "/p"}))
+    # 4 counters + 1 gauge + 1 histogram + meta.devtrace_source
+    assert len(errs) == 7
+    full = _doc10(
+        meta={"profile": "/p", "devtrace_source": "none"},
+        counters={n: 0 for n in mc.DEVTRACE_COUNTERS},
+        gauges={"devtrace_steps": 0},
+        histograms={"device_kernel_us":
+                    {"count": 0, "sum": 0, "counts": {}}})
+    assert mc._check_devtrace_names(full) == []
+    # an unprofiled document is not held to it
+    assert mc._check_devtrace_names(_doc10()) == []
+
+    errs = mc._check_push_names(
+        _doc10(meta={"metrics_push_url": "http://x"}))
+    assert len(errs) == 3  # 2 counters + meta.metrics_push_host
+    ok = _doc10(meta={"metrics_push_url": "http://x",
+                      "metrics_push_host": "h:1"},
+                counters={n: 0 for n in mc.PUSH_COUNTERS})
+    assert mc._check_push_names(ok) == []
+    assert mc._check_push_names(_doc10()) == []
+
+
+def test_metrics_check_fleet_doc(tmp_path):
+    """A push_receiver fleet document must carry per-host shards keyed
+    exactly by meta.fleet_hosts — a mismatch means a host's final push
+    was silently dropped from the aggregate."""
+    mc = _mc()
+    shard = _doc10()
+    good = _doc10(meta={"fleet": True, "fleet_hosts": ["a:1", "b:2"]},
+                  hosts={"a:1": shard, "b:2": shard})
+    assert mc._check_fleet_doc(good) == []
+    # hosts section missing entirely
+    assert mc._check_fleet_doc(
+        _doc10(meta={"fleet": True, "fleet_hosts": ["a:1"]})) != []
+    # key set drifted from the manifest
+    bad = _doc10(meta={"fleet": True, "fleet_hosts": ["a:1", "b:2"]},
+                 hosts={"a:1": shard})
+    assert any("does not match" in e for e in mc._check_fleet_doc(bad))
+    # non-fleet documents are not held to it
+    assert mc._check_fleet_doc(_doc10()) == []
+
+
+def test_validate_request_event_contract():
+    """`request` lifecycle events are held to the richer contract:
+    trace id, HTTP status, lane, every phase duration >= 0."""
+    ev = {"event": "request", "t": 0.1, "request_id": "rid-1",
+          "status": 200, "lane": "interactive", "admission_us": 10,
+          "queue_us": 5, "device_us": 100, "hedge_us": 0,
+          "render_us": 2, "total_us": 120}
+    assert validate_events_line(ev) == []
+    assert any("request_id" in e for e in
+               validate_events_line({**ev, "request_id": ""}))
+    assert any("status" in e for e in
+               validate_events_line({**ev, "status": "200"}))
+    assert any("lane" in e for e in
+               validate_events_line({k: v for k, v in ev.items()
+                                     if k != "lane"}))
+    assert any("device_us" in e for e in
+               validate_events_line({**ev, "device_us": -1}))
+    assert any("total_us" in e for e in
+               validate_events_line({k: v for k, v in ev.items()
+                                     if k != "total_us"}))
+    # non-request events keep the old loose contract
+    assert validate_events_line({"event": "hash_grow", "t": 1.0}) == []
+
+
+def _chrome_trace(path, events):
+    import gzip
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with gzip.open(path, "wb") as f:
+        f.write(json.dumps({"traceEvents": events}).encode())
+
+
+def test_devtrace_chrome_join_idle_unattributed(tmp_path):
+    """The midpoint join against step windows: overlapping kernels
+    union for idle, out-of-window kernels land in unattributed,
+    device-plane events count without an hlo_op arg, runtime
+    bookkeeping and the host span twin are excluded."""
+    from quorum_tpu.telemetry import devtrace
+
+    prof = str(tmp_path / "prof")
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        # two stage2 step windows
+        {"ph": "X", "name": "stage2_device", "ts": 1000.0,
+         "dur": 1000.0, "args": {"step_num": 0}},
+        {"ph": "X", "name": "stage2_device", "ts": 3000.0,
+         "dur": 500.0, "args": {"step_num": 1}},
+        # window 0: two overlapping hlo kernels + one device-plane
+        # event without args -> busy union [1100, 1400] = 300
+        {"ph": "X", "name": "fusion.1", "ts": 1100.0, "dur": 200.0,
+         "args": {"hlo_op": "fusion.1"}},
+        {"ph": "X", "name": "fusion.2", "ts": 1200.0, "dur": 200.0,
+         "args": {"hlo_op": "fusion.2"}},
+        {"ph": "X", "name": "while.1", "pid": 7, "ts": 1150.0,
+         "dur": 100.0},
+        # runtime bookkeeping on the device plane: excluded
+        {"ph": "X", "name": "ThreadpoolListener region", "pid": 7,
+         "ts": 1300.0, "dur": 500.0},
+        # window 1: one kernel
+        {"ph": "X", "name": "sort.9", "ts": 3100.0, "dur": 100.0,
+         "args": {"hlo_op": "sort.9"}},
+        # no window covers this midpoint
+        {"ph": "X", "name": "stray", "ts": 5000.0, "dur": 50.0,
+         "args": {"hlo_op": "stray"}},
+    ]
+    _chrome_trace(os.path.join(prof, "plugins", "profile", "run1",
+                               "host.trace.json.gz"), events)
+    # the HOST span twin observability() drops into the same dir
+    # must be ignored (it is not even valid JSON here)
+    with open(os.path.join(prof, "spans.trace.json"), "w") as f:
+        f.write("not json")
+    s = devtrace.summarize_profile(prof)
+    assert s.source == "trace_json" and len(s.files) == 1
+    assert len(s.steps) == 2
+    w0, w1 = sorted(s.steps, key=lambda w: w.ts_us)
+    assert w0.n_kernels == 3 and w0.kernel_us == 500.0
+    assert w0.idle_us == 1000.0 - 300.0
+    assert w1.kernel_us == 100.0 and w1.idle_us == 400.0
+    assert s.unattributed_kernel_us == 50.0
+    assert s.total_kernel_us == 650.0
+    assert s.stage_kernel_us() == {"stage2_device": 600.0}
+    top = dict(s.top_kernels(2))
+    assert top == {"fusion.1": 200.0, "fusion.2": 200.0}
+
+
+def _pb_varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _pb(fn, wt, payload):
+    key = _pb_varint((fn << 3) | wt)
+    if wt == 0:
+        return key + _pb_varint(payload)
+    return key + _pb_varint(len(payload)) + payload
+
+
+def test_devtrace_xplane_fallback(tmp_path):
+    """The no-dependency XPlane wire reader recovers steps and
+    kernels from a hand-encoded xplane.pb, and is skipped for
+    directories whose Chrome twin already parsed."""
+    from quorum_tpu.telemetry import devtrace
+
+    def meta_entry(mid, name):  # {event,stat}_metadata map entry
+        return _pb(1, 0, mid) + _pb(
+            2, 2, _pb(1, 0, mid) + _pb(2, 2, name.encode()))
+
+    def stat(mid, val):
+        return _pb(4, 2, _pb(1, 0, mid) + _pb(3, 0, val))
+
+    def event(mid, off_ps, dur_ps, stats=b""):
+        return _pb(4, 2, _pb(1, 0, mid) + _pb(2, 0, off_ps)
+                   + _pb(3, 0, dur_ps) + stats)
+
+    # metadata: event 1 = step annotation, 2 = kernel;
+    # stat 1 = step_num, 2 = hlo_op
+    plane = (_pb(2, 2, b"/host:CPU")
+             + _pb(4, 2, meta_entry(1, "stage1_insert"))
+             + _pb(4, 2, meta_entry(2, "fusion.7"))
+             + _pb(5, 2, meta_entry(1, "step_num"))
+             + _pb(5, 2, meta_entry(2, "hlo_op"))
+             + _pb(3, 2,               # one line at t=1us
+                   _pb(3, 0, 1000)
+                   # step window [1, 1001] us, step_num=4
+                   + event(1, 0, 1_000_000_000, stat(1, 4))
+                   # kernel at +100us, 50us, hlo_op stat
+                   + event(2, 100_000_000, 50_000_000, stat(2, 0))))
+    xp = str(tmp_path / "prof")
+    os.makedirs(xp)
+    with open(os.path.join(xp, "host.xplane.pb"), "wb") as f:
+        f.write(_pb(1, 2, plane))
+    s = devtrace.summarize_profile(xp)
+    assert s.source == "xplane"
+    assert len(s.steps) == 1
+    w = s.steps[0]
+    assert w.name == "stage1_insert" and w.step == 4
+    assert w.ts_us == 1.0 and w.dur_us == 1000.0
+    assert w.kernel_us == 50.0 and w.n_kernels == 1
+    assert s.kernels == {"fusion.7": 50.0}
+    # a Chrome twin in the same directory wins; the pb is skipped
+    _chrome_trace(os.path.join(xp, "host.trace.json.gz"),
+                  [{"ph": "X", "name": "other", "ts": 0.0,
+                    "dur": 10.0, "args": {"hlo_op": "other"}}])
+    s2 = devtrace.summarize_profile(xp)
+    assert s2.source == "trace_json" and len(s2.files) == 1
+    assert s2.kernels == {"other": 10.0}
+
+
+def test_record_profile_metrics_zero_surface(tmp_path):
+    """An empty --profile directory still lands the full devtrace
+    name surface (zeros) — what metrics_check requires — and the NULL
+    registry records nothing."""
+    from quorum_tpu.telemetry import devtrace
+
+    assert devtrace.record_profile_metrics(NULL, str(tmp_path)) \
+        is False
+    reg = registry_for(str(tmp_path / "m.json"))
+    assert devtrace.record_profile_metrics(reg, str(tmp_path)) is True
+    doc = reg.as_dict()
+    for n in ("device_kernel_us_total", "device_step_us_total",
+              "device_idle_us_total",
+              "device_kernel_unattributed_us_total"):
+        assert doc["counters"][n] == 0
+    assert doc["gauges"]["devtrace_steps"] == 0
+    assert doc["histograms"]["device_kernel_us"]["count"] == 0
+    assert doc["meta"]["devtrace_source"] == "none"
+
+
+class _FakeResp:
+    def __init__(self, status=200):
+        self.status = status
+
+    def read(self):
+        return b"ok"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_metrics_pusher_terminal_flush_retries(tmp_path):
+    """close() survives a receiver hiccup: failed attempts count on
+    metrics_push_failures_total, the bounded retry lands both the
+    exposition text and the final JSON document, metrics_pushed=True."""
+    from quorum_tpu.telemetry.push import MetricsPusher
+
+    calls, sleeps = [], []
+
+    def urlopen(req, timeout=None):
+        calls.append((req.full_url, req.data,
+                      dict(req.header_items())))
+        if len(calls) == 1:
+            raise OSError("connection refused")
+        return _FakeResp()
+
+    reg = MetricsRegistry()
+    pusher = MetricsPusher(reg, "http://127.0.0.1:1/push/",
+                           period_s=9999, host_id="h:1",
+                           _urlopen=urlopen, _sleep=sleeps.append)
+    ok = pusher.close(final_doc={"schema": SCHEMA_VERSION, "meta": {},
+                                 "counters": {}, "gauges": {},
+                                 "histograms": {}, "timers": {}})
+    assert ok is True
+    assert reg.counter("metrics_push_failures_total").value == 1
+    assert reg.counter("metrics_push_total").value == 1
+    assert reg.meta["metrics_pushed"] is True
+    assert sleeps == [0.25]
+    # attempt 2 = text to the base url, then the final doc to /final
+    assert calls[1][0] == "http://127.0.0.1:1/push"
+    assert calls[2][0] == "http://127.0.0.1:1/push/final"
+    assert json.loads(calls[2][1])["schema"] == SCHEMA_VERSION
+    assert calls[1][2].get("X-quorum-host") == "h:1"
+
+
+def test_metrics_pusher_gives_up_but_never_raises():
+    """A permanently-dead receiver costs counters and
+    metrics_pushed=False — never an exception."""
+    from quorum_tpu.telemetry import push as push_mod
+
+    sleeps = []
+
+    def urlopen(req, timeout=None):
+        raise OSError("down")
+
+    reg = MetricsRegistry()
+    pusher = push_mod.MetricsPusher(
+        reg, "http://127.0.0.1:1", period_s=9999,
+        _urlopen=urlopen, _sleep=sleeps.append)
+    assert pusher.close(final_doc={"x": 1}) is False
+    assert reg.meta["metrics_pushed"] is False
+    assert reg.counter("metrics_push_failures_total").value \
+        == push_mod.FINAL_ATTEMPTS
+    assert len(sleeps) == push_mod.FINAL_ATTEMPTS - 1

@@ -27,7 +27,14 @@ meta declares a resilience feature enabled (watchdog, hedging,
 reload, quotas), the feature's counter too (SERVE_FEATURE_COUNTERS).
 A document whose meta declares a checksummed database
 (`db_version >= 5`) or a verification mode (`verify_db`) must carry
-the integrity counters (INTEGRITY_COUNTERS, ISSUE 8).
+the integrity counters (INTEGRITY_COUNTERS, ISSUE 8). A document
+whose meta declares a `--profile` directory must carry the
+device-truth devtrace metrics (DEVTRACE_*, ISSUE 10); one declaring
+`metrics_push_url` must carry the push-transport counters (PUSH_*);
+and a push-receiver fleet aggregate (meta.fleet) must carry per-host
+shards matching meta.fleet_hosts. `request` lifecycle events in
+events JSONL are held to their richer contract (request_id, status,
+lane, non-negative phase durations) by the shared schema validator.
 
 `--prom` switches to linting Prometheus text exposition output
 (`--metrics-textfile` files or a saved `/metrics` scrape) through the
@@ -106,6 +113,27 @@ FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
 # verification telemetry regressed.
 INTEGRITY_COUNTERS = ("integrity_errors_total",
                       "integrity_bytes_verified_total")
+
+# The device-truth telemetry surface (ISSUE 10): a document whose
+# meta declares a `profile` directory must carry the devtrace
+# metrics — cli/observability.py parses the profiler trace post-run
+# and records them even when the directory held no readable trace
+# (value-0 counts), so a missing NAME means the devtrace recording
+# regressed, not that the profiler wrote nothing.
+DEVTRACE_COUNTERS = ("device_kernel_us_total", "device_step_us_total",
+                     "device_idle_us_total",
+                     "device_kernel_unattributed_us_total")
+DEVTRACE_GAUGES = ("devtrace_steps",)
+DEVTRACE_HISTOGRAMS = ("device_kernel_us",)
+DEVTRACE_META = ("devtrace_source",)
+
+# The push transport surface (ISSUE 10): a document whose meta
+# declares `metrics_push_url` must carry the pusher's counters (the
+# MetricsPusher creates them at start, value 0 counts) and the
+# identity stamp it writes. (`metrics_pushed` is stamped only AFTER
+# the final document lands, so the document itself cannot carry it.)
+PUSH_COUNTERS = ("metrics_push_total", "metrics_push_failures_total")
+PUSH_META = ("metrics_push_host",)
 
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
@@ -214,6 +242,69 @@ def _check_integrity_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_devtrace_names(doc: dict) -> list[str]:
+    """Devtrace-surface requirements (ISSUE 10): dispatch on
+    meta.profile — every `--profile` run records the device-kernel
+    attribution post-run, zeros included."""
+    meta = doc.get("meta", {})
+    if not meta.get("profile"):
+        return []
+    errs = []
+    why = f"meta.profile={meta.get('profile')!r}"
+    for name in DEVTRACE_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter {name!r}")
+    for name in DEVTRACE_GAUGES:
+        if name not in doc.get("gauges", {}):
+            errs.append(f"document with {why} missing gauge {name!r}")
+    for name in DEVTRACE_HISTOGRAMS:
+        if name not in doc.get("histograms", {}):
+            errs.append(f"document with {why} missing histogram "
+                        f"{name!r}")
+    for name in DEVTRACE_META:
+        if name not in meta:
+            errs.append(f"document with {why} missing meta.{name}")
+    return errs
+
+
+def _check_push_names(doc: dict) -> list[str]:
+    """Push-transport requirements (ISSUE 10): dispatch on
+    meta.metrics_push_url (the MetricsPusher stamps it at start)."""
+    meta = doc.get("meta", {})
+    if not meta.get("metrics_push_url"):
+        return []
+    errs = []
+    why = f"meta.metrics_push_url={meta.get('metrics_push_url')!r}"
+    for name in PUSH_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter {name!r}")
+    for name in PUSH_META:
+        if name not in meta:
+            errs.append(f"document with {why} missing meta.{name}")
+    return errs
+
+
+def _check_fleet_doc(doc: dict) -> list[str]:
+    """Fleet-document requirements (tools/push_receiver.py): a
+    document stamped meta.fleet must carry the per-host shards under
+    `hosts`, keyed exactly by meta.fleet_hosts — a mismatch means a
+    host's final push was dropped from the aggregate."""
+    meta = doc.get("meta", {})
+    if not meta.get("fleet"):
+        return []
+    errs = []
+    hosts = doc.get("hosts")
+    if not isinstance(hosts, dict) or not hosts:
+        return ["fleet document missing its per-host 'hosts' section"]
+    names = meta.get("fleet_hosts")
+    if not isinstance(names, list) or sorted(hosts) != sorted(
+            str(n) for n in names):
+        errs.append(
+            f"fleet document meta.fleet_hosts={names!r} does not "
+            f"match hosts keys {sorted(hosts)}")
+    return errs
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -257,6 +348,9 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_integrity_names(doc)
         problems = problems + _check_shard_names(doc)
         problems = problems + _check_hosts_doc(doc)
+        problems = problems + _check_devtrace_names(doc)
+        problems = problems + _check_push_names(doc)
+        problems = problems + _check_fleet_doc(doc)
     return problems
 
 

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Tiny stdlib push-gateway for quorum-tpu metrics (ISSUE 10): the
+receiving end of `--metrics-push-url` (quorum_tpu/telemetry/push.py).
+
+Each pushing host POSTs its Prometheus exposition text to `/push` and
+its final metrics JSON document to `/push/final`, both stamped with an
+`X-Quorum-Host` identity header. The receiver:
+
+* keeps the LATEST exposition text per host and re-serves the whole
+  fleet's at `GET /metrics` (duplicate `# TYPE` headers deduplicated),
+  so one scraper covers a fleet that cannot itself be scraped;
+* aggregates the per-host FINAL documents into one fleet document via
+  `parallel/multihost.merge_host_docs` — the exact merge rules
+  `aggregate_metrics` applies collectively (counters sum, gauges max,
+  histograms merge, job total = slowest host) — re-written atomically
+  to `--out` after every final push, with `meta.fleet` / per-host ids
+  stamped so `tools/metrics_check.py` can gate it;
+* serves the current fleet document at `GET /fleet` and liveness at
+  `GET /healthz`.
+
+Usage: python tools/push_receiver.py --port 9200 --out fleet.json
+
+The class is importable (`PushReceiver`) for tests and smoke tools;
+`--port 0` binds an ephemeral port (printed on stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from quorum_tpu.telemetry.registry import atomic_write  # noqa: E402
+
+
+def merge_fleet(docs_by_host: dict) -> dict:
+    """The fleet document: merge_host_docs over the per-host finals in
+    sorted host-id order (deterministic shard keys), stamped as a
+    pushed fleet aggregate."""
+    from quorum_tpu.parallel.multihost import merge_host_docs
+    hosts = sorted(docs_by_host)
+    merged = merge_host_docs([docs_by_host[h] for h in hosts])
+    # re-key the shards by the pushed identity (merge_host_docs keys
+    # by list position, which is meaningless here)
+    merged["hosts"] = {h: docs_by_host[h] for h in hosts}
+    merged["meta"]["fleet"] = True
+    merged["meta"]["fleet_hosts"] = hosts
+    return merged
+
+
+def _dedupe_type_lines(texts: list[str]) -> str:
+    """Concatenate per-host expositions keeping each `# TYPE` header
+    once (scrapers reject duplicates)."""
+    seen: set[str] = set()
+    out: list[str] = []
+    for text in texts:
+        for line in text.splitlines():
+            if line.startswith("# TYPE"):
+                if line in seen:
+                    continue
+                seen.add(line)
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+class PushReceiver:
+    """The aggregating HTTP listener. Thread-safe; daemon threads."""
+
+    def __init__(self, out_path: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 quiet: bool = True):
+        import http.server
+
+        self.out_path = out_path
+        self._lock = threading.Lock()
+        self._texts: dict[str, str] = {}      # host -> latest prom text
+        self._finals: dict[str, dict] = {}    # host -> final document
+        self._fleet: dict | None = None
+        self.pushes = 0
+        self.final_pushes = 0
+        self._t0 = time.perf_counter()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def _body(self) -> bytes | None:
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    n = -1
+                if n < 0 or n > 64 * 1024 * 1024:
+                    self.close_connection = True
+                    self._reply(400, b'{"error": "bad Content-Length"}\n')
+                    return None
+                return self.rfile.read(n)
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                # a bare-root --metrics-push-url maps '' -> /push and
+                # its terminal flush '/final' -> /push/final: accepting
+                # one but 404ing the other would drop every FINAL doc
+                # of a misconfigured-but-working pusher
+                route = self.path.split("?")[0].rstrip("/") or "/push"
+                if route == "/final":
+                    route = "/push/final"
+                body = self._body()
+                if body is None:
+                    return
+                hid = self.headers.get("X-Quorum-Host", "unknown")
+                if route == "/push":
+                    outer._on_text(hid, body)
+                    self._reply(200, b'{"status": "ok"}\n')
+                elif route == "/push/final":
+                    try:
+                        doc = json.loads(body.decode() or "{}")
+                        if not isinstance(doc, dict):
+                            raise ValueError("final doc must be an object")
+                    except (ValueError, UnicodeDecodeError) as e:
+                        self._reply(400, (json.dumps(
+                            {"error": str(e)}) + "\n").encode())
+                        return
+                    outer._on_final(hid, doc)
+                    self._reply(200, b'{"status": "ok"}\n')
+                else:
+                    self._reply(404, b'{"error": "not found"}\n')
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                route = self.path.split("?")[0]
+                if route == "/metrics":
+                    with outer._lock:
+                        texts = [outer._texts[h]
+                                 for h in sorted(outer._texts)]
+                    self._reply(200, _dedupe_type_lines(texts).encode(),
+                                "text/plain; version=0.0.4; "
+                                "charset=utf-8")
+                elif route == "/fleet":
+                    with outer._lock:
+                        fleet = outer._fleet
+                    if fleet is None:
+                        self._reply(404,
+                                    b'{"error": "no final pushes yet"}\n')
+                    else:
+                        self._reply(200, (json.dumps(fleet, indent=1)
+                                          + "\n").encode())
+                elif route == "/healthz":
+                    with outer._lock:
+                        body = json.dumps({
+                            "status": "ok",
+                            "uptime_s": round(
+                                time.perf_counter() - outer._t0, 3),
+                            "hosts": len(outer._texts),
+                            "final_hosts": len(outer._finals),
+                            "pushes": outer.pushes,
+                        }) + "\n"
+                    self._reply(200, body.encode())
+                else:
+                    self._reply(404, b'{"error": "not found"}\n')
+
+            def log_message(self, fmt, *args):
+                if not quiet:
+                    sys.stderr.write("push_receiver: "
+                                     + (fmt % args) + "\n")
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="quorum-push-receiver", daemon=True)
+        self._thread.start()
+
+    # -- push handling ----------------------------------------------------
+    def _on_text(self, host_id: str, body: bytes) -> None:
+        with self._lock:
+            self._texts[host_id] = body.decode(errors="replace")
+            self.pushes += 1
+
+    def _on_final(self, host_id: str, doc: dict) -> None:
+        with self._lock:
+            self._finals[host_id] = doc
+            self.final_pushes += 1
+            fleet = merge_fleet(self._finals)
+            self._fleet = fleet
+            # write INSIDE the lock: ThreadingHTTPServer handles
+            # concurrent finals, and a stale snapshot written last
+            # would silently drop the other host from the on-disk doc
+            if self.out_path:
+                atomic_write(self.out_path,
+                             json.dumps(fleet, indent=1) + "\n")
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def fleet(self) -> dict | None:
+        with self._lock:
+            return self._fleet
+
+    @property
+    def final_hosts(self) -> list[str]:
+        with self._lock:
+            return sorted(self._finals)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Aggregate quorum-tpu metric pushes "
+                    "(--metrics-push-url) into one fleet document")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="Bind address (default loopback)")
+    p.add_argument("--port", type=int, default=9200,
+                   help="Listen port (default 9200; 0 = ephemeral)")
+    p.add_argument("--out", metavar="path", default=None,
+                   help="Re-write the aggregated fleet document here "
+                        "after every final push (atomic replace)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="Log each push to stderr")
+    args = p.parse_args(argv)
+
+    rx = PushReceiver(out_path=args.out, host=args.host,
+                      port=args.port, quiet=not args.verbose)
+    print(f"push_receiver: listening on {rx.host}:{rx.port}"
+          + (f", fleet -> {args.out}" if args.out else ""), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rx.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

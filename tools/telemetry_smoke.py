@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Device-truth + push-transport smoke for CI (ISSUE 10, ci/tier1.sh).
+
+Two gates in one tool:
+
+1. **Profiled golden run**: build the mer database from the committed
+   golden reads with `--profile` + `--metrics` + `--trace-spans` AND
+   `--metrics-push-url` pointed at an in-process
+   tools/push_receiver.py. Asserts the final metrics document carries
+   the devtrace surface with real numbers (`device_kernel_us_total`
+   > 0 from the profiler's own trace — CPU traces carry `hlo_op`
+   kernel events too, which is the point of the gate), that
+   `trace_summary --device` renders the host-dispatch /
+   device-execute / device-idle attribution table, and that the
+   receiver aggregated the run's terminal push into a fleet document
+   (`meta.fleet`, written to --out-dir for metrics_check to gate).
+
+2. **Receiver outage**: a MetricsPusher pointed at a dead port must
+   fail its periodic pushes (counted, capped backoff) WITHOUT failing
+   anything else, and once a receiver comes up on that port the
+   terminal flush's bounded retry must still land the final document
+   (`metrics_pushed` meta True, the host present in the receiver's
+   fleet).
+
+Artifacts land in --out-dir:
+  telemetry_metrics.json — the profiled stage-1 document
+                           (metrics_check gates the devtrace + push
+                           names via meta.profile/metrics_push_url)
+  telemetry_fleet.json   — the receiver's aggregated fleet document
+                           (metrics_check gates meta.fleet)
+
+Exit 0 = all checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fail(msg: str) -> int:
+    print(f"[telemetry_smoke] FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Profiled golden run + push-transport smoke "
+                    "(ci/tier1.sh gate, ISSUE 10)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where telemetry_metrics.json / "
+                        "telemetry_fleet.json land (default: temp)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="telemetry_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from push_receiver import PushReceiver
+    import trace_summary
+    from quorum_tpu.cli import create_database as cdb_cli
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    db = os.path.join(out_dir, "db.jf")
+    metrics_path = os.path.join(out_dir, "telemetry_metrics.json")
+    fleet_path = os.path.join(out_dir, "telemetry_fleet.json")
+    profile_dir = os.path.join(out_dir, "profile")
+    spans_path = os.path.join(out_dir, "spans.jsonl")
+
+    # -- 1: profiled golden run, pushed to a live receiver ------------
+    rx = PushReceiver(out_path=fleet_path, port=0)
+    print(f"[telemetry_smoke] push receiver on 127.0.0.1:{rx.port}, "
+          f"building golden database with --profile -> {profile_dir}")
+    try:
+        rc = cdb_cli.main(
+            ["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+             "-o", db, "--metrics", metrics_path,
+             "--profile", profile_dir, "--trace-spans", spans_path,
+             "--metrics-push-url", f"http://127.0.0.1:{rx.port}/push",
+             "--metrics-push-interval", "0.2", reads])
+        if rc != 0:
+            return _fail(f"profiled database build rc={rc}")
+        hosts = rx.final_hosts
+        fleet = rx.fleet
+        periodic_pushes = rx.pushes
+    finally:
+        rx.close()
+
+    with open(metrics_path) as f:
+        doc = json.load(f)
+    meta = doc.get("meta", {})
+    if meta.get("devtrace_source") not in ("trace_json", "xplane"):
+        return _fail(f"devtrace_source={meta.get('devtrace_source')!r} "
+                     "(no profiler trace parsed)")
+    kernel_us = doc.get("counters", {}).get("device_kernel_us_total")
+    if not kernel_us or kernel_us <= 0:
+        return _fail(f"device_kernel_us_total={kernel_us!r} — CPU "
+                     "traces must carry kernel events too")
+    steps = doc.get("gauges", {}).get("devtrace_steps", 0)
+    if steps < 1:
+        return _fail("no step windows joined (devtrace_steps=0): the "
+                     "stage1_insert StepTraceAnnotations are missing "
+                     "from the trace")
+    stage_kernels = meta.get("devtrace_stage_kernel_us", {})
+    if "stage1_insert" not in stage_kernels:
+        return _fail(f"stage1_insert absent from per-stage kernel "
+                     f"attribution {sorted(stage_kernels)}")
+    print(f"[telemetry_smoke] devtrace: source="
+          f"{meta['devtrace_source']} kernel_us={kernel_us} "
+          f"steps={steps} stage1_insert="
+          f"{stage_kernels['stage1_insert']}us")
+
+    # the attribution table must render, with device truth > 0
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        ts_rc = trace_summary.main([spans_path, metrics_path,
+                                    "--device", profile_dir])
+    table = buf.getvalue()
+    sys.stdout.write(table)
+    if ts_rc != 0:
+        return _fail(f"trace_summary --device rc={ts_rc}")
+    if "device_execute_ms" not in table \
+            or "stage1_insert" not in table:
+        return _fail("trace_summary --device did not render the "
+                     "attribution table")
+
+    # the run's terminal push must have landed and aggregated
+    if not hosts:
+        return _fail("receiver saw no final push from the CLI")
+    if not fleet or not fleet.get("meta", {}).get("fleet"):
+        return _fail("receiver built no fleet document")
+    if not os.path.exists(fleet_path):
+        return _fail("fleet document was not written to --out")
+    # presence, not >= 1: the final doc is snapshotted BEFORE the
+    # terminal flush's own increment, so a run faster than the push
+    # period legitimately carries 0 — the receiver's view proves the
+    # periodic stream landed
+    if "metrics_push_total" not in fleet.get("counters", {}):
+        return _fail("fleet document lost the push counters")
+    # >= 2: the terminal flush itself POSTs one exposition text, so a
+    # single push proves only the flush — any beyond it had to come
+    # from the periodic loop
+    if periodic_pushes < 2:
+        return _fail("receiver saw no periodic exposition push "
+                     f"(pushes={periodic_pushes}; 1 is the terminal "
+                     "flush's own)")
+    print(f"[telemetry_smoke] push: fleet of {len(hosts)} host(s), "
+          f"{periodic_pushes} periodic push(es) -> {fleet_path}")
+
+    # -- 2: receiver outage: retry + terminal flush -------------------
+    from quorum_tpu.telemetry.push import MetricsPusher
+    from quorum_tpu.telemetry.registry import registry_for
+
+    port = _free_port()
+    reg = registry_for(None, force=True)
+    reg.set_meta(stage="outage_probe")
+    reg.counter("probe_events").inc(3)
+    pusher = MetricsPusher(reg, f"http://127.0.0.1:{port}/push",
+                           period_s=0.05)
+    deadline = time.perf_counter() + 15
+    while pusher.failures < 1:
+        if time.perf_counter() > deadline:
+            return _fail("no push failure recorded against the dead "
+                         "receiver")
+        time.sleep(0.02)
+    print(f"[telemetry_smoke] outage: {pusher.failures} failed "
+          f"push(es) against the dead port; bringing the receiver up")
+    rx2 = PushReceiver(port=port)
+    try:
+        ok = pusher.close(final_doc=reg.as_dict())
+        if not ok:
+            return _fail("terminal flush did not land after the "
+                         "receiver recovered")
+        if reg.meta.get("metrics_pushed") is not True:
+            return _fail("metrics_pushed meta not stamped True")
+        if not rx2.final_hosts:
+            return _fail("recovered receiver holds no final document")
+    finally:
+        rx2.close()
+    print("[telemetry_smoke] OK: devtrace attribution rendered, fleet "
+          "document aggregated, outage survived via retry + terminal "
+          "flush")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,18 @@ number of `--metrics` JSON documents, and prints:
     histograms — the per-batch device-time breakdown the trace
     records, folded to one table per run.
 
+`--device PROFILE_DIR` (ISSUE 10) additionally parses the
+jax.profiler trace the run wrote into that directory
+(telemetry/devtrace.py: Chrome trace primary, xplane.pb fallback) and
+prints the DEVICE-truth attribution table: per step-annotation stage,
+host dispatch time (from the metrics documents' `*_dispatch_us`
+histograms — host-observed), device-execute time (`device_kernel_us`
+summed from the profiler's own kernel events — device truth), and
+device idle inside the step windows (the device waiting on the
+host), plus the top-K kernels by device time. This is the table that
+says whether the sweep, the extension loop, or the exchange is on
+the roofline — the host dispatch/wait split alone cannot.
+
 This is the quick look a BENCH run's time budget needs; for the
 timeline view load the `.trace.json` twin in Perfetto or
 `chrome://tracing`.
@@ -102,6 +114,63 @@ def attribution(doc: dict) -> dict[str, float]:
     return out
 
 
+# step-annotation name (the StepTraceAnnotation the batch loops emit)
+# -> the dispatch/wait histogram prefix the same loop records, so the
+# --device table can put host-observed dispatch next to device truth
+_STEP_DISPATCH_PREFIX = {
+    "stage1_insert": "insert",
+    "stage2_device": "device",
+    "shard_build_step": "shard_step",
+    "serve_device": "serve",
+}
+
+
+def _hist_sum_us(docs: list[dict], name: str) -> float:
+    """Total µs recorded under histogram `name` across documents
+    (`*_us` histograms observe integer microseconds)."""
+    return sum(float(d.get("histograms", {}).get(name, {})
+                     .get("sum", 0)) for d in docs)
+
+
+def device_attribution(profile_dir: str, docs: list[dict]) -> int:
+    """The host-dispatch / device-execute / device-idle table from
+    the profiler's OWN trace (telemetry/devtrace.py), joined with the
+    metrics documents' host-observed dispatch histograms. Returns 0,
+    or 1 when the directory holds no readable trace."""
+    from quorum_tpu.telemetry import devtrace
+
+    s = devtrace.summarize_profile(profile_dir)
+    print(f"\n== device attribution: {profile_dir} "
+          f"(source {s.source}, {len(s.files)} file(s), "
+          f"{len(s.steps)} step window(s)) ==")
+    if s.source == "none":
+        print("no readable profiler trace found", file=sys.stderr)
+        return 1
+    kern = s.stage_kernel_us()
+    idle = s.stage_idle_us()
+    windows: dict[str, int] = {}
+    for w in s.steps:
+        windows[w.name] = windows.get(w.name, 0) + 1
+    print(f"{'stage':<18} {'steps':>6} {'host_dispatch_ms':>17} "
+          f"{'device_execute_ms':>18} {'device_idle_ms':>15}")
+    for name in sorted(windows):
+        prefix = _STEP_DISPATCH_PREFIX.get(name)
+        disp_us = (_hist_sum_us(docs, f"{prefix}_dispatch_us")
+                   if prefix else 0.0)
+        print(f"{name:<18} {windows[name]:>6} "
+              f"{disp_us / 1e3:>17.3f} "
+              f"{kern.get(name, 0.0) / 1e3:>18.3f} "
+              f"{idle.get(name, 0.0) / 1e3:>15.3f}")
+    print(f"device_kernel_us total: {s.total_kernel_us:.1f} "
+          f"(unattributed {s.unattributed_kernel_us:.1f}); "
+          f"step wall {s.total_step_us:.1f} us, "
+          f"idle {s.total_idle_us:.1f} us")
+    print("top kernels by device time:")
+    for name, us in s.top_kernels():
+        print(f"  {us / 1e3:>10.3f} ms  {name}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Summarize span JSONL + metrics JSON into per-"
@@ -110,6 +179,12 @@ def main(argv=None) -> int:
                    help="Span JSONL from --trace-spans")
     p.add_argument("metrics", nargs="*", metavar="METRICS.json",
                    help="Metrics documents from --metrics")
+    p.add_argument("--device", metavar="PROFILE_DIR", default=None,
+                   help="Parse the jax.profiler trace in this "
+                        "--profile directory and print the device-"
+                        "truth kernel attribution table "
+                        "(host dispatch / device execute / device "
+                        "idle per stage, top kernels)")
     args = p.parse_args(argv)
 
     try:
@@ -127,12 +202,14 @@ def main(argv=None) -> int:
         print(f"{label:<28} {calls:>6} {total:>9.3f} {mean_ms:>9.2f} "
               f"{pct:>6.1f}")
 
+    docs: list[dict] = []
     for mpath in args.metrics:
         try:
             doc = json.load(open(mpath))
         except (OSError, ValueError) as e:
             print(f"{mpath}: {e}", file=sys.stderr)
             return 1
+        docs.append(doc)
         for tname, t in doc.get("timers", {}).items():
             total = t.get("total_seconds", 0.0)
             print(f"\n== timers: {mpath} [{tname}] "
@@ -159,6 +236,8 @@ def main(argv=None) -> int:
             mean = h.get("sum", 0) / div / n if n else 0.0
             print(f"  {hname}: n={n} mean={mean:.2f} ms "
                   f"sum={h.get('sum', 0) / div / 1000.0:.3f} s")
+    if args.device:
+        return device_attribution(args.device, docs)
     return 0
 
 
