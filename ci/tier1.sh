@@ -52,6 +52,17 @@
 # document are gated through metrics_check (which requires the
 # devtrace/push names when meta declares profile/metrics_push_url).
 #
+# ISSUE 11 extends the telemetry smoke with the evaluation loop — an
+# induced pipeline stall firing (then healing) the absence alert
+# rule, a fault-plan serve burst burning the SLO in /healthz detail
+# without flipping liveness, and a quorum-autotune profile derived,
+# applied (meta.autotune_profile) and overridden by env — and adds
+# the perf-regression gate: tools/perf_diff.py judges the fresh
+# bench A/B document and the profiled telemetry stage document
+# against the committed PERF_BASELINE.json (per-metric tolerances;
+# a silently vanished metric fails like a slow one), with the
+# verdict document itself validated by metrics_check.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -59,11 +70,20 @@
 #        SKIP_BENCH_AB=1      skips the bench A/B gate.
 #        SKIP_CHAOS_SOAK=1    skips the serve-resilience chaos gate.
 #        SKIP_FSCK_SMOKE=1    skips the data-integrity fsck gate.
-#        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push gate.
+#        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push/alert gate.
+#        SKIP_PERF_DIFF=1     skips the perf-regression gate.
 set -o pipefail
 set -u
 
 cd "$(dirname "$0")/.."
+
+# hermetic lever resolution: an ambient autotune profile written by a
+# developer's quorum-autotune run (~/.cache/quorum_tpu/autotune) must
+# not steer the golden/bench runs this script judges — PERF_BASELINE
+# values were measured at the built-in defaults. Empty = profiles
+# disabled (ops/tuning); the telemetry smoke's autotune phase sets
+# its own explicit profile path over this.
+export QUORUM_AUTOTUNE_PROFILE="${QUORUM_AUTOTUNE_PROFILE:-}"
 
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
@@ -253,10 +273,45 @@ else
         echo "== metrics_check gates (telemetry) =="
         env JAX_PLATFORMS=cpu python tools/metrics_check.py \
             "$TEL_DIR/telemetry_metrics.json" \
-            "$TEL_DIR/telemetry_fleet.json" || telemetry_rc=1
+            "$TEL_DIR/telemetry_fleet.json" \
+            "$TEL_DIR/telemetry_alerts_metrics.json" \
+            "$TEL_DIR/telemetry_alerts_metrics.events.jsonl" \
+            "$TEL_DIR/telemetry_serve_metrics.json" \
+            "$TEL_DIR/telemetry_autotune_metrics.json" \
+            || telemetry_rc=1
     fi
     if [ "$telemetry_rc" -ne 0 ]; then
         echo "ci/tier1.sh: telemetry gate FAILED (rc=$telemetry_rc)" >&2
+    fi
+fi
+
+perf_rc=0
+if [ "${SKIP_PERF_DIFF:-0}" = "1" ]; then
+    echo "ci/tier1.sh: perf-diff gate skipped (SKIP_PERF_DIFF=1)"
+elif [ ! -f "${AB_DIR:-/nonexistent}/bench_ab.json" ] \
+        || [ ! -f "${TEL_DIR:-/nonexistent}/telemetry_metrics.json" ]; then
+    # the gate judges the FRESH artifacts of the bench-A/B and
+    # telemetry gates; with either skipped (or failed) there is
+    # nothing honest to judge
+    echo "ci/tier1.sh: perf-diff gate skipped (bench A/B or" \
+         "telemetry artifacts unavailable)"
+else
+    # the perf-regression gate (ISSUE 11): a throughput cliff or a
+    # silently vanished metric fails CI like a wrong byte does
+    echo "== perf-diff gate =="
+    PERF_DIR=$(mktemp -d /tmp/perf_diff.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "$PERF_DIR"' EXIT
+    env JAX_PLATFORMS=cpu python tools/perf_diff.py \
+        --baseline PERF_BASELINE.json \
+        bench_ab="$AB_DIR/bench_ab.json" \
+        stage1="$TEL_DIR/telemetry_metrics.json" \
+        --out "$PERF_DIR/perf_verdict.json" -q || perf_rc=$?
+    if [ -f "$PERF_DIR/perf_verdict.json" ]; then
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$PERF_DIR/perf_verdict.json" || perf_rc=1
+    fi
+    if [ "$perf_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: perf-diff gate FAILED (rc=$perf_rc)" >&2
     fi
 fi
 
@@ -268,4 +323,5 @@ if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
 if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
 if [ "$fsck_rc" -ne 0 ]; then exit "$fsck_rc"; fi
 if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
+if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -1,0 +1,303 @@
+"""quorum-autotune — derive the device-lever profile for this
+backend by measurement (ISSUE 11, ROADMAP item 5).
+
+Runs the round-7 in-process A/B probes (the same interleaved
+discipline as `bench.py --ab`: tunnel throughput varies 2-3x BETWEEN
+processes, so lever comparisons must happen within one) over a
+synthetic batch at the requested geometry, picks the winning settings
+for each lever, and persists them as a sealed JSON profile
+(ops/tuning.py) that every later run's lever resolution loads by
+default — explicit env vars still win. Parity is asserted in-process
+exactly as the bench does: a variant that does not produce identical
+output never becomes a default.
+
+Typical use on new hardware::
+
+    quorum-autotune                      # probe + write the backend
+                                         # profile (~/.cache/...)
+    quorum-autotune --out prof.json      # explicit path; apply with
+                                         # QUORUM_AUTOTUNE_PROFILE=prof.json
+    quorum-autotune --dry-run            # measure + report only
+
+The probe results print as BENCH-style metric lines (and land in
+`--metrics-lines PATH`), so `tools/metrics_check.py --require-metric
+autotune_stage1 --require-metric autotune_stage2` re-validates a
+freshly derived profile the same way CI validates the bench A/B
+documents.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _synth(n_reads: int, read_len: int, seed: int = 5,
+           coverage: int = 40, err_rate: float = 0.01):
+    """The bench generator's regime (bench.synth_reads, re-derived
+    here because bench.py lives outside the package): reads sampled
+    from one genome with substitution errors, so table load and
+    branch mix match real Illumina input."""
+    import numpy as np
+    genome_size = max(2 * read_len, n_reads * read_len // coverage)
+    rng = np.random.default_rng(seed)
+    genome = rng.integers(0, 4, size=genome_size, dtype=np.int8)
+    starts = rng.integers(0, genome_size - read_len, size=n_reads)
+    idx = starts[:, None] + np.arange(read_len)[None, :]
+    truth = genome[idx]
+    errs = rng.random(truth.shape) < err_rate
+    codes = np.where(errs,
+                     (truth + rng.integers(1, 4, size=truth.shape)) % 4,
+                     truth).astype(np.int8)
+    quals = np.full(codes.shape, 70, np.uint8)
+    quals[errs] = 68
+    lengths = np.full((n_reads,), read_len, np.int32)
+    return codes, quals, lengths
+
+
+def _bench_pair(fn_a, fn_b, reps: int):
+    """Interleaved min-of-reps timing (both warmed first so compiles
+    land in the persistent cache, not the measurement)."""
+    fn_a(), fn_b()
+    ta, tb = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn_a()
+        ta.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fn_b()
+        tb.append(time.perf_counter() - t0)
+    return min(ta), min(tb)
+
+
+def run_probes(n_reads: int, read_len: int, k: int,
+               reps: int) -> dict:
+    """Measure the three levers at this geometry. Returns the raw
+    numbers (seconds, parity flags) — the caller decides winners.
+    Raises RuntimeError when any variant breaks parity."""
+    import numpy as np
+
+    from ..io import packing
+    from ..models import corrector
+    from ..models.ec_config import ECConfig
+    from ..ops import ctable
+
+    codes, quals, lengths = _synth(n_reads, read_len)
+    qt = 38
+    pk1 = packing.pack_reads(codes, quals, lengths, thresholds=(qt,))
+    pk1.to_wire()
+    est = (codes.size // 40) + int(codes.size * 0.01 * k * 1.3)
+    meta = ctable.TileMeta(
+        k=k, bits=7, rb_log2=ctable.tile_rb_for(est, k, 7))
+
+    # -- stage 1: per-observation vs pre-aggregated insert ------------
+    import jax
+    tables = {}
+
+    def insert_once(agg: bool):
+        # force the lever for the probe, then RESTORE the caller's
+        # setting — an in-process embedder's explicit env override
+        # must survive the probe (cli/observability + smoke run
+        # autotune inside larger processes)
+        prev = os.environ.get("QUORUM_S1_AGGREGATE")
+        os.environ["QUORUM_S1_AGGREGATE"] = "1" if agg else "0"
+        try:
+            bstate = ctable.make_tile_build(meta)
+            bstate, full, _obs = ctable.tile_insert_reads_packed(
+                bstate, meta, pk1, qt)
+            if full:
+                raise RuntimeError("probe table filled — geometry "
+                                   "estimate too small")
+            jax.block_until_ready(bstate.tag)
+            tables[agg] = bstate
+        finally:
+            if prev is None:
+                os.environ.pop("QUORUM_S1_AGGREGATE", None)
+            else:
+                os.environ["QUORUM_S1_AGGREGATE"] = prev
+
+    s1_base_s, s1_agg_s = _bench_pair(lambda: insert_once(False),
+                                      lambda: insert_once(True), reps)
+
+    def _entries(bs):
+        return sorted(zip(*(
+            a.tolist() for a in ctable.tile_iterate(
+                ctable.tile_finalize(bs, meta), meta))))
+
+    s1_parity = _entries(tables[False]) == _entries(tables[True])
+    if not s1_parity:
+        raise RuntimeError("stage-1 aggregation parity FAILED — no "
+                           "profile written")
+
+    # -- stage 2: sweep compaction x loop draining --------------------
+    state = ctable.tile_finalize(tables[True], meta)
+    cfg = ECConfig(k=k, cutoff=4, poisson_dtype="float32")
+    pk2 = packing.pack_reads(codes, quals, lengths,
+                             thresholds=(cfg.qual_cutoff,))
+    pk2.to_wire()
+    outs = {}
+
+    def correct_once(compact: bool, drain: int):
+        _res, packed = corrector.correct_batch_packed(
+            state, meta, pk2, cfg, pack_cap=4 * n_reads,
+            compact_sweep=compact, drain_levels=drain)
+        jax.block_until_ready(packed)
+        outs[(compact, drain)] = np.asarray(packed)
+
+    base_s, sweep_s = _bench_pair(lambda: correct_once(False, 0),
+                                  lambda: correct_once(True, 0), reps)
+    b2, drain_s = _bench_pair(lambda: correct_once(False, 0),
+                              lambda: correct_once(True, 2), reps)
+    base_s = min(base_s, b2)
+    s2_parity = (np.array_equal(outs[(False, 0)], outs[(True, 0)])
+                 and np.array_equal(outs[(False, 0)], outs[(True, 2)]))
+    if not s2_parity:
+        raise RuntimeError("stage-2 lever parity FAILED — no profile "
+                           "written")
+    return {
+        "s1_base_s": s1_base_s, "s1_agg_s": s1_agg_s,
+        "s2_base_s": base_s, "s2_sweep_s": sweep_s,
+        "s2_sweep_drain_s": drain_s,
+        "parity": True,
+    }
+
+
+# a lever must beat the incumbent by this margin to flip the default:
+# min-of-reps absorbs most noise, the hysteresis absorbs the rest (a
+# 1% "win" re-measured tomorrow is a coin flip)
+WIN_MARGIN = 0.02
+
+
+def decide(measured: dict) -> dict:
+    """The winning lever settings from the probe numbers."""
+    levers = {}
+    levers["QUORUM_S1_AGGREGATE"] = (
+        "1" if measured["s1_agg_s"]
+        < measured["s1_base_s"] * (1.0 - WIN_MARGIN) else "0")
+    variants = {
+        ("0", "0"): measured["s2_base_s"],
+        ("1", "0"): measured["s2_sweep_s"],
+        ("1", "2"): measured["s2_sweep_drain_s"],
+    }
+    best = min(variants, key=variants.get)
+    if variants[best] >= measured["s2_base_s"] * (1.0 - WIN_MARGIN):
+        best = ("0", "0")  # not a real win: keep the plain loop
+    levers["QUORUM_COMPACT_SWEEP"] = best[0]
+    levers["QUORUM_DRAIN_LEVELS"] = best[1]
+    return levers
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="quorum-autotune",
+        description="Measure the device levers on this backend with "
+                    "the in-process A/B probes and persist the "
+                    "winners as a sealed profile that later runs "
+                    "load by default (env vars still win).")
+    p.add_argument("--out", metavar="path", default=None,
+                   help="Profile path (default: the per-backend file "
+                        "under QUORUM_AUTOTUNE_DIR, which lever "
+                        "resolution finds automatically; an explicit "
+                        "path is applied via "
+                        "QUORUM_AUTOTUNE_PROFILE=path)")
+    p.add_argument("--reads", type=int,
+                   default=int(os.environ.get("QUORUM_AB_READS",
+                                              "16384")),
+                   help="Probe batch rows (default 16384 or "
+                        "$QUORUM_AB_READS — match the production "
+                        "batch size: the levers trade width-"
+                        "proportional work)")
+    p.add_argument("--len", dest="read_len", type=int,
+                   default=int(os.environ.get("QUORUM_AB_LEN", "150")),
+                   help="Probe read length (default 150 or "
+                        "$QUORUM_AB_LEN)")
+    p.add_argument("-k", "--kmer-len", type=int,
+                   default=int(os.environ.get("QUORUM_AB_K", "24")),
+                   help="Probe mer length (default 24 or "
+                        "$QUORUM_AB_K)")
+    p.add_argument("--reps", type=int,
+                   default=int(os.environ.get("QUORUM_AB_REPS", "3")),
+                   help="Timing repetitions, min taken (default 3 "
+                        "or $QUORUM_AB_REPS)")
+    p.add_argument("--metrics-lines", metavar="path", default=None,
+                   help="Also write the probe metric lines here "
+                        "(BENCH-style; gate with metrics_check "
+                        "--require-metric autotune_stage1/_stage2)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="Measure and report; write nothing")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    from ..utils.jaxcache import enable_cache
+    enable_cache()
+    args = build_parser().parse_args(argv)
+    from ..utils import vlog as vlog_mod
+    vlog_mod.verbose = args.verbose or vlog_mod.verbose
+
+    import jax
+
+    from ..ops import tuning
+    from ..telemetry import metric_line
+
+    backend = tuning.backend_name()
+    geometry = {"reads": args.reads, "read_len": args.read_len,
+                "k": args.kmer_len}
+    lines = [metric_line("autotune_env", backend=backend,
+                         jax_backend=jax.default_backend(),
+                         reps=args.reps, **geometry)]
+    print(lines[-1], flush=True)
+    try:
+        measured = run_probes(args.reads, args.read_len,
+                              args.kmer_len, args.reps)
+    except RuntimeError as e:
+        print(f"quorum-autotune: {e}", file=sys.stderr)
+        return 1
+    levers = decide(measured)
+    lines.append(metric_line(
+        "autotune_stage1",
+        base_ms=round(measured["s1_base_s"] * 1e3, 1),
+        aggregated_ms=round(measured["s1_agg_s"] * 1e3, 1),
+        speedup=round(measured["s1_base_s"] / measured["s1_agg_s"], 3),
+        winner=levers["QUORUM_S1_AGGREGATE"],
+        parity="content-identical"))
+    print(lines[-1], flush=True)
+    lines.append(metric_line(
+        "autotune_stage2",
+        base_ms=round(measured["s2_base_s"] * 1e3, 1),
+        compact_sweep_ms=round(measured["s2_sweep_s"] * 1e3, 1),
+        compact_drain_ms=round(measured["s2_sweep_drain_s"] * 1e3, 1),
+        speedup_sweep=round(
+            measured["s2_base_s"] / measured["s2_sweep_s"], 3),
+        speedup_sweep_drain=round(
+            measured["s2_base_s"] / measured["s2_sweep_drain_s"], 3),
+        winner_sweep=levers["QUORUM_COMPACT_SWEEP"],
+        winner_drain=levers["QUORUM_DRAIN_LEVELS"],
+        parity="byte-identical"))
+    print(lines[-1], flush=True)
+
+    out = args.out or tuning.default_profile_path(backend)
+    if args.dry_run:
+        lines.append(metric_line("autotune_profile", written=False,
+                                 path=out, **levers))
+        print(lines[-1], flush=True)
+    else:
+        measured_rounded = {kk: round(vv, 6) if isinstance(vv, float)
+                            else vv for kk, vv in measured.items()}
+        tuning.write_profile(out, backend, geometry, levers,
+                             measured=measured_rounded)
+        lines.append(metric_line("autotune_profile", written=True,
+                                 path=out, **levers))
+        print(lines[-1], flush=True)
+    if args.metrics_lines:
+        with open(args.metrics_lines, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

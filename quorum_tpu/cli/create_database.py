@@ -161,7 +161,8 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
                        trace_spans=args.trace_spans,
                        profile=args.profile,
                        push_url=args.metrics_push_url,
-                       push_interval=args.metrics_push_interval) as obs:
+                       push_interval=args.metrics_push_interval,
+                       alert_rules=args.alert_rules) as obs:
         try:
             create_database_main(args.reads, args.output, cfg,
                                  cmdline=list(sys.argv),

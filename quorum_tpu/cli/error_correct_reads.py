@@ -179,6 +179,7 @@ def main(argv=None, db=None, prepacked=None) -> int:
         trace_spans=args.trace_spans,
         metrics_push_url=args.metrics_push_url,
         metrics_push_interval=args.metrics_push_interval,
+        alert_rules=args.alert_rules,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         on_bad_read=args.on_bad_read,

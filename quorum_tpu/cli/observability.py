@@ -75,6 +75,16 @@ def add_observability_args(p: argparse.ArgumentParser,
                         "Chrome trace_event twin, .trace.json)"
                         + (", suffixed .stage1/.stage2 per stage"
                            if driver else ""))
+    p.add_argument("--alert-rules", metavar="path", default=None,
+                   help="Alert rules JSON evaluated against the live "
+                        "registry on the heartbeat cadence "
+                        "(threshold / rate-over-window / absence / "
+                        "SLO burn-rate; merged over the built-in "
+                        "defaults by name). Firing rules land "
+                        "structured 'alert' events and "
+                        "alerts_firing{rule=} gauges"
+                        + ("; forwarded to both stages" if driver
+                           else ""))
     if not driver:
         p.add_argument("--metrics-live", action="store_true",
                        help="Force a live metrics registry even with "
@@ -103,6 +113,7 @@ class ObservabilitySession:
         self.tracer = tracer
         self.server = None  # exposition endpoint, once started
         self.pusher = None  # MetricsPusher, with --metrics-push-url
+        self.alerts = None  # AlertEngine (telemetry/alerts.py)
         self.status: str | None = None
         self._at_exit: list = []
         self._profile: str | None = None
@@ -130,6 +141,14 @@ class ObservabilitySession:
         reg = self.registry
         if not reg.enabled:
             return
+        if self.alerts is not None:
+            # stop the ticker BEFORE the final write: a closed engine
+            # never lands another event, so nothing can reopen (and
+            # truncate) the event sink after the registry closes it
+            try:
+                self.alerts.close()
+            except Exception:  # noqa: BLE001 - alerts never mask exits
+                pass
         for fn in self._at_exit:
             try:
                 fn(reg)
@@ -158,6 +177,7 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                   profile: str | None = None,
                   push_url: str | None = None,
                   push_interval: float = 0.0,
+                  alert_rules: str | None = None,
                   **meta):
     """The one observability lifecycle (ISSUE 3 satellite): registry +
     tracer up front, exposition started inside the umbrella, and a
@@ -178,6 +198,13 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     POSTs the live exposition there and terminal-flushes the final
     document on exit (telemetry/push.py) — the transport for fleets
     that cannot be scraped.
+
+    `alert_rules` (`--alert-rules`): every enabled registry gets an
+    AlertEngine (telemetry/alerts.py) — built-in rules, plus the
+    serve SLO set when meta declares stage="serve", plus the file's
+    rules (a bad file is reported loudly and counted, never fatal) —
+    attached at the heartbeat cadence and closed BEFORE the final
+    write so the document carries the end-of-run alert state.
 
     Typical shape::
 
@@ -200,9 +227,44 @@ def observability(metrics: str | None = None, interval: float = 0.0,
         # declares the devtrace surface: metrics_check requires the
         # device-kernel names whenever a document carries this
         reg.set_meta(profile=profile)
+    if reg.enabled:
+        # which autotune profile steers this run's levers (ISSUE 11):
+        # every document says where its defaults came from, and
+        # metrics_check validates the stamp
+        try:
+            from ..ops import tuning
+            ppath = tuning.active_profile_path()
+            if ppath:
+                reg.set_meta(autotune_profile=ppath)
+        except Exception:  # noqa: BLE001 - telemetry never kills runs
+            pass
     tracer = tracer_for(trace_spans)
     obs = ObservabilitySession(reg, tracer)
     obs._profile = profile
+    if reg.enabled:
+        # the alert engine (telemetry/alerts.py): built-in rules plus
+        # the serve SLO set for serve registries, overridden by the
+        # --alert-rules file. A bad file costs a loud stderr line and
+        # a counted rule error, never the run — but the defaults keep
+        # watching either way.
+        from ..telemetry import alerts as alerts_mod
+        rule_sets = [alerts_mod.DEFAULT_RULES]
+        if meta.get("stage") == "serve":
+            rule_sets.append(alerts_mod.DEFAULT_SERVE_RULES)
+        if alert_rules:
+            try:
+                rule_sets.append(alerts_mod.load_rules(alert_rules))
+                reg.set_meta(alert_rules_file=alert_rules)
+            except (OSError, ValueError) as e:
+                import sys as _sys
+                print(f"quorum-tpu: ignoring --alert-rules "
+                      f"{alert_rules}: {e}", file=_sys.stderr)
+                reg.counter("alert_rule_errors_total").inc()
+                reg.event("alert_rule_error", error=str(e))
+        obs.alerts = alerts_mod.AlertEngine(
+            reg, alerts_mod.merge_rules(*rule_sets))
+        obs.alerts.attach(period_s=(interval if interval
+                                    and interval > 0 else 5.0))
     # artifact loaders (db_format/checkpoint) run far below the entry
     # points, so the run's registry is installed ambiently for their
     # verification telemetry (integrity_errors_total / bytes-verified

@@ -41,6 +41,7 @@ def main(argv=None) -> int:
                        trace_spans=args.trace_spans,
                        push_url=args.metrics_push_url,
                        push_interval=args.metrics_push_interval,
+                       alert_rules=args.alert_rules,
                        stage="query_mer_database") as obs:
         reg, tracer = obs.registry, obs.tracer
         try:

@@ -323,7 +323,8 @@ def main(argv=None) -> int:
                                     if args.trace_spans else None),
                        profile=args.profile,
                        push_url=args.metrics_push_url,
-                       push_interval=args.metrics_push_interval) as obs:
+                       push_interval=args.metrics_push_interval,
+                       alert_rules=args.alert_rules) as obs:
         reg = obs.registry
         track_jax_compile_cache(reg)
 
@@ -466,6 +467,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         cdb_argv.extend(["--trace-spans", ts1])
     if args.metrics_textfile:
         cdb_argv.extend(["--metrics-textfile", args.metrics_textfile])
+    if args.alert_rules:
+        # each stage registry evaluates the same rule set (the
+        # driver's own registry too — its engine watches the
+        # stage_retries/push counters that live driver-side)
+        cdb_argv.extend(["--alert-rules", args.alert_rules])
     if args.metrics_port is not None:
         # the driver owns the endpoint; the stage must still run a
         # real registry so its counters appear on it
@@ -716,6 +722,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         ec_common.extend(["--trace-spans", ts2])
     if args.metrics_textfile:
         ec_common.extend(["--metrics-textfile", args.metrics_textfile])
+    if args.alert_rules:
+        ec_common.extend(["--alert-rules", args.alert_rules])
     if args.metrics_port is not None:
         ec_common.append("--metrics-live")
 
@@ -779,7 +787,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                      metrics_interval=args.metrics_interval,
                      metrics_textfile=args.metrics_textfile,
                      metrics_force=args.metrics_port is not None,
-                     trace_spans=ts2)
+                     trace_spans=ts2, alert_rules=args.alert_rules)
     kwargs = dict(no_discard=True,
                   trim_contaminant=args.trim_contaminant)
     for key, val in (("min_count", args.min_count), ("skip", args.skip),

@@ -197,6 +197,7 @@ def main(argv=None) -> int:
                        live=True, trace_spans=args.trace_spans,
                        push_url=args.metrics_push_url,
                        push_interval=args.metrics_push_interval,
+                       alert_rules=args.alert_rules,
                        stage="serve") as obs:
         try:
             rc = _serve(args, qual_cutoff, warmup_lengths, obs)
@@ -316,7 +317,8 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
         batcher, host=args.host, port=args.port,
         deadline_ms=args.deadline_ms, registry=reg,
         drain_grace_s=args.drain_grace_s, quota=quota,
-        engine_builder=None if args.no_reload else _engine_builder)
+        engine_builder=None if args.no_reload else _engine_builder,
+        alerts=getattr(obs, "alerts", None))
 
     def _sigterm(_signum, _frame):
         vlog("SIGTERM: draining")

@@ -461,10 +461,16 @@ def compact_sweep_default() -> bool:
     """Round-7 accelerator default (see ctable.accel_backend): the
     sibling sweep runs compacted (exact own-value pre-pass + candidate
     probe + c1k walk). QUORUM_COMPACT_SWEEP=1/0 forces it either way
-    (A/B escape hatch)."""
+    (A/B escape hatch); between the env var and the backend-keyed
+    guess sits the autotune profile (ops/tuning.py, ISSUE 11) — the
+    setting `quorum-autotune` measured to win on THIS backend."""
     raw = os.environ.get("QUORUM_COMPACT_SWEEP")
     if raw is not None and raw != "":
         return raw != "0"
+    from ..ops import tuning
+    prof = tuning.lever("QUORUM_COMPACT_SWEEP")
+    if prof is not None:
+        return prof != "0"
     return ctable.accel_backend()
 
 
@@ -472,11 +478,20 @@ def drain_levels_default() -> int:
     """Round-7 accelerator default (see ctable.accel_backend): the
     event-driven extension loop re-compacts live lanes to half then
     quarter width as lanes retire. QUORUM_DRAIN_LEVELS forces a level
-    count (0 = single-level loop)."""
+    count (0 = single-level loop); an autotune profile
+    (ops/tuning.py) supplies the measured count when no env forces
+    one."""
     raw = os.environ.get("QUORUM_DRAIN_LEVELS")
     if raw is not None and raw != "":
         try:
             return max(0, min(2, int(raw)))
+        except ValueError:
+            pass
+    from ..ops import tuning
+    prof = tuning.lever("QUORUM_DRAIN_LEVELS")
+    if prof is not None:
+        try:
+            return max(0, min(2, int(prof)))
         except ValueError:
             pass
     return 2 if ctable.accel_backend() else 0
@@ -1756,7 +1771,14 @@ def _batch_prologue(lengths, b: int, cfg: ECConfig, contam,
             f"Contaminant mer length ({cmeta.k}) different than correction "
             f"mer length ({cfg.k})")
     if ambig_cap is None:
-        ambig_cap = max(256, (2 * b) // 8)
+        from ..ops import tuning
+        # stall-and-retry keeps any cap bit-exact, so the ambiguous-
+        # continuation lane budget is a pure perf knob: env / autotune
+        # profile / b-derived default (ops/tuning.py, ISSUE 11). This
+        # prologue is the one resolution point every production entry
+        # (packed, unpacked, sharded) funnels through.
+        ambig_cap = max(1, int(tuning.cap("QUORUM_AMBIG_CAP",
+                                          max(256, (2 * b) // 8))))
     return uniform, cstate, cmeta, has_contam, ambig_cap
 
 

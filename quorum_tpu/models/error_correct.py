@@ -205,6 +205,9 @@ class ECOptions:
     # push-gateway (telemetry/push.py) for fleets without a scraper
     metrics_push_url: str | None = None
     metrics_push_interval: float = 0.0
+    # --alert-rules (ISSUE 11): rule file evaluated against the live
+    # registry on the heartbeat cadence (telemetry/alerts.py)
+    alert_rules: str | None = None
     # fault tolerance (ISSUE 4): with checkpoint_every > 0 the output
     # streams to <prefix>.fa/.log.partial with a resume journal
     # committed every N batches; resume=True skips already-corrected
@@ -301,6 +304,7 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                        profile=opts.profile,
                        push_url=opts.metrics_push_url,
                        push_interval=opts.metrics_push_interval,
+                       alert_rules=opts.alert_rules,
                        stage="error_correct", batch_size=opts.batch_size,
                        no_discard=bool(no_discard)) as obs:
         return _run_ec(db_path, sequences, cfg_in, opts, obs.registry,

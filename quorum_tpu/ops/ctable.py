@@ -962,17 +962,15 @@ def accel_backend() -> bool:
     when per-INDEX gather cost and width-proportional per-iteration
     cost dominate (the measured TPU regime, PERF_NOTES rounds 3-5),
     and a losing one in the CPU backend's fixed-cost regime (round-7
-    A/B). Keyed off the CONFIGURED default device first: test
-    environments pin CPU while an accelerator plugin stays registered
-    (tests/conftest.py), and default_backend() alone would misreport
+    A/B). Platform resolution lives in tuning.backend_name (the ONE
+    copy — autotune profiles are keyed by the same answer, so the
+    backend-keyed fallback and profile matching can never disagree):
+    configured default device first, because test environments pin
+    CPU while an accelerator plugin stays registered
+    (tests/conftest.py) and default_backend() alone would misreport
     them."""
-    try:
-        dev = jax.config.jax_default_device
-        if dev is not None:
-            return getattr(dev, "platform", "cpu") != "cpu"
-        return jax.default_backend() != "cpu"
-    except Exception:  # noqa: BLE001 - conservative on API drift
-        return False
+    from . import tuning
+    return tuning.backend_name() != "cpu"
 
 
 def s1_aggregate_default() -> bool:
@@ -984,22 +982,35 @@ def s1_aggregate_default() -> bool:
     round's CPU at 16k x 150, PERF_NOTES round 7; the TPU's per-index
     gather pricing only widens it), so unlike the stage-2 levers this
     defaults ON everywhere. QUORUM_S1_AGGREGATE=1/0 forces it either
-    way."""
+    way; between the env var and the built-in default sits the
+    autotune profile (ops/tuning.py, ISSUE 11) — a measured setting
+    for THIS backend beats the guess."""
     raw = os.environ.get("QUORUM_S1_AGGREGATE")
     if raw is not None and raw != "":
         return raw != "0"
+    from . import tuning
+    prof = tuning.lever("QUORUM_S1_AGGREGATE")
+    if prof is not None:
+        return prof != "0"
     return True
 
 
 def agg_cap_for(n: int) -> int | None:
     """The static distinct-mer capacity of the aggregated insert for
-    an n-observation batch (None = aggregation off). Half the batch
-    covers the measured intra-batch duplication (~2x at 40x coverage);
-    distinct mers past the cap simply report un-placed and resolve
-    through the per-observation drain path — exact-once either way."""
+    an n-observation batch (None = aggregation off). The default
+    fraction — half the batch — covers the measured intra-batch
+    duplication (~2x at 40x coverage); QUORUM_S1_AGG_CAP_FRAC (env or
+    autotune profile, ops/tuning.py) tunes it for other coverage
+    regimes. Distinct mers past the cap simply report un-placed and
+    resolve through the per-observation drain path — exact-once
+    either way."""
     if not s1_aggregate_default():
         return None
-    return min(n, max(1024, n // 2))
+    from . import tuning
+    frac = tuning.cap("QUORUM_S1_AGG_CAP_FRAC", 0.5)
+    if not 0.0 < frac <= 1.0:
+        frac = 0.5
+    return min(n, max(1024, int(n * frac)))
 
 
 def _aggregate_obs_impl(chi, clo, hq_add, lq_add, valid, cap: int):

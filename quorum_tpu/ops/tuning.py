@@ -1,0 +1,227 @@
+"""Autotune lever profiles: measured defaults instead of hardcoded
+guesses (ISSUE 11, ROADMAP item 5).
+
+The round-7 device levers (`QUORUM_COMPACT_SWEEP`,
+`QUORUM_DRAIN_LEVELS`, `QUORUM_S1_AGGREGATE`) default by a
+backend-keyed GUESS (`ctable.accel_backend()`): ON where the
+accelerator regime was measured to win, OFF on CPU. That guess is
+exactly what the in-process A/B probes (`bench.py --ab`) exist to
+replace — KMC 3 (PAPERS.md) ships resource-aware self-configuration
+as a first-class feature, deriving its bin counts and memory split
+from the machine it lands on. `quorum-autotune` (cli/autotune.py)
+runs the probes once per (backend, geometry) and persists the winning
+settings here as a SEALED JSON profile (io/integrity.seal — a
+corrupted or hand-mangled profile is ignored loudly, never silently
+applied); this module is the resolution layer the levers consult:
+
+    explicit env var  >  autotune profile  >  backend-keyed default
+
+Profile location: `QUORUM_AUTOTUNE_PROFILE` names a file explicitly
+(empty string disables profiles entirely); otherwise
+`QUORUM_AUTOTUNE_DIR` (default `~/.cache/quorum_tpu/autotune`) holds
+one profile per backend platform (`cpu.json`, `tpu.json`, ...). A
+profile recorded on a different backend is never applied. The loaded
+profile is cached per (path, mtime, size); `reset_cache()` clears it
+(tests, and long-lived processes that re-tune).
+
+`active_profile_path()` is what cli/observability.observability()
+stamps into `meta.autotune_profile`, so every metrics document says
+which profile steered its levers — and `tools/metrics_check.py`
+re-validates the claim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+PROFILE_SCHEMA = "quorum-tpu-autotune/1"
+
+# the levers a profile may pin (same spellings as the env vars that
+# force them — the profile IS a set of remembered env settings)
+LEVER_ENVS = ("QUORUM_COMPACT_SWEEP", "QUORUM_DRAIN_LEVELS",
+              "QUORUM_S1_AGGREGATE")
+# numeric caps a profile may pin (stage-2 ambiguous-continuation
+# compaction lanes; stage-1 aggregation lane fraction)
+CAP_ENVS = ("QUORUM_AMBIG_CAP", "QUORUM_S1_AGG_CAP_FRAC")
+
+_lock = threading.Lock()
+_cache: dict = {}          # path -> (stat_key, profile | None)
+_warned: set[str] = set()  # paths already complained about
+
+
+def backend_name() -> str:
+    """The platform the device work runs on — the profile key. Same
+    configured-default-device-first logic as ctable.accel_backend()
+    (test environments pin CPU while an accelerator plugin stays
+    registered)."""
+    try:
+        import jax
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return str(getattr(dev, "platform", "cpu"))
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 - conservative on API drift
+        return "cpu"
+
+
+def profile_dir() -> str:
+    return (os.environ.get("QUORUM_AUTOTUNE_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "quorum_tpu", "autotune"))
+
+
+def default_profile_path(backend: str | None = None) -> str:
+    return os.path.join(profile_dir(),
+                        f"{backend or backend_name()}.json")
+
+
+def _resolve_path() -> str | None:
+    explicit = os.environ.get("QUORUM_AUTOTUNE_PROFILE")
+    if explicit is not None:
+        return explicit or None  # "" disables profiles entirely
+    return default_profile_path()
+
+
+def _warn_once(path: str, msg: str) -> None:
+    with _lock:
+        if path in _warned:
+            return
+        _warned.add(path)
+    print(f"quorum-tpu: ignoring autotune profile {path}: {msg}",
+          file=sys.stderr)
+
+
+def load_profile(path: str | None = None) -> dict | None:
+    """The validated profile for the CURRENT backend, or None. Never
+    raises: lever resolution runs on every entry point, and a bad
+    profile must cost one stderr line, not the run."""
+    try:
+        path = path or _resolve_path()
+        if not path or not os.path.exists(path):
+            return None
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+        with _lock:
+            hit = _cache.get(path)
+            if hit is not None and hit[0] == key:
+                return hit[1]
+        prof = _load_uncached(path)
+        with _lock:
+            _cache[path] = (key, prof)
+        return prof
+    except Exception:  # noqa: BLE001 - resolution must never kill a run
+        return None
+
+
+def _load_uncached(path: str) -> dict | None:
+    from ..io import integrity
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        _warn_once(path, str(e))
+        return None
+    if not isinstance(doc, dict) \
+            or doc.get("schema") != PROFILE_SCHEMA:
+        _warn_once(path, f"not a {PROFILE_SCHEMA} document")
+        return None
+    if integrity.SEAL_FIELD not in doc:
+        # an unsealed profile is indistinguishable from a hand-edit;
+        # the autotune CLI always seals, so refuse rather than trust
+        _warn_once(path, "profile is not sealed (no crc32c field)")
+        return None
+    try:
+        integrity.check_seal(doc, "autotune profile", path)
+    except integrity.IntegrityError as e:
+        _warn_once(path, str(e))
+        return None
+    if doc.get("backend") != backend_name():
+        # a cpu-derived profile must not steer a tpu run (or vice
+        # versa) — silently quiet, not a warning: the per-backend
+        # default path makes this the common multi-backend case
+        return None
+    if not isinstance(doc.get("levers"), dict):
+        _warn_once(path, "profile carries no levers object")
+        return None
+    return doc
+
+
+def active_profile_path() -> str | None:
+    """The path of the profile that WOULD steer this run's levers
+    (valid, sealed, backend-matched) — the meta.autotune_profile
+    stamp. None when no profile applies."""
+    path = _resolve_path()
+    if path and load_profile(path) is not None:
+        return path
+    return None
+
+
+def lever(env_name: str) -> str | None:
+    """The profile's setting for one lever env (as the string the env
+    var would hold), or None when no profile applies or the profile
+    does not pin this lever. Callers check the REAL env var first —
+    an explicit env always wins."""
+    prof = load_profile()
+    if prof is None:
+        return None
+    val = prof.get("levers", {}).get(env_name)
+    return None if val is None else str(val)
+
+
+def cap(env_name: str, default: float) -> float:
+    """A numeric cap: env var wins, then the profile's `caps`, then
+    `default`. Unparseable values fall through to the next source."""
+    raw = os.environ.get(env_name)
+    if raw is not None and raw != "":
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    prof = load_profile()
+    if prof is not None:
+        val = prof.get("caps", {}).get(env_name)
+        if val is not None:
+            try:
+                return float(val)
+            except (TypeError, ValueError):
+                pass
+    return default
+
+
+def reset_cache() -> None:
+    """Forget cached profile parses and warnings (tests; a process
+    that just re-tuned)."""
+    with _lock:
+        _cache.clear()
+        _warned.clear()
+
+
+def write_profile(path: str, backend: str, geometry: dict,
+                  levers: dict, caps: dict | None = None,
+                  measured: dict | None = None) -> dict:
+    """Persist a sealed profile atomically; returns the sealed
+    document. The caller (quorum-autotune) measured `levers` as the
+    winners for (backend, geometry) — `measured` keeps the raw
+    numbers so a human (or a later re-tune) can audit the choice."""
+    from ..io import integrity
+    from ..telemetry.registry import atomic_write
+    doc = {
+        "schema": PROFILE_SCHEMA,
+        "backend": str(backend),
+        "geometry": dict(geometry),
+        "levers": {str(k): str(v) for k, v in levers.items()},
+    }
+    if caps:
+        doc["caps"] = {str(k): v for k, v in caps.items()}
+    if measured:
+        doc["measured"] = measured
+    doc = integrity.seal(doc)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    atomic_write(path, json.dumps(doc, indent=1) + "\n")
+    reset_cache()
+    return doc

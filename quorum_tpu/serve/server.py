@@ -117,7 +117,7 @@ class CorrectionServer:
     def __init__(self, batcher, host: str = "127.0.0.1", port: int = 0,
                  deadline_ms: float | None = None, registry=NULL,
                  drain_grace_s: float = 30.0, quota=None,
-                 engine_builder=None):
+                 engine_builder=None, alerts=None):
         import http.server
 
         self.batcher = batcher
@@ -126,6 +126,10 @@ class CorrectionServer:
         self.drain_grace_s = drain_grace_s
         # admission quota (serve/admission.TokenBucketQuota or None)
         self.quota = quota
+        # alert engine (telemetry/alerts.py, ISSUE 11): /healthz
+        # DETAIL only — a burning SLO needs attention, not ejection,
+        # so it never touches the liveness verdict
+        self.alerts = alerts
         # engine_builder(params: dict) -> warm engine; validates the
         # new DB before building. None = /reload answers 501.
         self.engine_builder = engine_builder
@@ -272,6 +276,15 @@ class CorrectionServer:
                       hedged=bool(req.hedged))
         self.registry.event("request", request_id=rid, status=status,
                             reads=reads, **ph)
+        if status == 200 and self.registry.enabled:
+            # the latency-SLO feed (telemetry/alerts.py): end-to-end
+            # time of SERVED requests, log-quantized so the exact-
+            # count histogram never trips its cardinality guard the
+            # way raw request_us does (failures/rejects are the
+            # availability rule's business, so only 200s count here)
+            from ..telemetry.alerts import latency_bucket_us
+            self.registry.histogram("request_e2e_bucket_us").observe(
+                latency_bucket_us(total_us))
         return ph
 
     def _handle_correct(self, handler, query: str) -> None:
@@ -486,7 +499,7 @@ class CorrectionServer:
             served = self._requests
         healthy = bool(getattr(self.batcher, "healthy", True))
         draining = self._drain_started.is_set()
-        return {
+        h = {
             # a draining replica is still healthy (it answers what it
             # admitted); an unhealthy one is NOT draining — it needs
             # ejection, not patience
@@ -503,6 +516,19 @@ class CorrectionServer:
                 self.batcher, "generation", 0)),
             "port": self.port,
         }
+        if self.alerts is not None:
+            # SLO burn + firing rules as DETAIL: the status/healthy
+            # verdict above is untouched — load balancers keep
+            # routing, operators (and the fleet receiver) see the
+            # burn (ISSUE 11)
+            try:
+                h["alerts"] = self.alerts.summary()
+                slo = self.alerts.slo_status()
+                if slo:
+                    h["slo"] = slo
+            except Exception:  # noqa: BLE001 - detail never breaks health
+                pass
+        return h
 
     def initiate_drain(self) -> None:
         """Begin graceful drain (idempotent, safe from signal

@@ -9,6 +9,8 @@ when no path is given, so instrumentation is zero-cost when disabled.
 See registry.py for the model and schema.py for the document formats.
 """
 
+from .alerts import (AlertEngine, DEFAULT_RULES, DEFAULT_SERVE_RULES,
+                     load_rules, merge_rules)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        NULL, NullRegistry, labeled,
                        observe_dispatch_wait, registry_for,
@@ -20,6 +22,8 @@ from .schema import (SCHEMA_VERSION, check_file, metric_line,
 from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
+    "AlertEngine", "DEFAULT_RULES", "DEFAULT_SERVE_RULES",
+    "load_rules", "merge_rules",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
     "NullRegistry", "labeled", "observe_dispatch_wait", "registry_for",
     "track_jax_compile_cache",

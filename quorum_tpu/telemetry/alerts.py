@@ -1,0 +1,587 @@
+"""Alert rules over the live registry: the layer that WATCHES the
+signals (ISSUE 11).
+
+PRs 1/2/10 made every layer of the pipeline report what it is doing —
+counters, heartbeats, push export, device-truth kernel attribution —
+but nothing acted on the reports: a stalled pipeline or a burning
+serve SLO looked exactly like a healthy run to everything except a
+human reading JSONL. This module closes that loop with a small
+declarative rule engine evaluated periodically against the run's own
+`MetricsRegistry`, on the same heartbeat cadence the exporters already
+use (plus a ticker thread, because a STALLED run is precisely the one
+that stops heartbeating).
+
+Rule kinds (JSON objects, loaded from `--alert-rules FILE` on top of
+built-in defaults):
+
+* ``threshold`` — compare a metric to a bound every evaluation::
+
+      {"name": "integrity_errors", "type": "threshold",
+       "metric": "counters.integrity_errors_total",
+       "op": ">", "value": 0}
+
+  Metric addresses are ``counters.NAME``, ``gauges.NAME``, or
+  ``histograms.NAME.count|sum|mean``. A metric that has not appeared
+  yet simply keeps the rule quiet (and can never crash the
+  evaluation thread — a bad address is counted in
+  ``alert_rule_errors_total`` instead of raised).
+
+* ``rate`` — the per-second increase of a counter over a sliding
+  window::
+
+      {"name": "push_failing", "type": "rate",
+       "metric": "counters.metrics_push_failures_total",
+       "window_s": 300, "op": ">", "value": 0.2}
+
+* ``absence`` — no sign of life for ``for_s`` seconds. Without a
+  ``metric`` the sign of life is the registry heartbeat itself
+  (every ``heartbeat()`` call notifies the engine through the
+  exporter hook); with one, the metric's value must CHANGE within
+  the window. This is the stalled-pipeline rule: the batch loops
+  heartbeat per batch, so a wedged device step goes quiet and the
+  ticker fires the alert mid-stall — and the next completed batch
+  heals it. Heartbeat-absence ARMS on the first beat: a registry
+  that never heartbeats at all (the quorum driver's manifest
+  registry idles while its stages do the heartbeating in their own
+  registries) is out of scope rather than a guaranteed false page
+  at ``for_s`` — its stages' engines carry the stall watch.
+
+* ``burn_rate`` — multi-window SLO burn (the Google SRE workbook
+  shape): the error ratio over each window, divided by the SLO's
+  error budget, must exceed the window's factor in EVERY window for
+  the rule to fire (long window = real burn, short window = still
+  burning). Error ratios come from counters
+  (``bad``/``total`` lists) or from a latency histogram
+  (``hist`` + ``above_us`` — use a LOW-CARDINALITY histogram like
+  the log-quantized ``request_e2e_bucket_us`` the serve layer
+  records via ``latency_bucket_us``; a raw exact-microsecond
+  histogram like ``request_us`` trips Histogram's 512-key guard and
+  its overflowed observations cannot be budget-attributed)::
+
+      {"name": "serve_slo_availability", "type": "burn_rate",
+       "objective": 0.999,
+       "bad": ["requests_failed", "requests_deadline_exceeded"],
+       "total": ["requests_completed", "requests_failed",
+                 "requests_deadline_exceeded"],
+       "windows": [[3600, 1.0], [300, 6.0]]}
+
+Firing rules land a structured ``alert`` event in the JSONL stream
+(``rule``/``state``/``value``/``detail``), flip the
+``alerts_firing{rule=...}`` gauge to 1 (back to 0 on heal — the
+gauges are pre-created at 0 so every document carries the surface),
+and count ``alerts_fired_total``. The serve layer additionally
+surfaces `summary()`/`slo_status()` in ``/healthz`` detail WITHOUT
+touching liveness: a burning SLO needs attention, not ejection.
+
+Everything here is best-effort by construction: rule evaluation never
+raises out of the engine, and a closed engine goes inert (so no event
+can land after the registry's event sink closed).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .registry import labeled
+
+# the built-in rule set every instrumented run evaluates; a
+# `--alert-rules` file overrides by name (or removes with
+# {"name": ..., "disable": true})
+DEFAULT_RULES = [
+    # no heartbeat for 5 minutes AFTER the first one: the pipeline
+    # stalled (a wedged device step, a hung producer) — the batch
+    # loops heartbeat per batch, so silence IS the signal (and a
+    # registry that never heartbeats, like the driver manifest, never
+    # arms — no false page on long multi-stage runs)
+    {"name": "pipeline_stalled", "type": "absence", "for_s": 300.0,
+     "severity": "page"},
+    # any artifact failed its digests (ISSUE 8) — never routine
+    {"name": "integrity_errors", "type": "threshold",
+     "metric": "counters.integrity_errors_total", "op": ">",
+     "value": 0, "severity": "page"},
+    # the driver is retrying stages: the run is limping
+    {"name": "stage_retries", "type": "threshold",
+     "metric": "counters.stage_retries_total", "op": ">", "value": 0,
+     "severity": "warn"},
+    # the push transport is failing faster than its retry absorbs
+    {"name": "push_failing", "type": "rate",
+     "metric": "counters.metrics_push_failures_total",
+     "window_s": 300.0, "op": ">", "value": 0.2, "severity": "warn"},
+]
+
+# the serve SLO surface (appended when meta.stage == "serve"): a
+# multi-window availability burn over the batcher's terminal-status
+# counters, and a deadline-budget burn over the request ledger's
+# end-to-end latency (ISSUE 10). The latency rule reads the
+# QUANTIZED `request_e2e_bucket_us` histogram the server records per
+# 200 (serve/server.py via latency_bucket_us below) — the exact-count
+# `request_us` histogram blows Histogram's 512-key cardinality guard
+# within a few hundred requests, after which over-budget observations
+# vanish into the "overflow" key and a rule reading it goes blind.
+DEFAULT_SERVE_RULES = [
+    # a serve replica heartbeats per served BATCH, so silence is the
+    # normal idle state, not a stall — the generic absence page would
+    # fire on every quiet replica 5 minutes after its last request.
+    # Serve health is the SLO rules' + the engine watchdog's job; a
+    # rules file can re-add an absence rule deliberately.
+    {"name": "pipeline_stalled", "disable": True},
+    {"name": "serve_slo_availability", "type": "burn_rate",
+     "objective": 0.999,
+     "bad": ["requests_failed", "requests_deadline_exceeded"],
+     "total": ["requests_completed", "requests_failed",
+               "requests_deadline_exceeded"],
+     "windows": [[3600.0, 1.0], [300.0, 6.0]], "severity": "page"},
+    {"name": "serve_slo_latency", "type": "burn_rate",
+     "objective": 0.99, "hist": "request_e2e_bucket_us",
+     "above_us": 2_000_000,
+     "windows": [[3600.0, 1.0], [300.0, 6.0]], "severity": "warn"},
+]
+
+
+def latency_bucket_us(us) -> int:
+    """Quarter-octave log quantization for latency histograms: four
+    buckets per power of two, <= ~160 distinct keys from 1 µs to
+    60 s — safely inside Histogram.MAX_KEYS, where exact-microsecond
+    values overflow within a few hundred requests. Rounds DOWN to the
+    bucket floor, so a budget comparison against the bucketed value
+    under-reports by at most one sub-bucket (~19%) — set `above_us`
+    with that margin in mind."""
+    us = int(us)
+    if us <= 4:
+        return max(us, 0)
+    base = 1 << (us.bit_length() - 1)
+    step = base >> 2
+    return base + (us - base) // step * step
+
+_RULE_TYPES = ("threshold", "rate", "absence", "burn_rate")
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+def load_rules(path: str) -> list[dict]:
+    """Parse a rules file: a JSON list of rule objects, or
+    ``{"rules": [...]}``. Raises ValueError on malformed input (the
+    CALLER decides whether that is fatal — observability() reports it
+    loudly and falls back to the defaults, because telemetry never
+    kills runs)."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and isinstance(obj.get("rules"), list):
+        obj = obj["rules"]
+    if not isinstance(obj, list):
+        raise ValueError(f"{path}: alert rules must be a JSON list "
+                         "(or {'rules': [...]})")
+    for r in obj:
+        if not isinstance(r, dict) or not r.get("name"):
+            raise ValueError(f"{path}: every rule needs a 'name'")
+    return obj
+
+
+def merge_rules(*rule_lists) -> list[dict]:
+    """Later lists override earlier ones by rule name; a rule with
+    ``disable: true`` removes the name entirely."""
+    out: dict[str, dict] = {}
+    for rules in rule_lists:
+        for r in rules or ():
+            name = str(r.get("name"))
+            if r.get("disable"):
+                out.pop(name, None)
+            else:
+                out[name] = r
+    return list(out.values())
+
+
+def _read_metric(reg, addr: str):
+    """Resolve ``counters.X`` / ``gauges.X`` / ``histograms.X.FIELD``
+    against the live registry WITHOUT creating the metric. Returns a
+    float, or None when the metric has not appeared. Raises ValueError
+    on a malformed address (counted as a rule error, not raised out
+    of evaluate)."""
+    parts = addr.split(".")
+    if len(parts) < 2:
+        raise ValueError(f"bad metric address {addr!r}")
+    kind, name = parts[0], ".".join(parts[1:])
+    if kind == "counters":
+        m = reg._counters.get(name)
+        return None if m is None else float(m.value)
+    if kind == "gauges":
+        m = reg._gauges.get(name)
+        return None if m is None else float(m.value)
+    if kind == "histograms":
+        name, _, field = name.rpartition(".")
+        if not name or field not in ("count", "sum", "mean"):
+            raise ValueError(f"bad histogram address {addr!r} "
+                             "(histograms.NAME.count|sum|mean)")
+        h = reg._hists.get(name)
+        if h is None:
+            return None
+        if field == "count":
+            return float(h.count)
+        if field == "sum":
+            return float(h.sum)
+        return float(h.sum) / h.count if h.count else 0.0
+    raise ValueError(f"bad metric address {addr!r} "
+                     "(counters.|gauges.|histograms.)")
+
+
+def _hist_above(reg, name: str, above: float) -> tuple[float, float]:
+    """(count_above, count_attributable) of an exact-count histogram
+    — the error series for latency-budget burn rules. Observations
+    that landed in the cardinality-guard "overflow" key carry no
+    value and are excluded from BOTH sides (counting them only in
+    the total would silently dilute the ratio toward zero on a
+    high-cardinality histogram); feed these rules a quantized
+    histogram (latency_bucket_us) so nothing overflows at all."""
+    h = reg._hists.get(name)
+    if h is None:
+        return 0.0, 0.0
+    with h._lock:
+        counts = dict(h.counts)
+    bad = known = 0
+    for v, n in counts.items():
+        if isinstance(v, int):
+            known += n
+            if v > above:
+                bad += n
+    return float(bad), float(known)
+
+
+class _Rule:
+    """One parsed rule plus its evaluation state."""
+
+    def __init__(self, spec: dict):
+        self.spec = dict(spec)
+        self.name = str(spec["name"])
+        self.type = spec.get("type")
+        if self.type not in _RULE_TYPES:
+            raise ValueError(f"rule {self.name!r}: unknown type "
+                             f"{self.type!r} (one of {_RULE_TYPES})")
+        self.severity = str(spec.get("severity", "warn"))
+        self.firing = False
+        self.fired_count = 0
+        self.error_reported = False
+        # sliding-window sample history: [(t, (v0, v1, ...)), ...]
+        self.samples: list[tuple[float, tuple]] = []
+        # absence bookkeeping
+        self.last_value = None
+        self.last_change: float | None = None
+        # burn-rate reporting (slo_status)
+        self.burns: dict[str, float] = {}
+        if self.type == "threshold":
+            self.metric = str(spec["metric"])
+            self.op = str(spec.get("op", ">"))
+            if self.op not in _OPS:
+                raise ValueError(f"rule {self.name!r}: bad op "
+                                 f"{self.op!r}")
+            self.value = float(spec["value"])
+        elif self.type == "rate":
+            self.metric = str(spec["metric"])
+            self.op = str(spec.get("op", ">"))
+            if self.op not in _OPS:
+                raise ValueError(f"rule {self.name!r}: bad op "
+                                 f"{self.op!r}")
+            self.value = float(spec["value"])
+            self.window_s = float(spec.get("window_s", 300.0))
+        elif self.type == "absence":
+            self.metric = spec.get("metric")
+            self.for_s = float(spec.get("for_s", 300.0))
+        else:  # burn_rate
+            objective = float(spec.get("objective", 0.999))
+            if not 0.0 < objective < 1.0:
+                raise ValueError(f"rule {self.name!r}: objective must "
+                                 "be in (0, 1)")
+            self.budget = 1.0 - objective
+            self.windows = [(float(w), float(f))
+                            for w, f in spec.get(
+                                "windows", [[3600.0, 1.0], [300.0, 6.0]])]
+            if not self.windows:
+                raise ValueError(f"rule {self.name!r}: needs windows")
+            self.hist = spec.get("hist")
+            self.above_us = float(spec.get("above_us", 0))
+            self.bad = list(spec.get("bad", ()))
+            self.total = list(spec.get("total", ()))
+            if self.hist is None and (not self.bad or not self.total):
+                raise ValueError(f"rule {self.name!r}: burn_rate "
+                                 "needs bad+total counters or "
+                                 "hist+above_us")
+
+    # -- sampling ---------------------------------------------------------
+    def _sample(self, now: float, values: tuple) -> None:
+        self.samples.append((now, values))
+        if self.type == "burn_rate":
+            longest = max(w for w, _f in self.windows)
+        else:
+            longest = self.window_s
+        cut = now - (longest * 1.25 + 1.0)
+        while len(self.samples) > 2 and self.samples[1][0] <= cut:
+            self.samples.pop(0)
+
+    def _at(self, now: float, window_s: float) -> tuple | None:
+        """The newest sample at or before now - window_s, falling back
+        to the oldest sample (burn over available history — standard
+        for engines younger than their longest window)."""
+        if not self.samples:
+            return None
+        target = now - window_s
+        best = None
+        for t, v in self.samples:
+            if t <= target:
+                best = (t, v)
+            else:
+                break
+        return best or self.samples[0]
+
+    # -- evaluation -------------------------------------------------------
+    def check(self, reg, now: float, beat_age: float):
+        """-> (firing: bool, value: float, detail: str)."""
+        if self.type == "threshold":
+            v = _read_metric(reg, self.metric)
+            if v is None:
+                return False, 0.0, "metric absent"
+            return (_OPS[self.op](v, self.value), v,
+                    f"{self.metric} {self.op} {self.value}")
+        if self.type == "rate":
+            v = _read_metric(reg, self.metric)
+            if v is None:
+                return False, 0.0, "metric absent"
+            self._sample(now, (v,))
+            prev = self._at(now, self.window_s)
+            dt = now - prev[0]
+            if dt <= 0:
+                return False, 0.0, "no history"
+            rate = (v - prev[1][0]) / dt
+            return (_OPS[self.op](rate, self.value), rate,
+                    f"d({self.metric})/dt over {self.window_s}s "
+                    f"{self.op} {self.value}/s")
+        if self.type == "absence":
+            if self.metric is None:
+                if beat_age is None:  # never armed: no beat ever seen
+                    return False, 0.0, "no heartbeat yet (unarmed)"
+                age = beat_age
+                detail = f"no heartbeat for {age:.1f}s (> {self.for_s}s)"
+            else:
+                v = _read_metric(reg, self.metric)
+                if v != self.last_value:
+                    self.last_value = v
+                    self.last_change = now
+                age = now - (self.last_change
+                             if self.last_change is not None else now)
+                detail = (f"{self.metric} unchanged for {age:.1f}s "
+                          f"(> {self.for_s}s)")
+            return age > self.for_s, age, detail
+        # burn_rate
+        if self.hist is not None:
+            bad, total = _hist_above(reg, self.hist, self.above_us)
+        else:
+            bad = sum(_read_metric(reg, f"counters.{c}") or 0.0
+                      for c in self.bad)
+            total = sum(_read_metric(reg, f"counters.{c}") or 0.0
+                        for c in self.total)
+        self._sample(now, (bad, total))
+        firing = bool(self.samples)
+        worst = 0.0
+        details = []
+        for window_s, factor in self.windows:
+            prev = self._at(now, window_s)
+            d_bad = bad - prev[1][0]
+            d_total = total - prev[1][1]
+            ratio = d_bad / d_total if d_total > 0 else 0.0
+            burn = ratio / self.budget if self.budget > 0 else 0.0
+            self.burns[f"{window_s:g}s"] = round(burn, 4)
+            worst = max(worst, burn)
+            details.append(f"{window_s:g}s burn {burn:.2f} "
+                           f"(need >= {factor:g})")
+            if burn < factor:
+                firing = False
+        return firing, worst, "; ".join(details)
+
+
+class AlertEngine:
+    """The evaluator: rules + state over ONE registry.
+
+    `attach(period_s)` wires it into the registry's exporter
+    notifications (heartbeat cadence — exporters self-rate-limit) and
+    starts the ticker daemon thread that keeps evaluating while the
+    run is silent (the absence case). `now` is injectable for
+    mocked-clock tests; the ticker is real-time and only started by
+    `attach`, so tests drive `evaluate()` directly.
+    """
+
+    def __init__(self, registry, rules: list[dict] | None = None,
+                 now=time.monotonic):
+        self.registry = registry
+        self._now = now
+        self._lock = threading.RLock()
+        self._closed = False
+        self._thread = None
+        self._stop = threading.Event()
+        self._period = 5.0
+        self._last_eval = -1e18
+        # None until the first beat: heartbeat-absence rules ARM on
+        # real activity, so a registry that never heartbeats (the
+        # driver manifest) cannot false-fire at for_s
+        self._last_beat: float | None = None
+        self.rules: list[_Rule] = []
+        bad: list[str] = []
+        for spec in (rules if rules is not None else DEFAULT_RULES):
+            try:
+                self.rules.append(_Rule(spec))
+            except (KeyError, TypeError, ValueError) as e:
+                bad.append(f"{spec.get('name', '?')}: {e}")
+        reg = registry
+        if getattr(reg, "enabled", False):
+            # the surface exists from setup, zeros included, so
+            # metrics_check can require the names whenever meta
+            # declares alert rules active
+            reg.counter("alerts_fired_total")
+            errs = reg.counter("alert_rule_errors_total")
+            for msg in bad:
+                errs.inc()
+                reg.event("alert_rule_error", error=msg)
+            reg.gauge("alert_rules_active").set(len(self.rules))
+            for rule in self.rules:
+                reg.gauge(labeled("alerts_firing",
+                                  rule=rule.name)).set(0)
+            reg.set_meta(alert_rules=[r.name for r in self.rules])
+
+    # -- liveness + cadence -----------------------------------------------
+    def beat(self) -> None:
+        """A sign of life from the run (every exporter notification —
+        i.e. every registry heartbeat — counts)."""
+        self._last_beat = self._now()
+
+    def _exporter(self, reg, final: bool = False) -> None:
+        """Registered via registry.add_exporter: called on every
+        heartbeat (rate-limited here) and once at the final write —
+        which is what heals an absence rule on a clean exit (a
+        finished run is not a stalled one)."""
+        if self._closed:
+            return
+        self.beat()
+        now = self._now()
+        if final or now - self._last_eval >= self._period:
+            self.evaluate()
+
+    def attach(self, period_s: float | None = None) -> None:
+        """Start periodic evaluation: exporter hook (heartbeat
+        cadence) plus the ticker thread that fires while the run is
+        silent."""
+        if period_s and period_s > 0:
+            self._period = float(period_s)
+        self.registry.add_exporter(self._exporter)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._tick_loop, name="quorum-alerts",
+                daemon=True)
+            self._thread.start()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self._period):
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 - never kill the ticker
+                pass
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate(self) -> list[str]:
+        """One pass over every rule; returns the names currently
+        firing. Never raises: a rule whose metric address is
+        malformed (or whose evaluation explodes) is counted in
+        `alert_rule_errors_total` once and skipped — the heartbeat
+        thread must survive any rules file."""
+        reg = self.registry
+        with self._lock:
+            if self._closed or not getattr(reg, "enabled", False):
+                return [r.name for r in self.rules if r.firing]
+            now = self._now()
+            self._last_eval = now
+            beat_age = (None if self._last_beat is None
+                        else now - self._last_beat)
+            firing: list[str] = []
+            for rule in self.rules:
+                try:
+                    cond, value, detail = rule.check(reg, now, beat_age)
+                except Exception as e:  # noqa: BLE001 - counted, not raised
+                    if not rule.error_reported:
+                        rule.error_reported = True
+                        reg.counter("alert_rule_errors_total").inc()
+                        reg.event("alert_rule_error", rule=rule.name,
+                                  error=f"{type(e).__name__}: {e}")
+                    continue
+                if cond and not rule.firing:
+                    rule.firing = True
+                    rule.fired_count += 1
+                    reg.counter("alerts_fired_total").inc()
+                    reg.gauge(labeled("alerts_firing",
+                                      rule=rule.name)).set(1)
+                    reg.event("alert", rule=rule.name, state="firing",
+                              severity=rule.severity,
+                              value=round(float(value), 6),
+                              detail=detail)
+                elif not cond and rule.firing:
+                    rule.firing = False
+                    reg.gauge(labeled("alerts_firing",
+                                      rule=rule.name)).set(0)
+                    reg.event("alert", rule=rule.name, state="healed",
+                              severity=rule.severity,
+                              value=round(float(value), 6),
+                              detail=detail)
+                if rule.firing:
+                    firing.append(rule.name)
+            return firing
+
+    # -- introspection ----------------------------------------------------
+    def summary(self) -> dict:
+        """The /healthz detail block: rule count, firing names, and
+        how many rule evaluations have errored."""
+        with self._lock:
+            return {
+                "rules": len(self.rules),
+                "firing": sorted(r.name for r in self.rules
+                                 if r.firing),
+                "fired_total": sum(r.fired_count for r in self.rules),
+                "rule_errors": sum(1 for r in self.rules
+                                   if r.error_reported),
+            }
+
+    def slo_status(self) -> dict:
+        """Per burn-rate rule: the last computed burn per window and
+        the firing flag — the serve /healthz 'slo' section. Empty
+        when no burn rules are configured."""
+        with self._lock:
+            out = {}
+            for r in self.rules:
+                if r.type != "burn_rate":
+                    continue
+                out[r.name] = {
+                    "objective": round(1.0 - r.budget, 6),
+                    "burn": dict(r.burns),
+                    "firing": r.firing,
+                }
+            return out
+
+    def close(self) -> None:
+        """Stop the ticker and run one last evaluation (so the final
+        document reflects the end-of-run state), then go inert: a
+        closed engine never lands another event — the registry's
+        event sink is about to close."""
+        if self._closed:
+            return
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._period + 2)
+        # reaching teardown is itself a sign of life: a finished run
+        # is not a stalled one, so an absence rule still firing heals
+        # in the final evaluation (threshold/burn state is untouched)
+        self.beat()
+        self.evaluate()
+        with self._lock:
+            self._closed = True

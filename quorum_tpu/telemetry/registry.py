@@ -169,6 +169,7 @@ class MetricsRegistry:
         self._hists: dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._events_f = None
+        self._events_closed = False
         self._t0 = time.perf_counter()
         self._last_beat = -1e18
         self._exporters: list = []
@@ -216,6 +217,12 @@ class MetricsRegistry:
             obj[k] = _scalar(v)
         line = json.dumps(obj) + "\n"
         with self._lock:
+            if self._events_closed:
+                # a straggler event after write() closed the sink
+                # (an alert ticker, a late exporter) must not REOPEN
+                # the path — the lazy "wb" open would truncate the
+                # stream it is trying to append to
+                return
             if self._events_f is None:
                 # line-journal discipline: an UNBUFFERED binary stream
                 # and exactly one os-level write per complete line,
@@ -302,6 +309,10 @@ class MetricsRegistry:
             if self._events_f is not None:
                 self._events_f.close()
                 self._events_f = None
+            # even an event-less run seals the sink: a straggler
+            # event after write() must not create (or truncate) the
+            # stream post-hoc
+            self._events_closed = True
         return path if doc is not None else None
 
 

@@ -137,6 +137,23 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
 REQUEST_EVENT_PHASES = ("admission_us", "queue_us", "device_us",
                         "hedge_us", "render_us", "total_us")
 
+# the alert lifecycle event (telemetry/alerts.py, ISSUE 11): one per
+# firing->healed transition of a rule
+ALERT_EVENT_STATES = ("firing", "healed")
+
+
+def _validate_alert_event(obj) -> list[str]:
+    """The `alert` event's contract on top of the generic event
+    shape: a named rule and a firing/healed state (value/detail/
+    severity ride along as ordinary scalars)."""
+    errs: list[str] = []
+    if not isinstance(obj.get("rule"), str) or not obj.get("rule"):
+        errs.append("alert event missing/empty 'rule'")
+    if obj.get("state") not in ALERT_EVENT_STATES:
+        errs.append(f"alert event 'state' must be one of "
+                    f"{ALERT_EVENT_STATES}, got {obj.get('state')!r}")
+    return errs
+
 
 def _validate_request_event(obj) -> list[str]:
     """The `request` lifecycle event's extra contract on top of the
@@ -176,6 +193,8 @@ def validate_events_line(obj) -> list[str]:
             errs.append(f"event field {k!r} is not scalar")
     if obj.get("event") == "request":
         errs.extend(_validate_request_event(obj))
+    if obj.get("event") == "alert":
+        errs.extend(_validate_alert_event(obj))
     return errs
 
 
@@ -230,6 +249,60 @@ def validate_chrome_trace(doc) -> list[str]:
     return errs
 
 
+# the perf-regression verdict document (tools/perf_diff.py, ISSUE 11)
+PERF_DIFF_SCHEMA = "quorum-tpu-perf-diff/1"
+
+
+def validate_perf_diff(doc) -> list[str]:
+    """Validate a perf_diff verdict document: verdict/checked/
+    regressions coherent, per-metric entries carrying ok flags. The
+    verdict must AGREE with the regression list — a 'pass' document
+    listing regressions (or vice versa) means the gate's output was
+    hand-altered or the tool broke."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["perf-diff document is not a JSON object"]
+    if doc.get("schema") != PERF_DIFF_SCHEMA:
+        errs.append(f"schema is {doc.get('schema')!r}, expected "
+                    f"{PERF_DIFF_SCHEMA!r}")
+    if doc.get("verdict") not in ("pass", "regression"):
+        errs.append(f"verdict must be pass|regression, got "
+                    f"{doc.get('verdict')!r}")
+    regs = doc.get("regressions")
+    if not isinstance(regs, list) or not all(
+            isinstance(r, str) for r in regs):
+        errs.append("regressions must be a list of strings")
+        regs = []
+    if not isinstance(doc.get("checked"), int) \
+            or isinstance(doc.get("checked"), bool) \
+            or doc.get("checked", -1) < 0:
+        errs.append("checked must be a non-negative int")
+    if doc.get("verdict") == "pass" and regs:
+        errs.append("verdict 'pass' but regressions listed")
+    if doc.get("verdict") == "regression" and not regs:
+        errs.append("verdict 'regression' with no regressions listed")
+    docs = doc.get("docs")
+    if not isinstance(docs, dict):
+        errs.append("missing/non-object 'docs' section")
+        return errs
+    n_bad = 0
+    for dk, dv in docs.items():
+        if not isinstance(dv, dict):
+            errs.append(f"docs[{dk!r}] is not an object")
+            continue
+        for mk, mv in dv.get("metrics", {}).items():
+            if not isinstance(mv, dict) or not isinstance(
+                    mv.get("ok"), bool):
+                errs.append(f"docs[{dk!r}].metrics[{mk!r}] needs a "
+                            "boolean 'ok'")
+            elif not mv["ok"]:
+                n_bad += 1
+    if doc.get("verdict") == "pass" and n_bad:
+        errs.append(f"verdict 'pass' but {n_bad} metric entr"
+                    f"{'y' if n_bad == 1 else 'ies'} report ok=false")
+    return errs
+
+
 def validate_bench_line(obj) -> list[str]:
     """Validate one parsed bench-style metric line (the `metric_line`
     output format: `metric` (str) plus scalar fields)."""
@@ -260,6 +333,8 @@ def check_file(path: str) -> list[str]:
         doc = json.loads(text)
     except ValueError:
         doc = None
+    if isinstance(doc, dict) and doc.get("schema") == PERF_DIFF_SCHEMA:
+        return validate_perf_diff(doc)
     if (isinstance(doc, dict)
             and ("schema" in doc or "counters" in doc)
             and "metric" not in doc and "event" not in doc):

@@ -29,6 +29,13 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 jax.config.update("jax_compilation_cache_dir",
                   "/tmp/quorum_tpu_test_jaxcache")
 
+# Hermetic lever resolution (ISSUE 11): an ambient autotune profile
+# in ~/.cache/quorum_tpu/autotune would silently steer the round-7
+# lever defaults (and stamp meta.autotune_profile into golden
+# documents) machine-by-machine. Empty = profiles disabled
+# (ops/tuning); tests that exercise profiles set their own paths.
+os.environ.setdefault("QUORUM_AUTOTUNE_PROFILE", "")
+
 import pytest  # noqa: E402
 
 _last_module = [None]

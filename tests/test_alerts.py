@@ -1,0 +1,491 @@
+"""Alert rule engine (telemetry/alerts.py, ISSUE 11): rule-kind
+semantics under a mocked clock, firing/healed transitions, the
+heartbeat-thread survival guarantee, the serve /healthz detail, and
+the metrics_check/schema surface for alert artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from quorum_tpu.telemetry import alerts, registry_for
+from quorum_tpu.telemetry.alerts import AlertEngine
+from quorum_tpu.telemetry.schema import (check_file,
+                                         validate_events_line)
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+METRICS_CHECK = os.path.join(REPO, "tools", "metrics_check.py")
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_engine(rules, tmp_path=None, events=False):
+    ev = str(tmp_path / "ev.jsonl") if events else None
+    reg = registry_for(None, events_path=ev, force=True)
+    clk = Clock()
+    return reg, clk, AlertEngine(reg, rules, now=clk)
+
+
+# ---------------------------------------------------------------------------
+# rule kinds
+# ---------------------------------------------------------------------------
+
+def test_threshold_fire_and_heal_transitions(tmp_path):
+    reg, clk, eng = make_engine(
+        [{"name": "deep", "type": "threshold",
+          "metric": "gauges.depth", "op": ">", "value": 3}],
+        tmp_path, events=True)
+    assert eng.evaluate() == []  # metric absent: quiet, no error
+    reg.gauge("depth").set(10)
+    assert eng.evaluate() == ["deep"]
+    assert eng.evaluate() == ["deep"]  # still firing: ONE event only
+    reg.gauge("depth").set(1)
+    assert eng.evaluate() == []
+    assert reg.counter("alerts_fired_total").value == 1
+    states = [json.loads(line) for line in
+              open(tmp_path / "ev.jsonl")]
+    alert_ev = [e for e in states if e["event"] == "alert"]
+    assert [e["state"] for e in alert_ev] == ["firing", "healed"]
+    assert all(e["rule"] == "deep" for e in alert_ev)
+    assert all(validate_events_line(e) == [] for e in alert_ev)
+    # the labeled gauge tracked the transitions
+    assert reg.gauge('alerts_firing{rule="deep"}').value == 0
+
+
+def test_absence_rule_on_dead_heartbeat(tmp_path):
+    reg, clk, eng = make_engine(
+        [{"name": "stalled", "type": "absence", "for_s": 5.0}])
+    # UNARMED: a registry that never heartbeats (the quorum driver's
+    # manifest registry idles while its stages heartbeat their own)
+    # must never false-page, however long it runs
+    assert eng.evaluate() == []
+    clk.advance(1000.0)
+    assert eng.evaluate() == []
+    eng.beat()  # first real activity arms the rule
+    clk.advance(4.0)
+    eng.beat()
+    assert eng.evaluate() == []  # beat within the window
+    clk.advance(5.5)  # silence past for_s
+    assert eng.evaluate() == ["stalled"]
+    eng.beat()  # the batch finally lands
+    assert eng.evaluate() == []
+    assert reg.gauge('alerts_firing{rule="stalled"}').value == 0
+
+
+def test_absence_rule_on_unchanging_metric():
+    reg, clk, eng = make_engine(
+        [{"name": "quiet", "type": "absence",
+          "metric": "counters.batches", "for_s": 3.0}])
+    reg.counter("batches").inc()
+    eng.beat()
+    assert eng.evaluate() == []
+    clk.advance(2.0)
+    reg.counter("batches").inc()  # progress
+    eng.beat()
+    assert eng.evaluate() == []
+    clk.advance(4.0)  # no progress, even though heartbeats continue
+    eng.beat()
+    assert eng.evaluate() == ["quiet"]
+    reg.counter("batches").inc()
+    assert eng.evaluate() == []
+
+
+def test_rate_rule_over_window():
+    reg, clk, eng = make_engine(
+        [{"name": "failing", "type": "rate",
+          "metric": "counters.fails", "window_s": 10.0,
+          "op": ">", "value": 1.0}])
+    reg.counter("fails")
+    assert eng.evaluate() == []
+    for _ in range(10):  # 0.5/s: under the threshold
+        clk.advance(2.0)
+        reg.counter("fails").inc(1)
+        assert eng.evaluate() == []
+    for _ in range(5):  # 5/s: over it
+        clk.advance(1.0)
+        reg.counter("fails").inc(5)
+    assert eng.evaluate() == ["failing"]
+    for _ in range(15):  # flat again: the window rolls over and heals
+        clk.advance(1.0)
+        eng.evaluate()
+    assert eng.evaluate() == []
+
+
+def test_burn_rate_multi_window_and_rollover():
+    reg, clk, eng = make_engine(
+        [{"name": "slo", "type": "burn_rate", "objective": 0.9,
+          "bad": ["bad"], "total": ["good", "bad"],
+          "windows": [[60.0, 1.0], [10.0, 1.0]]}])
+    good, bad = reg.counter("good"), reg.counter("bad")
+    good.inc(100)
+    for _ in range(30):  # healthy traffic
+        clk.advance(1.0)
+        good.inc(10)
+        assert eng.evaluate() == []
+    for _ in range(12):  # 100% failures: both windows burn
+        clk.advance(1.0)
+        bad.inc(10)
+    assert eng.evaluate() == ["slo"]
+    status = eng.slo_status()["slo"]
+    assert status["firing"] is True
+    assert status["burn"]["10s"] >= 1.0
+    assert status["burn"]["60s"] >= 1.0
+    # recovery: the SHORT window heals first (rollover), which is
+    # enough to stop firing under the all-windows rule
+    for _ in range(12):
+        clk.advance(1.0)
+        good.inc(10)
+        eng.evaluate()
+    assert eng.evaluate() == []
+    assert eng.slo_status()["slo"]["burn"]["10s"] < 1.0
+
+
+def test_burn_rate_from_latency_histogram():
+    reg, clk, eng = make_engine(
+        [{"name": "lat", "type": "burn_rate", "objective": 0.5,
+          "hist": "request_us", "above_us": 1000,
+          "windows": [[10.0, 1.0]]}])
+    h = reg.histogram("request_us")
+    for _ in range(10):
+        h.observe(100)
+    clk.advance(1.0)
+    assert eng.evaluate() == []
+    for _ in range(20):  # every request blows the budget
+        h.observe(50_000)
+    clk.advance(1.0)
+    assert eng.evaluate() == ["lat"]
+
+
+def test_latency_bucket_quantization_bounds_cardinality():
+    """The serve latency-SLO feed: quantized buckets must stay well
+    under Histogram.MAX_KEYS across the full latency range (raw
+    request_us overflows within a few hundred requests, blinding any
+    rule that reads it), round DOWN, and stay monotonic."""
+    from quorum_tpu.telemetry.registry import Histogram
+    keys = set()
+    prev = -1
+    for us in range(0, 60_000_000, 997):  # 0..60s, awkward stride
+        b = alerts.latency_bucket_us(us)
+        assert b <= us or us <= 4
+        assert b >= prev  # monotonic in the observed value
+        prev = b
+        keys.add(b)
+    assert len(keys) < Histogram.MAX_KEYS // 2
+    # floor error bounded by one quarter-octave (~25% worst case)
+    for us in (1000, 5000, 123_456, 2_000_001, 59_999_999):
+        b = alerts.latency_bucket_us(us)
+        assert b <= us < b * 1.26
+
+
+def test_no_traffic_is_not_a_burn():
+    reg, clk, eng = make_engine(
+        [{"name": "slo", "type": "burn_rate", "objective": 0.99,
+          "bad": ["bad"], "total": ["good", "bad"],
+          "windows": [[10.0, 1.0]]}])
+    for _ in range(30):
+        clk.advance(1.0)
+        assert eng.evaluate() == []  # zero traffic: burn 0, not NaN
+
+
+# ---------------------------------------------------------------------------
+# robustness: bad rules must not take down the evaluation thread
+# ---------------------------------------------------------------------------
+
+def test_missing_metric_never_crashes_and_bad_address_counts_once(
+        tmp_path):
+    reg, clk, eng = make_engine(
+        [{"name": "ok_rule", "type": "threshold",
+          "metric": "counters.never_appears", "op": ">", "value": 0},
+         {"name": "bad_addr", "type": "threshold",
+          "metric": "nodots", "op": ">", "value": 0}],
+        tmp_path, events=True)
+    for _ in range(5):
+        clk.advance(1.0)
+        assert eng.evaluate() == []  # never raises
+    # the malformed address errored ONCE; the absent metric is fine
+    assert reg.counter("alert_rule_errors_total").value == 1
+    errs = [json.loads(line) for line in open(tmp_path / "ev.jsonl")
+            if json.loads(line)["event"] == "alert_rule_error"]
+    assert len(errs) == 1 and errs[0]["rule"] == "bad_addr"
+
+
+def test_malformed_rule_spec_counted_at_construction():
+    reg = registry_for(None, force=True)
+    eng = AlertEngine(reg, [
+        {"name": "good", "type": "threshold",
+         "metric": "gauges.x", "op": ">", "value": 1},
+        {"name": "nope", "type": "wibble"},
+        {"name": "noop", "type": "threshold"},  # missing metric/value
+    ], now=Clock())
+    assert len(eng.rules) == 1
+    assert reg.counter("alert_rule_errors_total").value == 2
+    assert reg.meta["alert_rules"] == ["good"]
+
+
+def test_evaluate_from_heartbeat_thread_survives_everything(tmp_path):
+    """The exporter hook runs inside registry.heartbeat() on pipeline
+    threads: an evaluation raising there would kill the run. Drive it
+    through the REAL hook with a hostile rule set."""
+    ev = str(tmp_path / "ev.jsonl")
+    reg = registry_for(None, events_path=ev, force=True)
+    eng = AlertEngine(reg, [
+        {"name": "bad", "type": "threshold", "metric": "x",
+         "op": ">", "value": 0},
+        {"name": "burn", "type": "burn_rate", "objective": 0.9,
+         "bad": ["b"], "total": ["t"], "windows": [[5.0, 1.0]]},
+    ])
+    eng.attach(period_s=0.001)  # evaluate on ~every notification
+    errors = []
+
+    def beat_many():
+        try:
+            for _ in range(50):
+                reg.heartbeat(reads=1)
+        except Exception as e:  # noqa: BLE001 - the failure under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=beat_many) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.close()
+    assert errors == []
+
+
+def test_ticker_fires_while_registry_is_silent(tmp_path):
+    """The stalled-pipeline case end to end, real time: after ONE
+    heartbeat (arming), the run goes silent — the ticker thread
+    alone must fire the absence rule (the stalled loop will never
+    notify the engine itself)."""
+    import time as _time
+    ev = str(tmp_path / "ev.jsonl")
+    reg = registry_for(None, events_path=ev, force=True)
+    eng = AlertEngine(reg, [{"name": "stalled", "type": "absence",
+                             "for_s": 0.15}])
+    eng.attach(period_s=0.05)
+    reg.heartbeat(reads=1)  # batch 1 lands, then the pipeline wedges
+    deadline = _time.monotonic() + 5.0
+    try:
+        while _time.monotonic() < deadline:
+            if reg.gauge('alerts_firing{rule="stalled"}').value == 1:
+                break
+            _time.sleep(0.02)
+        assert reg.gauge('alerts_firing{rule="stalled"}').value == 1
+    finally:
+        eng.close()
+    # close() counts as life: the final state healed
+    assert reg.gauge('alerts_firing{rule="stalled"}').value == 0
+
+
+def test_closed_engine_is_inert(tmp_path):
+    reg, clk, eng = make_engine(
+        [{"name": "deep", "type": "threshold",
+          "metric": "gauges.depth", "op": ">", "value": 0}],
+        tmp_path, events=True)
+    eng.close()
+    reg.gauge("depth").set(5)
+    assert eng.evaluate() == []  # no state change after close
+    reg.heartbeat()  # exporter no-op
+    assert reg.counter("alerts_fired_total").value == 0
+
+
+# ---------------------------------------------------------------------------
+# rule loading / merging
+# ---------------------------------------------------------------------------
+
+def test_load_and_merge_rules(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({"rules": [
+        {"name": "pipeline_stalled", "type": "absence", "for_s": 1.0},
+        {"name": "push_failing", "disable": True},
+        {"name": "mine", "type": "threshold", "metric": "gauges.g",
+         "op": ">", "value": 1},
+    ]}))
+    merged = alerts.merge_rules(alerts.DEFAULT_RULES,
+                                alerts.load_rules(str(p)))
+    by_name = {r["name"]: r for r in merged}
+    assert by_name["pipeline_stalled"]["for_s"] == 1.0  # overridden
+    assert "push_failing" not in by_name                # disabled
+    assert "mine" in by_name                            # added
+    assert "integrity_errors" in by_name                # default kept
+
+
+def test_load_rules_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{\"rules\": 3}")
+    with pytest.raises(ValueError):
+        alerts.load_rules(str(p))
+    p.write_text(json.dumps([{"type": "absence"}]))  # no name
+    with pytest.raises(ValueError):
+        alerts.load_rules(str(p))
+
+
+def test_observability_survives_bad_rules_file(tmp_path):
+    """A typo'd --alert-rules file must cost a warning + a counted
+    rule error, never the run: the built-in defaults keep watching."""
+    from quorum_tpu.cli.observability import observability
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all")
+    mp = tmp_path / "m.json"
+    with observability(str(mp), alert_rules=str(bad),
+                       stage="test") as obs:
+        assert obs.alerts is not None
+        assert len(obs.alerts.rules) == len(alerts.DEFAULT_RULES)
+    doc = json.load(open(mp))
+    assert doc["counters"]["alert_rule_errors_total"] >= 1
+    assert doc["meta"]["alert_rules"]  # defaults active
+    assert "alert_rules_file" not in doc["meta"]
+
+
+# ---------------------------------------------------------------------------
+# serve /healthz detail
+# ---------------------------------------------------------------------------
+
+def test_serve_health_carries_alert_detail_without_liveness():
+    from quorum_tpu.serve.server import CorrectionServer
+
+    class FakeEngine:
+        compiles = 0
+
+    class FakeBatcher:
+        healthy = True
+        depth = 0
+        consecutive_failures = 0
+        generation = 0
+        engine = FakeEngine()
+
+        def drain(self, timeout=None):
+            return True
+
+    reg = registry_for(None, force=True)
+    clk = Clock()
+    eng = AlertEngine(reg, [
+        {"name": "serve_slo_availability", "type": "burn_rate",
+         "objective": 0.9, "bad": ["requests_failed"],
+         "total": ["requests_completed", "requests_failed"],
+         "windows": [[10.0, 1.0]]}], now=clk)
+    srv = CorrectionServer(FakeBatcher(), port=0, registry=reg,
+                           alerts=eng)
+    try:
+        reg.counter("requests_completed").inc(1)
+        clk.advance(1.0)
+        eng.evaluate()
+        h = srv.health()
+        assert h["status"] == "ok" and h["healthy"]
+        assert h["alerts"]["firing"] == []
+        assert h["slo"]["serve_slo_availability"]["firing"] is False
+        # burn the budget: every request fails
+        for _ in range(5):
+            clk.advance(1.0)
+            reg.counter("requests_failed").inc(10)
+            eng.evaluate()
+        h = srv.health()
+        assert h["slo"]["serve_slo_availability"]["firing"] is True
+        assert "serve_slo_availability" in h["alerts"]["firing"]
+        # the whole point: liveness is untouched
+        assert h["status"] == "ok" and h["healthy"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics_check / schema surface (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def _doc(**over):
+    doc = {"schema": "quorum-tpu-metrics/1",
+           "meta": {"alert_rules": ["a", "b"]},
+           "counters": {"alerts_fired_total": 1,
+                        "alert_rule_errors_total": 0},
+           "gauges": {"alert_rules_active": 2,
+                      'alerts_firing{rule="a"}': 1,
+                      'alerts_firing{rule="b"}': 0},
+           "histograms": {}, "timers": {}}
+    doc.update(over)
+    return doc
+
+
+def test_metrics_check_requires_alert_surface(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_check
+    finally:
+        sys.path.pop(0)
+    assert metrics_check._check_alert_names(_doc()) == []
+    # no alert_rules declared -> nothing required
+    assert metrics_check._check_alert_names(
+        _doc(meta={})) == []
+    # declared but counters dropped -> loud
+    bad = _doc(counters={})
+    errs = metrics_check._check_alert_names(bad)
+    assert any("alerts_fired_total" in e for e in errs)
+    # firing gauge out of range / naming an undeclared rule
+    errs = metrics_check._check_alert_names(
+        _doc(gauges={"alert_rules_active": 2,
+                     'alerts_firing{rule="a"}': 7}))
+    assert any("0 or 1" in e for e in errs)
+    errs = metrics_check._check_alert_names(
+        _doc(gauges={"alert_rules_active": 2,
+                     'alerts_firing{rule="zz"}': 0}))
+    assert any("not in meta.alert_rules" in e for e in errs)
+
+
+def test_metrics_check_autotune_meta(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_check
+    finally:
+        sys.path.pop(0)
+    ok = _doc(meta={"autotune_profile": "/x/cpu.json"})
+    assert metrics_check._check_autotune_meta(ok) == []
+    assert metrics_check._check_autotune_meta(_doc(meta={})) == []
+    bad = _doc(meta={"autotune_profile": ""})
+    assert metrics_check._check_autotune_meta(bad) != []
+
+
+def test_alert_event_schema():
+    good = {"event": "alert", "t": 1.0, "rule": "r",
+            "state": "firing", "value": 1.5, "detail": "x"}
+    assert validate_events_line(good) == []
+    assert validate_events_line(
+        {**good, "state": "wat"}) != []
+    assert validate_events_line(
+        {"event": "alert", "t": 1.0, "state": "firing"}) != []
+
+
+def test_metrics_check_cli_accepts_alerting_run_artifacts(tmp_path):
+    """End to end through the tool: a document + events stream from a
+    real engine run validate clean."""
+    ev = str(tmp_path / "run.events.jsonl")
+    mp = str(tmp_path / "run.json")
+    reg = registry_for(mp, events_path=ev, force=True)
+    clk = Clock()
+    eng = AlertEngine(reg, [{"name": "g", "type": "threshold",
+                             "metric": "gauges.v", "op": ">",
+                             "value": 1}], now=clk)
+    reg.gauge("v").set(5)
+    eng.evaluate()
+    reg.gauge("v").set(0)
+    eng.evaluate()
+    eng.close()
+    reg.set_meta(status="ok")
+    reg.write()
+    res = subprocess.run(
+        [sys.executable, METRICS_CHECK, mp, ev],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert check_file(mp) == [] and check_file(ev) == []

@@ -1,0 +1,192 @@
+"""Autotune profiles (ops/tuning.py + quorum-autotune, ISSUE 11):
+sealed-profile round trip, the env > profile > default resolution
+order at every lever, tamper/backend refusal, the winner-decision
+hysteresis, and the meta.autotune_profile stamp."""
+
+import json
+import os
+
+import pytest
+
+from quorum_tpu.cli import autotune
+from quorum_tpu.models import corrector
+from quorum_tpu.ops import ctable, tuning
+
+
+@pytest.fixture(autouse=True)
+def clean_tuning(monkeypatch, tmp_path):
+    """Isolate every test from ambient profiles: point the profile
+    dir at an empty tmp dir and clear the parse cache around each
+    test."""
+    monkeypatch.delenv("QUORUM_AUTOTUNE_PROFILE", raising=False)
+    monkeypatch.setenv("QUORUM_AUTOTUNE_DIR", str(tmp_path / "prof"))
+    for env in tuning.LEVER_ENVS + tuning.CAP_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    tuning.reset_cache()
+    yield
+    tuning.reset_cache()
+
+
+def write(tmp_path, levers, backend=None, caps=None, name="p.json"):
+    path = str(tmp_path / name)
+    tuning.write_profile(path, backend or tuning.backend_name(),
+                         {"reads": 64, "read_len": 32, "k": 13},
+                         levers, caps=caps)
+    return path
+
+
+def test_profile_round_trip_and_resolution_order(tmp_path,
+                                                 monkeypatch):
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "0",
+                            "QUORUM_COMPACT_SWEEP": "1",
+                            "QUORUM_DRAIN_LEVELS": "1"},
+                 caps={"QUORUM_AMBIG_CAP": 512,
+                       "QUORUM_S1_AGG_CAP_FRAC": 0.25})
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert tuning.active_profile_path() == path
+    # profile steers every lever...
+    assert ctable.s1_aggregate_default() is False
+    assert corrector.compact_sweep_default() is True
+    assert corrector.drain_levels_default() == 1
+    assert tuning.cap("QUORUM_AMBIG_CAP", 99) == 512.0
+    # ...but an explicit env var ALWAYS wins
+    monkeypatch.setenv("QUORUM_S1_AGGREGATE", "1")
+    monkeypatch.setenv("QUORUM_COMPACT_SWEEP", "0")
+    monkeypatch.setenv("QUORUM_DRAIN_LEVELS", "2")
+    monkeypatch.setenv("QUORUM_AMBIG_CAP", "64")
+    assert ctable.s1_aggregate_default() is True
+    assert corrector.compact_sweep_default() is False
+    assert corrector.drain_levels_default() == 2
+    assert tuning.cap("QUORUM_AMBIG_CAP", 99) == 64.0
+
+
+def test_no_profile_keeps_backend_keyed_defaults():
+    assert tuning.active_profile_path() is None
+    assert ctable.s1_aggregate_default() is True
+    # CPU test environment: stage-2 levers default off
+    assert corrector.compact_sweep_default() is \
+        ctable.accel_backend()
+
+
+def test_agg_cap_fraction_steers_capacity(monkeypatch, tmp_path):
+    assert ctable.agg_cap_for(65536) == 32768  # default half
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "1"},
+                 caps={"QUORUM_S1_AGG_CAP_FRAC": 0.25})
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert ctable.agg_cap_for(65536) == 16384
+    monkeypatch.setenv("QUORUM_S1_AGG_CAP_FRAC", "1.0")
+    assert ctable.agg_cap_for(65536) == 65536
+    monkeypatch.setenv("QUORUM_S1_AGG_CAP_FRAC", "7.0")  # nonsense
+    assert ctable.agg_cap_for(65536) == 32768  # clamped to default
+
+
+def test_tampered_profile_is_refused(tmp_path, monkeypatch,
+                                     capsys):
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "0"})
+    doc = json.load(open(path))
+    doc["levers"]["QUORUM_S1_AGGREGATE"] = "1"  # hand edit
+    json.dump(doc, open(path, "w"))
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert tuning.load_profile() is None
+    assert tuning.active_profile_path() is None
+    assert ctable.s1_aggregate_default() is True  # built-in default
+    assert "failed its header self-digest" in capsys.readouterr().err
+
+
+def test_unsealed_profile_is_refused(tmp_path, monkeypatch):
+    path = str(tmp_path / "unsealed.json")
+    json.dump({"schema": tuning.PROFILE_SCHEMA,
+               "backend": tuning.backend_name(),
+               "levers": {"QUORUM_S1_AGGREGATE": "0"}},
+              open(path, "w"))
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert tuning.load_profile() is None
+
+
+def test_foreign_backend_profile_never_applies(tmp_path,
+                                               monkeypatch):
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "0"},
+                 backend="tpu-imaginary")
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert tuning.load_profile() is None
+    assert ctable.s1_aggregate_default() is True
+
+
+def test_empty_env_disables_profiles(tmp_path, monkeypatch):
+    # a valid default-dir profile exists...
+    d = tmp_path / "prof"
+    d.mkdir()
+    tuning.write_profile(str(d / f"{tuning.backend_name()}.json"),
+                         tuning.backend_name(), {},
+                         {"QUORUM_S1_AGGREGATE": "0"})
+    tuning.reset_cache()
+    assert ctable.s1_aggregate_default() is False
+    # ...until QUORUM_AUTOTUNE_PROFILE= (empty) opts out entirely
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", "")
+    tuning.reset_cache()
+    assert tuning.active_profile_path() is None
+    assert ctable.s1_aggregate_default() is True
+
+
+def test_decide_hysteresis():
+    m = {"s1_base_s": 1.0, "s1_agg_s": 0.8,
+         "s2_base_s": 1.0, "s2_sweep_s": 0.7,
+         "s2_sweep_drain_s": 0.6}
+    lev = autotune.decide(m)
+    assert lev == {"QUORUM_S1_AGGREGATE": "1",
+                   "QUORUM_COMPACT_SWEEP": "1",
+                   "QUORUM_DRAIN_LEVELS": "2"}
+    # a within-noise "win" keeps the incumbent
+    m = {"s1_base_s": 1.0, "s1_agg_s": 0.995,
+         "s2_base_s": 1.0, "s2_sweep_s": 0.99,
+         "s2_sweep_drain_s": 0.995}
+    lev = autotune.decide(m)
+    assert lev == {"QUORUM_S1_AGGREGATE": "0",
+                   "QUORUM_COMPACT_SWEEP": "0",
+                   "QUORUM_DRAIN_LEVELS": "0"}
+    # sweep alone wins, drain loses
+    m = {"s1_base_s": 1.0, "s1_agg_s": 2.0,
+         "s2_base_s": 1.0, "s2_sweep_s": 0.5,
+         "s2_sweep_drain_s": 1.5}
+    lev = autotune.decide(m)
+    assert lev == {"QUORUM_S1_AGGREGATE": "0",
+                   "QUORUM_COMPACT_SWEEP": "1",
+                   "QUORUM_DRAIN_LEVELS": "0"}
+
+
+def test_observability_stamps_autotune_profile(tmp_path,
+                                               monkeypatch):
+    from quorum_tpu.cli.observability import observability
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "1"})
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    mp = tmp_path / "m.json"
+    with observability(str(mp), stage="test"):
+        pass
+    doc = json.load(open(mp))
+    assert doc["meta"]["autotune_profile"] == path
+    # and without a profile the stamp is absent
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", "")
+    tuning.reset_cache()
+    mp2 = tmp_path / "m2.json"
+    with observability(str(mp2), stage="test"):
+        pass
+    assert "autotune_profile" not in json.load(open(mp2))["meta"]
+
+
+def test_profile_cache_tracks_mtime(tmp_path, monkeypatch):
+    path = write(tmp_path, {"QUORUM_S1_AGGREGATE": "0"})
+    monkeypatch.setenv("QUORUM_AUTOTUNE_PROFILE", path)
+    tuning.reset_cache()
+    assert ctable.s1_aggregate_default() is False
+    # a re-tune replaces the file: resolution follows WITHOUT a
+    # process restart (write_profile also clears the cache, but an
+    # external writer only moves mtime/size)
+    tuning.write_profile(path, tuning.backend_name(), {},
+                         {"QUORUM_S1_AGGREGATE": "1"})
+    assert ctable.s1_aggregate_default() is True

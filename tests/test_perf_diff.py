@@ -1,0 +1,204 @@
+"""tools/perf_diff.py (ISSUE 11): profile extraction from both
+artifact kinds, limit semantics, the seeded-regression negative case
+the CI gate depends on, baseline generation, and verdict-document
+validation through metrics_check."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import perf_diff  # noqa: E402
+
+from quorum_tpu.telemetry.schema import (check_file,  # noqa: E402
+                                         validate_perf_diff)
+
+METRICS_CHECK = os.path.join(REPO, "tools", "metrics_check.py")
+
+
+def metrics_doc(stage1_s=2.5, kernel_us=5000, disp_mean=200):
+    return {
+        "schema": "quorum-tpu-metrics/1", "meta": {"stage": "x"},
+        "counters": {"device_kernel_us_total": kernel_us,
+                     "reads": 100},
+        "gauges": {"stage1_seconds": stage1_s},
+        "histograms": {"insert_dispatch_us": {
+            "count": 4, "sum": disp_mean * 4,
+            "counts": {str(disp_mean): 4}}},
+        "timers": {"stage1": {
+            "total_seconds": stage1_s,
+            "stages": {"insert_wait": {"seconds": stage1_s / 2,
+                                       "calls": 4, "units": 0}}}},
+    }
+
+
+def bench_lines(speedup=1.2, base_ms=100.0):
+    return (json.dumps({"metric": "ab_stage1_insert",
+                        "speedup": speedup, "base_ms": base_ms,
+                        "parity": "content-identical"}) + "\n"
+            + json.dumps({"metric": "ab_env", "reps": 2}) + "\n")
+
+
+@pytest.fixture
+def artifacts(tmp_path):
+    mp = tmp_path / "m.json"
+    mp.write_text(json.dumps(metrics_doc()))
+    bp = tmp_path / "bench.json"
+    bp.write_text(bench_lines())
+    return str(mp), str(bp)
+
+
+def test_extract_profile_both_kinds(artifacts):
+    mp, bp = artifacts
+    prof = perf_diff.extract_profile(mp)
+    assert prof["timers.stage1.total_seconds"] == 2.5
+    assert prof["timers.stage1.stages.insert_wait.seconds"] == 1.25
+    assert prof["counters.device_kernel_us_total"] == 5000.0
+    assert prof["histograms.insert_dispatch_us.mean"] == 200.0
+    assert prof["gauges.stage1_seconds"] == 2.5
+    bprof = perf_diff.extract_profile(bp)
+    assert bprof["bench.ab_stage1_insert.speedup"] == 1.2
+    assert bprof["bench.ab_stage1_insert.base_ms"] == 100.0
+    assert "bench.ab_stage1_insert.parity" not in bprof  # non-numeric
+
+
+def test_direction_heuristic():
+    assert perf_diff.direction_for(
+        "timers.stage1.total_seconds") == "lower_better"
+    assert perf_diff.direction_for(
+        "bench.ab.speedup") == "higher_better"
+    assert perf_diff.direction_for(
+        "gauges.foo_gb_per_h") == "higher_better"
+    assert perf_diff.direction_for(
+        "histograms.x_us.mean") == "lower_better"
+    assert perf_diff.direction_for("counters.reads") == "both"
+
+
+def test_check_metric_limit_semantics():
+    cm = perf_diff.check_metric
+    assert cm("m", {"value": 10, "max_ratio": 2.0}, 19)["ok"]
+    assert not cm("m", {"value": 10, "max_ratio": 2.0}, 21)["ok"]
+    assert cm("m", {"value": 10, "min_ratio": 0.5}, 6)["ok"]
+    assert not cm("m", {"value": 10, "min_ratio": 0.5}, 4)["ok"]
+    assert cm("m", {"min": 1}, 2)["ok"]
+    assert not cm("m", {"min": 1}, 0)["ok"]
+    assert not cm("m", {"value": 10, "tolerance_pct": 10}, 12)["ok"]
+    assert cm("m", {"value": 10, "tolerance_pct": 30}, 12)["ok"]
+    # absence: regression unless optional
+    assert not cm("m", {"value": 1}, None)["ok"]
+    assert cm("m", {"value": 1, "optional": True}, None)["ok"]
+
+
+def write_baseline(tmp_path, artifacts):
+    mp, bp = artifacts
+    out = str(tmp_path / "PERF_BASELINE.json")
+    rc = perf_diff.main(["--write-baseline", out,
+                         f"stage1={mp}", f"bench_ab={bp}"])
+    assert rc == 0
+    return out, mp, bp
+
+
+def test_baseline_gate_pass_and_verdict_doc(tmp_path, artifacts):
+    base, mp, bp = write_baseline(tmp_path, artifacts)
+    verdict = str(tmp_path / "v.json")
+    rc = perf_diff.main(["--baseline", base, f"stage1={mp}",
+                         f"bench_ab={bp}", "--out", verdict, "-q"])
+    assert rc == 0
+    doc = json.load(open(verdict))
+    assert doc["verdict"] == "pass" and doc["checked"] > 0
+    assert validate_perf_diff(doc) == []
+    assert check_file(verdict) == []
+    res = subprocess.run([sys.executable, METRICS_CHECK, verdict],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+
+def test_seeded_regression_fails_the_gate(tmp_path, artifacts):
+    """The negative case ci/tier1.sh depends on: a doctored candidate
+    (8x slower wall clock, collapsed speedup) must exit 1 with a
+    valid 'regression' verdict document."""
+    base, mp, bp = write_baseline(tmp_path, artifacts)
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(metrics_doc(stage1_s=20.0)))
+    nospeed = tmp_path / "nospeed.json"
+    nospeed.write_text(bench_lines(speedup=0.2))
+    verdict = str(tmp_path / "v.json")
+    rc = perf_diff.main(["--baseline", base, f"stage1={slow}",
+                         f"bench_ab={nospeed}", "--out", verdict,
+                         "-q"])
+    assert rc == 1
+    doc = json.load(open(verdict))
+    assert doc["verdict"] == "regression"
+    assert any("total_seconds" in r for r in doc["regressions"])
+    assert any("speedup" in r for r in doc["regressions"])
+    assert validate_perf_diff(doc) == []
+    res = subprocess.run([sys.executable, METRICS_CHECK, verdict],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr  # a valid doc, bad verdict
+
+
+def test_missing_metric_is_a_regression(tmp_path, artifacts):
+    base, mp, bp = write_baseline(tmp_path, artifacts)
+    gutted = tmp_path / "gutted.json"
+    doc = metrics_doc()
+    del doc["timers"]
+    gutted.write_text(json.dumps(doc))
+    rc = perf_diff.main(["--baseline", base, f"stage1={gutted}",
+                         f"bench_ab={bp}", "-q"])
+    assert rc == 1
+
+
+def test_missing_document_is_a_regression(tmp_path, artifacts):
+    base, mp, bp = write_baseline(tmp_path, artifacts)
+    rc = perf_diff.main(["--baseline", base, f"bench_ab={bp}", "-q"])
+    assert rc == 1
+
+
+def test_two_doc_mode_directions(tmp_path, artifacts):
+    mp, _bp = artifacts
+    same = perf_diff.main([mp, mp, "-q"])
+    assert same == 0
+    worse = tmp_path / "worse.json"
+    worse.write_text(json.dumps(metrics_doc(stage1_s=20.0)))
+    assert perf_diff.main([mp, str(worse), "-q"]) == 1
+    # the same delta in the GOOD direction passes
+    assert perf_diff.main([str(worse), mp, "-q"]) == 0
+
+
+def test_validate_perf_diff_rejects_incoherent():
+    base = {"schema": "quorum-tpu-perf-diff/1", "verdict": "pass",
+            "checked": 1, "regressions": [],
+            "docs": {"a": {"metrics": {"m": {"ok": True}}}}}
+    assert validate_perf_diff(base) == []
+    assert validate_perf_diff(
+        {**base, "verdict": "wat"}) != []
+    assert validate_perf_diff(
+        {**base, "regressions": ["x"]}) != []  # pass + regressions
+    assert validate_perf_diff(
+        {**base, "verdict": "regression"}) != []  # regression + none
+    tampered = json.loads(json.dumps(base))
+    tampered["docs"]["a"]["metrics"]["m"]["ok"] = False
+    assert validate_perf_diff(tampered) != []  # pass + ok=false entry
+
+
+def test_committed_baseline_is_valid():
+    """The repo's committed contract must parse and name only
+    extractable limits — CI trips over it otherwise."""
+    path = os.path.join(REPO, "PERF_BASELINE.json")
+    assert os.path.exists(path), "PERF_BASELINE.json missing"
+    doc = json.load(open(path))
+    assert doc["schema"] == perf_diff.BASELINE_SCHEMA
+    assert doc["docs"], "baseline names no documents"
+    for key, spec in doc["docs"].items():
+        assert spec["metrics"], f"doc {key} has no metrics"
+        for name, mspec in spec["metrics"].items():
+            assert isinstance(mspec, dict)
+            assert any(k in mspec for k in
+                       ("min", "max", "max_ratio", "min_ratio",
+                        "tolerance_pct")), \
+                f"{key}:{name} bounds nothing"

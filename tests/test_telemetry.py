@@ -289,6 +289,27 @@ def test_explicit_events_path_without_final_json(tmp_path):
     assert check_file(ev) == []
 
 
+def test_straggler_event_after_write_never_truncates(tmp_path):
+    """ISSUE 11 hardening: write() seals the event sink. An event
+    landing after it (an alert ticker's last transition, a late
+    exporter) used to re-open the path with 'wb' — truncating the
+    whole stream it meant to append to."""
+    ev = str(tmp_path / "run.events.jsonl")
+    reg = registry_for(None, events_path=ev)
+    reg.event("progress", n=1)
+    reg.event("progress", n=2)
+    reg.write()
+    reg.event("alert", rule="late", state="firing")  # straggler
+    lines = [json.loads(x) for x in open(ev) if x.strip()]
+    assert [x["n"] for x in lines] == [1, 2]  # stream intact
+    # and an event-less run never grows a post-hoc events file
+    ev2 = str(tmp_path / "empty.events.jsonl")
+    reg2 = registry_for(None, events_path=ev2)
+    reg2.write()
+    reg2.event("late", x=1)
+    assert not os.path.exists(ev2)
+
+
 def test_prometheus_render_and_lint():
     from quorum_tpu.telemetry import export
 

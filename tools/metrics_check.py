@@ -32,9 +32,16 @@ whose meta declares a `--profile` directory must carry the
 device-truth devtrace metrics (DEVTRACE_*, ISSUE 10); one declaring
 `metrics_push_url` must carry the push-transport counters (PUSH_*);
 and a push-receiver fleet aggregate (meta.fleet) must carry per-host
-shards matching meta.fleet_hosts. `request` lifecycle events in
-events JSONL are held to their richer contract (request_id, status,
-lane, non-negative phase durations) by the shared schema validator.
+shards matching meta.fleet_hosts. A document declaring alert rules
+active (meta.alert_rules, ISSUE 11) must carry the alert engine's
+counters/gauges with `alerts_firing{rule=}` values in {0, 1} naming
+declared rules; `meta.autotune_profile`, when present, must be a
+non-empty path. perf_diff verdict documents
+(quorum-tpu-perf-diff/1) validate for internal coherence (verdict
+vs regression list vs per-metric ok flags). `request` and `alert`
+lifecycle events in events JSONL are held to their richer contracts
+(request_id/status/lane/phases; rule/state) by the shared schema
+validator.
 
 `--prom` switches to linting Prometheus text exposition output
 (`--metrics-textfile` files or a saved `/metrics` scrape) through the
@@ -134,6 +141,14 @@ DEVTRACE_META = ("devtrace_source",)
 # the final document lands, so the document itself cannot carry it.)
 PUSH_COUNTERS = ("metrics_push_total", "metrics_push_failures_total")
 PUSH_META = ("metrics_push_host",)
+
+# The alerting surface (ISSUE 11): a document whose meta declares
+# alert rules active (telemetry/alerts.py stamps meta.alert_rules at
+# engine setup, counters/gauges pre-created at 0) must carry the
+# engine's counters and the rule-count gauge; any alerts_firing{rule=}
+# gauge present must hold 0/1 and name a declared rule.
+ALERT_COUNTERS = ("alerts_fired_total", "alert_rule_errors_total")
+ALERT_GAUGES = ("alert_rules_active",)
 
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
@@ -305,6 +320,54 @@ def _check_fleet_doc(doc: dict) -> list[str]:
     return errs
 
 
+def _check_alert_names(doc: dict) -> list[str]:
+    """Alerting-surface requirements (ISSUE 11): dispatch on
+    meta.alert_rules — the engine stamps the active rule names at
+    setup and pre-creates the counters, so a missing name means the
+    alerting telemetry regressed."""
+    meta = doc.get("meta", {})
+    rules = meta.get("alert_rules")
+    if not rules:
+        return []
+    errs = []
+    if not isinstance(rules, list) or not all(
+            isinstance(r, str) for r in rules):
+        return ["meta.alert_rules must be a list of rule names"]
+    why = f"meta.alert_rules ({len(rules)} rule(s))"
+    for name in ALERT_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter {name!r}")
+    for name in ALERT_GAUGES:
+        if name not in doc.get("gauges", {}):
+            errs.append(f"document with {why} missing gauge {name!r}")
+    declared = set(rules)
+    for gname, val in doc.get("gauges", {}).items():
+        if not gname.startswith("alerts_firing{"):
+            continue
+        if val not in (0, 1):
+            errs.append(f"gauge {gname!r} must be 0 or 1, got {val!r}")
+        rule = gname[len("alerts_firing{"):-1]
+        rule = rule.partition("=")[2].strip('"')
+        if rule and rule not in declared:
+            errs.append(f"gauge {gname!r} names a rule not in "
+                        f"meta.alert_rules")
+    return errs
+
+
+def _check_autotune_meta(doc: dict) -> list[str]:
+    """Autotune-surface requirement (ISSUE 11): meta.autotune_profile
+    — stamped by observability() when a profile steers the run's
+    levers — must be a non-empty path string."""
+    meta = doc.get("meta", {})
+    if "autotune_profile" not in meta:
+        return []
+    val = meta.get("autotune_profile")
+    if not isinstance(val, str) or not val:
+        return [f"meta.autotune_profile must be a non-empty path "
+                f"string, got {val!r}"]
+    return []
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -351,6 +414,8 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_devtrace_names(doc)
         problems = problems + _check_push_names(doc)
         problems = problems + _check_fleet_doc(doc)
+        problems = problems + _check_alert_names(doc)
+        problems = problems + _check_autotune_meta(doc)
     return problems
 
 

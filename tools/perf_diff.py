@@ -1,0 +1,438 @@
+#!/usr/bin/env python
+"""Performance regression verdicts over quorum-tpu metrics artifacts
+(ISSUE 11): compare what a run measured against what it is SUPPOSED
+to measure, with per-metric tolerances, so a 30% throughput loss
+fails CI the way a wrong byte does.
+
+Two modes:
+
+* **Baseline gate** (what ci/tier1.sh runs)::
+
+      python tools/perf_diff.py --baseline PERF_BASELINE.json \\
+          bench_ab=/tmp/bench_ab.json stage1=/tmp/metrics.json \\
+          --out verdict.json
+
+  `PERF_BASELINE.json` (committed at the repo root) names, per
+  document key, the metrics to check with their baseline values and
+  limits. Every named metric is extracted from the matching document
+  (final metrics JSON or BENCH metric-line file), compared, and the
+  verdict document (`quorum-tpu-perf-diff/1`, validated by
+  tools/metrics_check.py) is written to `--out`. Exit 1 on any
+  regression or required-metric absence.
+
+* **Two-document compare** (by hand, between rounds)::
+
+      python tools/perf_diff.py OLD.json NEW.json [--tolerance-pct 50]
+
+  Extracts the perf-shaped metrics both documents share (wall
+  seconds, dispatch/wait splits, devtrace kernel totals, serve phase
+  histograms, bench speedups/throughput) and applies the direction
+  heuristic: time-like metrics regress when they grow, speedup/
+  throughput-like ones when they shrink.
+
+Metric names are flat extraction paths over any artifact kind:
+
+    gauges.<name>                   timers.<name>.total_seconds
+    timers.<name>.stages.<s>.seconds
+    counters.<name>                 histograms.<name>.count|sum|mean
+    bench.<metric>.<field>          (BENCH metric-line documents)
+
+Limits per baseline metric (any combination): `max_ratio` /
+`min_ratio` (candidate vs baseline `value`), absolute `min` / `max`,
+symmetric `tolerance_pct`, plus `optional` (absence is not a
+regression) and `direction` ("higher_better" flips which ratio bound
+the generator emits). Tolerances are wide by design on wall-clock
+entries — shared CI boxes are noisy; the gate exists to catch the
+4x cliff and the silently-vanished metric, while `min`-bounded
+structural entries (device_kernel_us_total > 0, speedups, parity)
+stay tight.
+
+`--write-baseline` regenerates the baseline document from fresh
+artifacts (curated default limits by name shape); review the diff
+before committing it — the baseline is a CONTRACT, not a cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASELINE_SCHEMA = "quorum-tpu-perf-baseline/1"
+VERDICT_SCHEMA = "quorum-tpu-perf-diff/1"
+
+# two-doc mode: only metrics matching these shapes are compared (a
+# run manifest carries plenty of numbers that are not performance)
+_PERF_SHAPES = (
+    "timers.*.total_seconds", "timers.*.stages.*.seconds",
+    "gauges.*_seconds", "gauges.*gb_per_h*",
+    "counters.*_us_total",
+    "histograms.*_us.sum", "histograms.*_us.mean",
+    "bench.*.speedup*", "bench.*.value", "bench.*_ms",
+    "bench.*.base_ms", "bench.*.workers_ms",
+    "bench.*.aggregated_ms", "bench.*.compact_sweep_ms",
+    "bench.*.compact_drain_ms",
+)
+
+# direction heuristic: does a BIGGER candidate value mean regression?
+_LOWER_BETTER_SUFFIXES = ("_seconds", ".seconds", "_ms", "_us",
+                          ".sum", ".mean", "_us_total")
+_HIGHER_BETTER_MARKS = ("speedup", "gb_per_h", "gb_h", "throughput",
+                        ".value")
+
+
+def direction_for(name: str) -> str:
+    low = name.lower()
+    for mark in _HIGHER_BETTER_MARKS:
+        if mark in low:
+            return "higher_better"
+    for suf in _LOWER_BETTER_SUFFIXES:
+        if low.endswith(suf):
+            return "lower_better"
+    return "both"
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def extract_profile(path: str) -> dict[str, float]:
+    """The flat perf profile of one artifact: a final metrics JSON
+    document (gauges/timers/counters/histograms) or a BENCH-style
+    metric-line file (bench.<metric>.<field>)."""
+    with open(path) as f:
+        text = f.read()
+    prof: dict[str, float] = {}
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and ("counters" in doc
+                                  or "gauges" in doc):
+        for k, v in doc.get("gauges", {}).items():
+            if _is_num(v):
+                prof[f"gauges.{k}"] = float(v)
+        for k, v in doc.get("counters", {}).items():
+            if _is_num(v):
+                prof[f"counters.{k}"] = float(v)
+        for k, t in doc.get("timers", {}).items():
+            if _is_num(t.get("total_seconds")):
+                prof[f"timers.{k}.total_seconds"] = float(
+                    t["total_seconds"])
+            for sk, sv in t.get("stages", {}).items():
+                if isinstance(sv, dict) and _is_num(sv.get("seconds")):
+                    prof[f"timers.{k}.stages.{sk}.seconds"] = float(
+                        sv["seconds"])
+        for k, h in doc.get("histograms", {}).items():
+            if not isinstance(h, dict):
+                continue
+            n = h.get("count")
+            s = h.get("sum")
+            if _is_num(n):
+                prof[f"histograms.{k}.count"] = float(n)
+            if _is_num(s):
+                prof[f"histograms.{k}.sum"] = float(s)
+                if n:
+                    prof[f"histograms.{k}.mean"] = float(s) / n
+        return prof
+    # line-oriented: BENCH metric lines (and anything else is skipped)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(obj, dict) or not isinstance(
+                obj.get("metric"), str):
+            continue
+        m = obj["metric"]
+        for k, v in obj.items():
+            if k != "metric" and _is_num(v):
+                prof[f"bench.{m}.{k}"] = float(v)
+    return prof
+
+
+def check_metric(name: str, spec: dict, cand: float | None) -> dict:
+    """One metric's verdict entry: ok flag + the limits applied."""
+    entry: dict = {"ok": True}
+    base = spec.get("value")
+    if base is not None:
+        entry["baseline"] = base
+    if cand is None:
+        if spec.get("optional"):
+            entry["status"] = "absent (optional)"
+        else:
+            entry["ok"] = False
+            entry["status"] = "missing from candidate"
+        return entry
+    entry["value"] = cand
+    probs = []
+    if _is_num(base) and base != 0:
+        entry["ratio"] = round(cand / base, 4)
+    if spec.get("min") is not None and cand < spec["min"]:
+        probs.append(f"value {cand:g} < min {spec['min']:g}")
+    if spec.get("max") is not None and cand > spec["max"]:
+        probs.append(f"value {cand:g} > max {spec['max']:g}")
+    if _is_num(base) and base != 0:
+        # relative limits against a zero baseline are meaningless
+        # (every positive candidate would "exceed 0 x ratio"); a
+        # near-zero metric wants absolute min/max bounds instead —
+        # the generator refuses to emit ratio entries for them
+        if spec.get("max_ratio") is not None \
+                and cand > base * spec["max_ratio"]:
+            probs.append(f"value {cand:g} > baseline {base:g} x "
+                         f"{spec['max_ratio']:g}")
+        if spec.get("min_ratio") is not None \
+                and cand < base * spec["min_ratio"]:
+            probs.append(f"value {cand:g} < baseline {base:g} x "
+                         f"{spec['min_ratio']:g}")
+        tol = spec.get("tolerance_pct")
+        if tol is not None and abs(cand - base) > abs(base) * tol / 100.0:
+            probs.append(f"value {cand:g} outside +-{tol:g}% of "
+                         f"baseline {base:g}")
+    if probs:
+        entry["ok"] = False
+        entry["status"] = "; ".join(probs)
+    return entry
+
+
+def run_baseline(baseline_path: str, docs: dict[str, str],
+                 out: str | None, quiet: bool = False) -> int:
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_diff: {baseline_path}: {e}", file=sys.stderr)
+        return 2
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        print(f"perf_diff: {baseline_path} is not a "
+              f"{BASELINE_SCHEMA} document", file=sys.stderr)
+        return 2
+    verdict = {
+        "schema": VERDICT_SCHEMA,
+        "baseline": os.path.basename(baseline_path),
+        "verdict": "pass",
+        "checked": 0,
+        "regressions": [],
+        "docs": {},
+    }
+    for key, spec in baseline.get("docs", {}).items():
+        path = docs.get(key)
+        dv: dict = {"metrics": {}}
+        verdict["docs"][key] = dv
+        if path is None:
+            if spec.get("optional"):
+                dv["status"] = "not supplied (optional)"
+                continue
+            dv["status"] = "document not supplied"
+            verdict["regressions"].append(f"{key}: document not "
+                                          "supplied")
+            continue
+        try:
+            prof = extract_profile(path)
+        except OSError as e:
+            dv["status"] = str(e)
+            verdict["regressions"].append(f"{key}: {e}")
+            continue
+        dv["path"] = path
+        for name, mspec in spec.get("metrics", {}).items():
+            entry = check_metric(name, mspec, prof.get(name))
+            dv["metrics"][name] = entry
+            verdict["checked"] += 1
+            if not entry["ok"]:
+                verdict["regressions"].append(
+                    f"{key}: {name}: {entry.get('status')}")
+    extra = docs.keys() - baseline.get("docs", {}).keys()
+    if extra:
+        print(f"perf_diff: warning: supplied documents not in the "
+              f"baseline: {sorted(extra)}", file=sys.stderr)
+    if verdict["regressions"]:
+        verdict["verdict"] = "regression"
+    _finish(verdict, out, quiet)
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+def run_two_doc(old_path: str, new_path: str, tolerance_pct: float,
+                out: str | None, quiet: bool = False) -> int:
+    try:
+        old = extract_profile(old_path)
+        new = extract_profile(new_path)
+    except OSError as e:
+        print(f"perf_diff: {e}", file=sys.stderr)
+        return 2
+    shared = sorted(
+        n for n in old.keys() & new.keys()
+        if any(fnmatch.fnmatch(n, pat) for pat in _PERF_SHAPES))
+    verdict = {
+        "schema": VERDICT_SCHEMA,
+        "baseline": old_path,
+        "verdict": "pass",
+        "checked": 0,
+        "regressions": [],
+        "docs": {"candidate": {"path": new_path, "metrics": {}}},
+    }
+    mx = verdict["docs"]["candidate"]["metrics"]
+    factor = 1.0 + tolerance_pct / 100.0
+    for name in shared:
+        d = direction_for(name)
+        spec = {"value": old[name]}
+        if d in ("lower_better", "both"):
+            spec["max_ratio"] = factor
+        if d in ("higher_better", "both"):
+            spec["min_ratio"] = 1.0 / factor
+        entry = check_metric(name, spec, new[name])
+        entry["direction"] = d
+        mx[name] = entry
+        verdict["checked"] += 1
+        if not entry["ok"]:
+            verdict["regressions"].append(
+                f"candidate: {name}: {entry.get('status')}")
+    if verdict["regressions"]:
+        verdict["verdict"] = "regression"
+    _finish(verdict, out, quiet)
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+def _finish(verdict: dict, out: str | None, quiet: bool) -> None:
+    if not quiet:
+        for key, dv in verdict["docs"].items():
+            for name, entry in dv.get("metrics", {}).items():
+                mark = "ok " if entry["ok"] else "REG"
+                val = entry.get("value")
+                base = entry.get("baseline")
+                print(f"[perf_diff] {mark} {key}:{name} = "
+                      f"{val if val is not None else '-'}"
+                      + (f" (baseline {base}"
+                         + (f", ratio {entry['ratio']}"
+                            if "ratio" in entry else "") + ")"
+                         if base is not None else "")
+                      + ("" if entry["ok"]
+                         else f" -- {entry.get('status')}"))
+    for msg in verdict["regressions"]:
+        print(f"[perf_diff] REGRESSION {msg}", file=sys.stderr)
+    print(f"[perf_diff] verdict: {verdict['verdict']} "
+          f"({verdict['checked']} metric(s) checked, "
+          f"{len(verdict['regressions'])} regression(s))")
+    if out:
+        from quorum_tpu.telemetry.registry import atomic_write
+        atomic_write(out, json.dumps(verdict, indent=1) + "\n")
+
+
+# -- baseline generation ----------------------------------------------------
+
+# curated generator limits: what a committed baseline should bound,
+# by extracted-name shape. Wall-clock entries get cliff-wide ratios
+# (shared CI boxes are 2-4x noisy between runs); structural and
+# ratio-like entries stay tight.
+_GEN_RULES: list[tuple[str, dict]] = [
+    # lever speedups: a probe that stops speeding up (or starts
+    # losing parity runs) is exactly what the gate must catch
+    ("bench.*.speedup*", {"min_ratio": 0.33}),
+    # wall-clock probe times: generous cliff bounds
+    ("bench.*_ms", {"max_ratio": 5.0}),
+    ("timers.*.total_seconds", {"max_ratio": 5.0}),
+    ("timers.*.stages.*.seconds", {"max_ratio": 8.0, "optional": True}),
+    # devtrace totals: present and nonzero (the device did the work)
+    ("counters.device_kernel_us_total", {"min": 1.0, "max_ratio": 8.0}),
+    ("counters.device_step_us_total", {"min": 1.0, "max_ratio": 8.0}),
+    # dispatch/wait split histograms: time-like
+    ("histograms.*_us.mean", {"max_ratio": 8.0, "optional": True}),
+]
+
+
+def _gen_spec(name: str, value: float) -> dict | None:
+    for pat, limits in _GEN_RULES:
+        if fnmatch.fnmatch(name, pat):
+            rounded = round(value, 6)
+            if rounded == 0 and not any(
+                    k in limits for k in ("min", "max")):
+                # a ratio-bounded entry with a zero baseline would be
+                # an always-failing (or never-failing) contract — a
+                # metric this small has nothing to regress from
+                return None
+            return {"value": rounded, **limits}
+    return None
+
+
+def write_baseline(out: str, docs: dict[str, str]) -> int:
+    baseline = {
+        "schema": BASELINE_SCHEMA,
+        "meta": {
+            "note": "perf contract for ci/tier1.sh golden runs "
+                    "(tools/perf_diff.py); tolerances are deliberately "
+                    "cliff-wide on wall clock — shared CI boxes are "
+                    "noisy — and tight on structure/speedups",
+        },
+        "docs": {},
+    }
+    for key, path in sorted(docs.items()):
+        prof = extract_profile(path)
+        metrics = {}
+        for name in sorted(prof):
+            spec = _gen_spec(name, prof[name])
+            if spec is not None:
+                metrics[name] = spec
+        baseline["docs"][key] = {"metrics": metrics}
+    from quorum_tpu.telemetry.registry import atomic_write
+    atomic_write(out, json.dumps(baseline, indent=1) + "\n")
+    n = sum(len(d["metrics"]) for d in baseline["docs"].values())
+    print(f"[perf_diff] wrote baseline {out} "
+          f"({n} metric(s) over {len(docs)} document(s)) — review "
+          "before committing")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Regression verdicts over metrics/BENCH "
+                    "documents: baseline gate (--baseline KEY=PATH "
+                    "pairs) or two-document compare (OLD NEW)")
+    p.add_argument("docs", nargs="+", metavar="KEY=PATH | FILE",
+                   help="With --baseline/--write-baseline: KEY=PATH "
+                        "pairs naming the baseline's documents. "
+                        "Without: exactly two artifact paths "
+                        "(OLD NEW)")
+    p.add_argument("--baseline", metavar="path", default=None,
+                   help="Baseline contract JSON "
+                        "(quorum-tpu-perf-baseline/1)")
+    p.add_argument("--write-baseline", metavar="path", default=None,
+                   help="Generate a baseline contract from the "
+                        "supplied documents instead of judging them")
+    p.add_argument("--out", metavar="path", default=None,
+                   help="Write the verdict document "
+                        "(quorum-tpu-perf-diff/1) here")
+    p.add_argument("--tolerance-pct", type=float, default=50.0,
+                   help="Two-document mode: symmetric tolerance "
+                        "(default 50)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Only print regressions and the verdict")
+    args = p.parse_args(argv)
+
+    if args.baseline and args.write_baseline:
+        p.error("--baseline and --write-baseline are exclusive")
+    if args.baseline or args.write_baseline:
+        docs = {}
+        for item in args.docs:
+            key, sep, path = item.partition("=")
+            if not sep or not key or not path:
+                p.error(f"expected KEY=PATH, got {item!r}")
+            docs[key] = path
+        if args.write_baseline:
+            return write_baseline(args.write_baseline, docs)
+        return run_baseline(args.baseline, docs, args.out,
+                            quiet=args.quiet)
+    if len(args.docs) != 2:
+        p.error("two-document mode takes exactly OLD NEW")
+    return run_two_doc(args.docs[0], args.docs[1],
+                       args.tolerance_pct, args.out, quiet=args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

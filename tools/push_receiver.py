@@ -16,7 +16,11 @@ its final metrics JSON document to `/push/final`, both stamped with an
   to `--out` after every final push, with `meta.fleet` / per-host ids
   stamped so `tools/metrics_check.py` can gate it;
 * serves the current fleet document at `GET /fleet` and liveness at
-  `GET /healthz`.
+  `GET /healthz` — which carries a per-host `doc_age_s` staleness map
+  (seconds since each host's last push), the fleet-level signal a
+  silent host can't suppress; the same staleness rides `GET /metrics`
+  as `quorum_tpu_push_doc_age_seconds{host=...}` gauges so an
+  absence-style alert rule can watch it (ISSUE 11).
 
 Usage: python tools/push_receiver.py --port 9200 --out fleet.json
 
@@ -81,6 +85,7 @@ class PushReceiver:
         self._lock = threading.Lock()
         self._texts: dict[str, str] = {}      # host -> latest prom text
         self._finals: dict[str, dict] = {}    # host -> final document
+        self._last_seen: dict[str, float] = {}  # host -> last push t
         self._fleet: dict | None = None
         self.pushes = 0
         self.final_pushes = 0
@@ -147,7 +152,9 @@ class PushReceiver:
                     with outer._lock:
                         texts = [outer._texts[h]
                                  for h in sorted(outer._texts)]
-                    self._reply(200, _dedupe_type_lines(texts).encode(),
+                    body = (_dedupe_type_lines(texts)
+                            + outer._own_metrics_text())
+                    self._reply(200, body.encode(),
                                 "text/plain; version=0.0.4; "
                                 "charset=utf-8")
                 elif route == "/fleet":
@@ -160,15 +167,7 @@ class PushReceiver:
                         self._reply(200, (json.dumps(fleet, indent=1)
                                           + "\n").encode())
                 elif route == "/healthz":
-                    with outer._lock:
-                        body = json.dumps({
-                            "status": "ok",
-                            "uptime_s": round(
-                                time.perf_counter() - outer._t0, 3),
-                            "hosts": len(outer._texts),
-                            "final_hosts": len(outer._finals),
-                            "pushes": outer.pushes,
-                        }) + "\n"
+                    body = json.dumps(outer.health()) + "\n"
                     self._reply(200, body.encode())
                 else:
                     self._reply(404, b'{"error": "not found"}\n')
@@ -193,11 +192,13 @@ class PushReceiver:
     def _on_text(self, host_id: str, body: bytes) -> None:
         with self._lock:
             self._texts[host_id] = body.decode(errors="replace")
+            self._last_seen[host_id] = time.perf_counter()
             self.pushes += 1
 
     def _on_final(self, host_id: str, doc: dict) -> None:
         with self._lock:
             self._finals[host_id] = doc
+            self._last_seen[host_id] = time.perf_counter()
             self.final_pushes += 1
             fleet = merge_fleet(self._finals)
             self._fleet = fleet
@@ -209,6 +210,49 @@ class PushReceiver:
                              json.dumps(fleet, indent=1) + "\n")
 
     # -- introspection ----------------------------------------------------
+    def doc_ages(self) -> dict[str, float]:
+        """Per-host seconds since the last push of ANY kind — the
+        fleet-level staleness signal (ISSUE 11): a host that stopped
+        pushing is invisible in its own (absent) document, so the
+        RECEIVER is where its silence shows. Pairs with an absence
+        alert rule watching the receiver's exposition."""
+        now = time.perf_counter()
+        with self._lock:
+            return {h: round(now - t, 3)
+                    for h, t in sorted(self._last_seen.items())}
+
+    def health(self) -> dict:
+        ages = self.doc_ages()
+        with self._lock:
+            return {
+                "status": "ok",
+                "uptime_s": round(time.perf_counter() - self._t0, 3),
+                "hosts": len(self._texts),
+                "final_hosts": len(self._finals),
+                "pushes": self.pushes,
+                # a silent host is visible here long before any
+                # scraper notices its series went stale
+                "doc_age_s": ages,
+            }
+
+    def _own_metrics_text(self) -> str:
+        """The receiver's OWN gauges, appended to the fleet
+        exposition: per-host staleness + host counts, so one scrape
+        of the receiver answers 'which host went quiet' without the
+        fleet document."""
+        lines = ["# TYPE quorum_tpu_push_doc_age_seconds gauge"]
+        for h, age in self.doc_ages().items():
+            hv = h.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(
+                f'quorum_tpu_push_doc_age_seconds{{host="{hv}"}} {age}')
+        with self._lock:
+            lines.append("# TYPE quorum_tpu_push_hosts gauge")
+            lines.append(f"quorum_tpu_push_hosts {len(self._texts)}")
+            lines.append("# TYPE quorum_tpu_push_final_hosts gauge")
+            lines.append(
+                f"quorum_tpu_push_final_hosts {len(self._finals)}")
+        return "\n".join(lines) + "\n"
+
     @property
     def fleet(self) -> dict | None:
         with self._lock:

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Device-truth + push-transport smoke for CI (ISSUE 10, ci/tier1.sh).
+"""Device-truth + push-transport + alerting-loop smoke for CI
+(ISSUES 10 + 11, ci/tier1.sh).
 
-Two gates in one tool:
+Five gates in one tool:
 
 1. **Profiled golden run**: build the mer database from the committed
    golden reads with `--profile` + `--metrics` + `--trace-spans` AND
@@ -22,12 +23,38 @@ Two gates in one tool:
    (`metrics_pushed` meta True, the host present in the receiver's
    fleet).
 
+3. **Stall -> absence alert -> heal** (ISSUE 11): a golden build with
+   a `sleep` fault wedging one batch mid-run must fire the
+   `pipeline_stalled` absence rule FROM THE TICKER (the stalled loop
+   emits no heartbeats — that silence is the signal), land the
+   structured `alert` events in the JSONL stream, then heal when the
+   batch completes; the final document carries the alert surface
+   (gauge back at 0, alerts_fired_total >= 1) and passes
+   metrics_check.
+
+4. **Serve SLO burn without flipping liveness** (ISSUE 11): a live
+   quorum-serve under a fault plan failing every engine step after
+   the first must burn the availability SLO — `/healthz` DETAIL
+   (`slo`/`alerts`) reports the multi-window burn firing while the
+   liveness verdict stays healthy (a burning SLO needs attention, not
+   ejection) — and the drained final document passes metrics_check.
+
+5. **Autotune round trip** (ISSUE 11): `quorum-autotune` writes a
+   sealed profile whose probe lines pass `metrics_check
+   --require-metric`; a subsequent stage run LOADS it
+   (`meta.autotune_profile` stamped into its document) and an
+   explicit lever env var still wins over the profile.
+
 Artifacts land in --out-dir:
-  telemetry_metrics.json — the profiled stage-1 document
-                           (metrics_check gates the devtrace + push
-                           names via meta.profile/metrics_push_url)
-  telemetry_fleet.json   — the receiver's aggregated fleet document
-                           (metrics_check gates meta.fleet)
+  telemetry_metrics.json  — the profiled stage-1 document
+                            (metrics_check gates the devtrace + push
+                            names via meta.profile/metrics_push_url)
+  telemetry_fleet.json    — the receiver's aggregated fleet document
+                            (metrics_check gates meta.fleet)
+  telemetry_alerts_metrics.json(+.events.jsonl) — the stall run
+  telemetry_serve_metrics.json — the burned serve document
+  telemetry_autotune_metrics.json — the profile-applied stage run
+  autotune_profile.json / autotune_lines.json — the derived profile
 
 Exit 0 = all checks passed.
 """
@@ -197,9 +224,212 @@ def main(argv=None) -> int:
             return _fail("recovered receiver holds no final document")
     finally:
         rx2.close()
-    print("[telemetry_smoke] OK: devtrace attribution rendered, fleet "
-          "document aggregated, outage survived via retry + terminal "
+    print("[telemetry_smoke] outage survived via retry + terminal "
           "flush")
+
+    # -- 3: induced stall -> absence alert -> heal --------------------
+    from quorum_tpu.utils import faults
+
+    alerts_metrics = os.path.join(out_dir,
+                                  "telemetry_alerts_metrics.json")
+    alerts_events = os.path.join(
+        out_dir, "telemetry_alerts_metrics.events.jsonl")
+    stall_rules = os.path.join(out_dir, "stall_rules.json")
+    with open(stall_rules, "w") as f:
+        json.dump({"rules": [{"name": "pipeline_stalled",
+                              "type": "absence", "for_s": 0.8}]}, f)
+    stall_plan = json.dumps([{"site": "stage1.insert", "batch": 2,
+                              "action": "sleep", "seconds": 2.5}])
+    print("[telemetry_smoke] stall run: sleep fault at batch 2, "
+          "absence rule for_s=0.8")
+    try:
+        rc = cdb_cli.main(
+            ["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+             "-o", os.path.join(out_dir, "db_stall.jf"),
+             "--batch-size", "64",
+             "--metrics", alerts_metrics,
+             "--metrics-interval", "0.1",
+             "--alert-rules", stall_rules,
+             "--fault-plan", stall_plan, reads])
+    finally:
+        faults.reset()
+    if rc != 0:
+        return _fail(f"stall run rc={rc}")
+    with open(alerts_metrics) as f:
+        adoc = json.load(f)
+    states = []
+    with open(alerts_events) as f:
+        for line in f:
+            obj = json.loads(line)
+            if obj.get("event") == "alert" \
+                    and obj.get("rule") == "pipeline_stalled":
+                states.append(obj["state"])
+    if "firing" not in states or "healed" not in states:
+        return _fail(f"absence alert did not fire+heal (events: "
+                     f"{states})")
+    gauge = adoc.get("gauges", {}).get(
+        'alerts_firing{rule="pipeline_stalled"}')
+    if gauge != 0:
+        return _fail(f"pipeline_stalled gauge should have healed to "
+                     f"0, is {gauge!r}")
+    if adoc.get("counters", {}).get("alerts_fired_total", 0) < 1:
+        return _fail("alerts_fired_total did not count the firing")
+    print(f"[telemetry_smoke] stall: alert fired+healed "
+          f"({states.count('firing')} firing(s)), gauge back at 0")
+
+    # -- 4: serve SLO burn visible in /healthz, liveness intact -------
+    import threading
+
+    from quorum_tpu.cli import serve as serve_cli
+    from quorum_tpu.serve.client import ServeClient
+
+    serve_metrics = os.path.join(out_dir,
+                                 "telemetry_serve_metrics.json")
+    serve_rules = os.path.join(out_dir, "serve_rules.json")
+    with open(serve_rules, "w") as f:
+        # tiny windows so a few seconds of bad traffic burns; the
+        # objective/window shape is the production rule's, scaled
+        json.dump({"rules": [
+            {"name": "serve_slo_availability", "type": "burn_rate",
+             "objective": 0.9,
+             "bad": ["requests_failed", "requests_deadline_exceeded"],
+             "total": ["requests_completed", "requests_failed",
+                       "requests_deadline_exceeded"],
+             "windows": [[2.0, 1.0], [0.5, 1.0]]}]}, f)
+    # every engine step after the first fails: request 1 succeeds
+    # (compiles + seeds the serve histograms), the rest 500 — pure
+    # SLO burn with the process itself perfectly alive
+    serve_plan = json.dumps([{"site": "serve.engine.step", "at": 2,
+                              "count": -1, "action": "error"}])
+    port = _free_port()
+    rc_box: dict = {}
+
+    def run_server():
+        try:
+            rc_box["rc"] = serve_cli.main(
+                ["--port", str(port), "--max-batch", "64",
+                 "--max-wait-ms", "2", "-p", "4",
+                 "--max-consecutive-failures", "0",
+                 "--metrics", serve_metrics,
+                 "--metrics-interval", "0.2",
+                 "--alert-rules", serve_rules,
+                 "--fault-plan", serve_plan, db])
+        finally:
+            faults.reset()
+
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    client = ServeClient(port=port, timeout=300.0)
+    deadline = time.perf_counter() + 60
+    while True:
+        try:
+            client.healthz()
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                return _fail("serve never came up")
+            time.sleep(0.1)
+    with open(reads) as f:
+        body = "".join(f.readlines()[:8])  # 2 reads per request
+    r1 = client.correct(body)
+    if r1.status != 200:
+        return _fail(f"first serve request status={r1.status} "
+                     "(must succeed before the fault arms)")
+    burned = None
+    deadline = time.perf_counter() + 30
+    while time.perf_counter() < deadline:
+        r = client.correct(body)  # 500s: burning the error budget
+        h = client.healthz()
+        slo = h.get("slo", {}).get("serve_slo_availability", {})
+        if slo.get("firing"):
+            burned = h
+            break
+        time.sleep(0.1)
+    if burned is None:
+        return _fail("availability burn never surfaced in /healthz "
+                     "slo detail")
+    if burned.get("status") != "ok" or not burned.get("healthy"):
+        return _fail(f"SLO burn flipped liveness: status="
+                     f"{burned.get('status')!r} healthy="
+                     f"{burned.get('healthy')!r} — burn is detail, "
+                     "not ejection")
+    if "serve_slo_availability" not in burned.get(
+            "alerts", {}).get("firing", []):
+        return _fail("alerts summary in /healthz does not list the "
+                     "firing rule")
+    print(f"[telemetry_smoke] serve burn: "
+          f"{burned['slo']['serve_slo_availability']['burn']} "
+          f"firing with status={burned['status']!r}")
+    client.quiesce()
+    t.join(timeout=90)
+    if t.is_alive() or rc_box.get("rc") != 0:
+        return _fail(f"serve drain failed (alive={t.is_alive()} "
+                     f"rc={rc_box.get('rc')})")
+    with open(serve_metrics) as f:
+        sdoc = json.load(f)
+    if sdoc.get("counters", {}).get("alerts_fired_total", 0) < 1:
+        return _fail("serve document lost the alert firing")
+
+    # -- 5: autotune profile derived, applied, env still wins ---------
+    from quorum_tpu.cli import autotune as autotune_cli
+    from quorum_tpu.ops import ctable, tuning
+
+    profile_path = os.path.join(out_dir, "autotune_profile.json")
+    lines_path = os.path.join(out_dir, "autotune_lines.json")
+    autotune_metrics = os.path.join(
+        out_dir, "telemetry_autotune_metrics.json")
+    # same geometry as the bench A/B CI gate, so the compile cache is
+    # already warm for these shapes
+    rc = autotune_cli.main(["--reads", "256", "--len", "100",
+                            "-k", "15", "--reps", "1",
+                            "--out", profile_path,
+                            "--metrics-lines", lines_path])
+    if rc != 0:
+        return _fail(f"quorum-autotune rc={rc}")
+    import metrics_check
+    if metrics_check.main(["--require-metric", "autotune_stage1",
+                           "--require-metric", "autotune_stage2",
+                           "--require-metric", "autotune_profile",
+                           "-q", lines_path]) != 0:
+        return _fail("autotune probe lines failed metrics_check "
+                     "--require-metric")
+    os.environ["QUORUM_AUTOTUNE_PROFILE"] = profile_path
+    tuning.reset_cache()
+    try:
+        rc = cdb_cli.main(
+            ["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+             "-o", os.path.join(out_dir, "db_tuned.jf"),
+             "--metrics", autotune_metrics, reads])
+        if rc != 0:
+            return _fail(f"profile-applied build rc={rc}")
+        with open(autotune_metrics) as f:
+            tdoc = json.load(f)
+        if tdoc.get("meta", {}).get("autotune_profile") \
+                != profile_path:
+            return _fail(f"meta.autotune_profile="
+                         f"{tdoc.get('meta', {}).get('autotune_profile')!r}"
+                         f" (expected {profile_path})")
+        # an explicit env var must beat the profile's lever
+        prof_lever = json.load(open(profile_path))[
+            "levers"]["QUORUM_S1_AGGREGATE"]
+        flipped = "0" if prof_lever != "0" else "1"
+        os.environ["QUORUM_S1_AGGREGATE"] = flipped
+        try:
+            if ctable.s1_aggregate_default() != (flipped != "0"):
+                return _fail("env QUORUM_S1_AGGREGATE did not win "
+                             "over the profile lever")
+        finally:
+            os.environ.pop("QUORUM_S1_AGGREGATE", None)
+    finally:
+        os.environ.pop("QUORUM_AUTOTUNE_PROFILE", None)
+        tuning.reset_cache()
+    print(f"[telemetry_smoke] autotune: profile {profile_path} "
+          f"applied (meta stamped), env override wins")
+
+    print("[telemetry_smoke] OK: devtrace attribution rendered, fleet "
+          "document aggregated, outage survived, stall alert "
+          "fired+healed, SLO burn surfaced without flipping "
+          "liveness, autotune profile round-tripped")
     return 0
 
 

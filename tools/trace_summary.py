@@ -2,10 +2,13 @@
 """Offline span/metrics join: where did the time go, without
 TensorBoard (ISSUE 2 satellite).
 
-Usage: python tools/trace_summary.py SPANS.jsonl [METRICS.json ...]
+Usage: python tools/trace_summary.py FILE [FILE ...]
 
-Reads a `--trace-spans` JSONL stream (telemetry/spans.py) and any
-number of `--metrics` JSON documents, and prints:
+Each FILE is dispatched on content — a `--trace-spans` JSONL stream
+(telemetry/spans.py), a `--metrics` JSON document, or a multi-host
+document carrying per-host shards under `hosts` (the quorum driver's
+`.hosts.json` aggregate, or the fleet document
+`tools/push_receiver.py` assembles from pushes) — and prints:
 
   * the per-span aggregate (calls, total, mean, share of wall time),
     with parent/child nesting preserved in the ordering;
@@ -14,7 +17,11 @@ number of `--metrics` JSON documents, and prints:
   * a host / device-dispatch / device-wait attribution summary that
     joins the split timer stages and `*_dispatch_us`/`*_wait_us`
     histograms — the per-batch device-time breakdown the trace
-    records, folded to one table per run.
+    records, folded to one table per run;
+  * for hosts/fleet documents: the PER-HOST attribution table
+    (wall, host / device-dispatch / device-wait seconds per host,
+    slowest host highlighted — the job runs at the slowest host's
+    pace, ISSUE 11), then the aggregate's own tables.
 
 `--device PROFILE_DIR` (ISSUE 10) additionally parses the
 jax.profiler trace the run wrote into that directory
@@ -171,29 +178,75 @@ def device_attribution(profile_dir: str, docs: list[dict]) -> int:
     return 0
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(
-        description="Summarize span JSONL + metrics JSON into per-"
-                    "stage host/device/wait tables")
-    p.add_argument("spans", metavar="SPANS.jsonl",
-                   help="Span JSONL from --trace-spans")
-    p.add_argument("metrics", nargs="*", metavar="METRICS.json",
-                   help="Metrics documents from --metrics")
-    p.add_argument("--device", metavar="PROFILE_DIR", default=None,
-                   help="Parse the jax.profiler trace in this "
-                        "--profile directory and print the device-"
-                        "truth kernel attribution table "
-                        "(host dispatch / device execute / device "
-                        "idle per stage, top kernels)")
-    args = p.parse_args(argv)
+def _host_wall(doc: dict) -> float:
+    """One host's wall proxy: the longest StageTimer total (the
+    aggregate merge rule — job total = slowest host — uses the same
+    quantity), falling back to summed attribution when a shard
+    carries no timers."""
+    totals = [t.get("total_seconds", 0.0)
+              for t in doc.get("timers", {}).values()]
+    return max(totals) if totals else sum(attribution(doc).values())
 
-    try:
-        spans = load_spans(args.spans)
-    except (OSError, ValueError) as e:
-        print(f"{args.spans}: {e}", file=sys.stderr)
-        return 1
+
+def fleet_table(path: str, doc: dict) -> None:
+    """The per-host attribution table of a multi-host document (the
+    driver's `.hosts.json` aggregate or a push-receiver fleet doc):
+    who is slow, and where their time goes. The slowest host is
+    highlighted because it IS the job's wall clock (counters sum,
+    but the barrier waits for the straggler)."""
+    hosts = doc.get("hosts", {})
+    kind = "fleet" if doc.get("meta", {}).get("fleet") else "hosts"
+    print(f"\n== {kind} document: {path} ({len(hosts)} host(s)) ==")
+    if not hosts:
+        return
+    walls = {h: _host_wall(d) for h, d in hosts.items()}
+    slowest = max(walls, key=walls.get) if walls else None
+    print(f"{'host':<20} {'wall_s':>9} {'host_s':>9} "
+          f"{'dispatch_s':>11} {'wait_s':>9} {'status':>8}")
+    for h in sorted(hosts):
+        d = hosts[h]
+        att = attribution(d)
+        status = str(d.get("meta", {}).get("status", "-"))
+        mark = "  <-- slowest" if h == slowest and len(hosts) > 1 \
+            else ""
+        print(f"{h:<20} {walls[h]:>9.3f} {att['host']:>9.3f} "
+              f"{att['device dispatch']:>11.3f} "
+              f"{att['device wait']:>9.3f} {status:>8}{mark}")
+
+
+def render_metrics_doc(mpath: str, doc: dict) -> None:
+    for tname, t in doc.get("timers", {}).items():
+        total = t.get("total_seconds", 0.0)
+        print(f"\n== timers: {mpath} [{tname}] "
+              f"(total {total:.3f} s) ==")
+        print(f"{'stage':<20} {'calls':>6} {'seconds':>9} "
+              f"{'%total':>7}  class")
+        for sname, st in t.get("stages", {}).items():
+            s = st.get("seconds", 0.0)
+            pct = 100.0 * s / total if total > 0 else 0.0
+            print(f"{sname:<20} {st.get('calls', 0):>6} "
+                  f"{s:>9.3f} {pct:>7.1f}  {_bucket(sname)}")
+    att = attribution(doc)
+    total_att = sum(att.values())
+    print(f"\n== attribution: {mpath} ==")
+    for k in ("host", "device dispatch", "device wait"):
+        pct = 100.0 * att[k] / total_att if total_att > 0 else 0.0
+        print(f"{k:<18} {att[k]:>9.3f} s {pct:>6.1f}%")
+    for hname, h in sorted(doc.get("histograms", {}).items()):
+        if not hname.endswith(("_dispatch_ms", "_wait_ms",
+                               "_dispatch_us", "_wait_us")):
+            continue
+        div = 1e3 if hname.endswith("_us") else 1.0
+        n = h.get("count", 0)
+        mean = h.get("sum", 0) / div / n if n else 0.0
+        print(f"  {hname}: n={n} mean={mean:.2f} ms "
+              f"sum={h.get('sum', 0) / div / 1000.0:.3f} s")
+
+
+def render_spans_file(path: str) -> None:
+    spans = load_spans(path)
     rows, wall = span_table(spans)
-    print(f"== spans: {args.spans} ({len(spans)} spans, "
+    print(f"== spans: {path} ({len(spans)} spans, "
           f"wall {wall:.3f} s) ==")
     print(f"{'span':<28} {'calls':>6} {'total_s':>9} {'mean_ms':>9} "
           f"{'%wall':>6}")
@@ -202,40 +255,56 @@ def main(argv=None) -> int:
         print(f"{label:<28} {calls:>6} {total:>9.3f} {mean_ms:>9.2f} "
               f"{pct:>6.1f}")
 
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Summarize span JSONL / metrics JSON / multi-host "
+                    "fleet documents into per-stage (and per-host) "
+                    "host/device/wait tables")
+    p.add_argument("files", nargs="+", metavar="FILE",
+                   help="Span JSONL (--trace-spans), metrics JSON "
+                        "(--metrics), or hosts/fleet documents "
+                        "(.hosts.json, push_receiver --out) — "
+                        "dispatched on content")
+    p.add_argument("--device", metavar="PROFILE_DIR", default=None,
+                   help="Parse the jax.profiler trace in this "
+                        "--profile directory and print the device-"
+                        "truth kernel attribution table "
+                        "(host dispatch / device execute / device "
+                        "idle per stage, top kernels)")
+    args = p.parse_args(argv)
+
     docs: list[dict] = []
-    for mpath in args.metrics:
+    for path in args.files:
         try:
-            doc = json.load(open(mpath))
-        except (OSError, ValueError) as e:
-            print(f"{mpath}: {e}", file=sys.stderr)
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"{path}: {e}", file=sys.stderr)
             return 1
-        docs.append(doc)
-        for tname, t in doc.get("timers", {}).items():
-            total = t.get("total_seconds", 0.0)
-            print(f"\n== timers: {mpath} [{tname}] "
-                  f"(total {total:.3f} s) ==")
-            print(f"{'stage':<20} {'calls':>6} {'seconds':>9} "
-                  f"{'%total':>7}  class")
-            for sname, st in t.get("stages", {}).items():
-                s = st.get("seconds", 0.0)
-                pct = 100.0 * s / total if total > 0 else 0.0
-                print(f"{sname:<20} {st.get('calls', 0):>6} "
-                      f"{s:>9.3f} {pct:>7.1f}  {_bucket(sname)}")
-        att = attribution(doc)
-        total_att = sum(att.values())
-        print(f"\n== attribution: {mpath} ==")
-        for k in ("host", "device dispatch", "device wait"):
-            pct = 100.0 * att[k] / total_att if total_att > 0 else 0.0
-            print(f"{k:<18} {att[k]:>9.3f} s {pct:>6.1f}%")
-        for hname, h in sorted(doc.get("histograms", {}).items()):
-            if not hname.endswith(("_dispatch_ms", "_wait_ms",
-                                   "_dispatch_us", "_wait_us")):
-                continue
-            div = 1e3 if hname.endswith("_us") else 1.0
-            n = h.get("count", 0)
-            mean = h.get("sum", 0) / div / n if n else 0.0
-            print(f"  {hname}: n={n} mean={mean:.2f} ms "
-                  f"sum={h.get('sum', 0) / div / 1000.0:.3f} s")
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            doc = None
+        if isinstance(doc, dict) and isinstance(doc.get("hosts"),
+                                                dict):
+            # a multi-host aggregate (driver .hosts.json or a
+            # push-receiver fleet document): per-host table first,
+            # then the aggregate's own tables
+            fleet_table(path, doc)
+            docs.append(doc)
+            render_metrics_doc(path, doc)
+        elif isinstance(doc, dict) and ("counters" in doc
+                                        or "timers" in doc):
+            docs.append(doc)
+            render_metrics_doc(path, doc)
+        else:
+            try:
+                render_spans_file(path)
+            except (ValueError, KeyError) as e:
+                print(f"{path}: not a span/metrics/fleet artifact "
+                      f"({e})", file=sys.stderr)
+                return 1
     if args.device:
         return device_attribution(args.device, docs)
     return 0
