@@ -63,6 +63,16 @@
 # a silently vanished metric fails like a slow one), with the
 # verdict document itself validated by metrics_check.
 #
+# ISSUE 12 adds the static-analysis gate: `quorum-lint --strict`
+# (tools/qlint.py) — the repo-aware rule suite (durable-write
+# discipline, lever/fault-site/counter registry consistency, hot-path
+# sync hygiene, daemon-thread exception hygiene, lock discipline,
+# dead code) must pass with an EMPTY baseline and an up-to-date
+# generated README lever table — and runs the tier-1 pytest pass
+# under QUORUM_TSAN=1, the runtime lock-order sanitizer
+# (quorum_tpu/analysis/tsan.py): an observed A->B / B->A lock
+# acquisition inversion fails the test that saw it.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -72,10 +82,31 @@
 #        SKIP_FSCK_SMOKE=1    skips the data-integrity fsck gate.
 #        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push/alert gate.
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
+#        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
+#                             sanitizer on the pytest pass.
 set -o pipefail
 set -u
 
 cd "$(dirname "$0")/.."
+
+qlint_rc=0
+tsan_env=""
+if [ "${SKIP_QLINT:-0}" = "1" ]; then
+    echo "ci/tier1.sh: quorum-lint gate skipped (SKIP_QLINT=1)"
+else
+    # the static-analysis gate (ISSUE 12): findings fail, a non-empty
+    # qlint_baseline.json fails, a drifted README lever table fails.
+    # Cheap (pure AST, no jax import), so it runs first.
+    echo "== quorum-lint --strict =="
+    python tools/qlint.py --strict || qlint_rc=$?
+    if [ "$qlint_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: quorum-lint gate FAILED (rc=$qlint_rc)" >&2
+    fi
+    # the runtime half of the concurrency sanitizer rides the pytest
+    # pass below: every lock constructed under the suite records its
+    # acquisition order, inversions fail the observing test
+    tsan_env="QUORUM_TSAN=1"
+fi
 
 # hermetic lever resolution: an ambient autotune profile written by a
 # developer's quorum-autotune run (~/.cache/quorum_tpu/autotune) must
@@ -87,7 +118,10 @@ export QUORUM_AUTOTUNE_PROFILE="${QUORUM_AUTOTUNE_PROFILE:-}"
 
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# $tsan_env is "QUORUM_TSAN=1" unless SKIP_QLINT=1 — the runtime
+# lock-order sanitizer rides the whole pytest pass (unquoted on
+# purpose: empty expands to no arg)
+timeout -k 10 870 env JAX_PLATFORMS=cpu $tsan_env python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee /tmp/_t1.log
@@ -315,6 +349,7 @@ else
     fi
 fi
 
+if [ "$qlint_rc" -ne 0 ]; then exit "$qlint_rc"; fi
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
 if [ "$resume_rc" -ne 0 ]; then exit "$resume_rc"; fi

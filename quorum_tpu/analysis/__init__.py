@@ -1,0 +1,39 @@
+"""quorum_tpu.analysis: the repo-aware static-analysis suite and
+concurrency sanitizer behind `quorum-lint` (ISSUE 12).
+
+Each rule encodes a bug class a past hardening PR fixed by hand, so
+the next instance fails CI instead of waiting for a reviewer:
+
+=========================  ============================================
+rule                       bug class (origin)
+=========================  ============================================
+raw-artifact-write         non-atomic tmp+rename copies (PR 2/8)
+append-truncation          "wb" re-open truncating a stream (PR 11)
+lever-raw-env-read         env reads bypassing the catalog
+lever-undeclared /         QUORUM_* surface drifting from docs
+lever-unused
+fault-site-undeclared /    fault plans naming dead sites (PR 4)
+fault-site-unused
+counter-not-precreated     SERVE_FEATURE_COUNTERS lesson (PR 7)
+hot-path-sync              untimed host syncs in dispatch loops (PR 6/9)
+thread-swallowed-exception silent push-daemon death (PR 10)
+lock-unguarded-write       serve snapshot races (PR 7)
+lock-order-inversion       + runtime twin in analysis/tsan.py
+unused-definition          refactor orphans
+=========================  ============================================
+
+Import surface: `run_lint` for tests/tools, `tsan` for the runtime
+sanitizer, `cli.main` for the entry point.
+"""
+
+from . import tsan  # noqa: F401
+from .core import Finding, Project, run_rules  # noqa: F401
+
+
+def run_lint(root: str, rule_ids=None):
+    """Lint the repo at `root` with the full rule set (or a subset);
+    returns the surviving findings. The programmatic twin of the CLI
+    used by tests and tools."""
+    from . import (rules_deadcode, rules_hotpath, rules_io,  # noqa: F401
+                   rules_locks, rules_registry, rules_threads)
+    return run_rules(Project(root), rule_ids)

@@ -1,0 +1,310 @@
+"""quorum-lint core: project loading, findings, suppressions,
+baseline (ISSUE 12).
+
+The suite is AST-based and repo-aware: every rule encodes a bug class
+a past hardening PR actually fixed by hand (the `"wb"` re-open that
+truncated the event JSONL, the copied non-atomic tmp+rename writes,
+the swallowed HTTPException that silently killed the push daemon, the
+lock-free-snapshot races in serve), so the next instance fails CI
+instead of waiting for the next hand audit. Rules register with
+:func:`rule`; the CLI (analysis/cli.py) loads the whole repo once
+into a :class:`Project` and hands it to each rule.
+
+Suppression and exception handling:
+
+* ``# qlint: disable=RULE[,RULE...]`` on the finding's line (or on
+  the opening line of its statement) suppresses it — used for the
+  genuinely-intended cases (streaming outputs that cannot be atomic,
+  a lock-free snapshot that is the documented design);
+* a committed ``qlint_baseline.json`` grandfathers known findings
+  (kept EMPTY on main — the fix sweep is part of the deal; the
+  baseline exists so a red lint can land in an emergency without
+  deleting the gate);
+* ``--strict`` (what ci/tier1.sh runs) additionally fails when the
+  baseline is non-empty or the generated docs drifted.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+
+# -- findings -------------------------------------------------------------
+
+SEV_ERROR = "error"
+SEV_INFO = "info"
+
+
+class Finding:
+    """One lint result: where, which rule, what, and how to fix it."""
+
+    __slots__ = ("rule", "path", "line", "message", "hint", "severity")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 hint: str = "", severity: str = SEV_ERROR):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.hint = hint
+        self.severity = severity
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "message": self.message, "severity": self.severity}
+
+
+# -- rule registry --------------------------------------------------------
+
+RULES: dict[str, "Rule"] = {}
+
+
+class Rule:
+    __slots__ = ("id", "doc", "fn")
+
+    def __init__(self, id_: str, doc: str, fn):
+        self.id = id_
+        self.doc = doc
+        self.fn = fn
+
+
+def rule(id_: str, doc: str):
+    """Register a rule: `fn(project) -> list[Finding]`."""
+    def deco(fn):
+        RULES[id_] = Rule(id_, doc, fn)
+        return fn
+    return deco
+
+
+# -- source files ---------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*qlint:\s*disable=([\w,-]+)")
+
+
+class SourceFile:
+    """One parsed file: text, AST, per-line suppressions."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:  # pragma: no cover - repo parses
+            self.parse_error = str(e)
+        # line -> set of rule ids disabled on that line
+        self.suppressed: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppressed[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        return rule_id in self.suppressed.get(line, ())
+
+    @property
+    def in_package(self) -> bool:
+        return self.rel.startswith("quorum_tpu/")
+
+    @property
+    def in_tools(self) -> bool:
+        return self.rel.startswith("tools/")
+
+    @property
+    def in_tests(self) -> bool:
+        return self.rel.startswith("tests/")
+
+
+# -- the project ----------------------------------------------------------
+
+# what a default lint walks: the package, the tools shims, the bench
+# harness, and the tests (tests are scanned for *references* — usage
+# of a lever or a helper from a test keeps it alive — but rules that
+# report findings restrict themselves to package/tools scopes).
+DEFAULT_ROOTS = ("quorum_tpu", "tools", "tests", "bench.py")
+
+_SKIP_DIRS = {"__pycache__", ".git", "golden", ".claude"}
+
+
+class Project:
+    """The loaded repo: every scanned file, parsed once, plus the
+    helpers rules share (identifier usage index, function walker)."""
+
+    def __init__(self, root: str, roots=DEFAULT_ROOTS):
+        self.root = os.path.abspath(root)
+        self.files: dict[str, SourceFile] = {}
+        self._word_cache: dict[str, set[str]] = {}
+        for entry in roots:
+            full = os.path.join(self.root, entry)
+            if os.path.isfile(full):
+                self._load(entry)
+            elif os.path.isdir(full):
+                for dirpath, dirnames, filenames in os.walk(full):
+                    dirnames[:] = [d for d in dirnames
+                                   if d not in _SKIP_DIRS]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            rel = os.path.relpath(
+                                os.path.join(dirpath, fn), self.root)
+                            self._load(rel.replace(os.sep, "/"))
+
+    def _load(self, rel: str) -> None:
+        try:
+            with open(os.path.join(self.root, rel),
+                      encoding="utf-8") as f:
+                self.files[rel] = SourceFile(rel, f.read())
+        except OSError:  # pragma: no cover - racing deletes
+            pass
+
+    def package_files(self):
+        return [f for f in self.files.values() if f.in_package]
+
+    def get(self, rel: str) -> SourceFile | None:
+        return self.files.get(rel)
+
+    # -- cross-file identifier usage (deadcode, lever-unused) ------------
+    _WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+    def words_in(self, rel: str) -> set[str]:
+        """All identifier-shaped tokens in one file (string literals
+        and comments included — a name mentioned in a docstring table
+        or built via getattr stays 'used'; this rule errs alive)."""
+        cached = self._word_cache.get(rel)
+        if cached is None:
+            cached = set(self._WORD_RE.findall(self.files[rel].text))
+            self._word_cache[rel] = cached
+        return cached
+
+    def usage_count(self, name: str, exclude_rel: str | None = None
+                    ) -> int:
+        """How many files mention `name` (identifier-boundary match),
+        optionally excluding one file (the definition's own)."""
+        n = 0
+        for rel in self.files:
+            if rel == exclude_rel:
+                continue
+            if name in self.words_in(rel):
+                n += 1
+        return n
+
+
+# -- AST helpers shared by the rules --------------------------------------
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef with its qualname
+    ("Class.method" / "outer.<locals>.inner")."""
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield child, qual
+                yield from visit(child, f"{qual}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+    yield from visit(tree, "")
+
+
+def call_name(call: ast.Call) -> str:
+    """Dotted best-effort name of a call target: "os.replace",
+    "self._work.notify", "open"."""
+    return dotted(call.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(dotted(node.func) + "()")
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# -- baseline -------------------------------------------------------------
+
+BASELINE_NAME = "qlint_baseline.json"
+
+
+def load_baseline(path: str) -> list[dict]:
+    """The committed exception list: [{"rule", "file", "line"?}, ...].
+    A missing file is an empty baseline; a malformed one is a loud
+    error (a silently ignored baseline would un-gate CI)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("findings", doc) if isinstance(doc, dict) else doc
+    if not isinstance(entries, list) or not all(
+            isinstance(e, dict) and "rule" in e and "file" in e
+            for e in entries):
+        raise ValueError(
+            f"{path}: baseline must be a list of "
+            "{{rule, file[, line]}} objects")
+    return entries
+
+
+def baseline_matches(entry: dict, finding: Finding) -> bool:
+    if entry["rule"] != finding.rule or entry["file"] != finding.path:
+        return False
+    return "line" not in entry or int(entry["line"]) == finding.line
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict]
+                   ) -> tuple[list[Finding], list[dict]]:
+    """Split findings into (surviving, matched-entry list). An entry
+    can absorb any number of findings (file-wide when no line)."""
+    used: list[dict] = []
+    live: list[Finding] = []
+    for f in findings:
+        hit = next((e for e in entries if baseline_matches(e, f)), None)
+        if hit is None:
+            live.append(f)
+        elif hit not in used:
+            used.append(hit)
+    return live, used
+
+
+# -- driver ---------------------------------------------------------------
+
+def run_rules(project: Project, rule_ids=None) -> list[Finding]:
+    """Run the selected rules (default: all), drop suppressed
+    findings, return the rest sorted by location."""
+    ids = sorted(RULES) if rule_ids is None else list(rule_ids)
+    findings: list[Finding] = []
+    for rid in ids:
+        r = RULES.get(rid)
+        if r is None:
+            raise KeyError(f"unknown rule {rid!r} "
+                           f"(known: {', '.join(sorted(RULES))})")
+        for f in r.fn(project):
+            src = project.get(f.path)
+            if src is not None and src.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

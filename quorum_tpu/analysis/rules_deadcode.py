@@ -1,0 +1,160 @@
+"""Unused-definition rule (ISSUE 12 satellite): dead-code detection
+tuned for THIS repo's layout.
+
+Twelve PRs of refactors leave orphans — a helper whose last caller
+was folded into a shared idiom, an import kept from a deleted code
+path. Dead code is not free: it gets read, maintained, and (worst)
+trusted as load-bearing by the next refactor. The rule:
+
+* module-level functions and classes in ``quorum_tpu/`` whose name is
+  referenced in NO other scanned file and nowhere else in their own
+  module (tests count as references — a test-only helper is alive);
+* imports a module never references (``__init__.py`` re-exports and
+  conventional-alias imports are exempt).
+
+Findings in ``tools/`` are INFO severity (report-only, per the
+issue): the smoke tools are invoked by ci/tier1.sh with their whole
+surface, and deleting there is a human call.
+
+Usage detection is identifier-boundary text search across every
+scanned file including strings and comments — ``getattr``-style
+dynamic dispatch and doc references keep a symbol alive. The rule
+errs toward NOT flagging; what it does flag really has zero textual
+referents anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import SEV_ERROR, SEV_INFO, Finding, rule
+
+# names with implicit callers: entry points (pyproject scripts),
+# pytest hooks/fixtures, dunder machinery
+_IMPLICIT = {"main", "bench_main"}
+
+# conventional side-effect / namespace imports that exist to be
+# re-exported or to register something at import time
+_ALIAS_OK = {"annotations"}
+
+
+def _module_defs(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            yield node
+
+
+def _decorated_implicit(node) -> bool:
+    for dec in node.decorator_list:
+        text = ast.unparse(dec)
+        if "fixture" in text or "register" in text or "rule" in text:
+            return True
+    return False
+
+
+@rule("unused-definition",
+      "module-level def/class or import nothing references")
+def unused_definition(project):
+    findings = []
+    for src in project.files.values():
+        if src.tree is None or src.in_tests:
+            continue
+        if not (src.in_package or src.in_tools):
+            continue
+        severity = SEV_INFO if src.in_tools else SEV_ERROR
+        is_init = src.rel.endswith("__init__.py")
+
+        # --- defs and classes -----------------------------------------
+        for node in _module_defs(src.tree):
+            name = node.name
+            if (name.startswith("__") or name in _IMPLICIT
+                    or _decorated_implicit(node)):
+                continue
+            # own-module references beyond the def line itself: a
+            # local caller or a docstring pointer — alive either way
+            if _mentions_beyond_def(src, name):
+                continue
+            if project.usage_count(name, exclude_rel=src.rel) > 0:
+                continue
+            kind = ("class" if isinstance(node, ast.ClassDef)
+                    else "function")
+            findings.append(Finding(
+                "unused-definition", src.rel, node.lineno,
+                f"{kind} {name} has no reference anywhere in the "
+                "scanned tree (package, tools, tests, bench)",
+                "delete it (git remembers), or wire up the caller "
+                "it was written for",
+                severity=severity))
+
+        # --- imports --------------------------------------------------
+        if is_init:
+            continue  # __init__ imports ARE the public surface
+        for node in src.tree.body:
+            imported: list[tuple[str, int]] = []
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    imported.append((name, node.lineno))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    name = alias.asname or alias.name
+                    imported.append((name, node.lineno))
+            for name, line in imported:
+                if name in _ALIAS_OK or name.startswith("_"):
+                    continue
+                # `# noqa` on the import line: a declared side-effect
+                # or registration import (the rule modules themselves)
+                if line <= len(src.lines) and "noqa" in \
+                        src.lines[line - 1]:
+                    continue
+                if _mentions_beyond_import(src, name):
+                    continue
+                findings.append(Finding(
+                    "unused-definition", src.rel, line,
+                    f"import {name} is never used in this module",
+                    "remove the import",
+                    severity=severity))
+    return findings
+
+
+def _mentions_beyond_def(src, name: str) -> bool:
+    """Does `name` appear on any line that is not its own def/class
+    line or a decorator line directly above one?"""
+    hits = 0
+    for line in src.lines:
+        if f"def {name}" in line or f"class {name}" in line:
+            continue
+        if _word_in(line, name):
+            hits += 1
+    return hits > 0
+
+
+def _mentions_beyond_import(src, name: str) -> bool:
+    for line in src.lines:
+        stripped = line.strip()
+        if stripped.startswith(("import ", "from ")) and \
+                _word_in(line, name):
+            continue
+        if _word_in(line, name):
+            return True
+    return False
+
+
+def _word_in(line: str, name: str) -> bool:
+    i = 0
+    while True:
+        i = line.find(name, i)
+        if i < 0:
+            return False
+        before = line[i - 1] if i else " "
+        after_idx = i + len(name)
+        after = line[after_idx] if after_idx < len(line) else " "
+        if not (before.isalnum() or before == "_") and not (
+                after.isalnum() or after == "_"):
+            return True
+        i += 1

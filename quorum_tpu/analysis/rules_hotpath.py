@@ -1,0 +1,201 @@
+"""Hot-path hygiene rule (ISSUE 12 rule 3).
+
+The per-batch dispatch loops are the performance contract of this
+repo: stage 1/stage 2 throughput comes from keeping the device fed,
+and every host sync the loop takes OUTSIDE the measured dispatch/wait
+window is invisible stall time — it neither shows up in the
+`*_dispatch_us`/`*_wait_us` attribution (PR 2) nor in the devtrace
+idle split (PR 10), it just makes the run slower and the telemetry
+wrong. PERF_NOTES round 6 measured exactly this shape binding
+multi-device scaling before the host pipeline was sharded.
+
+``hot-path-sync`` scans the dispatch regions of the four device-loop
+modules (models/create_database.py, models/error_correct.py,
+ops/ctable.py, serve/engine.py). A *dispatch region* is the body of
+any function that calls ``observe_dispatch_wait`` or dispatches under
+``tracer.step(...)``. Inside it, these force or risk a host sync:
+
+* ``jax.block_until_ready`` / ``jax.device_get`` / ``.item()``
+* ``np.asarray(x)`` and ``bool/int/float(x)`` where ``x`` is a name
+  produced by the traced device step
+
+and each is a finding unless it sits in a **recognized timer
+section**:
+
+* between ``time.perf_counter()`` stamps that feed
+  ``observe_dispatch_wait`` (the measured window — where the ONE
+  deliberate sync point belongs), or
+* inside a ``with timer.stage(...)`` block (grow/checkpoint/seal
+  phases measure their own sync), or
+* a ready-data copy: the argument names a traced step output and an
+  earlier, timed sync in the same function already awaited that step
+  (pulling an already-materialized flag D2H is a copy, not a stall).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, rule, walk_functions
+
+SCOPE = (
+    "quorum_tpu/models/create_database.py",
+    "quorum_tpu/models/error_correct.py",
+    "quorum_tpu/ops/ctable.py",
+    "quorum_tpu/serve/engine.py",
+)
+
+_ALWAYS_SYNC = ("jax.block_until_ready", "block_until_ready",
+                "jax.device_get", "device_get")
+_CAST_FUNCS = ("bool", "int", "float", "np.asarray", "numpy.asarray")
+
+
+def _walk_no_defs(fn: ast.AST):
+    """Walk a function body without descending into nested function/
+    class definitions (their statements execute elsewhere)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_perf_counter_assign(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and call_name(node.value) in ("time.perf_counter",
+                                          "perf_counter"))
+
+
+def _tracer_step_withs(fn: ast.AST):
+    """`with tracer.step(...)` / `with self.tracer.step(...)` blocks
+    directly in this function (not nested defs)."""
+    for node in _walk_no_defs(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        call_name(item.context_expr).endswith(
+                            "tracer.step"):
+                    yield node
+
+
+def _step_result_names(fn: ast.AST) -> set[str]:
+    """Names assigned inside `with tracer.step(...)` blocks — the
+    device step's outputs (tuple targets included)."""
+    names: set[str] = set()
+    for w in _tracer_step_withs(fn):
+        for node in ast.walk(w):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, ast.Name):
+                            names.add(leaf.id)
+    return names
+
+
+def _timer_stage_spans(fn: ast.AST) -> list[tuple[int, int]]:
+    spans = []
+    for node in _walk_no_defs(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call) and (
+                    call_name(ce).endswith("timer.stage")
+                    or call_name(ce) == "timer"):
+                spans.append((node.lineno, node.end_lineno or
+                              node.lineno))
+    return spans
+
+
+def _sync_calls(fn: ast.AST, step_names: set[str]):
+    """(node, why) for every potential host sync in this function
+    (not descending into nested defs)."""
+    for node in _walk_no_defs(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node)
+        if fname in _ALWAYS_SYNC:
+            yield node, f"{fname}() blocks on the device"
+            continue
+        if fname.endswith(".item") and not node.args:
+            yield node, ".item() forces a D2H sync"
+            continue
+        if fname in _CAST_FUNCS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in step_names:
+                yield node, (f"{fname}({arg.id}) syncs on a device-"
+                             "step output")
+
+
+def _find_regions(tree: ast.Module):
+    """Functions whose body is a dispatch region."""
+    for fn, qual in walk_functions(tree):
+        has_observe = any(
+            isinstance(n, ast.Call)
+            and call_name(n).endswith("observe_dispatch_wait")
+            for n in _walk_no_defs(fn))
+        has_step = any(True for _ in _tracer_step_withs(fn))
+        if has_observe or has_step:
+            yield fn, qual
+
+
+@rule("hot-path-sync",
+      "host sync in a per-batch dispatch loop outside a timer section")
+def hot_path_sync(project):
+    findings = []
+    for rel in SCOPE:
+        src = project.get(rel)
+        if src is None or src.tree is None:
+            continue
+        for fn, qual in _find_regions(src.tree):
+            perf_lines = sorted(
+                n.lineno for n in _walk_no_defs(fn)
+                if _is_perf_counter_assign(n))
+            observe_lines = sorted(
+                n.lineno for n in _walk_no_defs(fn)
+                if isinstance(n, ast.Call)
+                and call_name(n).endswith("observe_dispatch_wait"))
+            timer_spans = _timer_stage_spans(fn)
+            step_names = _step_result_names(fn)
+
+            def timed(line: int) -> bool:
+                # the measured window: a perf_counter stamp before
+                # AND a later stamp or the observe call after — the
+                # sync is exactly what the wait histogram measures
+                before = any(p < line for p in perf_lines)
+                after = any(p > line for p in perf_lines) or any(
+                    o >= line for o in observe_lines)
+                return before and after
+
+            def in_timer_stage(line: int) -> bool:
+                return any(lo <= line <= hi for lo, hi in timer_spans)
+
+            exempt_lines: list[int] = []
+            for node, why in sorted(
+                    _sync_calls(fn, step_names),
+                    key=lambda p: p[0].lineno):
+                line = node.lineno
+                if timed(line) or in_timer_stage(line):
+                    exempt_lines.append(line)
+                    continue
+                # ready-data copy: this step's outputs were already
+                # awaited by an earlier, timed sync
+                arg = node.args[0] if node.args else None
+                if (isinstance(arg, ast.Name)
+                        and arg.id in step_names
+                        and any(e < line for e in exempt_lines)):
+                    exempt_lines.append(line)
+                    continue
+                findings.append(Finding(
+                    "hot-path-sync", rel, line,
+                    f"{why} inside dispatch region {qual} but "
+                    "outside any recognized timer section — stall "
+                    "time invisible to the dispatch/wait attribution",
+                    "move it inside the perf_counter window feeding "
+                    "observe_dispatch_wait (or a timer.stage block), "
+                    "or defer the host read out of the loop"))
+    return findings

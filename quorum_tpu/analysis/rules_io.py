@@ -1,0 +1,156 @@
+"""Durable-write discipline rules (ISSUE 12 rule 1).
+
+PR 2 found FOUR hand-copied non-atomic tmp+rename writes and folded
+them into `telemetry.registry.atomic_write`; PR 8 added fsync-the-
+directory durability to that one place; PR 11's hardening pass found
+the events JSONL being lazily re-opened `"wb"` — a truncation of the
+stream it meant to append to. Both classes are mechanical, so both
+are rules now:
+
+* ``raw-artifact-write`` — an ``open(path, "w"/"wb"/"a"/...)``
+  landing a run artifact must either be part of the atomic idiom
+  (the enclosing function also calls ``os.replace`` — which is what
+  ``atomic_write``, ``_atomic_db_write`` and the checkpoint writers
+  look like) or be a recognized stream (``.partial`` outputs, the
+  quarantine FASTQ — paths whose expression says so), or carry an
+  explicit ``# qlint: disable=raw-artifact-write`` with its
+  justification. Anything else is a torn-file-on-crash waiting for a
+  reader.
+* ``append-truncation`` — the PR-11 class: a truncating re-open of an
+  instance-held path (``self.<attr>``) from more than one call site
+  in a module. The second open destroys what the first wrote; streams
+  must open once (guarded) and append thereafter.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, call_name, const_str, dotted, rule,
+                   walk_functions)
+
+_WRITE_MODES = ("w", "a", "x")
+
+# substrings in the PATH EXPRESSION that mark a genuine stream (the
+# allowlist the issue calls for): .partial outputs are journaled and
+# committed by rename at finalize, quarantine files are append-streams
+# of rejected raw records. Deliberately NOT "tmp": a .tmp write is
+# only fine when the enclosing function also os.replace()s it (the
+# separate atomic-idiom check) — exempting the substring would waive
+# exactly the write-the-tmp-but-forget-the-replace case.
+_STREAM_MARKERS = ("partial", "quarantine")
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """The constant mode string of a builtin open() call, or None
+    when it isn't a literal-mode builtin open."""
+    if call_name(call) != "open":
+        return None
+    mode = None
+    if len(call.args) >= 2:
+        mode = const_str(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = const_str(kw.value)
+    return mode
+
+
+def _is_write_mode(mode: str) -> bool:
+    # "r+b" (in-place patching, the corrupt fault action) is not a
+    # create/truncate/append — only w/a/x modes land new artifacts
+    return any(m in mode for m in _WRITE_MODES)
+
+
+def _path_expr(call: ast.Call) -> str:
+    if call.args:
+        return ast.unparse(call.args[0])
+    for kw in call.keywords:
+        if kw.arg == "file":
+            return ast.unparse(kw.value)
+    return ""
+
+
+@rule("raw-artifact-write",
+      "open() with a write mode outside the atomic-replace idiom")
+def raw_artifact_write(project):
+    findings = []
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        # map every call to its innermost enclosing function (outer
+        # functions yield before nested ones, so the last write wins)
+        # — module-level calls fall back to the module region
+        owner: dict[int, tuple[ast.AST, str]] = {}
+        for node, qual in walk_functions(src.tree):
+            for call in ast.walk(node):
+                if isinstance(call, ast.Call):
+                    owner[id(call)] = (node, qual)
+        for call in ast.walk(src.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            mode = _open_mode(call)
+            if mode is None or not _is_write_mode(mode):
+                continue
+            region, qual = owner.get(id(call), (src.tree, "<module>"))
+            # the atomic idiom: the same function later os.replace()s
+            # the tmp file into place (atomic_write, _atomic_db_write,
+            # and the checkpoint writers all look like this)
+            replaces = any(
+                call_name(c) in ("os.replace", "os.rename")
+                for c in ast.walk(region) if isinstance(c, ast.Call))
+            if replaces:
+                continue
+            path_src = _path_expr(call)
+            if any(m in path_src.lower() for m in _STREAM_MARKERS):
+                continue
+            findings.append(Finding(
+                "raw-artifact-write", src.rel, call.lineno,
+                f"open({path_src!r}, {mode!r}) in {qual} lands an "
+                "artifact without the atomic-replace idiom (crash = "
+                "torn file for every later reader)",
+                "use telemetry.registry.atomic_write / "
+                "io.db_format._atomic_db_write, or write a sibling "
+                ".tmp and os.replace it; a genuine stream takes "
+                "# qlint: disable=raw-artifact-write with its reason"))
+    return findings
+
+
+@rule("append-truncation",
+      "truncating re-open of an instance-held path (PR-11 JSONL class)")
+def append_truncation(project):
+    findings = []
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        sites: dict[str, list[ast.Call]] = {}
+        for call in ast.walk(src.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            mode = _open_mode(call)
+            if mode is None or "w" not in mode:
+                continue
+            if not call.args:
+                continue
+            path = call.args[0]
+            # only instance-held paths: the bug class is a long-lived
+            # object lazily re-opening ITS OWN stream (locals named
+            # `tmp` in two writer functions are unrelated files)
+            if not (isinstance(path, ast.Attribute)
+                    and isinstance(path.value, ast.Name)
+                    and path.value.id == "self"):
+                continue
+            sites.setdefault(dotted(path), []).append(call)
+        for path_src, calls in sorted(sites.items()):
+            if len(calls) < 2:
+                continue
+            for call in calls:
+                findings.append(Finding(
+                    "append-truncation", src.rel, call.lineno,
+                    f"{path_src} is opened with a truncating mode at "
+                    f"{len(calls)} call sites in this module — a "
+                    "re-open destroys the stream the first open was "
+                    "building (the PR-11 events-JSONL truncation)",
+                    "open the stream once behind a guard (if self._f "
+                    "is None) and seal it on close; a second writer "
+                    "must append or go through the guard"))
+    return findings

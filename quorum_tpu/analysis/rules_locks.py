@@ -1,0 +1,331 @@
+"""Lock discipline rules (ISSUE 12 rule 5): the static half of the
+concurrency sanitizer.
+
+The serve tier and the telemetry exporters are the two places the
+repo runs real thread concurrency (dispatcher/watchdog/HTTP handlers;
+heartbeat/ticker/push/scrape), and PRs 7, 10, and 11 each hand-fixed
+a race here — the lock-free warm_lengths snapshot, the receiver
+writing its fleet doc outside its lock, the straggler event after
+write(). ROADMAP items 1 and 4 (multi-host fleet, online counting
+fused into the threaded serve engine) multiply the hazard. Two
+passes:
+
+* ``lock-unguarded-write`` — a lockset pass per class (and per
+  module-level lock) over the nine lock-bearing modules: an attribute
+  that is mutated under ``with self._lock`` somewhere is a
+  lock-guarded attribute, so mutating it WITHOUT the lock elsewhere
+  is a finding. Convention honored: methods named ``*_locked`` assert
+  the caller holds the lock; ``__init__`` constructs before the
+  object escapes. A deliberate lock-free snapshot (serve/engine's
+  warm_lengths) carries an inline disable with its reason.
+* ``lock-order-inversion`` — cross-module acquisition edges (a
+  ``with``-lock block that calls into a method known to take another
+  catalogued lock, or lexically nests one) checked against
+  :data:`LOCK_ORDER`, the declared global order. An edge from a
+  later-ranked lock into an earlier-ranked one is an inversion — the
+  static mirror of what analysis/tsan.py detects at runtime.
+
+The declared order (outermost first). Telemetry locks rank below
+serve locks because exporters/alert evaluation are CALLED FROM serve
+paths holding serve locks, never the reverse; the registry lock is
+the innermost of all — metric increments happen under everything.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted, rule
+
+SCOPE = (
+    "quorum_tpu/serve/batcher.py",
+    "quorum_tpu/serve/server.py",
+    "quorum_tpu/serve/admission.py",
+    "quorum_tpu/telemetry/export.py",
+    "quorum_tpu/telemetry/alerts.py",
+    "quorum_tpu/telemetry/spans.py",
+    "quorum_tpu/telemetry/registry.py",
+    "quorum_tpu/utils/faults.py",
+    "quorum_tpu/ops/tuning.py",
+)
+
+# Lock keys are "<module-stem>.<Class>.<attr>" or "<module-stem>.<name>"
+# for module-level locks. Outermost (acquired first) ranks first.
+LOCK_ORDER = (
+    "server.CorrectionHTTPServer._reload_lock",
+    "server.CorrectionHTTPServer._req_lock",
+    "batcher.Batcher._lock",
+    "admission.TokenBucketQuota._lock",
+    "alerts.AlertEngine._lock",
+    "export._LIVE_LOCK",
+    "spans.SpanTracer._lock",
+    "registry.MetricsRegistry._lock",
+    "faults.FaultPlan._lock",
+    "tuning._lock",
+)
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock",
+               "threading.Condition", "Lock", "RLock", "Condition")
+
+
+def _stem(rel: str) -> str:
+    return rel.rsplit("/", 1)[-1][:-3]
+
+
+class _ClassLocks:
+    """Lock attributes of one class, with Condition aliases folded
+    onto the lock they wrap."""
+
+    def __init__(self, cls: ast.ClassDef, stem: str):
+        self.cls = cls
+        self.stem = stem
+        self.attrs: dict[str, str] = {}  # attr -> canonical attr
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call):
+                continue
+            ctor = call_name(node.value)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    canonical = tgt.attr
+                    if "Condition" in ctor and node.value.args:
+                        wrapped = node.value.args[0]
+                        if (isinstance(wrapped, ast.Attribute)
+                                and isinstance(wrapped.value, ast.Name)
+                                and wrapped.value.id == "self"):
+                            canonical = wrapped.attr
+                    self.attrs[tgt.attr] = canonical
+
+    def key(self, attr: str) -> str:
+        return f"{self.stem}.{self.cls.name}.{self.attrs[attr]}"
+
+
+def _module_locks(tree: ast.Module, stem: str) -> dict[str, str]:
+    """Module-global lock names -> key."""
+    locks = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and call_name(
+                    node.value) in _LOCK_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    locks[tgt.id] = f"{stem}.{tgt.id}"
+    return locks
+
+
+def _with_lock_items(node: ast.With, cl: _ClassLocks | None,
+                     mod_locks: dict[str, str]) -> list[str]:
+    """Lock keys this `with` statement acquires."""
+    keys = []
+    for item in node.items:
+        ce = item.context_expr
+        if (isinstance(ce, ast.Attribute)
+                and isinstance(ce.value, ast.Name)
+                and ce.value.id == "self"
+                and cl is not None and ce.attr in cl.attrs):
+            keys.append(cl.key(ce.attr))
+        elif isinstance(ce, ast.Name) and ce.id in mod_locks:
+            keys.append(mod_locks[ce.id])
+    return keys
+
+
+def _collect(project):
+    """Per scoped module: (tree, stem, classes, mod_locks)."""
+    out = []
+    for rel in SCOPE:
+        src = project.get(rel)
+        if src is None or src.tree is None:
+            continue
+        stem = _stem(rel)
+        classes = {cls.name: _ClassLocks(cls, stem)
+                   for cls in src.tree.body
+                   if isinstance(cls, ast.ClassDef)}
+        classes = {name: cl for name, cl in classes.items()
+                   if cl.attrs}
+        out.append((src, stem, classes, _module_locks(src.tree, stem)))
+    return out
+
+
+def _store_attrs(node: ast.AST) -> list[tuple[str, int]]:
+    """self.X stores (plain or augmented) in the subtree."""
+    stores = []
+    for n in ast.walk(node):
+        tgts = []
+        if isinstance(n, ast.Assign):
+            tgts = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [n.target]
+        for tgt in tgts:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                stores.append((tgt.attr, tgt.lineno))
+            elif (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "self"):
+                stores.append((tgt.value.attr, tgt.lineno))
+    return stores
+
+
+@rule("lock-unguarded-write",
+      "mutation of a lock-guarded attribute without the lock")
+def lock_unguarded_write(project):
+    findings = []
+    for src, stem, classes, mod_locks in _collect(project):
+        for cls_node in src.tree.body:
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            cl = classes.get(cls_node.name)
+            if cl is None:
+                continue
+            methods = [m for m in cls_node.body if isinstance(
+                m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            # pass 1: attrs mutated under the lock anywhere
+            guarded: set[str] = set()
+            locked_spans: list[tuple[int, int]] = []
+            for m in methods:
+                for w in ast.walk(m):
+                    if isinstance(w, ast.With) and _with_lock_items(
+                            w, cl, mod_locks):
+                        locked_spans.append(
+                            (w.lineno, w.end_lineno or w.lineno))
+                        guarded.update(
+                            a for a, _ in _store_attrs(w))
+            guarded -= set(cl.attrs)  # the locks themselves
+            if not guarded:
+                continue
+
+            def under_lock(line: int) -> bool:
+                return any(lo <= line <= hi for lo, hi in locked_spans)
+
+            # pass 2: the same attrs mutated outside any locked span
+            for m in methods:
+                if m.name in ("__init__", "__del__", "__enter__",
+                              "__exit__") or m.name.endswith("_locked"):
+                    continue
+                for attr, line in _store_attrs(m):
+                    if attr not in guarded or under_lock(line):
+                        continue
+                    findings.append(Finding(
+                        "lock-unguarded-write", src.rel, line,
+                        f"self.{attr} is mutated under "
+                        f"{cls_node.name}'s lock elsewhere but "
+                        f"written here in {m.name}() without it — "
+                        "a concurrent reader can observe the torn "
+                        "update",
+                        "take the lock (or rename the method "
+                        "*_locked if every caller already holds it); "
+                        "a deliberate lock-free snapshot takes "
+                        "# qlint: disable=lock-unguarded-write "
+                        "with its reason"))
+    return findings
+
+
+# method names too generic to resolve by name across modules: a
+# `.close()` on a file object must not resolve to AlertEngine.close.
+# Cross-module edges only come from DISTINCTIVE method names.
+_GENERIC_METHODS = frozenset((
+    "close", "open", "write", "read", "get", "put", "set", "add",
+    "start", "stop", "run", "flush", "clear", "pop", "update",
+    "event", "inc", "observe", "append", "wait", "notify", "send",
+))
+
+
+def _lock_taking_methods(collected):
+    """(class name, method name) -> lock key, for resolving calls
+    made while holding a lock into acquisition edges."""
+    out: dict[str, str] = {}
+    for src, stem, classes, mod_locks in collected:
+        for cls_name, cl in classes.items():
+            cls_node = cl.cls
+            for m in cls_node.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if m.name in _GENERIC_METHODS:
+                    continue
+                for w in ast.walk(m):
+                    if isinstance(w, ast.With):
+                        for key in _with_lock_items(w, cl, mod_locks):
+                            out[f"{cls_name}.{m.name}"] = key
+    return out
+
+
+@rule("lock-order-inversion",
+      "lock acquisition order contradicting the declared LOCK_ORDER")
+def lock_order_inversion(project):
+    collected = _collect(project)
+    rank = {key: i for i, key in enumerate(LOCK_ORDER)}
+    takers = _lock_taking_methods(collected)
+    # method-name -> candidate lock keys (cross-module resolution is
+    # by name; collisions produce multiple candidates and we only
+    # report when EVERY candidate inverts — precision over recall)
+    by_method: dict[str, set[str]] = {}
+    for qual, key in takers.items():
+        by_method.setdefault(qual.rsplit(".", 1)[-1], set()).add(key)
+
+    findings = []
+    seen: set[tuple] = set()
+    for src, stem, classes, mod_locks in _collect(project):
+        for cls_node in [n for n in src.tree.body
+                         if isinstance(n, ast.ClassDef)] + [None]:
+            cl = classes.get(cls_node.name) if cls_node else None
+            scope_node = cls_node if cls_node else src.tree
+            # the module pass (cls_node None) must not re-walk class
+            # bodies — a module-lock acquisition inside a method is
+            # already covered by its class pass
+            class_spans = [] if cls_node else [
+                (n.lineno, n.end_lineno or n.lineno)
+                for n in src.tree.body if isinstance(n, ast.ClassDef)]
+            for w in ast.walk(scope_node):
+                if not isinstance(w, ast.With):
+                    continue
+                if any(lo <= w.lineno <= hi for lo, hi in class_spans):
+                    continue
+                held = _with_lock_items(w, cl, mod_locks)
+                if not held:
+                    continue
+                outer = held[0]
+                if outer not in rank:
+                    continue
+                inner_keys: list[tuple[str, int, str]] = []
+                for n in ast.walk(w):
+                    if isinstance(n, ast.With) and n is not w:
+                        for k in _with_lock_items(n, cl, mod_locks):
+                            inner_keys.append(
+                                (k, n.lineno, "nested with"))
+                    elif isinstance(n, ast.Call):
+                        name = call_name(n).rsplit(".", 1)[-1]
+                        cands = by_method.get(name, ())
+                        if cands and all(
+                                k in rank
+                                and rank[k] < rank[outer]
+                                for k in cands):
+                            inner_keys.append((
+                                sorted(cands)[0], n.lineno,
+                                f"call to {dotted(n.func)}() which "
+                                "acquires it"))
+                for inner, line, how in inner_keys:
+                    if inner == outer or inner not in rank:
+                        continue
+                    key = (src.rel, line, outer, inner)
+                    if key in seen:
+                        continue
+                    if rank[inner] < rank[outer]:
+                        seen.add(key)
+                        findings.append(Finding(
+                            "lock-order-inversion", src.rel, line,
+                            f"{outer} is held while acquiring "
+                            f"{inner} ({how}) but LOCK_ORDER ranks "
+                            f"{inner} OUTER — the reverse nesting "
+                            "elsewhere deadlocks",
+                            "acquire in declared order (analysis/"
+                            "rules_locks.LOCK_ORDER), or re-rank the "
+                            "order if this direction is the designed "
+                            "one everywhere"))
+    return findings

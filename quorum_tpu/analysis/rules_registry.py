@@ -1,0 +1,272 @@
+"""Declared-registry consistency rules (ISSUE 12 rule 2).
+
+Three registries exist precisely because their members kept drifting
+from their consumers: the env-lever catalog (utils/levers.py), the
+fault-site catalog (utils/faults.SITES), and the required-counter
+contract (telemetry/contract.py). Each rule checks BOTH directions —
+an undeclared use is a finding (it bypasses the registry) and an
+unused declaration is a finding (the registry is lying about the
+system's surface).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, const_str, rule
+
+# the modules that ARE the registries: reads/declarations inside them
+# are the mechanism, not a bypass
+_LEVERS_MODULE = "quorum_tpu/utils/levers.py"
+_FAULTS_MODULE = "quorum_tpu/utils/faults.py"
+
+_ENV_READ_FUNCS = ("os.environ.get", "os.getenv", "environ.get")
+_LEVER_FUNCS = ("levers.raw", "levers.get_bool")
+
+
+def _lever_catalog() -> dict:
+    from ..utils.levers import CATALOG
+    return CATALOG
+
+
+def _fault_sites() -> dict:
+    from ..utils.faults import SITES
+    return SITES
+
+
+def _env_read_name(call: ast.Call) -> str | None:
+    """The constant env-var name of an os.environ read, or None."""
+    if call_name(call) in _ENV_READ_FUNCS and call.args:
+        return const_str(call.args[0])
+    return None
+
+
+def _iter_env_reads(tree: ast.AST):
+    """(node, name) for every constant-name environ read: .get/getenv
+    calls plus `os.environ["X"]` subscripts in load context."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _env_read_name(node)
+            if name is not None:
+                yield node, name
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load):
+            base = ast.unparse(node.value)
+            if base in ("os.environ", "environ"):
+                name = const_str(node.slice)
+                if name is not None:
+                    yield node, name
+
+
+@rule("lever-raw-env-read",
+      "QUORUM_* env read in quorum_tpu/ bypassing utils.levers")
+def lever_raw_env_read(project):
+    findings = []
+    for src in project.package_files():
+        if src.tree is None or src.rel == _LEVERS_MODULE:
+            continue
+        for node, name in _iter_env_reads(src.tree):
+            if not name.startswith("QUORUM_"):
+                continue
+            findings.append(Finding(
+                "lever-raw-env-read", src.rel, node.lineno,
+                f"direct environ read of {name!r} bypasses the lever "
+                "catalog — a renamed or undeclared lever would "
+                "silently steer nothing",
+                "read it via quorum_tpu.utils.levers.raw(name) (or "
+                "the typed getters); declare the lever in "
+                "levers.CATALOG if it is new"))
+    return findings
+
+
+@rule("lever-undeclared",
+      "QUORUM_* name read anywhere but missing from levers.CATALOG")
+def lever_undeclared(project):
+    catalog = _lever_catalog()
+    findings = []
+    for src in project.files.values():
+        if src.tree is None or src.rel == _LEVERS_MODULE:
+            continue
+        if src.in_tests:
+            # tests may fabricate lever names to probe the catalog
+            # check itself; the package and tools must not
+            continue
+        seen: set[str] = set()
+        for node, name in _iter_env_reads(src.tree):
+            if not name.startswith("QUORUM_") or name in catalog:
+                continue
+            if name in seen:
+                continue
+            seen.add(name)
+            findings.append(Finding(
+                "lever-undeclared", src.rel, node.lineno,
+                f"{name!r} is read here but not declared in "
+                "utils/levers.py — undocumented, untyped, invisible "
+                "to --emit-docs",
+                "add a _declare(...) entry (name, type, default, one-"
+                "line doc) to quorum_tpu/utils/levers.py"))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _LEVER_FUNCS or not node.args:
+                continue
+            name = const_str(node.args[0])
+            if (name is None or not name.startswith("QUORUM_")
+                    or name in catalog or name in seen):
+                continue
+            seen.add(name)
+            findings.append(Finding(
+                "lever-undeclared", src.rel, node.lineno,
+                f"levers read of undeclared {name!r} (would raise "
+                "KeyError at runtime)",
+                "declare it in quorum_tpu/utils/levers.py"))
+    return findings
+
+
+@rule("lever-unused",
+      "levers.CATALOG entry nothing in the repo reads")
+def lever_unused(project):
+    catalog = _lever_catalog()
+    findings = []
+    levers_src = project.get(_LEVERS_MODULE)
+    for name in sorted(catalog):
+        if project.usage_count(name, exclude_rel=_LEVERS_MODULE) == 0:
+            line = 1
+            if levers_src is not None:
+                for i, text in enumerate(levers_src.lines, 1):
+                    if f'"{name}"' in text:
+                        line = i
+                        break
+            findings.append(Finding(
+                "lever-unused", _LEVERS_MODULE, line,
+                f"catalog declares {name!r} but nothing in the repo "
+                "reads it — the published lever table would lie",
+                "wire the lever up or delete the declaration"))
+    return findings
+
+
+@rule("fault-site-undeclared",
+      "faults.inject() site string missing from faults.SITES")
+def fault_site_undeclared(project):
+    sites = _fault_sites()
+    findings = []
+    for src in project.package_files():
+        if src.tree is None or src.rel == _FAULTS_MODULE:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = call_name(node)
+            if not (fn == "faults.inject" or fn.endswith(".inject")
+                    and "faults" in fn):
+                continue
+            if not node.args:
+                continue
+            name = const_str(node.args[0])
+            if name is None:
+                continue
+            # the shorthand "site@batch=N" never appears at inject
+            # call sites, but normalize anyway
+            base = name.partition("@")[0]
+            if base in sites:
+                continue
+            findings.append(Finding(
+                "fault-site-undeclared", src.rel, node.lineno,
+                f"inject site {name!r} is not declared in "
+                "utils/faults.SITES — plans targeting it work by "
+                "accident and the site list in the module doc lies",
+                "declare the site (name -> where it fires) in "
+                "quorum_tpu/utils/faults.py SITES"))
+    return findings
+
+
+@rule("fault-site-unused",
+      "faults.SITES entry with no live inject() call")
+def fault_site_unused(project):
+    sites = _fault_sites()
+    live: set[str] = set()
+    for src in project.package_files():
+        if src.tree is None or src.rel == _FAULTS_MODULE:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and call_name(
+                    node) == "faults.inject" and node.args:
+                name = const_str(node.args[0])
+                if name:
+                    live.add(name.partition("@")[0])
+    findings = []
+    faults_src = project.get(_FAULTS_MODULE)
+    for name in sorted(sites):
+        if name in live:
+            continue
+        line = 1
+        if faults_src is not None:
+            for i, text in enumerate(faults_src.lines, 1):
+                if f'"{name}"' in text:
+                    line = i
+                    break
+        findings.append(Finding(
+            "fault-site-unused", _FAULTS_MODULE, line,
+            f"SITES declares {name!r} but no faults.inject() call "
+            "carries it — plans naming the site silently never fire",
+            "remove the declaration or restore the inject() call"))
+    return findings
+
+
+@rule("counter-not-precreated",
+      "contract-required counter with no literal .counter() creation")
+def counter_not_precreated(project):
+    """The PR-7 SERVE_FEATURE_COUNTERS lesson: a counter the contract
+    requires (telemetry/contract.py) only appears in documents if the
+    code CREATES it — at setup, so a zero value still lands. This
+    pass proves every required name has a literal `.counter("name")`
+    call (directly, or through a module-level NAME = "literal"
+    constant) somewhere in quorum_tpu/."""
+    from ..telemetry.contract import precreated_counter_names
+    created: set[str] = set()
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        # module-level string constants, for the
+        # COUNTER_X = "name"; reg.counter(COUNTER_X) indirection
+        consts: dict[str, str] = {}
+        for node in src.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                val = const_str(node.value)
+                if val is not None:
+                    consts[node.targets[0].id] = val
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = call_name(node)
+            if not fn.endswith(".counter") and fn != "counter":
+                continue
+            arg = node.args[0]
+            name = const_str(arg)
+            if name is None and isinstance(arg, ast.Name):
+                name = consts.get(arg.id)
+            if name:
+                created.add(name)
+    findings = []
+    contract_rel = "quorum_tpu/telemetry/contract.py"
+    contract_src = project.get(contract_rel)
+    for name in precreated_counter_names():
+        if name in created:
+            continue
+        line = 1
+        if contract_src is not None:
+            for i, text in enumerate(contract_src.lines, 1):
+                if f'"{name}"' in text:
+                    line = i
+                    break
+        findings.append(Finding(
+            "counter-not-precreated", contract_rel, line,
+            f"contract requires counter {name!r} but no "
+            '.counter("...") literal in quorum_tpu/ creates it — '
+            "metrics_check would fail every document that declares "
+            "the feature",
+            "create the counter at feature setup (value 0 counts) "
+            "with the literal name, or drop it from the contract"))
+    return findings

@@ -1,0 +1,132 @@
+"""Daemon-thread exception hygiene rule (ISSUE 12 rule 4).
+
+PR 10's review found the metrics push daemon dying SILENTLY: a
+``BadStatusLine`` from a non-HTTP peer raised
+``http.client.HTTPException``, which the loop's ``except`` net did
+not cover — the thread unwound, the run kept going, and pushes just
+stopped, uncounted. The fix was one counter increment. The class is
+mechanical: a background thread has no caller to propagate into, so
+an ``except`` that neither re-raises nor counts is a failure mode
+with NO observable signal — precisely what the telemetry tier exists
+to prevent.
+
+``thread-swallowed-exception`` finds every function used as a
+``threading.Thread(target=...)`` in quorum_tpu/ (by name, resolved
+against the defs in the same module — methods, module functions, and
+closure ``def loop():`` targets alike), then requires every
+``except`` handler in those functions (nested defs included: they run
+on the same thread) to do at least one of:
+
+* re-raise (any ``raise``),
+* increment a counter (``....inc(...)``) — the push-daemon fix,
+* hard-exit (``os._exit``) or call a ``fail``-named helper.
+
+Anything else is a silent swallow. A deliberate best-effort pass
+(teardown paths where even counting could throw) takes
+``# qlint: disable=thread-swallowed-exception`` with its reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, rule, walk_functions
+
+
+def _thread_target_names(tree: ast.Module) -> set[str]:
+    """Bare function/method names passed as Thread(target=...)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = call_name(node)
+        if not fn.endswith("Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Name):
+                names.add(v.id)
+            elif isinstance(v, ast.Attribute):
+                # self._loop / batcher._dispatch_loop: resolve by
+                # method name; library targets (httpd.serve_forever)
+                # simply won't match a local def
+                names.add(v.attr)
+    return names
+
+
+_LOG_ONLY = ("vlog", "print", "warn", "warning", "debug", "info",
+             "error", "exception", "log")
+
+
+def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+    """Does this handler produce a signal? Loud =
+    * re-raise, hard-exit, or a fail-named helper;
+    * a counter increment (`.inc(...)`) or tally (`x[0] += 1`);
+    * relaying the bound exception through an error CHANNEL — stored
+      (`box["err"] = e`, `self.err = e`) or passed to a non-logging
+      call (`q.put(("__err__", e))`): the waiting side re-raises it.
+    A handler that only logs (vlog/print) — or does nothing — is the
+    silent-death class."""
+    bound = handler.name  # `except X as e:` -> "e", else None
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.AugAssign):
+            return True  # errors[0] += 1: a tally is a counter
+        if isinstance(node, ast.Assign) and bound and any(
+                isinstance(n, ast.Name) and n.id == bound
+                for n in ast.walk(node.value)):
+            return True  # exception stored into a relay channel
+        if isinstance(node, ast.Call):
+            fn = call_name(node)
+            if fn.endswith(".inc"):
+                return True
+            if fn in ("os._exit", "_exit"):
+                return True
+            last = fn.rsplit(".", 1)[-1]
+            if "fail" in last:
+                return True
+            if bound and last not in _LOG_ONLY and any(
+                    isinstance(n, ast.Name) and n.id == bound
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                    for n in ast.walk(a)):
+                return True  # exception forwarded through a call
+    return False
+
+
+@rule("thread-swallowed-exception",
+      "except in a thread-target function with no raise/counter")
+def thread_swallowed_exception(project):
+    findings = []
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        targets = _thread_target_names(src.tree)
+        if not targets:
+            continue
+        for fn, qual in walk_functions(src.tree):
+            if fn.name not in targets:
+                continue
+            # the whole subtree, nested defs included — everything
+            # here executes on the daemon thread
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if _handler_is_loud(node):
+                    continue
+                caught = (ast.unparse(node.type)
+                          if node.type is not None else "BaseException")
+                findings.append(Finding(
+                    "thread-swallowed-exception", src.rel, node.lineno,
+                    f"thread target {qual} swallows {caught} with "
+                    "neither a re-raise nor a counter — the thread "
+                    "(or its work item) degrades with zero signal, "
+                    "the PR-10 silent-push-death class",
+                    "count it (reg.counter(...).inc()) and/or "
+                    "re-raise; a deliberate best-effort teardown "
+                    "takes # qlint: disable=thread-swallowed-"
+                    "exception with a reason"))
+    return findings

@@ -1,0 +1,244 @@
+"""Runtime lock-order sanitizer: the dynamic half of the concurrency
+sanitizer (ISSUE 12), opt-in via ``QUORUM_TSAN=1``.
+
+The static lockset pass (rules_locks.py) sees the acquisitions the
+AST names; this sees the ones that actually HAPPEN — watchdog
+rebuilds racing /reload, exporters called from handler threads,
+whatever shape tomorrow's streaming-ingest tier (ROADMAP item 4)
+takes. :func:`install` replaces ``threading.Lock``/``RLock`` with
+wrapping factories; every wrapper records, per thread, the stack of
+wrapped locks currently held, keyed by the lock's CONSTRUCTION SITE
+(file:line) so the thousands of per-metric Counter locks collapse to
+one key. Acquiring B while holding A records the edge A->B; a later
+acquisition of A while holding B is an **observed inversion** — two
+threads interleaving those paths deadlock — and lands in
+:func:`violations` with both stacks.
+
+Design constraints, in order:
+
+* **No false positives.** Same-site self-edges are ignored (many
+  instances share a construction-site key; ordering among them is
+  invisible at this granularity). Reentrant RLock re-acquisition is
+  not an edge. An inversion is only reported for an exact reversed
+  pair of construction-site keys.
+* **Never deadlock the run.** The sanitizer's own bookkeeping lock is
+  only ever taken with no wrapped lock's internal state touched
+  under it; wrapped acquire/release happen OUTSIDE it.
+* **Cheap.** Per acquire: one thread-local list append and one dict
+  probe; the stack walk for diagnostics happens only when a NEW edge
+  is first seen.
+
+The conftest opt-in (``QUORUM_TSAN=1``, on in ci/tier1.sh) installs
+this before tests import the serve/telemetry stack and FAILS the
+test on any violation observed during it — the runtime analogue of a
+lint finding. ``threading.Condition(lock)`` works unchanged: the
+wrapper exposes only acquire/release/locked, so Condition uses its
+portable fallback path through exactly those methods.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+_BOOK = threading.Lock()          # guards _EDGES/_VIOLATIONS only
+_EDGES: dict = {}                 # (site_a, site_b) -> acquire stack
+_VIOLATIONS: list[dict] = []
+_VIOLATION_PAIRS: set = set()     # (a, b) already reported
+_INSTALLED = False
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_TLS = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def _site() -> str:
+    """file:line of the wrapper's construction, excluding this module
+    and the threading module — the allocation-site key."""
+    for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+        fn = frame.filename
+        if fn.endswith(("analysis/tsan.py", "threading.py")):
+            continue
+        return f"{os.path.basename(fn)}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _SanitizedLock:
+    """A threading.Lock/RLock wrapper recording acquisition order.
+    Reentrant re-acquisition (RLock) is tracked via the per-thread
+    held stack — re-entries append a no-edge marker so the matching
+    release pops cleanly."""
+
+    __slots__ = ("_inner", "_sitekey")
+
+    def __init__(self, inner, sitekey: str):
+        self._inner = inner
+        self._sitekey = sitekey
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def release(self):
+        self._record_release()
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # concurrent.futures registers this as an at-fork hook on the
+        # module lock it creates at import; per-thread held stacks
+        # are thread-local, so the child starts clean anyway
+        self._inner._at_fork_reinit()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # -- Condition compatibility -------------------------------------
+    # threading.Condition binds these if present; the RLock fast
+    # paths delegate to the real lock (full release/restore across a
+    # wait()) while keeping the held stack truthful. On a plain Lock
+    # the inner has none of them, so fall back to acquire/release —
+    # exactly Condition's own portable fallback.
+    def _release_save(self):
+        save = getattr(self._inner, "_release_save", None)
+        if save is None:
+            self.release()
+            return None
+        state = save()
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self:
+                del stack[i]
+        return state
+
+    def _acquire_restore(self, state):
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is None:
+            self.acquire()
+            return
+        restore(state)
+        self._record_acquire()
+
+    def _is_owned(self):
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _record_acquire(self) -> None:
+        stack = _held()
+        if any(w is self for w, _ in stack):
+            stack.append((self, None))  # reentrant: no edge
+            return
+        site = self._sitekey
+        candidates = []
+        with _BOOK:
+            for _, held_site in stack:
+                if held_site is None or held_site == site:
+                    continue
+                edge = (held_site, site)
+                if edge not in _EDGES or (site, held_site) in _EDGES:
+                    candidates.append(edge)
+        if candidates:
+            # the stack walk is the expensive part — do it unlocked,
+            # then RE-CHECK for the reverse edge inside the same
+            # critical section that publishes ours: two threads
+            # racing the reversed acquisitions (the exact deadlock
+            # interleaving) each see the other's edge from whichever
+            # publish lands second
+            here = "".join(traceback.format_stack(limit=8)[:-2])
+            with _BOOK:
+                for edge in candidates:
+                    rev = (edge[1], edge[0])
+                    if rev in _EDGES and edge not in _VIOLATION_PAIRS:
+                        _VIOLATION_PAIRS.add(edge)
+                        _VIOLATIONS.append({
+                            "held": edge[0], "acquiring": edge[1],
+                            "thread": threading.current_thread().name,
+                            "stack": here,
+                            "reverse_stack": _EDGES[rev],
+                        })
+                    _EDGES.setdefault(edge, here)
+        stack.append((self, site))
+
+    def _record_release(self) -> None:
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is self:
+                del stack[i]
+                return
+
+
+def _make_factory(real_ctor):
+    def factory(*a, **kw):
+        return _SanitizedLock(real_ctor(*a, **kw), _site())
+    return factory
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock with sanitizing factories. Modules
+    that bound the real factory at import time keep it (partial
+    coverage is the documented cost of a pure-Python sanitizer);
+    everything constructed via `threading.Lock()` after this point is
+    tracked."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    threading.Lock = _make_factory(_REAL_LOCK)
+    threading.RLock = _make_factory(_REAL_RLOCK)
+    _INSTALLED = True
+
+
+def uninstall() -> None:
+    global _INSTALLED
+    if not _INSTALLED:
+        return
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _INSTALLED = False
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def violations() -> list[dict]:
+    with _BOOK:
+        return list(_VIOLATIONS)
+
+
+def reset() -> None:
+    """Forget observed edges and violations (test isolation)."""
+    with _BOOK:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+        _VIOLATION_PAIRS.clear()
+
+
+def format_violation(v: dict) -> str:
+    return (f"lock-order inversion: thread {v['thread']!r} acquired "
+            f"{v['acquiring']} while holding {v['held']}, but the "
+            f"reverse order was observed earlier.\n"
+            f"-- this acquisition --\n{v['stack']}"
+            f"-- earlier reverse acquisition --\n{v['reverse_stack']}")
+
+
+def enabled_by_env() -> bool:
+    from ..utils import levers
+    return levers.get_bool("QUORUM_TSAN")

@@ -34,6 +34,8 @@ import os
 import sys
 import time
 
+from ..utils import levers
+
 
 def _synth(n_reads: int, read_len: int, seed: int = 5,
            coverage: int = 40, err_rate: float = 0.01):
@@ -102,7 +104,7 @@ def run_probes(n_reads: int, read_len: int, k: int,
         # setting — an in-process embedder's explicit env override
         # must survive the probe (cli/observability + smoke run
         # autotune inside larger processes)
-        prev = os.environ.get("QUORUM_S1_AGGREGATE")
+        prev = levers.raw("QUORUM_S1_AGGREGATE")
         os.environ["QUORUM_S1_AGGREGATE"] = "1" if agg else "0"
         try:
             bstate = ctable.make_tile_build(meta)
@@ -173,8 +175,8 @@ WIN_MARGIN = 0.02
 
 def decide(measured: dict) -> dict:
     """The winning lever settings from the probe numbers."""
-    levers = {}
-    levers["QUORUM_S1_AGGREGATE"] = (
+    winners = {}
+    winners["QUORUM_S1_AGGREGATE"] = (
         "1" if measured["s1_agg_s"]
         < measured["s1_base_s"] * (1.0 - WIN_MARGIN) else "0")
     variants = {
@@ -185,9 +187,9 @@ def decide(measured: dict) -> dict:
     best = min(variants, key=variants.get)
     if variants[best] >= measured["s2_base_s"] * (1.0 - WIN_MARGIN):
         best = ("0", "0")  # not a real win: keep the plain loop
-    levers["QUORUM_COMPACT_SWEEP"] = best[0]
-    levers["QUORUM_DRAIN_LEVELS"] = best[1]
-    return levers
+    winners["QUORUM_COMPACT_SWEEP"] = best[0]
+    winners["QUORUM_DRAIN_LEVELS"] = best[1]
+    return winners
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -204,22 +206,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "path is applied via "
                         "QUORUM_AUTOTUNE_PROFILE=path)")
     p.add_argument("--reads", type=int,
-                   default=int(os.environ.get("QUORUM_AB_READS",
-                                              "16384")),
+                   default=int(levers.raw("QUORUM_AB_READS",
+                                          "16384")),
                    help="Probe batch rows (default 16384 or "
                         "$QUORUM_AB_READS — match the production "
                         "batch size: the levers trade width-"
                         "proportional work)")
     p.add_argument("--len", dest="read_len", type=int,
-                   default=int(os.environ.get("QUORUM_AB_LEN", "150")),
+                   default=int(levers.raw("QUORUM_AB_LEN", "150")),
                    help="Probe read length (default 150 or "
                         "$QUORUM_AB_LEN)")
     p.add_argument("-k", "--kmer-len", type=int,
-                   default=int(os.environ.get("QUORUM_AB_K", "24")),
+                   default=int(levers.raw("QUORUM_AB_K", "24")),
                    help="Probe mer length (default 24 or "
                         "$QUORUM_AB_K)")
     p.add_argument("--reps", type=int,
-                   default=int(os.environ.get("QUORUM_AB_REPS", "3")),
+                   default=int(levers.raw("QUORUM_AB_REPS", "3")),
                    help="Timing repetitions, min taken (default 3 "
                         "or $QUORUM_AB_REPS)")
     p.add_argument("--metrics-lines", metavar="path", default=None,
@@ -257,13 +259,13 @@ def main(argv=None) -> int:
     except RuntimeError as e:
         print(f"quorum-autotune: {e}", file=sys.stderr)
         return 1
-    levers = decide(measured)
+    winners = decide(measured)
     lines.append(metric_line(
         "autotune_stage1",
         base_ms=round(measured["s1_base_s"] * 1e3, 1),
         aggregated_ms=round(measured["s1_agg_s"] * 1e3, 1),
         speedup=round(measured["s1_base_s"] / measured["s1_agg_s"], 3),
-        winner=levers["QUORUM_S1_AGGREGATE"],
+        winner=winners["QUORUM_S1_AGGREGATE"],
         parity="content-identical"))
     print(lines[-1], flush=True)
     lines.append(metric_line(
@@ -275,27 +277,29 @@ def main(argv=None) -> int:
             measured["s2_base_s"] / measured["s2_sweep_s"], 3),
         speedup_sweep_drain=round(
             measured["s2_base_s"] / measured["s2_sweep_drain_s"], 3),
-        winner_sweep=levers["QUORUM_COMPACT_SWEEP"],
-        winner_drain=levers["QUORUM_DRAIN_LEVELS"],
+        winner_sweep=winners["QUORUM_COMPACT_SWEEP"],
+        winner_drain=winners["QUORUM_DRAIN_LEVELS"],
         parity="byte-identical"))
     print(lines[-1], flush=True)
 
     out = args.out or tuning.default_profile_path(backend)
     if args.dry_run:
         lines.append(metric_line("autotune_profile", written=False,
-                                 path=out, **levers))
+                                 path=out, **winners))
         print(lines[-1], flush=True)
     else:
         measured_rounded = {kk: round(vv, 6) if isinstance(vv, float)
                             else vv for kk, vv in measured.items()}
-        tuning.write_profile(out, backend, geometry, levers,
+        tuning.write_profile(out, backend, geometry, winners,
                              measured=measured_rounded)
         lines.append(metric_line("autotune_profile", written=True,
-                                 path=out, **levers))
+                                 path=out, **winners))
         print(lines[-1], flush=True)
     if args.metrics_lines:
-        with open(args.metrics_lines, "w") as f:
-            f.write("\n".join(lines) + "\n")
+        # atomic replace: metrics_check gates this document in CI — a
+        # torn write must not look like a truncated probe run
+        from ..telemetry.registry import atomic_write
+        atomic_write(args.metrics_lines, "\n".join(lines) + "\n")
     return 0
 
 

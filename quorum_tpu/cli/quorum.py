@@ -23,7 +23,7 @@ import dataclasses
 from ..io import checkpoint as ckpt_mod
 from ..io import integrity as integrity_mod
 from ..io import fastq, packing
-from ..utils import faults
+from ..utils import faults, levers
 from ..models.error_correct import ECOptions, run_error_correct
 
 # EC's default quality cutoff when the driver passes no -q/-Q to it —
@@ -40,7 +40,7 @@ from ..models.ec_config import DEFAULT_QUAL_CUTOFF as _EC_QUAL_CUTOFF
 # QUORUM_REPLAY_CACHE_BYTES accepts k/M/G/T suffixes (utils/sizes).
 def _replay_cap() -> int:
     from ..utils.sizes import parse_size
-    raw = os.environ.get("QUORUM_REPLAY_CACHE_BYTES")
+    raw = levers.raw("QUORUM_REPLAY_CACHE_BYTES")
     if raw is None:
         return 6 * 1024 ** 3
     try:

@@ -62,7 +62,7 @@ import jax.numpy as jnp
 
 from ..ops import ctable
 from ..ops.ctable import TileMeta, TileState
-from ..utils import faults
+from ..utils import faults, levers
 from . import integrity
 from .integrity import IntegrityError  # noqa: F401 (re-export)
 
@@ -506,7 +506,7 @@ def _verify_v5(path: str, header: dict, offset: int, mode: str,
         return verified
     idxs = list(range(len(chunks)))
     if mode == "sample" and len(chunks) > 4:
-        seed = os.environ.get("QUORUM_VERIFY_SAMPLE_SEED")
+        seed = levers.raw("QUORUM_VERIFY_SAMPLE_SEED")
         rng = random.Random(int(seed)) if seed else random.Random()
         idxs = sorted(rng.sample(range(len(chunks)),
                                  max(4, len(chunks) // 16)))

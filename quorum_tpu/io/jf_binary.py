@@ -17,6 +17,8 @@ spellings and enforce the same k check at the call site."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import ref_db
@@ -102,6 +104,18 @@ def write_jf_binary(path: str, khi, klo, counts, k: int,
         "size": int(max(16, 1 << (max(1, n - 1)).bit_length())),
         "canonical": True,
     }
-    with open(path, "wb") as f:
+    # atomic replace (quorum-lint raw-artifact-write): the jf export
+    # is an artifact other tools load, never a stream. Streamed into
+    # a sibling tmp — rec can be GBs, so the record buffer is never
+    # copied just to prepend the ~200-byte header.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(json.dumps(header).encode())
         f.write(rec.tobytes())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # renames are only durable once the directory entry is down
+    # (ISSUE 8) — same contract as _atomic_db_write
+    from .integrity import fsync_dir
+    fsync_dir(path)

@@ -237,11 +237,23 @@ def write_ref_db(path: str, khi, klo, vals, k: int, bits: int,
         "hostname": os.uname().nodename,
     }
     blob = json.dumps(header).encode()
-    with open(path, "wb") as f:
+    kw = key_words.tobytes()
+    # atomic replace (quorum-lint raw-artifact-write): a crashed
+    # export must never leave a torn reference DB for a later
+    # loader. Streamed into a sibling tmp — the word arrays can be
+    # GBs, so no concatenated copy of the payload is ever built.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(blob)
-        kw = key_words.tobytes()
-        f.write(kw + b"\0" * (kbytes - len(kw)))
+        f.write(kw)
+        f.write(b"\0" * (kbytes - len(kw)))
         f.write(val_words.tobytes())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # renames are only durable once the directory entry is down
+    # (ISSUE 8) — same contract as _atomic_db_write
+    integrity.fsync_dir(path)
 
 
 # ---------------------------------------------------------------------------

@@ -99,17 +99,6 @@ def read_ref_header(path: str, max_header: int = 1 << 20
     return header, payload
 
 
-def payload_extent(header: dict) -> int | None:
-    """Expected payload byte count from the header's byte-count
-    fields (key_bytes + value_bytes); None when the header doesn't
-    carry them. quorum-fsck uses this for a fast truncation check
-    (and precise damage offsets) before paying the full decode."""
-    try:
-        return int(header["key_bytes"]) + int(header["value_bytes"])
-    except (KeyError, TypeError, ValueError):
-        return None
-
-
 def describe(header: dict) -> str:
     """One-line geometry summary for diagnostics."""
     fields = []

@@ -37,9 +37,7 @@ extends 3'->5'; positions are raw 0-based read indices throughout.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
-import os
 from typing import NamedTuple
 
 import numpy as np
@@ -47,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..io import packing
+from ..utils import levers
 from ..ops import ctable, mer
 from ..ops.poisson import poisson_term
 from .ec_config import (
@@ -464,7 +463,7 @@ def compact_sweep_default() -> bool:
     (A/B escape hatch); between the env var and the backend-keyed
     guess sits the autotune profile (ops/tuning.py, ISSUE 11) — the
     setting `quorum-autotune` measured to win on THIS backend."""
-    raw = os.environ.get("QUORUM_COMPACT_SWEEP")
+    raw = levers.raw("QUORUM_COMPACT_SWEEP")
     if raw is not None and raw != "":
         return raw != "0"
     from ..ops import tuning
@@ -481,7 +480,7 @@ def drain_levels_default() -> int:
     count (0 = single-level loop); an autotune profile
     (ops/tuning.py) supplies the measured count when no env forces
     one."""
-    raw = os.environ.get("QUORUM_DRAIN_LEVELS")
+    raw = levers.raw("QUORUM_DRAIN_LEVELS")
     if raw is not None and raw != "":
         try:
             return max(0, min(2, int(raw)))

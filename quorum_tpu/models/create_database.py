@@ -17,7 +17,6 @@ one row gather per lookup in stage 2.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import time
 from typing import Sequence
 
@@ -77,8 +76,8 @@ def s1_overlap_default() -> bool:
     QUORUM_S1_OVERLAP=0 — the double-buffered dispatch is bit-exact
     (resolution order is dispatch order, retries stay synchronous), so
     the switch exists for A/B measurement, not correctness."""
-    import os
-    return os.environ.get("QUORUM_S1_OVERLAP", "1") != "0"
+    from ..utils import levers
+    return levers.raw("QUORUM_S1_OVERLAP", "1") != "0"
 
 
 # canonical home is ops/ctable (so the fused stage-1 dispatch can use

@@ -239,7 +239,10 @@ def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
     path = prefix + suffix + (".gz" if gzip else "")
     if gzip:
         return gzip_mod.open(path, "wt", compresslevel=1)
-    return open(path, "w")
+    # the .fa/.log outputs stream gigabytes through AsyncWriter; the
+    # checkpointed path writes .partial siblings finalized by rename
+    # (io/checkpoint), the non-checkpointed path is a plain stream
+    return open(path, "w")  # qlint: disable=raw-artifact-write
 
 
 def resolve_cutoff(state, meta, opts: ECOptions) -> int:

@@ -29,11 +29,9 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Callable
 
 import numpy as np
 
-from ..ops import mer as merops
 from ..ops.poisson import poisson_term_f32, poisson_term_np
 from .ec_config import (
     ECConfig,

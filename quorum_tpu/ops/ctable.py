@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import NamedTuple
 
 import numpy as np
@@ -47,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from . import mer
+from ..utils import levers
 
 BUCKET = 4  # slots per bucket = one aligned 16-byte gather row
 _EMPTY_TAG = np.uint32(0xFFFFFFFF)
@@ -985,7 +985,7 @@ def s1_aggregate_default() -> bool:
     way; between the env var and the built-in default sits the
     autotune profile (ops/tuning.py, ISSUE 11) — a measured setting
     for THIS backend beats the guess."""
-    raw = os.environ.get("QUORUM_S1_AGGREGATE")
+    raw = levers.raw("QUORUM_S1_AGGREGATE")
     if raw is not None and raw != "":
         return raw != "0"
     from . import tuning
@@ -1402,15 +1402,6 @@ def tile_insert_observations(bstate: TBuildState, meta: TileMeta, khi, klo,
                                         cap, n)
     full, placed = _finish_obs(done, valid)
     return bstate, bool(full), placed
-
-
-@functools.partial(jax.jit, static_argnums=(1,))
-def tile_dup_check(bstate: TBuildState, meta: TileMeta):
-    """True iff any bucket holds two occupied slots with the same tag
-    pair — impossible unless the two tag scatters ever disagreed on a
-    winner (see _tile_build_round). Checked once per build (fused with
-    finalize+stats in tile_seal; this standalone entry serves tests)."""
-    return _dup_check_impl(bstate, meta)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))

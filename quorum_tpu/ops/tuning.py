@@ -37,6 +37,8 @@ import os
 import sys
 import threading
 
+from ..utils import levers
+
 PROFILE_SCHEMA = "quorum-tpu-autotune/1"
 
 # the levers a profile may pin (same spellings as the env vars that
@@ -68,7 +70,7 @@ def backend_name() -> str:
 
 
 def profile_dir() -> str:
-    return (os.environ.get("QUORUM_AUTOTUNE_DIR")
+    return (levers.raw("QUORUM_AUTOTUNE_DIR")
             or os.path.join(os.path.expanduser("~"), ".cache",
                             "quorum_tpu", "autotune"))
 
@@ -79,7 +81,7 @@ def default_profile_path(backend: str | None = None) -> str:
 
 
 def _resolve_path() -> str | None:
-    explicit = os.environ.get("QUORUM_AUTOTUNE_PROFILE")
+    explicit = levers.raw("QUORUM_AUTOTUNE_PROFILE")
     if explicit is not None:
         return explicit or None  # "" disables profiles entirely
     return default_profile_path()
@@ -174,7 +176,7 @@ def lever(env_name: str) -> str | None:
 def cap(env_name: str, default: float) -> float:
     """A numeric cap: env var wins, then the profile's `caps`, then
     `default`. Unparseable values fall through to the next source."""
-    raw = os.environ.get(env_name)
+    raw = levers.raw(env_name)
     if raw is not None and raw != "":
         try:
             return float(raw)

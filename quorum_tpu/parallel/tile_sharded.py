@@ -952,10 +952,9 @@ def replicate_cap_bytes() -> int:
     are replicated per device (every probe a local gather); bigger
     tables stay row-sharded with routed lookups. Tunable via
     QUORUM_REPLICATE_TABLE_BYTES (k/M/G/T suffixes)."""
-    import os
-
+    from ..utils import levers
     from ..utils.sizes import parse_size
-    raw = os.environ.get("QUORUM_REPLICATE_TABLE_BYTES")
+    raw = levers.raw("QUORUM_REPLICATE_TABLE_BYTES")
     if raw:
         try:
             return parse_size(raw)

@@ -488,7 +488,17 @@ class AlertEngine:
             try:
                 self.evaluate()
             except Exception:  # noqa: BLE001 - never kill the ticker
-                pass
+                # evaluate() is designed not to raise, so anything
+                # landing here is an engine bug — count it (the
+                # quorum-lint thread-swallowed-exception class: a
+                # silently degrading ticker means a stalled run stops
+                # alerting, which is exactly what the ticker exists
+                # to catch)
+                try:
+                    self.registry.counter(
+                        "alert_rule_errors_total").inc()
+                except Exception:  # noqa: BLE001  # qlint: disable=thread-swallowed-exception
+                    pass  # counting failed too: registry torn down
 
     # -- evaluation -------------------------------------------------------
     def evaluate(self) -> list[str]:

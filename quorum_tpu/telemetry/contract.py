@@ -1,0 +1,126 @@
+"""The telemetry metric-name contract: the required-counter catalogs
+that `tools/metrics_check.py` gates CI documents against, single-
+sourced here (ISSUE 12).
+
+These lists used to live in the checker tool, which meant the
+contract and the code that fulfils it could drift: a counter renamed
+in `quorum_tpu/serve/` kept passing local tests while the CI gate
+went quietly vacuous (the PR-7 SERVE_FEATURE_COUNTERS lesson was
+exactly this shape — feature counters exist only if the serve layers
+pre-create them at setup, so a missing name must FAIL the document,
+which only works while the checker's list matches the creators).
+
+Now three consumers import ONE catalog:
+
+* ``tools/metrics_check.py`` — requires the names in produced
+  documents (dispatching on meta, as before);
+* ``quorum_tpu/analysis`` — the ``counter-not-precreated`` rule
+  statically verifies every counter named here is created by a
+  literal ``.counter("name")`` call somewhere in ``quorum_tpu/``, so
+  a rename or deletion breaks the lint, not just the late CI gate;
+* the telemetry layers themselves, as the canonical spelling.
+
+Keep entries appendable: removing or renaming one is a contract
+change and must update the creators, the goldens, and this file in
+the same PR (quorum-lint will insist).
+"""
+
+from __future__ import annotations
+
+# The serve request/batch metric surface (quorum_tpu/serve/): a final
+# metrics document stamped `meta.stage == "serve"` must carry these.
+# Counters appear once the first request is admitted; the histograms
+# once the first batch dispatches.
+SERVE_REQUIRED_COUNTERS = (
+    "requests_accepted",
+    "requests_completed",
+    "reads_in",
+    "reads_corrected",
+    "batches",
+    "engine_compiles",
+)
+SERVE_REQUIRED_HISTOGRAMS = (
+    "batch_reads",
+    "queue_wait_us",
+    "request_us",
+    "request_reads",
+    "serve_dispatch_us",
+    "serve_wait_us",
+)
+
+# The serve resilience surface (ISSUE 7): a serve document whose meta
+# declares one of these features enabled must carry its counter (the
+# serve layers create them at setup, so value 0 counts).
+#   meta.step_timeout_ms > 0 -> engine_restarts_total (watchdog)
+#   meta.max_hedges > 0      -> hedges_total
+#   meta.reload truthy       -> reload_total
+#   meta.quota_rps > 0       -> quota_rejections_total
+SERVE_FEATURE_COUNTERS = (
+    ("step_timeout_ms", "engine_restarts_total"),
+    ("max_hedges", "hedges_total"),
+    ("reload", "reload_total"),
+    ("quota_rps", "quota_rejections_total"),
+)
+
+# The fault-tolerance metric surface (ISSUE 4): documents that declare
+# the corresponding feature in meta must carry its counters.
+#   meta.checkpoint_every > 0  -> checkpoint_writes_total
+#   meta.resumed truthy        -> resume_skipped_reads
+#   meta.on_bad_read in
+#     ("skip", "quarantine")   -> bad_reads_total
+#   meta.driver == "quorum"    -> stage_retries_total
+FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
+                  "bad_reads_total", "stage_retries_total")
+
+# The data-integrity surface (ISSUE 8): a document whose meta declares
+# a checksummed database (db_version >= 5) or a verification mode
+# (verify_db) must carry the integrity counters.
+INTEGRITY_COUNTERS = ("integrity_errors_total",
+                      "integrity_bytes_verified_total")
+
+# The device-truth telemetry surface (ISSUE 10): a document whose
+# meta declares a `profile` directory must carry the devtrace metrics
+# (cli/observability.py records them post-run, zeros included).
+DEVTRACE_COUNTERS = ("device_kernel_us_total", "device_step_us_total",
+                     "device_idle_us_total",
+                     "device_kernel_unattributed_us_total")
+DEVTRACE_GAUGES = ("devtrace_steps",)
+DEVTRACE_HISTOGRAMS = ("device_kernel_us",)
+DEVTRACE_META = ("devtrace_source",)
+
+# The push transport surface (ISSUE 10): a document whose meta
+# declares `metrics_push_url` must carry the pusher's counters.
+PUSH_COUNTERS = ("metrics_push_total", "metrics_push_failures_total")
+PUSH_META = ("metrics_push_host",)
+
+# The alerting surface (ISSUE 11): a document whose meta declares
+# alert rules active must carry the engine's counters and gauges.
+ALERT_COUNTERS = ("alerts_fired_total", "alert_rule_errors_total")
+ALERT_GAUGES = ("alert_rules_active",)
+
+# The sharded (--devices N) metric surface (ISSUE 5): a stage-1
+# document built over more than one shard must carry the per-shard
+# telemetry parallel/tile_sharded.record_shard_metrics writes.
+SHARD_REQUIRED_COUNTERS = ("shard_batches", "shard_reads",
+                           "shard_inserts_total", "distinct_mers")
+SHARD_REQUIRED_GAUGES = ("n_shards", "shard_distinct_min",
+                         "shard_distinct_max", "shard_inserts_min",
+                         "shard_inserts_max")
+SHARD_REQUIRED_META_LISTS = ("shard_distinct_mers", "shard_inserts")
+
+
+def precreated_counter_names() -> tuple[str, ...]:
+    """Every counter name the contract expects quorum_tpu code to
+    create with a LITERAL ``.counter("name")`` call — the analyzer's
+    pre-creation catalog (quorum-lint `counter-not-precreated`).
+    Union of the per-surface lists above, deduplicated, sorted."""
+    names: set[str] = set()
+    names.update(SERVE_REQUIRED_COUNTERS)
+    names.update(name for _, name in SERVE_FEATURE_COUNTERS)
+    names.update(FAULT_COUNTERS)
+    names.update(INTEGRITY_COUNTERS)
+    names.update(DEVTRACE_COUNTERS)
+    names.update(PUSH_COUNTERS)
+    names.update(ALERT_COUNTERS)
+    names.update(SHARD_REQUIRED_COUNTERS)
+    return tuple(sorted(names))

@@ -47,6 +47,7 @@ import threading
 import urllib.error
 import urllib.request
 
+from ..utils import levers
 from ..utils.vlog import vlog
 
 DEFAULT_PERIOD_S = 5.0
@@ -60,7 +61,7 @@ def default_host_id() -> str:
     """The per-host push identity: QUORUM_PUSH_HOST when set (stable
     fleet names), else hostname:pid (unique per process, so two local
     runs never clobber each other's shard in the fleet document)."""
-    env = os.environ.get("QUORUM_PUSH_HOST")
+    env = levers.raw("QUORUM_PUSH_HOST")
     if env:
         return env
     return f"{socket.gethostname()}:{os.getpid()}"

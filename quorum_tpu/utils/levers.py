@@ -1,0 +1,181 @@
+"""The env-lever catalog: every ``QUORUM_*`` environment variable the
+system reads, declared in ONE place (ISSUE 12).
+
+Eleven PRs of hardening grew ~20 tuning/debug levers, each read at
+its own call site with its own ad-hoc ``os.environ.get`` — which is
+how levers drift: a renamed variable silently stops steering anything,
+a new one ships undocumented, and the README table (when someone
+remembers to update it) disagrees with the code. This module is the
+fix, enforced by static analysis rather than convention:
+
+* every lever is declared here with its name, type, default, and a
+  one-line doc — ``quorum-lint``'s ``lever-undeclared`` rule fails CI
+  on any ``QUORUM_*`` env read whose name is not in the catalog, and
+  ``lever-unused`` fails on a catalog entry nothing reads;
+* every read inside ``quorum_tpu/`` must go through :func:`raw` (or
+  the typed getters) — the ``lever-raw-env-read`` rule flags a direct
+  ``os.environ.get("QUORUM_...")``, so the catalog check cannot be
+  bypassed;
+* ``quorum-lint --emit-docs`` renders :func:`render_docs` into the
+  README between the ``qlint:levers`` markers, so the published table
+  is generated from this catalog and cannot drift.
+
+The catalog intentionally does NOT own resolution *semantics*: the
+round-7 levers resolve env > autotune profile > backend default
+(ops/tuning.py), sizes take k/M/G/T suffixes (utils/sizes), and
+``vlog`` has its own truthiness — those stay at the call sites, which
+read the raw string from here and interpret it exactly as before.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class Lever:
+    """One declared env lever: the catalog row."""
+
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: str, default: str, doc: str):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+
+CATALOG: dict[str, Lever] = {}
+
+
+def _declare(name: str, type_: str, default: str, doc: str) -> None:
+    CATALOG[name] = Lever(name, type_, default, doc)
+
+
+# -- the catalog ----------------------------------------------------------
+# Keep entries alphabetical; the README table renders in this order.
+
+_declare(
+    "QUORUM_AB_K", "int", "24",
+    "Probe mer length for `bench.py --ab` and `quorum-autotune`.")
+_declare(
+    "QUORUM_AB_LEN", "int", "150",
+    "Probe read length for `bench.py --ab` and `quorum-autotune`.")
+_declare(
+    "QUORUM_AB_READS", "int", "16384",
+    "Probe batch rows for `bench.py --ab` and `quorum-autotune` "
+    "(match the production batch size).")
+_declare(
+    "QUORUM_AB_REPS", "int", "3",
+    "Timing repetitions for the A/B probes (min taken).")
+_declare(
+    "QUORUM_AMBIG_CAP", "int", "max(256, batch/4)",
+    "Extension-loop ambiguous-continuation lane budget (stage 2); "
+    "env > autotune profile > geometry default (ops/tuning.cap).")
+_declare(
+    "QUORUM_AUTOTUNE_DIR", "path", "~/.cache/quorum_tpu/autotune",
+    "Directory holding one sealed autotune profile per backend "
+    "(`cpu.json`, `tpu.json`, ...).")
+_declare(
+    "QUORUM_AUTOTUNE_PROFILE", "path", "(per-backend file)",
+    "Explicit autotune profile path; empty string disables profiles "
+    "entirely (hermetic CI runs).")
+_declare(
+    "QUORUM_COMPACT_SWEEP", "bool", "(backend/profile)",
+    "Force the stage-2 compacted sibling sweep on (1) or off (0); "
+    "unset = autotune profile, else ON on accelerators only.")
+_declare(
+    "QUORUM_DRAIN_LEVELS", "int", "(backend/profile)",
+    "Stage-2 extension-loop lane-drain re-compaction levels (0-2); "
+    "unset = autotune profile, else backend-keyed default.")
+_declare(
+    "QUORUM_FAULT_PLAN", "json", "(none)",
+    "Deterministic fault-injection plan (JSON, @file, or path) — the "
+    "env fallback behind `--fault-plan`, how subprocesses under test "
+    "inherit a plan (utils/faults.py).")
+_declare(
+    "QUORUM_MULTICHIP_BATCH", "int", "128",
+    "Batch rows for `bench.py --multichip` scaling points.")
+_declare(
+    "QUORUM_MULTICHIP_K", "int", "24",
+    "Mer length for `bench.py --multichip` scaling points.")
+_declare(
+    "QUORUM_PUSH_HOST", "str", "hostname:pid",
+    "Stable per-host identity for `--metrics-push-url` fleet shards "
+    "(telemetry/push.py).")
+_declare(
+    "QUORUM_REPLAY_CACHE_BYTES", "size", "6G",
+    "Budget for the driver's stage-1 replay capture (k/M/G/T "
+    "suffixes); past it stage 2 re-reads FASTQ from disk.")
+_declare(
+    "QUORUM_REPLICATE_TABLE_BYTES", "size", "4G",
+    "Stage-2 multi-device layout threshold: tables at or under this "
+    "replicate per device, bigger ones row-shard with routed "
+    "lookups (parallel/tile_sharded.py).")
+_declare(
+    "QUORUM_S1_AGGREGATE", "bool", "1",
+    "Stage-1 batch-local insert pre-aggregation (sort + segment-sum "
+    "before the claim rounds); 0 forces the direct path.")
+_declare(
+    "QUORUM_S1_AGG_CAP_FRAC", "float", "0.5",
+    "Aggregated-insert distinct-lane capacity as a fraction of the "
+    "observation count; env > autotune profile > default.")
+_declare(
+    "QUORUM_S1_OVERLAP", "bool", "1",
+    "Sharded stage-1 pack/H2D overlap with the previous batch's "
+    "all_to_all exchange; 0 reverts to the serial order.")
+_declare(
+    "QUORUM_TPU_VERBOSE", "bool", "0",
+    "Timestamped verbose logging (vlog) for library callers that "
+    "never run a CLI parser; the CLIs' --verbose ORs into it.")
+_declare(
+    "QUORUM_TSAN", "bool", "0",
+    "Opt-in runtime lock-order sanitizer: wraps threading.Lock/RLock "
+    "to record per-thread acquisition orders and fail the run on an "
+    "observed inversion (analysis/tsan.py; on in CI tier-1).")
+_declare(
+    "QUORUM_VERIFY_SAMPLE_SEED", "int", "(random)",
+    "Seed for `--verify-db=sample`'s chunk-scrub selection, so a "
+    "sampled verification is reproducible (io/db_format.py).")
+
+
+# -- readers --------------------------------------------------------------
+
+def raw(name: str, default: str | None = None) -> str | None:
+    """THE catalogued env read: ``os.environ.get`` plus the guarantee
+    that `name` is a declared lever. Every ``QUORUM_*`` read inside
+    ``quorum_tpu/`` routes through here (enforced by quorum-lint), so
+    an undeclared or misspelled lever fails loudly at the read site
+    instead of silently steering nothing."""
+    if name not in CATALOG:
+        raise KeyError(f"undeclared lever {name!r}: declare it in "
+                       "quorum_tpu/utils/levers.py (quorum-lint "
+                       "enforces the catalog)")
+    return os.environ.get(name, default)
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    """Common boolean truthiness: unset/empty -> `default`; "0",
+    "false", "no" (any case) -> False; anything else -> True."""
+    val = raw(name)
+    if val is None or val.strip() == "":
+        return default
+    return val.strip().lower() not in ("0", "false", "no")
+
+
+def names() -> list[str]:
+    return sorted(CATALOG)
+
+
+def render_docs() -> str:
+    """The README env-lever table, generated from the catalog (the
+    `quorum-lint --emit-docs` payload). One row per lever; the doc
+    column is the catalog's one-liner verbatim."""
+    lines = [
+        "| Lever | Type | Default | What it does |",
+        "|---|---|---|---|",
+    ]
+    for name in names():
+        lv = CATALOG[name]
+        lines.append(
+            f"| `{lv.name}` | {lv.type} | `{lv.default}` | {lv.doc} |")
+    return "\n".join(lines) + "\n"

@@ -11,14 +11,14 @@ this, they do not override it off.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
+from . import levers
+
 
 def _env_enabled() -> bool:
-    return os.environ.get("QUORUM_TPU_VERBOSE", "").strip().lower() not in (
-        "", "0", "false", "no")
+    return levers.get_bool("QUORUM_TPU_VERBOSE")
 
 
 verbose = _env_enabled()

@@ -38,6 +38,37 @@ os.environ.setdefault("QUORUM_AUTOTUNE_PROFILE", "")
 
 import pytest  # noqa: E402
 
+# Concurrency sanitizer opt-in (ISSUE 12): QUORUM_TSAN=1 — on in
+# ci/tier1.sh — wraps threading.Lock/RLock so every lock constructed
+# from here on records per-thread acquisition order, keyed by
+# construction site. An observed A->B / B->A inversion (two threads
+# interleaving those paths deadlock) FAILS the test that observed it,
+# with both acquisition stacks. Installed before test modules import
+# the serve/telemetry stack so their locks are all wrapped.
+from quorum_tpu.analysis import tsan as _tsan  # noqa: E402
+
+if _tsan.enabled_by_env():
+    _tsan.install()
+
+
+@pytest.fixture(autouse=True)
+def _tsan_inversion_gate():
+    """Fail the test during which a lock-order inversion was first
+    observed (QUORUM_TSAN=1 runs only). Background threads may
+    surface an inversion a beat late; the stacks in the report point
+    at the acquiring code either way."""
+    if not _tsan.installed():
+        yield
+        return
+    before = len(_tsan.violations())
+    yield
+    fresh = _tsan.violations()[before:]
+    if fresh:
+        pytest.fail("QUORUM_TSAN observed lock-order inversion(s):\n"
+                    + "\n".join(_tsan.format_violation(v)
+                                for v in fresh))
+
+
 _last_module = [None]
 
 

@@ -64,102 +64,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from quorum_tpu.telemetry import check_file  # noqa: E402
 from quorum_tpu.telemetry.export import lint_prometheus_text  # noqa: E402
 
-# The serve request/batch metric surface (quorum_tpu/serve/): a final
-# metrics document stamped `meta.stage == "serve"` must carry these,
-# or the serving telemetry regressed — ci/tier1.sh gates a golden
-# serve run through this check. Counters appear once the first
-# request is admitted; the histograms once the first batch dispatches.
-SERVE_REQUIRED_COUNTERS = (
-    "requests_accepted",
-    "requests_completed",
-    "reads_in",
-    "reads_corrected",
-    "batches",
-    "engine_compiles",
+# The required-name catalogs are single-sourced in
+# quorum_tpu/telemetry/contract.py (ISSUE 12): this checker, the
+# quorum-lint counter-pre-creation rule, and the telemetry layers all
+# import the SAME lists, so the CI gate and the code that fulfils it
+# cannot drift. Re-exported here because tests and callers address
+# them as metrics_check.* attributes.
+from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
+    ALERT_COUNTERS,
+    ALERT_GAUGES,
+    DEVTRACE_COUNTERS,
+    DEVTRACE_GAUGES,
+    DEVTRACE_HISTOGRAMS,
+    DEVTRACE_META,
+    FAULT_COUNTERS,
+    INTEGRITY_COUNTERS,
+    PUSH_COUNTERS,
+    PUSH_META,
+    SERVE_FEATURE_COUNTERS,
+    SERVE_REQUIRED_COUNTERS,
+    SERVE_REQUIRED_HISTOGRAMS,
+    SHARD_REQUIRED_COUNTERS,
+    SHARD_REQUIRED_GAUGES,
+    SHARD_REQUIRED_META_LISTS,
 )
-SERVE_REQUIRED_HISTOGRAMS = (
-    "batch_reads",
-    "queue_wait_us",
-    "request_us",
-    "request_reads",
-    "serve_dispatch_us",
-    "serve_wait_us",
-)
-
-# The serve resilience surface (ISSUE 7): a serve document whose meta
-# declares one of these features enabled must carry its counter (the
-# serve layers create them at setup, so value 0 counts — a missing
-# name means the watchdog/hedging/reload/quota telemetry regressed).
-#   meta.step_timeout_ms > 0 -> engine_restarts_total (watchdog)
-#   meta.max_hedges > 0      -> hedges_total
-#   meta.reload truthy       -> reload_total
-#   meta.quota_rps > 0       -> quota_rejections_total
-SERVE_FEATURE_COUNTERS = (
-    ("step_timeout_ms", "engine_restarts_total"),
-    ("max_hedges", "hedges_total"),
-    ("reload", "reload_total"),
-    ("quota_rps", "quota_rejections_total"),
-)
-
-# The fault-tolerance metric surface (ISSUE 4): documents that declare
-# the corresponding feature in meta must carry its counters — the
-# stages create them at setup (value 0 counts), so a missing name
-# means the retry/checkpoint/quarantine telemetry regressed.
-#   meta.checkpoint_every > 0  -> checkpoint_writes_total
-#   meta.resumed truthy        -> resume_skipped_reads
-#   meta.on_bad_read in
-#     ("skip", "quarantine")   -> bad_reads_total
-#   meta.driver == "quorum"    -> stage_retries_total
-FAULT_COUNTERS = ("checkpoint_writes_total", "resume_skipped_reads",
-                  "bad_reads_total", "stage_retries_total")
-
-# The data-integrity surface (ISSUE 8): a document whose meta declares
-# a checksummed database (db_version >= 5) or a verification mode
-# (verify_db) must carry the integrity counters — the loaders create
-# them at verify time (value 0 counts), so a missing name means the
-# verification telemetry regressed.
-INTEGRITY_COUNTERS = ("integrity_errors_total",
-                      "integrity_bytes_verified_total")
-
-# The device-truth telemetry surface (ISSUE 10): a document whose
-# meta declares a `profile` directory must carry the devtrace
-# metrics — cli/observability.py parses the profiler trace post-run
-# and records them even when the directory held no readable trace
-# (value-0 counts), so a missing NAME means the devtrace recording
-# regressed, not that the profiler wrote nothing.
-DEVTRACE_COUNTERS = ("device_kernel_us_total", "device_step_us_total",
-                     "device_idle_us_total",
-                     "device_kernel_unattributed_us_total")
-DEVTRACE_GAUGES = ("devtrace_steps",)
-DEVTRACE_HISTOGRAMS = ("device_kernel_us",)
-DEVTRACE_META = ("devtrace_source",)
-
-# The push transport surface (ISSUE 10): a document whose meta
-# declares `metrics_push_url` must carry the pusher's counters (the
-# MetricsPusher creates them at start, value 0 counts) and the
-# identity stamp it writes. (`metrics_pushed` is stamped only AFTER
-# the final document lands, so the document itself cannot carry it.)
-PUSH_COUNTERS = ("metrics_push_total", "metrics_push_failures_total")
-PUSH_META = ("metrics_push_host",)
-
-# The alerting surface (ISSUE 11): a document whose meta declares
-# alert rules active (telemetry/alerts.py stamps meta.alert_rules at
-# engine setup, counters/gauges pre-created at 0) must carry the
-# engine's counters and the rule-count gauge; any alerts_firing{rule=}
-# gauge present must hold 0/1 and name a declared rule.
-ALERT_COUNTERS = ("alerts_fired_total", "alert_rule_errors_total")
-ALERT_GAUGES = ("alert_rules_active",)
-
-# The sharded (--devices N) metric surface (ISSUE 5): a stage-1
-# document built over more than one shard must carry the per-shard
-# insert/occupancy telemetry parallel/tile_sharded.record_shard_metrics
-# writes — the scale-out observability is the point of the feature.
-SHARD_REQUIRED_COUNTERS = ("shard_batches", "shard_reads",
-                           "shard_inserts_total", "distinct_mers")
-SHARD_REQUIRED_GAUGES = ("n_shards", "shard_distinct_min",
-                         "shard_distinct_max", "shard_inserts_min",
-                         "shard_inserts_max")
-SHARD_REQUIRED_META_LISTS = ("shard_distinct_mers", "shard_inserts")
 
 
 def _check_shard_names(doc: dict) -> list[str]:

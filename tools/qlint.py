@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Shim for `quorum-lint` (quorum_tpu/analysis/cli.py) so CI and
+developers can run the static-analysis suite without installing the
+package: `python tools/qlint.py --strict`. See the README "Static
+analysis" section for the rule list and suppression syntax."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from quorum_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
