@@ -458,6 +458,194 @@ def run_ab():
         parity="byte-identical" if rw_par else "MISMATCH"))
     assert rw_par, "render-worker outputs disagree"
 
+    # -- memory-frugal counting (ISSUE 14) ----------------------------
+    run_ab_memfrugal(codes, quals, lengths, n_reads, read_len, k, reps,
+                     genome_size)
+
+
+def run_ab_memfrugal(codes, quals, lengths, n_reads, read_len, k, reps,
+                     genome_size):
+    """The ISSUE 14 probes, in-process like the rest of --ab:
+
+    * ``ab_prefilter`` — full build vs two-pass sketch+gated build
+      over the same packed batches; asserts (a) the filtered table is
+      exactly the full table minus true singletons (modulo counted
+      false passes), and (b) stage-2 output over the filtered table
+      is BYTE-IDENTICAL to the full table at the same presence floor
+      (the parity theorem, ops/sketch). Reports table entries/bytes
+      both ways, drop counts, build times and Gb/h.
+    * ``ab_partitions`` — the real CLI single-pass vs --partitions 4
+      builds over a temp FASTQ; asserts db_payload_bytes equality and
+      reports per-variant wall, peak-rows ratio, plus the
+      minimizer-vs-address bin balance (ops/mer.minimizer_kmers) that
+      justifies the address-bit bin key.
+    """
+    import tempfile
+    import time as _time
+
+    import jax as _j
+    import jax.numpy as jnp
+
+    from quorum_tpu.io import db_format, packing
+    from quorum_tpu.models import corrector
+    from quorum_tpu.models.ec_config import ECConfig
+    from quorum_tpu.ops import ctable, mer
+    from quorum_tpu.ops import sketch as sketch_mod
+
+    qt = 38
+    n_batches = 4
+    rows = n_reads // n_batches
+    pks = []
+    for i in range(n_batches):
+        pk = packing.pack_reads(codes[i * rows:(i + 1) * rows],
+                                quals[i * rows:(i + 1) * rows],
+                                lengths[:rows], thresholds=(qt,))
+        pk.to_wire()
+        pks.append(pk)
+    meta = ctable.TileMeta(
+        k=k, bits=7,
+        rb_log2=ctable.tile_rb_for(
+            genome_size + int(codes.size * ERR_RATE * k * 1.3), k, 7))
+    smeta = sketch_mod.SketchMeta(
+        sketch_mod.cells_log2_for(meta.rows * 24))
+
+    def build_full():
+        bs = ctable.make_tile_build(meta)
+        for pk in pks:
+            bs, full, _obs = ctable.tile_insert_reads_packed(
+                bs, meta, pk, qt)
+            assert not full
+        _j.block_until_ready(bs.tag)
+        return bs
+
+    dropped = {"n": 0}
+
+    def build_two_pass():
+        sk = sketch_mod.make_sketch(smeta)
+        for pk in pks:
+            sk, _n = sketch_mod.sketch_update_packed(sk, smeta, k, pk,
+                                                     qt)
+        bs = ctable.make_tile_build(meta)
+        dropped["n"] = 0
+        for pk in pks:
+            bs, sk, full, _obs, d_hq, d_lq = \
+                sketch_mod.tile_insert_reads_packed_gated(
+                    bs, meta, sk, smeta, pk, qt, "two-pass")
+            assert not full
+            dropped["n"] += d_hq + d_lq
+        _j.block_until_ready(bs.tag)
+        return bs
+
+    t0 = _time.perf_counter()
+    bs_full = build_full()
+    full_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    bs_filt = build_two_pass()
+    filt_s = _time.perf_counter() - t0
+    for _ in range(reps - 1):
+        t0 = _time.perf_counter()
+        build_full()
+        full_s = min(full_s, _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        build_two_pass()
+        filt_s = min(filt_s, _time.perf_counter() - t0)
+    false_pass = int(sketch_mod.singleton_entries(bs_filt))
+    st_full = ctable.tile_finalize(bs_full, meta)
+    st_filt = ctable.tile_finalize(bs_filt, meta)
+    n_full = int(ctable.tile_stats(st_full, meta)[0])
+    n_filt = int(ctable.tile_stats(st_filt, meta)[0])
+    # stage-2 parity AT THE FLOOR: flooring both tables must yield
+    # bit-identical planes (the filtered table only ever lost mers
+    # that finalize below the floor), hence byte-identical output
+    fl_full = ctable.tile_floor(st_full, meta, 2)
+    fl_filt = ctable.tile_floor(st_filt, meta, 2)
+    cfg = ECConfig(k=k, cutoff=4, poisson_dtype="float32")
+    pk2 = packing.pack_reads(codes[:rows], quals[:rows],
+                             lengths[:rows],
+                             thresholds=(cfg.qual_cutoff,))
+    pk2.to_wire()
+    outs = {}
+    for tag, st in (("full", fl_full), ("filt", fl_filt)):
+        _res, packed = corrector.correct_batch_packed(
+            st, meta, pk2, cfg, pack_cap=4 * rows)
+        _j.block_until_ready(packed)
+        outs[tag] = np.asarray(packed).tobytes()
+    pf_par = outs["full"] == outs["filt"]
+    bases = int(codes.size)
+    # table bytes: the v4/v5 export cost (5 B/entry at k=24-style
+    # geometry: 4 lo + hi bytes) plus the bucket-index plane — the
+    # quantity QUORUM_REPLICATE_TABLE_BYTES gates on is the resident
+    # row plane, which scales with the same entry count
+    hi_b = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+    print(metric_line(
+        "ab_prefilter",
+        base_ms=round(full_s * 1e3, 1),
+        two_pass_ms=round(filt_s * 1e3, 1),
+        speedup=round(full_s / filt_s, 3),
+        gb_h=round(bases / filt_s * 3600 / 1e9, 3),
+        entries_full=n_full, entries_prefiltered=n_filt,
+        table_bytes_full=n_full * (4 + hi_b) + meta.rows,
+        table_bytes_prefiltered=n_filt * (4 + hi_b) + meta.rows,
+        table_reduction=round(n_full / max(1, n_filt), 3),
+        dropped_obs=dropped["n"], false_pass=false_pass,
+        parity_at_floor="byte-identical" if pf_par else "MISMATCH"))
+    assert pf_par, "prefiltered stage-2 output differs at the floor"
+    assert dropped["n"] > 0, "prefilter dropped nothing"
+    assert n_filt < n_full, "prefilter did not shrink the table"
+
+    # -- partitioned build: the real CLI, byte-compared ---------------
+    from quorum_tpu.cli import create_database as cdb_cli
+
+    tmpd = tempfile.mkdtemp(prefix="quorum_ab_parts.")
+    fq = os.path.join(tmpd, "reads.fastq")
+    write_fastq(fq, codes, quals)
+    size = str(max(65536, meta.rows * 16))
+    common = ["-s", size, "-m", str(k), "-b", "7", "-q", str(qt),
+              "--batch-size", str(rows)]
+    t0 = _time.perf_counter()
+    rc = cdb_cli.main(common + ["-o", os.path.join(tmpd, "single.qdb"),
+                                fq])
+    single_s = _time.perf_counter() - t0
+    assert rc == 0, "ab_partitions: single-pass build failed"
+    P = 4
+    t0 = _time.perf_counter()
+    rc = cdb_cli.main(common + ["-o", os.path.join(tmpd, "part.qdb"),
+                                "--partitions", str(P), fq])
+    part_s = _time.perf_counter() - t0
+    assert rc == 0, "ab_partitions: partitioned build failed"
+    pb = db_format.db_payload_bytes(os.path.join(tmpd, "single.qdb"))
+    qb = db_format.db_payload_bytes(os.path.join(tmpd, "part.qdb"))
+    part_par = pb == qb
+    # bin balance: address bins (what the build uses) vs raw
+    # minimizer bins (KMC's key) over this input's distinct mers —
+    # the max/mean ratio is the skew a minimizer-keyed table would
+    # have to absorb in its hottest partition
+    chi, clo, _q, valid = ctable.extract_observations_impl(
+        jnp.asarray(codes), jnp.asarray(quals), k, qt)
+    _a, rem_lo, _rh = ctable._hash_addr_rem(chi, clo, k, meta.rb_log2)
+    addr_bin = np.asarray(rem_lo) & (P - 1)
+    mval, _kvalid = mer.minimizer_kmers(jnp.asarray(codes), k,
+                                        min(7, k - 1))
+    mbin = (np.asarray(mval).ravel() % P)
+    vm = np.asarray(valid).astype(bool)
+    a_counts = np.bincount(addr_bin.ravel()[vm], minlength=P)
+    m_counts = np.bincount(mbin[vm], minlength=P)
+    print(metric_line(
+        "ab_partitions", partitions=P,
+        single_ms=round(single_s * 1e3, 1),
+        partitioned_ms=round(part_s * 1e3, 1),
+        gb_h=round(bases / part_s * 3600 / 1e9, 3),
+        peak_rows_ratio=round(1.0 / P, 3),
+        addr_bin_skew=round(float(a_counts.max())
+                            / max(1.0, float(a_counts.mean())), 3),
+        minimizer_bin_skew=round(float(m_counts.max())
+                                 / max(1.0, float(m_counts.mean())),
+                                 3),
+        parity="byte-identical" if part_par else "MISMATCH"))
+    assert part_par, "partitioned payload differs from single-pass"
+    import shutil
+    shutil.rmtree(tmpd, ignore_errors=True)
+
 
 def main():
     from quorum_tpu.utils.jaxcache import enable_cache

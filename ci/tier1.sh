@@ -23,7 +23,11 @@
 # lane-draining loop, stage-1 pre-aggregation, parity asserted
 # in-process) whose freshly produced metric-line document goes through
 # tools/metrics_check.py --require-metric, so CI validates a BENCH
-# document the same way it validates the stage/serve docs.
+# document the same way it validates the stage/serve docs. ISSUE 14
+# extends it with the memory-frugal probes: ab_prefilter (two-pass
+# singleton prefilter — table reduction measured, stage-2 parity at
+# the presence floor asserted) and ab_partitions (a real --partitions
+# 4 CLI build byte-compared against the single-pass payload).
 #
 # ISSUE 7 adds the serve-resilience gate: a short seeded chaos soak
 # (tools/chaos_soak.py, fixed seed, bounded wall time) driving a live
@@ -231,6 +235,8 @@ else
             --require-metric ab_stage1_insert \
             --require-metric ab_stage2_device \
             --require-metric ab_render_workers \
+            --require-metric ab_prefilter \
+            --require-metric ab_partitions \
             "$AB_DIR/bench_ab.json" || bench_rc=1
     fi
     if [ "$bench_rc" -ne 0 ]; then

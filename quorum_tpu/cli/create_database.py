@@ -61,6 +61,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "files under a sealed manifest at <output> — "
                         "no cross-device gather, no single-chip "
                         "geometry cap, same payload bytes")
+    p.add_argument("--prefilter", choices=("auto", "off", "two-pass",
+                                           "inline"),
+                   default="auto",
+                   help="Singleton prefilter (ISSUE 14): two-pass "
+                        "streams the input once into a count-min "
+                        "sketch then inserts only mers seen >= 2 "
+                        "times (exact); inline gates inserts behind "
+                        "the online sketch, khmer-style "
+                        "(approximate at the margin). Dropped "
+                        "singletons shrink the table severalfold in "
+                        "error-rich data; the database declares its "
+                        "presence floor so stage 2 stays consistent. "
+                        "auto = QUORUM_PREFILTER env > autotune "
+                        "profile > off")
+    p.add_argument("--partitions", type=int, default=1, metavar="P",
+                   help="Build the table in P sequential passes over "
+                        "the input (power of two <= 256), each "
+                        "counting one disjoint leading-bit row range "
+                        "at 1/P the table memory and exporting "
+                        "straight into the sharded manifest "
+                        "(--db-layout=sharded is implied). The "
+                        "reassembled payload is byte-identical to a "
+                        "single-pass build; kill->resume re-runs "
+                        "only the torn partition")
     p.add_argument("--profile", metavar="dir", default=None,
                    help="Write a jax.profiler trace to this directory")
     p.add_argument("--metrics", metavar="path", default=None,
@@ -95,7 +119,8 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None, handoff: dict | None = None, batches=None) -> int:
+def main(argv=None, handoff: dict | None = None, batches=None,
+         batches_factory=None) -> int:
     from ..utils.jaxcache import enable_cache
     enable_cache()
     args = build_parser().parse_args(argv)
@@ -129,6 +154,51 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    # memory-frugal counting (ISSUE 14): resolve + validate the
+    # prefilter mode and partition count before any device work
+    from ..ops.sketch import prefilter_default
+    auto = args.prefilter == "auto"
+    prefilter = prefilter_default() if auto else args.prefilter
+    P = args.partitions
+    if P < 1 or P > 256 or (P & (P - 1)):
+        print(f"--partitions must be a power of two in [1, 256], "
+              f"got {P}", file=sys.stderr)
+        return 1
+    if prefilter != "off" and devices > 1:
+        if auto:
+            # an env/profile-resolved default the user never asked
+            # for must DEGRADE on an unsupported combination, not
+            # refuse the run (an explicit flag still refuses loudly)
+            vlog_mod.vlog("Prefilter default ", prefilter,
+                          " does not compose with --devices ", devices,
+                          "; running unfiltered")
+            prefilter = "off"
+        else:
+            print("--prefilter composes with --devices 1 today; use "
+                  "--partitions for multi-pass capacity over a mesh",
+                  file=sys.stderr)
+            return 1
+    if prefilter == "inline" and (P > 1 or args.checkpoint_dir):
+        if auto:
+            vlog_mod.vlog("Prefilter default inline does not compose "
+                          "with --partitions/--checkpoint-dir; "
+                          "running unfiltered")
+            prefilter = "off"
+        else:
+            print("--prefilter=inline supports neither --partitions "
+                  "nor --checkpoint-dir (the online sketch is "
+                  "neither pass-stable nor snapshotted); use "
+                  "--prefilter=two-pass", file=sys.stderr)
+            return 1
+    if args.ref_format and (P > 1 or prefilter != "off"):
+        print("--ref-format supports neither --partitions nor "
+              "--prefilter", file=sys.stderr)
+        return 1
+    db_layout = args.db_layout
+    if P > 1:
+        # the partitioned export IS the sharded manifest: each pass
+        # streams its shard file as it completes
+        db_layout = "sharded"
     cfg = BuildConfig(
         k=args.mer,
         bits=args.bits,
@@ -144,7 +214,9 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
         resume=args.resume,
         on_bad_read=args.on_bad_read,
         db_version=args.db_version,
-        db_layout=args.db_layout,
+        db_layout=db_layout,
+        prefilter=prefilter,
+        partitions=P,
         quarantine_path=(args.output + ".quarantine.fastq"
                          if args.on_bad_read == "quarantine" else None),
     )
@@ -168,6 +240,7 @@ def main(argv=None, handoff: dict | None = None, batches=None) -> int:
                                  cmdline=list(sys.argv),
                                  ref_format=args.ref_format,
                                  handoff=handoff, batches=batches,
+                                 batches_factory=batches_factory,
                                  metrics=obs.registry, tracer=obs.tracer)
             rc = 0
             obs.registry.set_meta(output=args.output)

@@ -57,6 +57,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "random subset of entry chunks, off skips. "
                         "A bad digest refuses the load (rc 3, "
                         "integrity_errors_total)")
+    p.add_argument("--presence-floor", type=int, default=0, metavar="N",
+                   help="Treat mers with count < N as absent at DB "
+                        "load (0 = auto: a prefiltered database "
+                        "applies its declared floor, others keep "
+                        "full presence). The floor is what makes a "
+                        "--prefilter database byte-equivalent to the "
+                        "unfiltered one (ISSUE 14)")
     p.add_argument("--apriori-error-rate", type=float, default=0.01,
                    help="Probability of a base being an error")
     p.add_argument("--poisson-threshold", type=float, default=1e-6,
@@ -184,6 +191,7 @@ def main(argv=None, db=None, prepacked=None) -> int:
         resume=args.resume,
         on_bad_read=args.on_bad_read,
         verify_db=args.verify_db,
+        presence_floor=args.presence_floor,
     )
     try:
         run_error_correct(

@@ -239,6 +239,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "v5 database: full (default), sample "
                         "(random chunk scrub), or off. A bad digest "
                         "refuses the run (rc 3)")
+    # memory-frugal counting (ISSUE 14)
+    p.add_argument("--prefilter", choices=("auto", "off", "two-pass",
+                                           "inline"),
+                   default="auto",
+                   help="Stage-1 singleton prefilter: drop mers seen "
+                        "once before they claim a table slot "
+                        "(two-pass = exact via a sketch pass; inline "
+                        "= khmer-style online). The database declares "
+                        "its presence floor and stage 2 auto-applies "
+                        "it — output equals an unfiltered run at the "
+                        "same floor. auto = QUORUM_PREFILTER env > "
+                        "autotune profile > off")
+    p.add_argument("--partitions", type=int, default=1, metavar="P",
+                   help="Build the mer database in P sequential "
+                        "passes (power of two <= 256), each at 1/P "
+                        "the table memory, exported straight into "
+                        "the sharded manifest — byte-identical "
+                        "payload, terabase-scale inputs on one HBM")
     p.add_argument("--render-workers", type=int, default=0, metavar="N",
                    help="Stage-2 host finish/render workers behind a "
                         "sequence-numbered reorder stage (0 = auto, "
@@ -398,6 +416,24 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         return 1
     vlog("Using ", n_devices, " device(s)")
 
+    # ISSUE 14 validations, mirrored here so the operator gets the
+    # refusal directly instead of "Creating the mer database failed"
+    P = args.partitions
+    if P < 1 or P > 256 or (P & (P - 1)):
+        print(f"quorum: --partitions must be a power of two in "
+              f"[1, 256], got {P}", file=sys.stderr)
+        return 1
+    if args.prefilter not in ("auto", "off") and n_devices > 1:
+        print("quorum: --prefilter composes with --devices 1 today; "
+              "use --partitions for multi-pass capacity over a mesh",
+              file=sys.stderr)
+        return 1
+    if args.prefilter == "inline" and (P > 1 or args.checkpoint_dir):
+        print("quorum: --prefilter=inline supports neither "
+              "--partitions nor --checkpoint-dir; use "
+              "--prefilter=two-pass", file=sys.stderr)
+        return 1
+
     # per-stage observability paths (forward --metrics, --profile and
     # --trace-spans consistently to both children, suffixed per
     # stage; --metrics-textfile is shared — each stage's heartbeats
@@ -449,6 +485,10 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                 "--devices", str(n_devices),
                 "--db-version", str(args.db_version),
                 "--db-layout", args.db_layout]
+    if args.prefilter != "auto":
+        cdb_argv.extend(["--prefilter", args.prefilter])
+    if args.partitions != 1:
+        cdb_argv.extend(["--partitions", str(args.partitions)])
     if args.checkpoint_dir:
         cdb_argv.extend(["--checkpoint-dir", args.checkpoint_dir,
                          "--checkpoint-every",
@@ -487,8 +527,13 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     # and replayed into stage 2, sparing the second disk parse + H2D
     # re-pack that the two-process reference gets from the page cache.
     reads_cache: list = []
+    # "complete" flips True only when the caching producer is consumed
+    # to exhaustion: a multi-pass stage 1 that abandons its first
+    # iterator mid-stream (a partition-geometry restart) must never
+    # leave a TRUNCATED cache that stage 2 would silently replay as
+    # the whole input (ISSUE 14 review)
     cache_state = {"bytes": 0, "ok": not args.paired_files,
-                   "writer": None}
+                   "writer": None, "complete": False}
     # with --checkpoint-dir the replay cache ALSO streams to disk
     # (io/checkpoint.ReplayCache), so a later --resume run feeds
     # stage 2 from the capture instead of re-parsing the FASTQ —
@@ -561,15 +606,39 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                         if writer is not None:
                             writer.add(cached[0], cached[1])
                 yield item
-            # every batch landed: commit the on-disk capture (the
-            # manifest is the atomic commit point — a kill before
-            # this line just means the next resume re-parses)
+            # every batch landed: the RAM cache is the full input now
+            # (an abandoned iterator never reaches this line), and the
+            # on-disk capture commits (the manifest is the atomic
+            # commit point — a kill before this line just means the
+            # next resume re-parses)
+            cache_state["complete"] = True
             if writer is not None and cache_state["ok"]:
                 writer.finish()
         return prefetch(_pack_and_keep(src),
                         metrics=reg if reg.enabled else None,
                         name="reads_producer",
                         tracer=driver_tracer)
+
+    def _plain_batches():
+        # repeat passes of a multi-pass stage 1 (ISSUE 14): a fresh
+        # quiet re-parse — deterministic batching identical to the
+        # caching producer (a quarantine/skip policy skips the same
+        # records), no cache side effects, no double-counted
+        # telemetry. The span-parallel single-file reader (PR 9)
+        # keeps these re-reads cheap.
+        from ..utils.pipeline import prefetch
+        t1 = min_q_char + args.min_quality
+        policy = (fastq.BadReadPolicy("skip", None, None)
+                  if args.on_bad_read != "abort" else None)
+        src = fastq.read_batches(args.reads, args.batch_size,
+                                 threads=threads, policy=policy)
+
+        def _pack(it):
+            for b in it:
+                pk1 = packing.pack_reads(b.codes, b.quals, b.lengths,
+                                         thresholds=(t1,))
+                yield dataclasses.replace(b, quals=None), pk1.compact()
+        return prefetch(_pack(src))
 
     handoff: dict = {}
     if reg.enabled:
@@ -578,25 +647,39 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     def _stage1_cursor():
         if not args.checkpoint_dir:
             return None
+        if args.partitions > 1:
+            return ckpt_mod.Stage1PartitionCursor(
+                args.checkpoint_dir).cursor()
         cls = (ckpt_mod.Stage1ShardedCheckpoint if n_devices > 1
                else ckpt_mod.Stage1Checkpoint)
         return cls(args.checkpoint_dir).cursor()
 
     def _stage1_attempt(attempt: int) -> int:
         # every attempt gets a FRESH shared producer and replay cache
-        # (a failed attempt consumed part of the previous generator)
+        # (a failed attempt consumed part of the previous generator).
+        # The producer is handed over as a FACTORY: pass 1 of a
+        # multi-pass build consumes the caching producer (populating
+        # the stage-2 replay cache exactly once), repeat passes
+        # re-parse quietly.
         handoff.clear()
         reads_cache.clear()
         cache_state["bytes"] = 0
         cache_state["ok"] = not args.paired_files
+        cache_state["complete"] = False
         cache_state["writer"] = (
             replay_store.start(replay_identity, _replay_cap())
             if replay_store is not None else None)
         argv = list(cdb_argv)
         if args.checkpoint_dir and (args.resume or attempt > 0):
             argv.append("--resume")
+        calls = {"n": 0}
+
+        def factory():
+            first = calls["n"] == 0
+            calls["n"] += 1
+            return _cached_batches() if first else _plain_batches()
         return cdb_cli.main(argv + list(args.reads), handoff=handoff,
-                            batches=_cached_batches())
+                            batches_factory=factory)
 
     def _stage1_db_reusable() -> bool:
         """The reuse bar: a readable database header whose geometry
@@ -664,7 +747,8 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
             reg.gauge("stage1_seconds").set(s1_s)
             reg.event("stage_done", stage="create_database",
                       seconds=s1_s)
-    prepacked = reads_cache if cache_state["ok"] and reads_cache else None
+    prepacked = (reads_cache if cache_state["ok"]
+                 and cache_state["complete"] and reads_cache else None)
     prepacked_factory = (lambda: prepacked) if prepacked else None
     if prepacked_factory is None and replay_store is not None:
         # resumed run with stage 1 skipped (or its RAM cache lost):

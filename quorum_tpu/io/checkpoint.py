@@ -533,6 +533,190 @@ def _addressable_row_shards(bstate, S: int, rows_total: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Stage 1, partitioned (--partitions P): pass-granular cursor manifest
+# ---------------------------------------------------------------------------
+
+STAGE1_PARTITIONS_FORMAT = "quorum_tpu_stage1_partitions/1"
+SKETCH_FORMAT = "quorum_tpu_sketch_ckpt/1"
+
+
+class Stage1PartitionCursor:
+    """Crash-safe progress cursor for the minimizer-partitioned
+    multi-pass stage-1 build (ISSUE 14) — the Stage1ShardedCheckpoint
+    manifest protocol at PARTITION-PASS granularity: the completed
+    partitions' shard files (already durable at their final output
+    paths — each pass's export IS its checkpoint) plus ONE sealed
+    cursor manifest, ``<dir>/stage1.partitions.json``, atomically
+    replaced after every pass. A kill mid-pass leaves the cursor at
+    the last completed partition; ``--resume`` validates the config
+    identity AND every completed shard file's whole-file digest, then
+    re-runs only the torn/remaining partitions — byte-identical
+    output, no batch-level snapshots needed (a pass restarts from its
+    first batch)."""
+
+    MANIFEST = "stage1.partitions.json"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, self.MANIFEST)
+
+    def _read(self) -> dict | None:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except ValueError:
+            raise CheckpointError(
+                f"corrupt partition cursor '{self.path}'") from None
+        if doc.get("format") != STAGE1_PARTITIONS_FORMAT:
+            raise CheckpointError(
+                f"'{self.path}' is not a stage-1 partition cursor "
+                f"(format={doc.get('format')!r})")
+        _check_seal_ckpt(doc, "stage-1 partition cursor", self.path)
+        return doc
+
+    def save(self, identity: dict, completed: list[dict],
+             out_dir: str) -> None:
+        """Commit the cursor after a pass: `completed` is the ordered
+        list of write_db_shard_file manifest records (plus per-pass
+        stat fields) for every finished partition. Each record gains
+        the PHYSICAL whole-file digest of its shard (the manifest's
+        `file_crc32c` is the v5 header+payload digest, which excludes
+        the trailer line) so load() can verify with one crc32c_file
+        pass. atomic_write = the commit point."""
+        os.makedirs(self.dir, exist_ok=True)
+        for rec in completed:
+            # memoized ON the caller's record: the cursor commits
+            # after EVERY pass with the same record objects, and
+            # re-hashing all prior shards each time would be O(P^2)
+            # whole-file reads
+            if "ckpt_file_crc32c" not in rec:
+                rec["ckpt_file_crc32c"] = integrity.crc32c_file(
+                    os.path.join(out_dir, str(rec["path"])))
+        atomic_write(self.path, json.dumps(integrity.seal({
+            "format": STAGE1_PARTITIONS_FORMAT,
+            "identity": identity,
+            "completed": list(completed),
+        })) + "\n")
+        faults.inject("partition.commit", path=self.path)
+
+    def load(self, identity: dict, out_dir: str) -> list[dict] | None:
+        """The completed-partition records, or None when there is no
+        usable cursor. A cursor written by a different run (identity
+        mismatch) is None — a fresh build, not an error. A completed
+        shard file that is missing or fails its recorded digest
+        raises CheckpointError: resuming must never trust a partition
+        the manifest can't vouch for."""
+        doc = self._read()
+        if doc is None or doc.get("identity") != identity:
+            return None
+        completed = doc.get("completed") or []
+        for rec in completed:
+            p = os.path.join(out_dir, str(rec.get("path", "")))
+            if not os.path.exists(p):
+                raise CheckpointError(
+                    f"partition cursor names completed shard '{p}' "
+                    "but the file is missing; delete the cursor to "
+                    "rebuild from scratch")
+            got = integrity.crc32c_file(p)
+            if got != int(rec.get("ckpt_file_crc32c", -1)):
+                integrity.record_error(
+                    f"completed partition shard '{p}': digest "
+                    f"mismatch (crc32c {got:#010x} != cursor "
+                    f"{int(rec.get('ckpt_file_crc32c', -1)):#010x})",
+                    path=p, section="shard", offset=0)
+                raise CheckpointError(
+                    f"completed partition shard '{p}' failed its "
+                    "digest; refusing to resume over a corrupted "
+                    "partition (delete it and the cursor to rebuild)")
+        return completed
+
+    def cursor(self) -> int | None:
+        """Header-only peek: how many partitions are committed (the
+        driver's retry events); None when no usable cursor."""
+        try:
+            doc = self._read()
+        except CheckpointError:
+            return None
+        if doc is None:
+            return None
+        return len(doc.get("completed") or [])
+
+    def clear(self) -> None:
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class SketchCheckpoint:
+    """Snapshot of the two-pass prefilter's finished sketch
+    (``<dir>/stage1.sketch.ckpt``), so a resumed partitioned+
+    prefiltered build skips the sketch pass instead of re-streaming
+    the whole input. Same streamed tmp-then-rename + payload-digest
+    contract as Stage1Checkpoint."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, "stage1.sketch.ckpt")
+
+    def save(self, cells: np.ndarray, identity: dict) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        cells = np.ascontiguousarray(np.asarray(cells, np.uint8))
+        header = integrity.seal({
+            "format": SKETCH_FORMAT,
+            "identity": identity,
+            "cells": int(cells.shape[0]),
+            "payload_crc32c": integrity.crc32c(cells),
+        })
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(cells.tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        integrity.fsync_dir(self.path)
+        faults.inject("checkpoint.commit", path=self.path)
+
+    def load(self, identity: dict) -> np.ndarray | None:
+        """The sketch cell plane, or None (mismatched identity = a
+        different run's sketch = fresh pass, not an error). A corrupt
+        payload raises CheckpointError."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            try:
+                header = json.loads(f.readline(1 << 20))
+            except ValueError:
+                raise CheckpointError(
+                    f"corrupt sketch checkpoint '{self.path}' (bad "
+                    "header)") from None
+            if header.get("format") != SKETCH_FORMAT:
+                raise CheckpointError(
+                    f"'{self.path}' is not a sketch checkpoint "
+                    f"(format={header.get('format')!r})")
+            _check_seal_ckpt(header, "sketch checkpoint", self.path)
+            if header.get("identity") != identity:
+                return None
+            payload = f.read()
+        if len(payload) != int(header["cells"]):
+            raise CheckpointError(
+                f"corrupt sketch checkpoint '{self.path}': payload "
+                f"{len(payload)} bytes, want {header['cells']}")
+        _check_payload_crc(payload, header, "sketch checkpoint",
+                           self.path)
+        return np.frombuffer(payload, dtype=np.uint8)
+
+    def clear(self) -> None:
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Stage 2: output journal
 # ---------------------------------------------------------------------------
 

@@ -164,12 +164,16 @@ def _v5_checksums(buf: np.ndarray, rows_n: int) -> tuple[dict, int]:
 
 def write_db(path: str, state, meta, cmdline: list[str] | None = None,
              compact: bool = True, n_entries: int | None = None,
-             db_version: int = DEFAULT_DB_VERSION) -> None:
+             db_version: int = DEFAULT_DB_VERSION,
+             extra_header: dict | None = None) -> None:
     """`n_entries` (optional) spares the occupancy-counting pass when
     the caller already knows it (stage 1's tile_seal does).
     `db_version` selects the compact export format: 5 (default)
     writes the v4 payload plus per-section CRC32C digests and a
-    whole-file-digest trailer; 4 writes the bare round-5 layout."""
+    whole-file-digest trailer; 4 writes the bare round-5 layout.
+    `extra_header` merges extra fields into the header (the prefilter
+    declaration + Poisson stats of ISSUE 14 — payload bytes are
+    untouched, so the layout-parity guarantees hold)."""
     if isinstance(meta, TileMeta):
         if compact:
             if db_version not in (4, 5):
@@ -206,6 +210,7 @@ def write_db(path: str, state, meta, cmdline: list[str] | None = None,
                 "n_entries": n,
                 "hi_bytes": hi_bytes,
                 "value_bytes": int(buf.nbytes),
+                **(extra_header or {}),
                 **_header_common(cmdline),
             }
             trailer = None
@@ -270,9 +275,118 @@ def _row_shards(rows, n_shards: int, rows_total: int) -> list:
     return [out[s] for s in range(n_shards)]
 
 
+def write_db_shard_file(path_prefix: str, rows_s, meta, s: int, S: int,
+                        cmdline: list[str] | None = None,
+                        db_version: int = DEFAULT_DB_VERSION) -> dict:
+    """Write ONE shard of a sharded database export — shard `s`'s
+    local row plane (device jnp or host numpy, `meta.rows // S` rows
+    at the GLOBAL geometry `meta`) compacted on its own device
+    (ctable.tile_export_v4) and streamed into
+    ``PREFIX.shard-s-of-S.qdb``, a self-contained v5 (or v4) file
+    with its own section digests and trailer. Returns the manifest
+    record (`write_db_manifest` consumes a list of these). Factored
+    out of the one-shot sharded export so the partitioned multi-pass
+    build (ISSUE 14) can stream each partition's shard to disk as its
+    pass completes — the shard bytes are identical either way."""
+    if db_version not in (4, 5):
+        raise ValueError(f"db_version must be 4 or 5, got {db_version}")
+    rows_total = meta.rows
+    rows_local = rows_total // S
+    hi_bytes = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+    if isinstance(rows_s, np.ndarray):
+        occ = int(np.count_nonzero(
+            rows_s[:, 0::2] & np.uint32(meta.max_val)))
+        rows_dev = jnp.asarray(rows_s)
+    else:
+        occ = int(jnp.sum(
+            (rows_s[:, 0::2] & jnp.uint32(meta.max_val)) != 0,
+            dtype=jnp.int32))
+        rows_dev = rows_s
+    # cap is a STATIC jit arg: power-of-two rounding keeps one
+    # export executable across shards (and runs) instead of one
+    # per distinct occupancy
+    cap = 1 << max(10, (max(1, occ) - 1).bit_length())
+    counts, lo_b, hi_pl, _n = ctable.tile_export_v4(
+        TileState(rows_dev), meta, cap)
+    buf = np.asarray(jnp.concatenate(
+        [counts, lo_b[:4 * occ]]
+        + [hi_pl[j, :occ] for j in range(hi_bytes)]))
+    shard_path = shard_file_name(path_prefix, s, S)
+    header = {
+        "format": FORMAT,
+        "version": db_version,
+        "layout": "shard",
+        "shard": s,
+        "n_shards": S,
+        "key_len": 2 * meta.k,
+        "bits": meta.bits,
+        "rb_log2": meta.rb_log2,  # GLOBAL geometry
+        "rows": rows_total,
+        "rows_local": rows_local,
+        "n_entries": occ,
+        "hi_bytes": hi_bytes,
+        "value_bytes": int(buf.nbytes),
+        **_header_common(cmdline),
+    }
+    if db_version >= 5:
+        cks, payload_crc = _v5_checksums(buf, rows_local)
+        header["checksum"] = cks
+    else:
+        payload_crc = integrity.crc32c(buf)
+    # digests computed BEFORE the write so an injected post-commit
+    # corruption (the db.write fault, or real bit rot) can never
+    # leak into the manifest and self-certify
+    line = json.dumps(header).encode() + b"\n"
+    hcrc = integrity.crc32c(line)
+    fcrc = integrity.crc32c_combine(hcrc, payload_crc,
+                                    int(buf.nbytes))
+    trailer_bytes = None
+    if db_version >= 5:
+        trailer_bytes = (json.dumps({
+            "format": TRAILER_FORMAT,
+            "header_crc32c": hcrc,
+            "file_crc32c": fcrc,
+        }) + "\n").encode()
+    _atomic_db_write(shard_path, header, buf.tobytes(),
+                     trailer=(None if trailer_bytes is None
+                              else lambda _l, _t=trailer_bytes: _t))
+    return {"path": os.path.basename(shard_path), "shard": s,
+            "n_entries": occ, "value_bytes": int(buf.nbytes),
+            "file_crc32c": fcrc}
+
+
+def write_db_manifest(path: str, recs: list, meta, S: int,
+                      cmdline: list[str] | None = None,
+                      db_version: int = DEFAULT_DB_VERSION,
+                      extra_header: dict | None = None) -> None:
+    """Commit the sealed manifest over `recs` (write_db_shard_file
+    records, shard order). Every shard file must already be durable —
+    the manifest swap is the commit point. `extra_header` (e.g. the
+    prefilter declaration + poisson_stats, ISSUE 14) merges into the
+    sealed document, so loaders see it via read_db's header."""
+    hi_bytes = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
+    manifest = integrity.seal({
+        "format": MANIFEST_FORMAT,
+        "version": db_version,
+        "layout": "sharded",
+        "key_len": 2 * meta.k,
+        "bits": meta.bits,
+        "rb_log2": meta.rb_log2,
+        "rows": meta.rows,
+        "n_shards": S,
+        "n_entries": sum(int(r["n_entries"]) for r in recs),
+        "hi_bytes": hi_bytes,
+        "shards": recs,
+        **(extra_header or {}),
+        **_header_common(cmdline),
+    })
+    _atomic_db_write(path, manifest, b"")
+
+
 def write_db_sharded(path: str, state, meta,
                      cmdline: list[str] | None = None,
-                     db_version: int = DEFAULT_DB_VERSION) -> None:
+                     db_version: int = DEFAULT_DB_VERSION,
+                     extra_header: dict | None = None) -> None:
     """The no-gather sharded export (`--db-layout=sharded`): each
     shard's leading-row-bit range compacts ON ITS OWN DEVICE
     (ctable.tile_export_v4 with the GLOBAL geometry's key/hi-byte
@@ -292,89 +406,13 @@ def write_db_sharded(path: str, state, meta,
     if db_version not in (4, 5):
         raise ValueError(f"db_version must be 4 or 5, got {db_version}")
     S = int(getattr(meta, "n_shards", 1))
-    rows_total = meta.rows
-    rows_local = rows_total // S
-    hi_bytes = (max(0, meta.rem_bits - meta.rlo_bits) + 7) // 8
-    recs = []
-    total = 0
-    for s, rows_s in enumerate(_row_shards(state.rows, S, rows_total)):
-        if isinstance(rows_s, np.ndarray):
-            occ = int(np.count_nonzero(
-                rows_s[:, 0::2] & np.uint32(meta.max_val)))
-            rows_dev = jnp.asarray(rows_s)
-        else:
-            occ = int(jnp.sum(
-                (rows_s[:, 0::2] & jnp.uint32(meta.max_val)) != 0,
-                dtype=jnp.int32))
-            rows_dev = rows_s
-        # cap is a STATIC jit arg: power-of-two rounding keeps one
-        # export executable across shards (and runs) instead of one
-        # per distinct occupancy
-        cap = 1 << max(10, (max(1, occ) - 1).bit_length())
-        counts, lo_b, hi_pl, _n = ctable.tile_export_v4(
-            TileState(rows_dev), meta, cap)
-        buf = np.asarray(jnp.concatenate(
-            [counts, lo_b[:4 * occ]]
-            + [hi_pl[j, :occ] for j in range(hi_bytes)]))
-        shard_path = shard_file_name(path, s, S)
-        header = {
-            "format": FORMAT,
-            "version": db_version,
-            "layout": "shard",
-            "shard": s,
-            "n_shards": S,
-            "key_len": 2 * meta.k,
-            "bits": meta.bits,
-            "rb_log2": meta.rb_log2,  # GLOBAL geometry
-            "rows": rows_total,
-            "rows_local": rows_local,
-            "n_entries": occ,
-            "hi_bytes": hi_bytes,
-            "value_bytes": int(buf.nbytes),
-            **_header_common(cmdline),
-        }
-        if db_version >= 5:
-            cks, payload_crc = _v5_checksums(buf, rows_local)
-            header["checksum"] = cks
-        else:
-            payload_crc = integrity.crc32c(buf)
-        # digests computed BEFORE the write so an injected post-commit
-        # corruption (the db.write fault, or real bit rot) can never
-        # leak into the manifest and self-certify
-        line = json.dumps(header).encode() + b"\n"
-        hcrc = integrity.crc32c(line)
-        fcrc = integrity.crc32c_combine(hcrc, payload_crc,
-                                        int(buf.nbytes))
-        trailer_bytes = None
-        if db_version >= 5:
-            trailer_bytes = (json.dumps({
-                "format": TRAILER_FORMAT,
-                "header_crc32c": hcrc,
-                "file_crc32c": fcrc,
-            }) + "\n").encode()
-        _atomic_db_write(shard_path, header, buf.tobytes(),
-                         trailer=(None if trailer_bytes is None
-                                  else lambda _l, _t=trailer_bytes: _t))
-        recs.append({"path": os.path.basename(shard_path), "shard": s,
-                     "n_entries": occ, "value_bytes": int(buf.nbytes),
-                     "file_crc32c": fcrc})
-        total += occ
+    recs = [write_db_shard_file(path, rows_s, meta, s, S, cmdline,
+                                db_version)
+            for s, rows_s in enumerate(
+                _row_shards(state.rows, S, meta.rows))]
     # every shard is durable; the manifest swap is the commit point
-    manifest = integrity.seal({
-        "format": MANIFEST_FORMAT,
-        "version": db_version,
-        "layout": "sharded",
-        "key_len": 2 * meta.k,
-        "bits": meta.bits,
-        "rb_log2": meta.rb_log2,
-        "rows": rows_total,
-        "n_shards": S,
-        "n_entries": total,
-        "hi_bytes": hi_bytes,
-        "shards": recs,
-        **_header_common(cmdline),
-    })
-    _atomic_db_write(path, manifest, b"")
+    write_db_manifest(path, recs, meta, S, cmdline, db_version,
+                      extra_header)
 
 
 def read_header(path: str) -> dict:

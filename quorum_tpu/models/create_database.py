@@ -17,6 +17,7 @@ one row gather per lookup in stage 2.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Sequence
 
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 from ..io import checkpoint as ckpt_mod
 from ..io import fastq, db_format, packing
 from ..ops import ctable, mer
+from ..ops import sketch as sketch_mod
 from ..telemetry import NULL as NULL_METRICS
 from ..telemetry import NULL_TRACER, observe_dispatch_wait
 from ..utils import faults
@@ -69,6 +71,17 @@ class BuildConfig:
     # PREFIX.shard-K-of-S.qdb v5 files under a sealed manifest — no
     # cross-device gather, no single-chip geometry cap
     db_layout: str = "single"
+    # --prefilter (ISSUE 14): the RESOLVED singleton-prefilter mode —
+    # "off", "two-pass" (sketch pass then exact gated inserts), or
+    # "inline" (khmer-style online gating). Non-off modes imply the
+    # stage-2 presence floor (ops/sketch docstring).
+    prefilter: str = "off"
+    # --partitions (ISSUE 14): P > 1 builds the table in P sequential
+    # passes over the input, each counting one disjoint leading-bit
+    # row range at 1/P the table memory, exported streaming into the
+    # PR 9 sharded manifest — byte-identical payload to a single-pass
+    # build
+    partitions: int = 1
 
 
 def s1_overlap_default() -> bool:
@@ -96,6 +109,39 @@ class BuildStats:
     batches: int = 0
     grows: int = 0
     distinct: int = 0
+    # prefilter accounting (ISSUE 14; zero when the prefilter is off).
+    # poisson_* are the FULL-table Poisson-cutoff statistics (table
+    # stats + the dropped hq singletons' exact contribution), exported
+    # in the database header so stage 2 computes the same cutoff it
+    # would from the unfiltered table.
+    prefilter_mode: str = "off"
+    prefilter_dropped: int = 0
+    prefilter_dropped_hq: int = 0
+    prefilter_false_pass: int = 0
+    sketch_cells_log2: int = 0
+    poisson_distinct_hq: int = 0
+    poisson_total_hq: int = 0
+
+    def db_extra_header(self) -> dict | None:
+        """The prefilter declaration + corrected Poisson stats for the
+        database export header; None for unfiltered builds (no header
+        change, byte-compatible)."""
+        if self.prefilter_mode == "off":
+            return None
+        return {
+            "prefilter": {
+                "mode": self.prefilter_mode,
+                "min_obs": 2,
+                "dropped": int(self.prefilter_dropped),
+                "dropped_hq": int(self.prefilter_dropped_hq),
+                "false_pass": int(self.prefilter_false_pass),
+                "sketch_cells_log2": int(self.sketch_cells_log2),
+            },
+            "poisson_stats": {
+                "distinct_hq": int(self.poisson_distinct_hq),
+                "total_hq": int(self.poisson_total_hq),
+            },
+        }
 
 
 def build_database(
@@ -104,6 +150,7 @@ def build_database(
     batches=None,
     metrics=None,
     tracer=None,
+    batches_factory=None,
 ):
     """Run the full stage-1 pipeline. Returns
     (TileState, TileMeta, stats) — the query-ready tile table.
@@ -111,7 +158,11 @@ def build_database(
     `batches` (optional) overrides the disk readers: an iterable of
     (ReadBatch, PackedReads) pairs whose hq planes include
     cfg.qual_thresh (the quorum driver uses this to share one
-    parse+pack between both stages).
+    parse+pack between both stages). `batches_factory` (optional)
+    is the multi-pass variant: a zero-arg callable returning a FRESH
+    such iterable per call — required by the two-pass prefilter
+    (ISSUE 14), which streams the input once into the sketch and once
+    into the table.
 
     `metrics` (optional telemetry registry, --metrics on the CLI)
     records reads/bases/batches/distinct-mer counters, hash geometry
@@ -126,6 +177,24 @@ def build_database(
     """
     reg = metrics if metrics is not None else NULL_METRICS
     tracer = tracer if tracer is not None else NULL_TRACER
+    if cfg.partitions > 1:
+        raise ValueError(
+            "partitioned builds stream their export per pass — run "
+            "them through create_database_main, not build_database")
+    if cfg.prefilter not in sketch_mod.PREFILTER_MODES:
+        raise ValueError(f"unknown prefilter mode {cfg.prefilter!r} "
+                         f"(one of {sketch_mod.PREFILTER_MODES})")
+    if cfg.prefilter != "off" and cfg.devices > 1:
+        raise ValueError(
+            "--prefilter composes with --devices 1 today; use "
+            "--partitions for multi-pass capacity over a mesh")
+    if cfg.prefilter == "two-pass":
+        return _build_two_pass(paths, cfg, batches, batches_factory,
+                               reg, tracer)
+    if batches is None and batches_factory is not None:
+        # single-pass build handed the multi-pass plumbing: consume
+        # one fresh iterable, exactly like a plain `batches`
+        batches = batches_factory()
     if cfg.devices > 1:
         # --devices N: the tile-sharded multi-device build
         # (parallel/tile_sharded), fed by the SAME packed-wire
@@ -138,6 +207,26 @@ def build_database(
     reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
                  qual_thresh=cfg.qual_thresh, batch_size=cfg.batch_size,
                  s1_aggregate=ctable.s1_aggregate_default())
+    # inline prefilter (ISSUE 14): gate inserts behind the online
+    # sketch, khmer-style. Rides the normal loop; incompatible with
+    # batch-level checkpoints (the sketch is not snapshotted — a
+    # resumed table without its sketch would re-open every gate).
+    sk = smeta = None
+    if cfg.prefilter == "inline":
+        if cfg.checkpoint_dir:
+            raise RuntimeError(
+                "--prefilter=inline does not checkpoint (the online "
+                "sketch is not snapshotted); use --prefilter=two-pass "
+                "with --checkpoint-dir")
+        smeta = sketch_mod.SketchMeta(
+            sketch_mod.cells_log2_for(cfg.initial_size))
+        sk = sketch_mod.make_sketch(smeta)
+        stats.prefilter_mode = "inline"
+        stats.sketch_cells_log2 = smeta.cells_log2
+        reg.set_meta(prefilter="inline",
+                     sketch_cells_log2=smeta.cells_log2)
+        reg.counter("prefilter_dropped_total")
+        reg.counter("prefilter_false_pass_total")
 
     # crash safety (ISSUE 4): resume from the last atomic snapshot —
     # the table planes come back exactly as checkpointed, and the
@@ -201,15 +290,31 @@ def build_database(
                 t0 = time.perf_counter()
                 with tracer.step("stage1_insert", step_i,
                                  reads=batch.n):
-                    # ONE dispatch: extract + insert fused
-                    bstate, full, (chi, clo, q, valid, placed) = \
-                        ctable.tile_insert_reads_packed(
-                            bstate, meta, pk, cfg.qual_thresh)
+                    # ONE dispatch: extract + insert fused (the
+                    # inline-prefiltered variant gates behind the
+                    # sketch in the same executable)
+                    if sk is not None:
+                        (bstate, sk, full,
+                         (chi, clo, q, valid, placed),
+                         d_hq, d_lq) = \
+                            sketch_mod.tile_insert_reads_packed_gated(
+                                bstate, meta, sk, smeta, pk,
+                                cfg.qual_thresh, "inline")
+                    else:
+                        bstate, full, (chi, clo, q, valid, placed) = \
+                            ctable.tile_insert_reads_packed(
+                                bstate, meta, pk, cfg.qual_thresh)
+                        d_hq = d_lq = 0
                     t1 = time.perf_counter()
                     full = bool(full)
                     t2 = time.perf_counter()
                 observe_dispatch_wait(reg, "insert", t0, t1, t2,
                                       timer=timer)
+                if d_hq or d_lq:
+                    stats.prefilter_dropped += d_hq + d_lq
+                    stats.prefilter_dropped_hq += d_hq
+                    reg.counter("prefilter_dropped_total").inc(
+                        d_hq + d_lq)
                 if full:
                     pending = jnp.logical_and(valid,
                                               jnp.logical_not(placed))
@@ -253,8 +358,22 @@ def build_database(
         # ONE dispatch: dup check + finalize + stats fused (separate
         # calls each walk the full build planes; measured seconds per
         # pass at production table sizes)
-        state, dup, occ, _d, _t = ctable.tile_seal(bstate, meta)
+        if sk is not None:
+            # single-observation entries pre-seal = the sketch's
+            # false passes (ops/sketch.singleton_entries)
+            stats.prefilter_false_pass = int(
+                sketch_mod.singleton_entries(bstate))
+            reg.counter("prefilter_false_pass_total").inc(
+                stats.prefilter_false_pass)
+        state, dup, occ, d_hq, t_hq = ctable.tile_seal(bstate, meta)
         occ = int(occ)
+        if sk is not None:
+            # full-table Poisson stats: each dropped hq singleton
+            # would have been one distinct hq mer of count 1
+            stats.poisson_distinct_hq = (int(d_hq)
+                                         + stats.prefilter_dropped_hq)
+            stats.poisson_total_hq = (int(t_hq)
+                                      + stats.prefilter_dropped_hq)
         if bool(dup):  # pragma: no cover
             raise RuntimeError(
                 "internal error: duplicate tag pair in a bucket (torn "
@@ -276,14 +395,21 @@ def build_database(
     return state, meta, stats
 
 
-def _default_batches(paths, cfg: BuildConfig, reg, tracer):
+def _default_batches(paths, cfg: BuildConfig, reg, tracer,
+                     quiet: bool = False):
     """The disk -> decode -> bit-pack producer BOTH build paths (and
     the quorum driver's shared replay cache) consume: host
     decode/encode/bit-packing overlaps device rounds (double
     buffering, the PP row of SURVEY §2.4). H2D stays on the MAIN
     thread in the packed wire format (io/packing.py, 0.5 B/base):
     device_put from the prefetch thread measured slower (tunnel
-    client degrades under concurrent access; PERF_NOTES.md r4)."""
+    client degrades under concurrent access; PERF_NOTES.md r4).
+
+    `quiet` marks a REPEAT pass of a multi-pass build (ISSUE 14): the
+    bad-read policy degrades to a silent skip (identical batching —
+    quarantine also skips the record — without double-counting
+    bad_reads_total or rewriting the quarantine file) and no meta is
+    re-stamped."""
     def _pack(it):
         for b in it:
             pk = packing.pack_reads(b.codes, b.quals, b.lengths,
@@ -302,19 +428,22 @@ def _default_batches(paths, cfg: BuildConfig, reg, tracer):
             "single-controller CLI")
     policy = None
     if cfg.on_bad_read != "abort":
-        # read_batches owns the policy's lifecycle: its generator
-        # finally closes the quarantine stream however this build
-        # ends
-        policy = fastq.BadReadPolicy(
-            cfg.on_bad_read, cfg.quarantine_path,
-            reg if reg.enabled else None)
-        reg.counter("bad_reads_total")  # lands even at 0
-        reg.set_meta(on_bad_read=cfg.on_bad_read)
+        if quiet:
+            policy = fastq.BadReadPolicy("skip", None, None)
+        else:
+            # read_batches owns the policy's lifecycle: its generator
+            # finally closes the quarantine stream however this build
+            # ends
+            policy = fastq.BadReadPolicy(
+                cfg.on_bad_read, cfg.quarantine_path,
+                reg if reg.enabled else None)
+            reg.counter("bad_reads_total")  # lands even at 0
+            reg.set_meta(on_bad_read=cfg.on_bad_read)
     src = fastq.read_batches(paths, cfg.batch_size,
                              threads=cfg.threads, policy=policy)
     return prefetch(_pack(src),
-                    metrics=reg if reg.enabled else None,
-                    tracer=tracer)
+                    metrics=reg if reg.enabled and not quiet else None,
+                    tracer=tracer if not quiet else NULL_TRACER)
 
 
 def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
@@ -549,6 +678,649 @@ def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
     return state, meta, stats
 
 
+# ---------------------------------------------------------------------------
+# Memory-frugal counting (ISSUE 14): two-pass prefilter + partitioned
+# multi-pass builds
+# ---------------------------------------------------------------------------
+
+
+class _PartitionGrew(Exception):
+    """A partition pass overflowed its table. Growing in place would
+    change the partition predicate mid-stream (the partition bits are
+    the remainder bits AT the planned local geometry), so the whole
+    partitioned attempt restarts at the next geometry instead — rare
+    with an honest -s, and always correct."""
+
+    def __init__(self, rb_local: int):
+        super().__init__(f"partition pass needs rb_local={rb_local}")
+        self.rb_local = rb_local
+
+
+def _resolve_batches_factory(paths, cfg: BuildConfig, batches,
+                             batches_factory, reg, tracer):
+    """Multi-pass input plumbing: a zero-arg callable returning a
+    fresh (ReadBatch, PackedReads) iterable per pass. The FIRST call
+    gets the full-fat producer (telemetry, bad-read policy side
+    effects); repeat passes re-parse quietly (deterministic batching,
+    no double counting). A one-shot `batches` iterable cannot be
+    replayed — callers that own one (the quorum driver) pass a
+    factory instead."""
+    if batches_factory is not None:
+        return batches_factory
+    if batches is not None:
+        raise ValueError(
+            "multi-pass builds (--prefilter=two-pass / --partitions) "
+            "re-stream the input once per pass: pass batches_factory "
+            "(a fresh iterable per call), not a one-shot batches "
+            "iterable")
+    calls = {"n": 0}
+
+    def factory():
+        first = calls["n"] == 0
+        calls["n"] += 1
+        return _default_batches(paths, cfg,
+                                reg if first else NULL_METRICS,
+                                tracer if first else NULL_TRACER,
+                                quiet=not first)
+    return factory
+
+
+def _run_sketch_pass(batches, cfg: BuildConfig, smeta, reg, tracer,
+                     timer, stats: BuildStats, count_stats: bool):
+    """Pass 1 of the two-pass prefilter: stream every batch into the
+    counting sketch (ops/sketch), one fused dispatch per batch.
+    Returns the finished SketchState. Counts reads/bases into `stats`
+    only when this is the run's first look at the input."""
+    sk = sketch_mod.make_sketch(smeta)
+    t_pass = time.perf_counter()
+    n_batches = 0
+    for batch, pk in batches:
+        step_i = n_batches
+        n_batches += 1
+        reg.heartbeat(stage="create_database", partition="sketch",
+                      reads=stats.reads, batches=step_i)
+        with tracer.span("sketch_batch", step=step_i, reads=batch.n):
+            t0 = time.perf_counter()
+            with tracer.step("stage1_sketch", step_i, reads=batch.n):
+                sk, n_obs = sketch_mod.sketch_update_packed(
+                    sk, smeta, cfg.k, pk, cfg.qual_thresh)
+                t1 = time.perf_counter()
+                n_obs = int(n_obs)
+                t2 = time.perf_counter()
+            observe_dispatch_wait(reg, "sketch", t0, t1, t2,
+                                  timer=timer)
+        if count_stats:
+            stats.reads += batch.n
+            stats.bases += int(batch.lengths.sum())
+            stats.batches += 1
+    reg.counter("partition_passes_total").inc()
+    reg.event("partition_pass", partition="sketch",
+              n_partitions=cfg.partitions, batches=n_batches,
+              seconds=round(time.perf_counter() - t_pass, 3))
+    return sk
+
+
+def _run_insert_pass(batches, cfg: BuildConfig, lmeta, sk, smeta,
+                     part, n_parts: int, reg, tracer, timer,
+                     stats: BuildStats, count_stats: bool,
+                     allow_grow: bool, step0: int = 0):
+    """One gated/partition-filtered insert pass over the input:
+    builds a fresh tile table at `lmeta` and returns
+    (bstate, lmeta, n_batches). With `allow_grow` (the non-partitioned
+    two-pass build) a full table grows in place like the plain loop;
+    without it (partition passes) a full table raises _PartitionGrew —
+    the partition predicate is pinned to the planned geometry.
+    Dropped-observation counters accumulate into `stats` when the
+    prefilter is active."""
+    bstate = ctable.make_tile_build(lmeta)
+    n_batches = 0
+    # NOTE: the gated insert DONATES the sketch buffer (inline mode
+    # rewrites it in place); the returned handle must replace it even
+    # in read-only two-pass mode, and flows back to the caller for
+    # the next pass.
+    for batch, pk in batches:
+        step_i = step0 + n_batches
+        faults.inject("stage1.insert", batch=step_i)
+        n_batches += 1
+        if count_stats:
+            stats.batches += 1
+            stats.reads += batch.n
+            nb = int(batch.lengths.sum())
+            stats.bases += nb
+            timer.add_units("insert_wait", nb)
+        reg.heartbeat(stage="create_database", reads=stats.reads,
+                      bases=stats.bases, batches=stats.batches,
+                      partition=part if part is not None else 0)
+        with tracer.span("stage1_batch", step=step_i, reads=batch.n,
+                         partition=part if part is not None else 0):
+            t0 = time.perf_counter()
+            with tracer.step("stage1_insert", step_i, reads=batch.n):
+                if sk is not None:
+                    (bstate, sk, full, (chi, clo, q, valid, placed),
+                     d_hq, d_lq) = \
+                        sketch_mod.tile_insert_reads_packed_gated(
+                            bstate, lmeta, sk, smeta, pk,
+                            cfg.qual_thresh, "two-pass", part=part,
+                            n_parts=n_parts)
+                else:
+                    bstate, full, (chi, clo, q, valid, placed) = \
+                        ctable.tile_insert_reads_packed(
+                            bstate, lmeta, pk, cfg.qual_thresh,
+                            part=part, n_parts=n_parts)
+                    d_hq = d_lq = 0
+                t1 = time.perf_counter()
+                full = bool(full)
+                t2 = time.perf_counter()
+            observe_dispatch_wait(reg, "insert", t0, t1, t2,
+                                  timer=timer)
+            if d_hq or d_lq:
+                stats.prefilter_dropped += d_hq + d_lq
+                stats.prefilter_dropped_hq += d_hq
+                reg.counter("prefilter_dropped_total").inc(d_hq + d_lq)
+            if full:
+                pending = jnp.logical_and(valid,
+                                          jnp.logical_not(placed))
+            for _ in range(cfg.max_grows + 1):
+                if not full:
+                    break
+                if not allow_grow:
+                    raise _PartitionGrew(lmeta.rb_log2 + 1)
+                rows_before = lmeta.rows
+                vlog("Hash table full at ", rows_before,
+                     " buckets; doubling")
+                with timer.stage("grow"), tracer.span(
+                        "hash_grow", rows_before=rows_before):
+                    bstate, lmeta = ctable.tile_grow_build(bstate,
+                                                           lmeta)
+                    stats.grows += 1
+                    reg.counter("hash_grows").inc()
+                    reg.event("hash_grow", rows_before=rows_before,
+                              rows_after=lmeta.rows)
+                    bstate, full, placed = \
+                        ctable.tile_insert_observations(
+                            bstate, lmeta, chi, clo, q, pending)
+                    full = bool(full)
+                    pending = jnp.logical_and(
+                        pending, jnp.logical_not(placed))
+            else:
+                if full:
+                    raise RuntimeError("Hash is full")
+    return bstate, lmeta, n_batches, sk
+
+
+def _build_two_pass(paths, cfg: BuildConfig, batches, batches_factory,
+                    reg, tracer):
+    """The two-pass prefiltered build at full geometry (partitions ==
+    1, devices == 1): pass 1 streams the input into the sketch, pass
+    2 inserts only mers the sketch saw >= 2 times. Same return
+    contract as build_database; the caller's export attaches the
+    prefilter declaration + corrected Poisson stats
+    (BuildStats.db_extra_header)."""
+    factory = _resolve_batches_factory(paths, cfg, batches,
+                                       batches_factory, reg, tracer)
+    smeta = sketch_mod.SketchMeta(
+        sketch_mod.cells_log2_for(cfg.initial_size))
+    rb = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
+    meta = ctable.TileMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb)
+    stats = BuildStats(prefilter_mode="two-pass",
+                       sketch_cells_log2=smeta.cells_log2)
+    reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
+                 qual_thresh=cfg.qual_thresh,
+                 batch_size=cfg.batch_size, prefilter="two-pass",
+                 sketch_cells_log2=smeta.cells_log2,
+                 s1_aggregate=ctable.s1_aggregate_default())
+    reg.counter("partition_passes_total")
+    reg.counter("prefilter_dropped_total")
+    reg.counter("prefilter_false_pass_total")
+    timer = StageTimer()
+    sk_ck = (ckpt_mod.SketchCheckpoint(cfg.checkpoint_dir)
+             if cfg.checkpoint_dir else None)
+    sk_identity = {"k": cfg.k, "qual_thresh": cfg.qual_thresh,
+                   "batch_size": cfg.batch_size, "paths": list(paths),
+                   "cells_log2": smeta.cells_log2}
+    with trace(cfg.profile):
+        sk = None
+        if sk_ck is not None and cfg.resume:
+            cells = sk_ck.load(sk_identity)
+            if cells is not None:
+                sk = sketch_mod.SketchState(jnp.asarray(cells))
+                reg.event("resume", stage="create_database",
+                          sketch="loaded")
+                vlog("Resuming two-pass prefilter: sketch restored "
+                     "from checkpoint (skipping pass 1)")
+        if sk is None:
+            with timer.stage("sketch_pass"):
+                sk = _run_sketch_pass(factory(), cfg, smeta, reg,
+                                      tracer, timer, stats,
+                                      count_stats=True)
+            if sk_ck is not None:
+                sk_ck.save(np.asarray(sk.cells), sk_identity)
+        count_stats = stats.batches == 0  # resumed past the sketch?
+        t_pass = time.perf_counter()
+        bstate, meta, n_b, sk = _run_insert_pass(
+            factory(), cfg, meta, sk, smeta, None, 1, reg, tracer,
+            timer, stats, count_stats=count_stats, allow_grow=True)
+        reg.counter("partition_passes_total").inc()
+        reg.event("partition_pass", partition=0, n_partitions=1,
+                  batches=n_b,
+                  seconds=round(time.perf_counter() - t_pass, 3))
+    with timer.stage("seal"), tracer.span("seal"):
+        stats.prefilter_false_pass = int(
+            sketch_mod.singleton_entries(bstate))
+        reg.counter("prefilter_false_pass_total").inc(
+            stats.prefilter_false_pass)
+        state, dup, occ, d_hq, t_hq = ctable.tile_seal(bstate, meta)
+        occ = int(occ)
+        stats.poisson_distinct_hq = (int(d_hq)
+                                     + stats.prefilter_dropped_hq)
+        stats.poisson_total_hq = int(t_hq) + stats.prefilter_dropped_hq
+        if bool(dup):  # pragma: no cover
+            raise RuntimeError(
+                "internal error: duplicate tag pair in a bucket (torn "
+                "tag write) — please report")
+    timer.report(stats.bases)
+    stats.distinct = occ
+    if reg.enabled:
+        reg.counter("reads").inc(stats.reads)
+        reg.counter("bases").inc(stats.bases)
+        reg.counter("batches").inc(stats.batches)
+        reg.counter("distinct_mers").inc(stats.distinct)
+        slots = meta.rows * ctable.TSLOTS
+        reg.gauge("hash_buckets").set(meta.rows)
+        reg.gauge("hash_slots").set(slots)
+        reg.gauge("hash_fill").set(round(stats.distinct / slots, 6))
+        reg.set_timer("stage1", timer.as_dict(stats.bases))
+    if sk_ck is not None:
+        sk_ck.clear()
+    vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
+         stats.distinct, " distinct mers (two-pass prefilter dropped ",
+         stats.prefilter_dropped, " singleton observations)")
+    return state, meta, stats
+
+
+def _partition_identity(cfg: BuildConfig, paths, rb_local: int,
+                        cells_log2: int) -> dict:
+    """What a partition cursor must match to be resumable: the exact
+    run shape INCLUDING the local geometry (a geometry restart makes
+    prior shard files stale) and the sketch size."""
+    return {"k": cfg.k, "bits": cfg.bits,
+            "qual_thresh": cfg.qual_thresh,
+            "batch_size": cfg.batch_size, "paths": list(paths),
+            "partitions": cfg.partitions, "devices": cfg.devices,
+            "db_version": cfg.db_version, "prefilter": cfg.prefilter,
+            "rb_local": rb_local, "cells_log2": cells_log2}
+
+
+def _global_export_meta(cfg: BuildConfig, rb_global: int):
+    """The GLOBAL-geometry meta the per-partition shard files are
+    written under: a plain TileMeta inside the single-chip cap, the
+    duck-typed sharded meta past it (exactly how rb_log2 > 24
+    manifests load — io/db_format._read_db_manifest)."""
+    if rb_global <= 24:
+        return ctable.TileMeta(k=cfg.k, bits=cfg.bits,
+                               rb_log2=rb_global)
+    from ..parallel.tile_sharded import TileShardedMeta
+    return TileShardedMeta(k=cfg.k, bits=cfg.bits, rb_log2=rb_global,
+                           n_shards=cfg.partitions)
+
+
+def _run_partition_pass_sharded(batches, cfg: BuildConfig, rb_local,
+                                part, n_parts, reg, tracer, timer,
+                                stats, count_stats, step0):
+    """One partition pass over the --devices N mesh: the tile-sharded
+    build at the pass-local geometry with the partition filter fused
+    into the step (tile_sharded.build_step_wire part=), then a gather
+    of the (1/P-sized) finished plane for the departition transform.
+    A full table raises _PartitionGrew like the single-chip pass."""
+    from jax.sharding import NamedSharding, PartitionSpec  # noqa: F401
+
+    from ..parallel import tile_sharded as ts
+
+    S = cfg.devices
+    mesh = ts.make_mesh(S)
+    lmeta = ts.TileShardedMeta(k=cfg.k, bits=cfg.bits,
+                               rb_log2=rb_local, n_shards=S)
+    bstate = ts.make_build_state(lmeta, mesh)
+    steps: dict = {}
+
+    def _get_step(b_rows, length, thresholds):
+        key = (b_rows, length, thresholds)
+        step = steps.get(key)
+        if step is None:
+            step = ts.build_step_wire(mesh, lmeta, cfg.qual_thresh,
+                                      b_rows, length, thresholds,
+                                      part=part, n_parts=n_parts)
+            steps[key] = step
+        return step
+
+    n_batches = 0
+    level_budget = 2 * S + 8
+    for batch, pk in batches:
+        step_i = step0 + n_batches
+        faults.inject("stage1.insert", batch=step_i)
+        n_batches += 1
+        if count_stats:
+            stats.batches += 1
+            stats.reads += batch.n
+            nb = int(batch.lengths.sum())
+            stats.bases += nb
+            timer.add_units("insert_wait", nb)
+        reg.heartbeat(stage="create_database", reads=stats.reads,
+                      bases=stats.bases, batches=stats.batches,
+                      partition=part, devices=S)
+        wire = jnp.asarray(pk.to_wire())
+        pending = jnp.ones((pk.n_reads * pk.length,), bool)
+        passes = 0
+        with tracer.span("stage1_batch", step=step_i, reads=batch.n,
+                         partition=part):
+            while True:
+                t0 = time.perf_counter()
+                with tracer.step("stage1_insert", step_i,
+                                 reads=batch.n):
+                    bstate, full, over, placed, _n_ins = _get_step(
+                        pk.n_reads, pk.length, pk.thresholds)(
+                            bstate, wire, pending)
+                    t1 = time.perf_counter()
+                    full_b, over_b = bool(full), bool(over)
+                    t2 = time.perf_counter()
+                observe_dispatch_wait(reg, "insert", t0, t1, t2,
+                                      timer=timer)
+                if full_b:
+                    raise _PartitionGrew(rb_local + 1)
+                if not over_b:
+                    break
+                passes += 1
+                reg.counter("shard_overflow_passes").inc()
+                if passes > level_budget:
+                    raise RuntimeError("Hash is full")
+                pending = jnp.logical_and(pending,
+                                          jnp.logical_not(placed))
+    with timer.stage("seal"), tracer.span("seal", partition=part):
+        state = ts.finalize(bstate, lmeta, mesh)
+        gstate, glmeta = ts.gather_table(state, lmeta)
+    return gstate, glmeta, n_batches
+
+
+def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
+                                cmdline, handoff, reg, tracer,
+                                batches=None, batches_factory=None
+                                ) -> BuildStats:
+    """The minimizer-partitioned multi-pass build (`--partitions P`,
+    ISSUE 14; KMC 2's disk-partitioned counting, arxiv 1407.1507,
+    adapted to a hash-addressed table): P sequential passes over the
+    input, pass p counting ONLY the mers whose hash remainder's low
+    log2(P) bits equal p — at the pass-local geometry those mers fill
+    an entire table of rows/P rows that IS, after the departition
+    rebase (ctable.tile_departition_rows), the global table's
+    contiguous leading-bit row range. Each finished pass streams its
+    range straight into a PR 9 shard file (io/db_format.
+    write_db_shard_file) and commits a pass-granular cursor
+    (Stage1PartitionCursor), so peak table memory drops by ~P, the
+    reassembled payload is byte-identical to a single-pass build, and
+    a killed run re-runs only its torn partition.
+
+    Why the bin key is the bucket ADDRESS and not the raw minimizer
+    KMC bins by: a shard file is a contiguous row range, and only an
+    address-derived bin makes a partition a row range (byte-exact
+    reassembly) — and the Feistel-mixed address is uniform where raw
+    minimizer bins are famously skewed. ops/mer.minimizer_kmers is
+    the measurement-grade extractor (bench.py --ab reports the
+    balance gap); a future disk-binned super-mer spill would be its
+    consumer (ROADMAP item 2 notes)."""
+    P = cfg.partitions
+    g = P.bit_length() - 1
+    # the composition rules live HERE, not just in the CLIs: a
+    # library caller must not get an unfiltered table whose header
+    # claims a prefilter ran (ISSUE 14 review)
+    if cfg.prefilter == "inline":
+        raise ValueError(
+            "--prefilter=inline does not compose with --partitions "
+            "(the online sketch is not pass-stable); use two-pass")
+    if cfg.prefilter != "off" and cfg.devices > 1:
+        raise ValueError(
+            "--prefilter composes with --devices 1 today")
+    factory = _resolve_batches_factory(paths, cfg, batches,
+                                       batches_factory, reg, tracer)
+    S = cfg.devices
+    owner_bits = S.bit_length() - 1
+    timer = StageTimer()
+    stats = BuildStats(prefilter_mode=cfg.prefilter)
+    reg.set_meta(stage="create_database", k=cfg.k, bits=cfg.bits,
+                 qual_thresh=cfg.qual_thresh,
+                 batch_size=cfg.batch_size, devices=S, partitions=P,
+                 prefilter=cfg.prefilter,
+                 s1_aggregate=ctable.s1_aggregate_default())
+    reg.counter("partition_passes_total")
+    if cfg.prefilter != "off":
+        reg.counter("prefilter_dropped_total")
+        reg.counter("prefilter_false_pass_total")
+
+    rb_req = ctable.tile_rb_for(cfg.initial_size, cfg.k, cfg.bits)
+    rb_local = max(rb_req - g, ctable.min_tile_rb_log2(cfg.k, cfg.bits),
+                   4 + owner_bits)
+    rb_local = min(rb_local, 24 + owner_bits)
+    cursor = (ckpt_mod.Stage1PartitionCursor(cfg.checkpoint_dir)
+              if cfg.checkpoint_dir else None)
+    sk_ck = (ckpt_mod.SketchCheckpoint(cfg.checkpoint_dir)
+             if cfg.checkpoint_dir and cfg.prefilter == "two-pass"
+             else None)
+    smeta = (sketch_mod.SketchMeta(
+        sketch_mod.cells_log2_for(cfg.initial_size))
+        if cfg.prefilter == "two-pass" else None)
+    if smeta is not None:
+        stats.prefilter_mode = "two-pass"
+        stats.sketch_cells_log2 = smeta.cells_log2
+        reg.set_meta(sketch_cells_log2=smeta.cells_log2)
+    out_dir = os.path.dirname(os.path.abspath(output)) or "."
+    # the sketch is GEOMETRY-INDEPENDENT (a pure function of the
+    # observation stream), so it survives partition-geometry restarts
+    # and its checkpoint identity carries no rb_local
+    sk_holder: dict = {"sk": None}
+    sk_identity = {"k": cfg.k, "qual_thresh": cfg.qual_thresh,
+                   "batch_size": cfg.batch_size, "paths": list(paths),
+                   "cells_log2": (smeta.cells_log2
+                                  if smeta is not None else 0)}
+
+    def _attempt(rb_local: int):
+        identity = _partition_identity(
+            cfg, paths, rb_local,
+            smeta.cells_log2 if smeta is not None else 0)
+        completed: dict[int, dict] = {}
+        if cursor is not None and cfg.resume:
+            prior = cursor.load(identity, out_dir)
+            if prior:
+                completed = {int(r["shard"]): r for r in prior}
+                reg.event("resume", stage="create_database",
+                          partitions_done=sorted(completed))
+                vlog("Resuming partitioned build: partitions ",
+                     sorted(completed), " already exported")
+                # restore the skipped passes' accounting (the cursor
+                # records ride the manifest fields plus the per-pass
+                # stats the final header needs)
+                for p_done, r in completed.items():
+                    stats.distinct += int(r["n_entries"])
+                    stats.poisson_distinct_hq += int(
+                        r.get("distinct_hq", 0))
+                    stats.poisson_total_hq += int(r.get("total_hq", 0))
+                    fp = int(r.get("false_pass", 0))
+                    dr = int(r.get("dropped", 0))
+                    dr_hq = int(r.get("dropped_hq", 0))
+                    stats.prefilter_false_pass += fp
+                    stats.prefilter_dropped += dr
+                    stats.prefilter_dropped_hq += dr_hq
+                    if cfg.prefilter != "off":
+                        reg.counter("prefilter_dropped_total").inc(dr)
+                        reg.counter(
+                            "prefilter_false_pass_total").inc(fp)
+                    reg.gauge(
+                        f'partition_distinct{{partition="{p_done}"}}'
+                    ).set(int(r["n_entries"]))
+        sk = sk_holder["sk"]
+        if smeta is not None and sk is None:
+            if sk_ck is not None and cfg.resume:
+                cells = sk_ck.load(sk_identity)
+                if cells is not None:
+                    sk = sketch_mod.SketchState(jnp.asarray(cells))
+                    vlog("Resuming two-pass prefilter: sketch "
+                         "restored (skipping pass 1)")
+            if sk is None:
+                with timer.stage("sketch_pass"):
+                    sk = _run_sketch_pass(
+                        factory(), cfg, smeta, reg, tracer, timer,
+                        stats, count_stats=stats.batches == 0)
+                if sk_ck is not None:
+                    sk_ck.save(np.asarray(sk.cells), sk_identity)
+            sk_holder["sk"] = sk
+        gmeta = _global_export_meta(cfg, rb_local + g)
+        step0 = 0
+        for p in range(P):
+            if p in completed:
+                continue
+            t_pass = time.perf_counter()
+            count_stats = stats.batches == 0
+            dropped0 = stats.prefilter_dropped
+            dropped_hq0 = stats.prefilter_dropped_hq
+            if S > 1:
+                gstate, lmeta, n_b = _run_partition_pass_sharded(
+                    factory(), cfg, rb_local, p, P, reg, tracer,
+                    timer, stats, count_stats, step0)
+                false_pass = 0
+                occ, d_hq, t_hq = (int(x) for x in
+                                   ctable.tile_stats(gstate, lmeta))
+                local_state = gstate
+            else:
+                lmeta = ctable.TileMeta(k=cfg.k, bits=cfg.bits,
+                                        rb_log2=rb_local)
+                bstate, lmeta_after, n_b, sk = _run_insert_pass(
+                    factory(), cfg, lmeta, sk, smeta, p, P, reg,
+                    tracer, timer, stats, count_stats,
+                    allow_grow=False, step0=step0)
+                # the gated insert donates the sketch buffer: keep
+                # the holder on the LIVE handle so a geometry restart
+                # never resurrects a donated-away one
+                sk_holder["sk"] = sk
+                with timer.stage("seal"), tracer.span("seal",
+                                                      partition=p):
+                    false_pass = (int(sketch_mod.singleton_entries(
+                        bstate)) if sk is not None else 0)
+                    local_state, dup, occ, d_hq, t_hq = \
+                        ctable.tile_seal(bstate, lmeta_after)
+                    occ, d_hq, t_hq = int(occ), int(d_hq), int(t_hq)
+                    if bool(dup):  # pragma: no cover
+                        raise RuntimeError(
+                            "internal error: duplicate tag pair in a "
+                            "bucket (torn tag write) — please report")
+            step0 += n_b
+            with timer.stage("export"), tracer.span("partition_export",
+                                                    partition=p):
+                dstate, bad = ctable.tile_departition_rows(
+                    local_state, lmeta, g, p)
+                if bool(bad):  # pragma: no cover - routing invariant
+                    raise RuntimeError(
+                        "internal error: partition pass counted a mer "
+                        "outside its bin — please report")
+                rec = db_format.write_db_shard_file(
+                    output, dstate.rows, gmeta, p, P, cmdline,
+                    db_version=cfg.db_version)
+            # the cursor record = the manifest record plus the
+            # per-pass stats a RESUMED run must restore (stripped
+            # before the final manifest commits)
+            completed[p] = {
+                **rec, "distinct_hq": d_hq, "total_hq": t_hq,
+                "false_pass": false_pass,
+                "dropped": stats.prefilter_dropped - dropped0,
+                "dropped_hq": stats.prefilter_dropped_hq - dropped_hq0,
+            }
+            if sk is not None:
+                stats.prefilter_false_pass += false_pass
+                reg.counter("prefilter_false_pass_total").inc(
+                    false_pass)
+            stats.distinct += occ
+            stats.poisson_distinct_hq += d_hq
+            stats.poisson_total_hq += t_hq
+            reg.counter("partition_passes_total").inc()
+            reg.gauge(f'partition_distinct{{partition="{p}"}}').set(occ)
+            reg.event("partition_pass", partition=p, n_partitions=P,
+                      batches=n_b, distinct=occ,
+                      seconds=round(time.perf_counter() - t_pass, 3))
+            if cursor is not None:
+                cursor.save(identity,
+                            [completed[i] for i in sorted(completed)],
+                            out_dir)
+            else:
+                faults.inject("partition.commit", path=rec["path"])
+        # manifest records proper: the cursor's per-pass stat fields
+        # stay checkpoint-local
+        keep = ("path", "shard", "n_entries", "value_bytes",
+                "file_crc32c")
+        return ([{k: completed[p][k] for k in keep}
+                 for p in range(P)], gmeta)
+
+    with trace(cfg.profile):
+        for _ in range(cfg.max_grows + 1):
+            try:
+                recs, gmeta = _attempt(rb_local)
+                break
+            except _PartitionGrew as e:
+                vlog("Partition pass overflowed at local rb_log2=",
+                     rb_local, "; restarting all passes at ",
+                     e.rb_local)
+                reg.counter("hash_grows").inc()
+                reg.event("partition_geometry_grow",
+                          rb_local_before=rb_local,
+                          rb_local_after=e.rb_local)
+                stats.grows += 1
+                stats.distinct = 0
+                stats.poisson_distinct_hq = 0
+                stats.poisson_total_hq = 0
+                stats.prefilter_dropped = 0
+                stats.prefilter_dropped_hq = 0
+                stats.prefilter_false_pass = 0
+                # the input accounting restarts with the passes: a
+                # partial first attempt must not freeze reads/bases
+                # at a prefix (count_stats keys off batches == 0)
+                stats.reads = 0
+                stats.bases = 0
+                stats.batches = 0
+                rb_local = e.rb_local
+                if cursor is not None:
+                    cursor.clear()
+        else:
+            raise RuntimeError("Hash is full")
+    if smeta is not None:
+        # full-table Poisson stats: each dropped hq singleton would
+        # have been one distinct hq mer of count 1 (exact — a dropped
+        # mer has exactly one observation)
+        stats.poisson_distinct_hq += stats.prefilter_dropped_hq
+        stats.poisson_total_hq += stats.prefilter_dropped_hq
+    # every shard is durable: the manifest is the commit point, and
+    # the pass-granular checkpoint artifacts die with it
+    db_format.write_db_manifest(output, recs, gmeta, P, cmdline,
+                                db_version=cfg.db_version,
+                                extra_header=stats.db_extra_header())
+    if cursor is not None:
+        cursor.clear()
+    if sk_ck is not None:
+        sk_ck.clear()
+    timer.report(stats.bases)
+    if reg.enabled:
+        reg.counter("reads").inc(stats.reads)
+        reg.counter("bases").inc(stats.bases)
+        reg.counter("batches").inc(stats.batches)
+        reg.counter("distinct_mers").inc(stats.distinct)
+        rows_g = (1 << (rb_local + g))
+        slots = rows_g * ctable.TSLOTS
+        reg.gauge("hash_buckets").set(rows_g)
+        reg.gauge("hash_slots").set(slots)
+        reg.gauge("hash_fill").set(round(stats.distinct / slots, 6))
+        reg.gauge("partition_rows_local").set(1 << rb_local)
+        reg.set_timer("stage1", timer.as_dict(stats.bases))
+    vlog("Counted ", stats.reads, " reads, ", stats.bases, " bases, ",
+         stats.distinct, " distinct mers over ", P,
+         " partition passes (peak table rows 1/", P, " of global)")
+    return stats
+
+
 def create_database_main(
     paths: Sequence[str],
     output: str,
@@ -559,15 +1331,34 @@ def create_database_main(
     batches=None,
     metrics=None,
     tracer=None,
+    batches_factory=None,
 ) -> BuildStats:
     """With `handoff` (a dict), the built device-resident table is
     stashed as handoff["db"] = (state, meta) so an in-process stage-2
     can skip re-reading and re-uploading it (the tunnel H2D of a
     full-size table costs ~0.1 s/MB — ~50 s for a 0.5 GB table — while
     the reference's equivalent, re-mmapping a page-cached file, is
-    free; quorum.in:154-231 runs both stages over the same file)."""
+    free; quorum.in:154-231 runs both stages over the same file).
+    Partitioned builds (`cfg.partitions > 1`) stream their export per
+    pass and never hold the whole table — no handoff, stage 2 loads
+    the manifest (its peak-memory contract is the point)."""
+    if ref_format and (cfg.partitions > 1 or cfg.prefilter != "off"):
+        raise ValueError(
+            "--ref-format supports neither --partitions nor "
+            "--prefilter (the reference format carries no manifest "
+            "or prefilter declaration)")
+    if cfg.partitions > 1:
+        # the minimizer-partitioned multi-pass build (ISSUE 14):
+        # exports ARE per-pass (sharded manifest), peak table memory
+        # is 1/P, and there is no whole-table handoff by design
+        return _build_database_partitioned(
+            paths, cfg, output, cmdline, handoff,
+            metrics if metrics is not None else NULL_METRICS,
+            tracer if tracer is not None else NULL_TRACER,
+            batches=batches, batches_factory=batches_factory)
     state, meta, stats = build_database(paths, cfg, batches=batches,
-                                        metrics=metrics, tracer=tracer)
+                                        metrics=metrics, tracer=tracer,
+                                        batches_factory=batches_factory)
     if handoff is not None:
         # the sharded build hands over the ROW-SHARDED table +
         # TileShardedMeta; stage 2 reshards once per its chosen layout
@@ -579,7 +1370,8 @@ def create_database_main(
         # the single-chip geometry cap and the ~13 min cross-device
         # gather (PR 5 notes) both disappear
         db_format.write_db_sharded(output, state, meta, cmdline,
-                                   db_version=cfg.db_version)
+                                   db_version=cfg.db_version,
+                                   extra_header=stats.db_extra_header())
         if cfg.checkpoint_dir:
             cls = (ckpt_mod.Stage1ShardedCheckpoint if cfg.devices > 1
                    else ckpt_mod.Stage1Checkpoint)
@@ -616,7 +1408,8 @@ def create_database_main(
     else:
         db_format.write_db(output, write_state, write_meta, cmdline,
                            n_entries=stats.distinct,
-                           db_version=cfg.db_version)
+                           db_version=cfg.db_version,
+                           extra_header=stats.db_extra_header())
     if cfg.checkpoint_dir:
         # the finished database IS the durable artifact now; a stale
         # snapshot must not feed a later unrelated --resume

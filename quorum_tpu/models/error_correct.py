@@ -34,6 +34,7 @@ import jax
 from ..io import checkpoint as ckpt_mod
 from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
+from ..ops import ctable
 from ..ops.poisson import compute_poisson_cutoff
 from ..telemetry import observe_dispatch_wait
 from ..utils import faults
@@ -227,6 +228,13 @@ class ECOptions:
     # a sequence-numbered reorder stage — output bytes identical to
     # the serial pipeline for any N. 0 = auto (min(4, cores))
     render_workers: int = 0
+    # --presence-floor (ISSUE 14): entries with count < floor vanish
+    # from the table at load (ctable.tile_floor). 0 = auto: a database
+    # declaring a prefilter applies its matching floor (min_obs), any
+    # other database keeps the full-presence default of 1 — so plain
+    # pipelines are bit-unchanged and prefiltered ones are exactly
+    # the floored-full-table run (the parity theorem, ops/sketch)
+    presence_floor: int = 0
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -245,16 +253,27 @@ def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
     return open(path, "w")  # qlint: disable=raw-artifact-write
 
 
-def resolve_cutoff(state, meta, opts: ECOptions) -> int:
+def resolve_cutoff(state, meta, opts: ECOptions,
+                   header: dict | None = None) -> int:
     """args.cutoff_given ? arg : compute_poisson_cutoff(...) with the
     reference's exact parameterization (error_correct_reads.cc:710-717):
     collision_prob = apriori/3, threshold = poisson_threshold/apriori.
     Returns 0 when the computation fails and no -p was given (caller
-    dies with the reference message)."""
+    dies with the reference message).
+
+    A PREFILTERED database (ISSUE 14) carries the full-table stats in
+    its header (`poisson_stats`: the filtered table's distinct/total
+    hq plus the dropped hq singletons' exact contribution) — using
+    them keeps the computed cutoff identical to an unfiltered run's,
+    which the byte-parity guarantee depends on."""
     if opts.cutoff is not None:
         return opts.cutoff
     vlog("Computing Poisson cutoff")
-    _occ, distinct, total = db_format.db_stats(state, meta)
+    ps = (header or {}).get("poisson_stats")
+    if ps:
+        distinct, total = ps["distinct_hq"], ps["total_hq"]
+    else:
+        _occ, distinct, total = db_format.db_stats(state, meta)
     return compute_poisson_cutoff(
         int(distinct), int(total),
         opts.apriori_error_rate / 3.0,
@@ -340,8 +359,16 @@ def _run_ec(db_path: str, sequences: Sequence[str],
     if db is not None:
         # in-process handoff from stage 1: the table is already device
         # resident (re-uploading a full-size table through the tunnel
-        # costs ~0.1 s/MB; the reference's page-cached re-mmap is free)
+        # costs ~0.1 s/MB; the reference's page-cached re-mmap is free).
+        # The header is still read from the (always-written) file for
+        # the prefilter declaration + Poisson stats (ISSUE 14) —
+        # best-effort: a missing/foreign file just means no
+        # declaration, the pre-prefilter behavior.
         state, meta = db
+        try:
+            header = db_format.read_header(db_path)
+        except (OSError, ValueError):
+            header = {}
     else:
         to_dev = True
         if opts.devices > 1:
@@ -357,16 +384,32 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                 # resident single-chip copy would be both impossible
                 # and wasted
                 to_dev = False
-        state, meta, _header = db_format.read_db(db_path,
-                                                 to_device=to_dev,
-                                                 no_mmap=opts.no_mmap,
-                                                 verify=opts.verify_db)
+        state, meta, header = db_format.read_db(db_path,
+                                                to_device=to_dev,
+                                                no_mmap=opts.no_mmap,
+                                                verify=opts.verify_db)
 
-    cutoff = resolve_cutoff(state, meta, opts)
+    cutoff = resolve_cutoff(state, meta, opts, header=header)
     vlog("Using cutoff of ", cutoff)
     if cutoff == 0 and opts.cutoff is None:
         raise RuntimeError(
             "Cutoff computation failed. Pass it explicitly with -p switch.")
+
+    # presence floor (ISSUE 14): explicit flag > the database's own
+    # prefilter declaration > full presence. Applied AFTER cutoff
+    # resolution (the cutoff is a full-table statistic in both the
+    # filtered and unfiltered runs) and BEFORE the corrector ever
+    # probes the table, so a prefiltered database and the floored
+    # full database are bit-identical corrector inputs.
+    floor = int(opts.presence_floor or 0)
+    if floor <= 0:
+        floor = int((header.get("prefilter") or {}).get("min_obs", 1))
+    if floor > 1:
+        state = ctable.tile_floor(state, meta, floor)
+        vlog("Applying presence floor of ", floor,
+             " (count-below-floor mers treated as absent)")
+    if reg.enabled:
+        reg.set_meta(presence_floor=floor)
 
     if cfg_in is not None:
         cfg = cfg_in

@@ -1192,6 +1192,21 @@ def extract_observations_impl(codes_i8, quals_u8, k: int,
     return chi.ravel(), clo.ravel(), qualbit.ravel(), valid.ravel()
 
 
+def partition_mask(chi, clo, meta, part: int, n_parts: int):
+    """Partition-ownership predicate for the minimizer-partitioned
+    multi-pass build (ISSUE 14): pass `part` of `n_parts` owns the
+    canonical mers whose hash remainder's low log2(n_parts) bits —
+    equivalently, the GLOBAL bucket address's leading bits at the
+    global geometry rb_local + log2(n_parts) — equal `part`. Disjoint
+    and exhaustive by construction, so P sequential passes insert
+    every mer exactly once and each pass's finished rows ARE the
+    global table's contiguous leading-bit row range (the PR 9 shard
+    format; see models/create_database._build_database_partitioned
+    for why the bin key is the address, not the raw minimizer)."""
+    _a, rem_lo, _rh = _hash_addr_rem(chi, clo, meta.k, meta.rb_log2)
+    return (rem_lo & jnp.uint32(n_parts - 1)) == jnp.uint32(part)
+
+
 def _rounds_core(bstate: TBuildState, meta: TileMeta, chi, clo, qual,
                  valid, rounds: int, cap: int, agg_cap: int | None):
     """The shared insert body behind every tile entry point: round 1 +
@@ -1199,7 +1214,10 @@ def _rounds_core(bstate: TBuildState, meta: TileMeta, chi, clo, qual,
     observations (agg_cap != None): the distinct mers insert once with
     summed adds at agg_cap width, and per-observation done flags map
     back through the segment ids so the grow/drain contracts are
-    unchanged. Returns (bstate, done[n], n_failed, n_unfit)."""
+    unchanged. Partition filtering (partition_mask) happens in the
+    CALLERS, folded into `valid` before this body — masked
+    observations report done, never pending. Returns
+    (bstate, done[n], n_failed, n_unfit)."""
     hq_add, lq_add, done = _prep_obs(qual, valid)
     if agg_cap:
         u_chi, u_clo, u_hq, u_lq, u_valid, seg_of = _aggregate_obs_impl(
@@ -1233,9 +1251,13 @@ def _rounds_core(bstate: TBuildState, meta: TileMeta, chi, clo, qual,
 def _insert_reads_fused_core(bstate: TBuildState, meta: TileMeta,
                              codes, quals, qual_thresh: int,
                              rounds: int, cap: int,
-                             agg_cap: int | None = None):
+                             agg_cap: int | None = None,
+                             part_key: tuple = (None, 1)):
     chi, clo, qual, valid = extract_observations_impl(
         codes, quals, meta.k, qual_thresh)
+    part, n_parts = part_key
+    if part is not None:
+        valid = valid & partition_mask(chi, clo, meta, part, n_parts)
     bstate, done, n_failed, n_unfit = _rounds_core(
         bstate, meta, chi, clo, qual, valid, rounds, cap, agg_cap)
     return bstate, (chi, clo, qual, valid), done, n_failed, n_unfit
@@ -1253,13 +1275,14 @@ def _tile_insert_reads_fused(bstate: TBuildState, meta: TileMeta,
                                     qual_thresh, rounds, cap, agg_cap)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 6, 7, 8, 9),
+@functools.partial(jax.jit, static_argnums=(1, 3, 4, 5, 6, 7, 8, 9, 10),
                    donate_argnums=(0,))
 def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
                                     wire, qual_thresh: int, rounds: int,
                                     cap: int, b: int, length: int,
                                     thresholds: tuple,
-                                    agg_cap: int | None = None):
+                                    agg_cap: int | None = None,
+                                    part_key: tuple = (None, 1)):
     """The fused insert fed the bit-packed wire format (io/packing.py:
     2-bit codes + N mask + the 1-bit qual>=thresh plane — 0.5 B/base
     over the tunnel instead of 2, fused into ONE u8 H2D buffer since
@@ -1274,7 +1297,8 @@ def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
     quals = mer.synth_quals_device(hq[int(qual_thresh)], length,
                                    qual_thresh)
     return _insert_reads_fused_core(bstate, meta, codes, quals,
-                                    qual_thresh, rounds, cap, agg_cap)
+                                    qual_thresh, rounds, cap, agg_cap,
+                                    part_key)
 
 
 def _drain_survivors(bstate, meta, addr, rlo, rhi, p0, hq_add, lq_add,
@@ -1313,11 +1337,16 @@ def tile_insert_reads(bstate: TBuildState, meta: TileMeta, codes_i8,
 
 def tile_insert_reads_packed(bstate: TBuildState, meta: TileMeta,
                              packed, qual_thresh: int,
-                             max_rounds: int = 24):
+                             max_rounds: int = 24,
+                             part: int | None = None,
+                             n_parts: int = 1):
     """tile_insert_reads over the bit-packed wire format
     (io/packing.PackedReads) — 0.5 B/base crosses the H2D link instead
     of 2; bit-identical table (tests/test_packing.py). The batch must
-    have been packed with `qual_thresh` among its thresholds."""
+    have been packed with `qual_thresh` among its thresholds. With
+    `part` set (the partitioned multi-pass build, ISSUE 14) only this
+    partition's mers insert; the returned obs `valid` mask is
+    post-filter, so grow retries stay partition-scoped."""
     packed.require_plane(qual_thresh)
     b, length = packed.n_reads, packed.length
     n = b * length
@@ -1325,7 +1354,7 @@ def tile_insert_reads_packed(bstate: TBuildState, meta: TileMeta,
     bstate, obs, done, n_failed, n_unfit = _tile_insert_reads_fused_packed(
         bstate, meta, jnp.asarray(packed.to_wire()), qual_thresh,
         max_rounds - 1, cap, b, length, packed.thresholds,
-        agg_cap_for(n))
+        agg_cap_for(n), (part, n_parts))
     return _insert_reads_tail(bstate, meta, obs, done, n_failed, n_unfit,
                               max_rounds, cap, n)
 
@@ -1524,6 +1553,83 @@ def _canonical_rows(state: TileState, meta: TileMeta) -> TileState:
     rows = jnp.zeros_like(state.rows)
     rows = rows.at[:, 0::2].set(slo)
     rows = rows.at[:, 1::2].set(shi)
+    return TileState(rows)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def tile_departition_rows(state: TileState, lmeta: TileMeta, g: int,
+                          part: int):
+    """Rebase one partition's finished LOCAL-geometry rows onto the
+    GLOBAL geometry of the partitioned build (ISSUE 14): at local
+    rb_local the stored remainder's low ``g = log2(P)`` bits are the
+    (constant) partition id — the global bucket address's leading
+    bits — and the global remainder is simply the local remainder
+    shifted right by g. Pure elementwise re-packing of the entry
+    words; the transformed plane is bit-identical to the global rows
+    range [part * rows_local, (part+1) * rows_local) of a single-pass
+    build at rb_local + g, which is what makes the per-partition
+    export a byte-exact PR 9 shard file. Returns (TileState, bad) —
+    `bad` flags any occupied entry whose dropped bits disagree with
+    `part` (an internal routing error, asserted by the caller)."""
+    lo = state.rows[:, 0::2]
+    hi = state.rows[:, 1::2]
+    occ = (lo & jnp.uint32(lmeta.max_val)) != 0
+    if g == 0:
+        return state, jnp.asarray(False)
+    rl = lmeta.rlo_bits
+    vq = lo & jnp.uint32((1 << (lmeta.bits + 1)) - 1)
+    rlo_l = lo >> (lmeta.bits + 1)
+    rem_lo_l = rlo_l | (hi << rl)
+    rem_hi_l = hi >> (32 - rl)
+    bad = jnp.any(occ & ((rem_lo_l & jnp.uint32((1 << g) - 1))
+                         != jnp.uint32(part)))
+    rem_lo_g = (rem_lo_l >> g) | (rem_hi_l << (32 - g))
+    rem_hi_g = rem_hi_l >> g
+    new_rlo = rem_lo_g & jnp.uint32((1 << rl) - 1)
+    new_hi = (rem_lo_g >> rl) | (rem_hi_g << (32 - rl))
+    hi_bits_g = max(0, 2 * lmeta.k - (lmeta.rb_log2 + g) - rl)
+    new_hi = (new_hi & jnp.uint32((1 << hi_bits_g) - 1)) \
+        if hi_bits_g < 32 else new_hi
+    new_lo = jnp.where(occ, (new_rlo << (lmeta.bits + 1)) | vq,
+                       jnp.uint32(0))
+    new_hi = jnp.where(occ, new_hi, jnp.uint32(0))
+    rows = jnp.zeros_like(state.rows)
+    rows = rows.at[:, 0::2].set(new_lo)
+    rows = rows.at[:, 1::2].set(new_hi)
+    return TileState(rows), bad
+
+
+def tile_floor(state: TileState, meta, floor: int) -> TileState:
+    """Apply a presence floor: entries whose stored count is below
+    `floor` become empty (both words zeroed). This is how stage 2
+    consumes a prefiltered database exactly (ops/sketch docstring):
+    flooring the FULL table and flooring the PREFILTERED table yield
+    bit-identical planes, because the prefilter only ever dropped
+    mers that finalize below the floor. Handles device (jnp) and
+    host (numpy) row planes — the rb_log2 > 24 manifest load path is
+    host-side."""
+    if floor <= 1:
+        return state
+    rows = state.rows
+    if isinstance(rows, np.ndarray):
+        out = rows.copy()
+        lo = out[:, 0::2]
+        keep = (lo & np.uint32(meta.max_val)) >= np.uint32(floor)
+        out[:, 0::2] = np.where(keep, lo, np.uint32(0))
+        out[:, 1::2] = np.where(keep, out[:, 1::2], np.uint32(0))
+        return TileState(out)
+    return _tile_floor_jit(state, int(meta.max_val), int(floor))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _tile_floor_jit(state: TileState, max_val: int, floor: int
+                    ) -> TileState:
+    lo = state.rows[:, 0::2]
+    keep = (lo & jnp.uint32(max_val)) >= jnp.uint32(floor)
+    rows = jnp.zeros_like(state.rows)
+    rows = rows.at[:, 0::2].set(jnp.where(keep, lo, jnp.uint32(0)))
+    rows = rows.at[:, 1::2].set(
+        jnp.where(keep, state.rows[:, 1::2], jnp.uint32(0)))
     return TileState(rows)
 
 

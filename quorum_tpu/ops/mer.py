@@ -242,6 +242,95 @@ def rolling_kmers(codes, k: int):
     return fhi, flo, rhi, rlo, valid
 
 
+# ---------------------------------------------------------------------------
+# Minimizer extraction (KMC 2's bin key, arxiv 1407.1507)
+# ---------------------------------------------------------------------------
+
+MAX_MINIMIZER_M = 15  # 2m <= 30 bits: one uint32 lane per m-mer
+
+
+def _mix32_mer(x):
+    """Invertible 32-bit mix for minimizer ORDERING: the raw
+    lexicographic order is pathologically skewed (poly-A m-mers win
+    almost every window — the KMC 2 paper's motivation for its
+    hand-tuned ordering); an invertible mix gives a pseudo-random
+    total order with the same minimizer semantics."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def minimizer_kmers(codes, k: int, m: int):
+    """Canonical m-mer minimizer of every k-window of a code batch.
+
+    For position p (the k-window covering bases [p-k+1, p]) the
+    minimizer is min over the k-m+1 m-mer windows inside it of
+    mix32(min(fwd m-mer, revcomp m-mer)) — the KMC 2 bin key, fully
+    vectorized (one rolling m-mer pass + k-m+1 shifted mins, all
+    elementwise [B, L] work like rolling_kmers).
+
+    Returns (minval uint32[B, L], valid bool[B, L]); `valid` mirrors
+    rolling_kmers' k-window validity. Positions before the window
+    fills, or whose window holds a non-ACGT base, carry 0xFFFFFFFF.
+
+    Note for the partitioned stage-1 build (ISSUE 14): the build bins
+    by the table's bucket-ADDRESS bits, not this value — the hash bin
+    is what makes each pass a contiguous global row range (byte-exact
+    PR 9 shard files) and is uniform where raw minimizer bins are
+    famously skewed. This extractor exists for measurement (bench.py
+    --ab reports minimizer- vs address-bin balance) and for a future
+    disk-binned super-mer spill path (ROADMAP item 2 notes).
+    """
+    if not 1 <= m <= min(k, MAX_MINIMIZER_M):
+        raise ValueError(f"minimizer m={m} must be in [1, "
+                         f"min(k, {MAX_MINIMIZER_M})]")
+    B, L = codes.shape
+    _fhi, flo, _rhi, rlo, mvalid = rolling_kmers(codes, m)
+    sent = jnp.uint32(0xFFFFFFFF)
+    canon = jnp.minimum(flo, rlo)
+    mval = jnp.where(mvalid, _mix32_mer(canon), sent)
+    # guard: the mix of a valid m-mer could equal the sentinel; pin it
+    mval = jnp.where(mvalid & (mval == sent), sent - 1, mval)
+    out = mval
+    for j in range(1, k - m + 1):
+        shifted = jnp.pad(mval, ((0, 0), (j, 0)),
+                          constant_values=np.uint32(0xFFFFFFFF))[:, :L]
+        out = jnp.minimum(out, shifted)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    ok = codes >= 0
+    last_bad = jax.lax.cummax(jnp.where(~ok, pos, jnp.int32(-1)), axis=1)
+    kvalid = (pos - last_bad) >= k
+    return jnp.where(kvalid, out, sent), kvalid
+
+
+def minimizer_py(seq: str, m: int) -> int:
+    """Host twin for one k-mer string: the mixed canonical m-mer
+    minimizer value (must match minimizer_kmers bit-for-bit at the
+    window's last position)."""
+    k = len(seq)
+    assert 1 <= m <= min(k, MAX_MINIMIZER_M)
+    best = 0xFFFFFFFF
+    for i in range(k - m + 1):
+        hi, lo = pack_kmer(seq[i:i + m])
+        rhi, rlo = revcomp_py(hi, lo, m)
+        canon = min(lo, rlo)
+        x = np.uint32(canon)
+        with np.errstate(over="ignore"):
+            x = x ^ (x >> np.uint32(16))
+            x = x * np.uint32(0x7FEB352D)
+            x = x ^ (x >> np.uint32(15))
+            x = x * np.uint32(0x846CA68B)
+            x = x ^ (x >> np.uint32(16))
+        v = int(x)
+        if v == 0xFFFFFFFF:
+            v = 0xFFFFFFFE
+        best = min(best, v)
+    return best
+
+
 # ------------------------------------------------- packed-wire widening
 # Device side of the bit-packed read transport (host side + format doc:
 # io/packing.py). All elementwise broadcast/reshape — no gathers — so

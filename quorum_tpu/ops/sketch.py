@@ -1,0 +1,378 @@
+"""Singleton-prefilter counting sketch: the khmer move (ISSUE 14).
+
+In error-rich Illumina data the bulk of DISTINCT canonical mers are
+error singletons — observed exactly once, never trusted by stage 2's
+count gates — yet each claims a full slot in the stage-1 table,
+inflating it past ``QUORUM_REPLICATE_TABLE_BYTES`` and pushing stage 2
+off the fast replicated layout. khmer (probabilistic online counting)
+and KMC 2's first-pass filtering (PAPERS.md) are the blueprints: count
+*approximately* first, spend exact table memory only on mers that can
+recur.
+
+The sketch is a count-min over TWO-BIT saturating counters: ``d = 2``
+independent hash positions per canonical mer, each cell holding one of
+three states {0: never seen, 1: seen once, 2: seen >= 2 times}. Cells
+never undercount (the count-min invariant, maintained per cell by a
+gather + saturating combine + scatter-max — see
+:func:`_sketch_update_lanes`), so a mer whose sketch value is < 2 is
+*certainly* a singleton; collisions only inflate, producing false
+PASSES (singletons that keep their table slot), never false drops.
+Cells are stored one per uint8 lane: the state is 2 bits of
+information, but XLA's scatter-max is element-granular — packing four
+cells per byte would need claim rounds (ops/ctable's write-then-verify
+machinery) costing far more than the 4x density saves. Geometry comes
+from ``QUORUM_SKETCH_BITS`` (log2 cells; env > autotune profile >
+auto-sized from the requested table size).
+
+Two modes consume it (models/create_database):
+
+* **two-pass** — pass 1 streams every batch into the sketch only;
+  pass 2 re-reads the input and inserts only mers the sketch saw >= 2
+  times. Exact: the dropped set is precisely a subset of the true
+  singletons, and every inserted mer keeps its exact hq/lq counts.
+* **inline** — one pass, khmer-style: each batch updates the sketch
+  and gates its inserts on the POST-update value; a mer's gate opens
+  at its second observation, and the deferred first observation is
+  retro-credited (+1 at the quality of the current batch's
+  observations). Approximate at the margin: under a cell collision or
+  a quality-class flip between a mer's first and later observations,
+  a stored count can be off by one — documented, measured by the A/B
+  probe, and NOT the mode the byte-parity guarantee is stated over.
+
+Parity contract (the floor theorem): dropped mers all finalize at
+count 1, and stage 2 applied at ``presence floor`` f >= 2 maps every
+count-below-f entry to absent at load (models/error_correct), so a
+prefiltered database and the full database are BIT-IDENTICAL table
+inputs to the floored corrector — .fa/.log byte-equal, gated by
+``bench.py --ab`` and tests. Without the floor, count-1 mers are
+visible to the corrector (they set quality levels and c1keep at their
+read's positions — measured, PERF_NOTES round 10), which is why the
+prefilter declares ``prefilter.min_obs`` in the database header and
+stage 2 auto-applies the matching floor.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils import levers
+from . import ctable, mer
+
+# saturation ceiling: {0, 1, >=2} is all the prefilter gate reads
+_SAT = 2
+
+# the two independent hash streams (odd golden-ratio-family mixers)
+_H_C = ((0x9E3779B9, 0x85EBCA6B), (0xC2B2AE35, 0x27D4EB2F))
+
+
+class SketchMeta(NamedTuple):
+    """Static sketch geometry: 2^cells_log2 two-bit cells (uint8
+    lanes), d=2 hash positions per key."""
+
+    cells_log2: int
+
+    @property
+    def cells(self) -> int:
+        return 1 << self.cells_log2
+
+    @property
+    def nbytes(self) -> int:
+        return self.cells
+
+
+class SketchState(NamedTuple):
+    """The cell plane: uint8[cells], values in {0, 1, 2}."""
+
+    cells: jax.Array
+
+
+def cells_log2_for(n_hint: int) -> int:
+    """Sketch sizing: ~8 cells per expected distinct mer keeps the
+    false-pass rate (both cells of a singleton inflated by
+    collisions) around (1/8)^2 ~ 2%; QUORUM_SKETCH_BITS (env >
+    autotune profile, ops/tuning.cap) overrides the auto size."""
+    from . import tuning
+    explicit = tuning.cap("QUORUM_SKETCH_BITS", 0.0)
+    if explicit:
+        return int(min(30, max(10, explicit)))
+    auto = max(1, int(n_hint)) * 8
+    return int(min(30, max(16, (auto - 1).bit_length())))
+
+
+def make_sketch(meta: SketchMeta) -> SketchState:
+    return SketchState(jnp.zeros((meta.cells,), jnp.uint8))
+
+
+def _mix(x, c: int):
+    x = x * jnp.uint32(c | 1)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    return x
+
+
+def sketch_addrs(chi, clo, meta: SketchMeta):
+    """d=2 independent cell addresses per canonical key pair."""
+    mask = jnp.uint32(meta.cells - 1)
+    out = []
+    for ca, cb in _H_C:
+        h = _mix(chi, ca) ^ _mix(clo ^ jnp.uint32(cb), cb)
+        out.append((h & mask).astype(jnp.int32))
+    return out
+
+
+def sketch_min(state: SketchState, meta: SketchMeta, chi, clo):
+    """The count-min query: min over the d cells, int32 per lane."""
+    a1, a2 = sketch_addrs(chi, clo, meta)
+    return jnp.minimum(state.cells[a1], state.cells[a2]).astype(jnp.int32)
+
+
+def _sketch_update_lanes(state: SketchState, meta: SketchMeta, u_chi,
+                         u_clo, u_mult, u_valid) -> SketchState:
+    """Update the sketch with batch-DISTINCT lanes (one lane per
+    distinct mer, `u_mult` its multiplicity in the batch). Per cell:
+    new = max(old, min(SAT, old + mult)) via gather + scatter-max —
+    maintains cell >= min(SAT, total observations of every mer
+    hashing there) (induction per cell: max never decreases, and a
+    lane's write is >= what its own mer needs given old >= its prior
+    floor). Lanes MUST be batch-unique: duplicate lanes of one mer
+    would each add `mult` from the same `old`, undercounting the
+    within-batch total."""
+    cells = state.cells
+    mult = jnp.minimum(u_mult.astype(jnp.int32), _SAT)
+    sent = jnp.int32(meta.cells)  # positive OOB + drop (never wrap)
+    for addr in sketch_addrs(u_chi, u_clo, meta):
+        a = jnp.where(u_valid, addr, sent)
+        old = cells[jnp.where(u_valid, addr, 0)].astype(jnp.int32)
+        new = jnp.minimum(jnp.int32(_SAT), old + mult)
+        cells = cells.at[a].max(
+            jnp.where(u_valid, new, 0).astype(jnp.uint8), mode="drop")
+    return SketchState(cells)
+
+
+def _distinct_lanes(chi, clo, hq_add, lq_add, valid):
+    """Full-width batch aggregation to distinct-mer lanes (the sort +
+    segment-sum of ctable._aggregate_obs_impl at cap = n): returns
+    (u_chi, u_clo, u_hq, u_lq, u_valid, seg_of[n]) with u_hq+u_lq the
+    exact per-mer multiplicity and seg_of each observation's lane."""
+    n = chi.shape[0]
+    return ctable._aggregate_obs_impl(chi, clo, hq_add, lq_add, valid, n)
+
+
+def _extract_wire(k: int, wire, qual_thresh: int, b: int,
+                  length: int, thresholds: tuple):
+    pcodes, nmask, hq, lengths = mer.wire_parts_device(
+        wire, b, length, thresholds)
+    codes = mer.unpack_codes_device(pcodes, nmask, lengths, length)
+    quals = mer.synth_quals_device(hq[int(qual_thresh)], length,
+                                   qual_thresh)
+    return ctable.extract_observations_impl(codes, quals, k,
+                                            qual_thresh)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 4, 5, 6, 7),
+                   donate_argnums=(0,))
+def _sketch_pass_wire(sk: SketchState, smeta: SketchMeta, k: int, wire,
+                      qual_thresh: int, b: int, length: int,
+                      thresholds: tuple):
+    """Pass-1 executable (two-pass mode): widen the packed wire,
+    extract canonical observations, aggregate to distinct lanes, and
+    update the sketch — one dispatch per batch, same wire the insert
+    path consumes. Returns (sketch, n_obs)."""
+    chi, clo, qual, valid = _extract_wire(k, wire, qual_thresh, b,
+                                          length, thresholds)
+    hq_add, lq_add, _d = ctable._prep_obs(qual, valid)
+    u_chi, u_clo, u_hq, u_lq, u_valid, _seg = _distinct_lanes(
+        chi, clo, hq_add, lq_add, valid)
+    sk = _sketch_update_lanes(sk, smeta, u_chi, u_clo, u_hq + u_lq,
+                              u_valid)
+    return sk, jnp.sum(valid.astype(jnp.int32))
+
+
+def sketch_update_packed(sk: SketchState, smeta: SketchMeta, k: int,
+                         packed, qual_thresh: int):
+    """Stream one PackedReads batch into the sketch (pass 1 of the
+    two-pass prefilter). Returns (sketch, n_obs int)."""
+    packed.require_plane(qual_thresh)
+    sk, n_obs = _sketch_pass_wire(
+        sk, smeta, k, jnp.asarray(packed.to_wire()), qual_thresh,
+        packed.n_reads, packed.length, packed.thresholds)
+    return sk, n_obs
+
+
+def _gated_insert_core(bstate, tmeta, sk: SketchState,
+                       smeta: SketchMeta, chi, clo, qual, valid,
+                       rounds: int, cap: int, mode: str,
+                       part: int | None, n_parts: int,
+                       agg_cap: int | None):
+    """The shared prefiltered insert body. `mode`:
+
+    * ``"two-pass"`` — gate each observation on the FINISHED sketch
+      (read-only): insert iff sketch >= 2. Exact.
+    * ``"inline"`` — aggregate to distinct lanes, gate on the
+      post-batch value (old + batch multiplicity >= 2), retro-credit
+      the deferred first observation when the gate transitions
+      (old == 1), and update the sketch. Approximate at the margin
+      (module docstring).
+
+    Returns (bstate, sk, valid_gated, done, n_failed, n_unfit,
+    dropped_hq, dropped_lq)."""
+    if part is not None:
+        valid = valid & ctable.partition_mask(chi, clo, tmeta, part,
+                                              n_parts)
+    hq_add, lq_add, _d = ctable._prep_obs(qual, valid)
+    if mode == "two-pass":
+        gate = sketch_min(sk, smeta, chi, clo) >= 2
+        gated = valid & gate
+        dropped_hq = jnp.sum(jnp.where(valid & ~gate, hq_add, 0))
+        dropped_lq = jnp.sum(jnp.where(valid & ~gate, lq_add, 0))
+        bstate, done, n_failed, n_unfit = ctable._rounds_core(
+            bstate, tmeta, chi, clo, qual, gated, rounds, cap,
+            agg_cap)
+        return (bstate, sk, gated, done, n_failed, n_unfit,
+                dropped_hq, dropped_lq)
+
+    # inline: distinct lanes carry the gate, the retro credit, and
+    # the sketch update in one body
+    n = chi.shape[0]
+    u_chi, u_clo, u_hq, u_lq, u_valid, seg_of = _distinct_lanes(
+        chi, clo, hq_add, lq_add, valid)
+    u_mult = (u_hq + u_lq).astype(jnp.int32)
+    old = sketch_min(sk, smeta, u_chi, u_clo)
+    u_gate = u_valid & (old + jnp.minimum(u_mult, _SAT) >= 2)
+    retro = u_gate & (old == 1)
+    # quality proxy for the deferred first observation: the batch's
+    # own quality class for this mer (exact when a mer's observations
+    # are quality-homogeneous — the common case; off by one otherwise)
+    u_hq_c = u_hq + jnp.where(retro & (u_hq > 0), 1, 0).astype(jnp.uint32)
+    u_lq_c = u_lq + jnp.where(retro & (u_hq == 0), 1, 0).astype(jnp.uint32)
+    u_hq_c = jnp.where(u_gate, u_hq_c, 0)
+    u_lq_c = jnp.where(u_gate, u_lq_c, 0)
+    sk = _sketch_update_lanes(sk, smeta, u_chi, u_clo, u_mult, u_valid)
+    dropped_hq = jnp.sum(jnp.where(u_valid & ~u_gate, u_hq, 0))
+    dropped_lq = jnp.sum(jnp.where(u_valid & ~u_gate, u_lq, 0))
+    addr, rlo, rhi = ctable.tile_key_parts(u_chi, u_clo, tmeta)
+    p0 = ctable._preferred_slot(rlo, rhi)
+    udone = ~u_gate
+    bstate, udone, _left = ctable._tile_round_body(
+        bstate, tmeta, addr, rlo, rhi, p0, u_hq_c, u_lq_c, udone)
+    ucap = min(n, max(1024, n // 8))
+    bstate, udone, n_failed, n_unfit = ctable._tile_compact_rounds_body(
+        bstate, tmeta, addr, rlo, rhi, p0, u_hq_c, u_lq_c, udone,
+        rounds, ucap)
+    # per-observation done: gated-out mers' observations are DONE
+    # (deferred to a later batch via the sketch, not pending), placed
+    # lanes map back through the segment ids
+    lane_done = udone[jnp.clip(seg_of, 0, n - 1)]
+    gate_of = u_gate[jnp.clip(seg_of, 0, n - 1)]
+    done = (~valid) | (valid & (~gate_of | lane_done))
+    n_unfit = jnp.sum((valid & ~done).astype(jnp.int32))
+    return (bstate, sk, valid & gate_of, done, n_failed, n_unfit,
+            dropped_hq, dropped_lq)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 5, 6, 7, 8, 9, 10,
+                                            11, 12, 13),
+                   donate_argnums=(0, 1))
+def _gated_insert_wire(bstate, sk: SketchState, tmeta,
+                       smeta: SketchMeta, wire, qual_thresh: int,
+                       rounds: int, cap: int, b: int, length: int,
+                       thresholds: tuple, mode: str,
+                       part_key: tuple, agg_cap: int | None):
+    """extract + gate + insert (+ inline sketch update) as ONE
+    executable over the fused packed wire — the same transport the
+    plain insert path consumes (0.5 B/base H2D)."""
+    part, n_parts = part_key
+    chi, clo, qual, valid = _extract_wire(tmeta.k, wire, qual_thresh,
+                                          b, length, thresholds)
+    bstate, sk, gated, done, n_failed, n_unfit, d_hq, d_lq = \
+        _gated_insert_core(bstate, tmeta, sk, smeta, chi, clo, qual,
+                           valid, rounds, cap, mode, part, n_parts,
+                           agg_cap)
+    return (bstate, sk, (chi, clo, qual, gated), done, n_failed,
+            n_unfit, d_hq, d_lq)
+
+
+def tile_insert_reads_packed_gated(bstate, tmeta, sk: SketchState,
+                                   smeta: SketchMeta, packed,
+                                   qual_thresh: int, mode: str,
+                                   part: int | None = None,
+                                   n_parts: int = 1,
+                                   max_rounds: int = 24):
+    """The prefiltered twin of ctable.tile_insert_reads_packed:
+    returns (bstate, sk, full, (chi, clo, qual, valid, placed),
+    dropped_hq, dropped_lq) where `valid` is the POST-gate (and
+    post-partition-filter) mask, so the caller's grow/retry contract
+    (pending = valid & ~placed) is unchanged.
+
+    Inline caveat: observations that overflow the compaction caps
+    drain per-observation through the plain path, which cannot carry
+    a retro credit — a mer resolved there may count one low. Rare
+    (cap overflows need near-full buckets) and inside inline's
+    documented approximation."""
+    packed.require_plane(qual_thresh)
+    b, length = packed.n_reads, packed.length
+    n = b * length
+    cap = min(n, max(1024, n // 8))
+    bstate, sk, obs, done, n_failed, n_unfit, d_hq, d_lq = \
+        _gated_insert_wire(bstate, sk, tmeta, smeta,
+                           jnp.asarray(packed.to_wire()), qual_thresh,
+                           max_rounds - 1, cap, b, length,
+                           packed.thresholds, mode,
+                           (part, n_parts), ctable.agg_cap_for(n))
+    # ONE host sync for the flags + drop counters (tunnel round trips
+    # are the fixed cost; stacking makes it one D2H)
+    n_failed, n_unfit, d_hq, d_lq = (
+        int(x) for x in np.asarray(jnp.stack(
+            [n_failed, n_unfit,
+             jnp.asarray(d_hq, jnp.int32),
+             jnp.asarray(d_lq, jnp.int32)])))
+    chi, clo, qual, valid = obs
+    if n_failed == 0 and n_unfit > 0:
+        addr, rlo, rhi, p0 = ctable._tile_parts_jit(tmeta, chi, clo)
+        hq_add, lq_add, _d0 = ctable._prep_obs(qual, valid)
+        bstate, done = ctable._drain_survivors(
+            bstate, tmeta, addr, rlo, rhi, p0, hq_add, lq_add, done,
+            max_rounds, cap, n)
+    full, placed = ctable._finish_obs(done, valid)
+    return (bstate, sk, bool(full), (chi, clo, qual, valid, placed),
+            d_hq, d_lq)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def singleton_entries(bstate) -> jax.Array:
+    """Occupied build-table entries with exactly ONE observation
+    (hq + lq == 1) — in a two-pass prefiltered build these are
+    precisely the sketch's false passes (a true >= 2 mer can never
+    total 1). One fused reduction over the build planes."""
+    occ = (bstate.tag[:, 0::2] != ctable._EMPTY_TAG).reshape(-1)
+    return jnp.sum((occ & ((bstate.hq + bstate.lq) == 1))
+                   .astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Mode resolution (env > autotune profile > off)
+# ---------------------------------------------------------------------------
+
+PREFILTER_MODES = ("off", "two-pass", "inline")
+
+
+def prefilter_default() -> str:
+    """The prefilter mode when the CLI flag is absent:
+    QUORUM_PREFILTER env > autotune profile (ops/tuning) > off. Off by
+    default because the prefilter is a SEMANTIC opt-in: it implies the
+    stage-2 presence floor (module docstring), not just a layout
+    change."""
+    raw = levers.raw("QUORUM_PREFILTER")
+    if raw:
+        return raw if raw in PREFILTER_MODES else "off"
+    from . import tuning
+    prof = tuning.lever("QUORUM_PREFILTER")
+    if prof and prof in PREFILTER_MODES:
+        return prof
+    return "off"

@@ -44,10 +44,12 @@ PROFILE_SCHEMA = "quorum-tpu-autotune/1"
 # the levers a profile may pin (same spellings as the env vars that
 # force them — the profile IS a set of remembered env settings)
 LEVER_ENVS = ("QUORUM_COMPACT_SWEEP", "QUORUM_DRAIN_LEVELS",
-              "QUORUM_S1_AGGREGATE")
+              "QUORUM_S1_AGGREGATE", "QUORUM_PREFILTER")
 # numeric caps a profile may pin (stage-2 ambiguous-continuation
-# compaction lanes; stage-1 aggregation lane fraction)
-CAP_ENVS = ("QUORUM_AMBIG_CAP", "QUORUM_S1_AGG_CAP_FRAC")
+# compaction lanes; stage-1 aggregation lane fraction; prefilter
+# sketch geometry, ISSUE 14)
+CAP_ENVS = ("QUORUM_AMBIG_CAP", "QUORUM_S1_AGG_CAP_FRAC",
+            "QUORUM_SKETCH_BITS")
 
 _lock = threading.Lock()
 _cache: dict = {}          # path -> (stat_key, profile | None)

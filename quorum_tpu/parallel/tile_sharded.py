@@ -379,13 +379,17 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
 
 def build_step_wire(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
                     b: int, length: int, thresholds: tuple,
-                    bucket_slack: float = 2.0):
+                    bucket_slack: float = 2.0,
+                    part: int | None = None, n_parts: int = 1):
     """`build_step` fed the fused packed wire (io/packing
     .PackedReads.to_wire — 0.5 B/base over the H2D link, the SAME
     producer the single-chip stage 1 consumes): the flat u8 buffer is
     sliced back into planes on device, each shard widens ITS row range
     to int32 codes + the synthetic qual plane, and the insert body is
-    identical. Returns f(bstate, wire_u8, pending[b*length]) ->
+    identical. With `part` set (a pass of the partitioned build,
+    ISSUE 14), observations outside the partition are masked invalid
+    before routing — each pass's mesh counts only its own global row
+    range. Returns f(bstate, wire_u8, pending[b*length]) ->
     (bstate, full, overflow, placed, shard_inserts[S])."""
     S = meta.n_shards
     if b % S:
@@ -401,6 +405,9 @@ def build_step_wire(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
         chi, clo, q, valid = extract_observations_impl(
             codes, quals, meta.k, qual_thresh)
         valid = valid & pending
+        if part is not None:
+            valid = valid & ctable.partition_mask(chi, clo, meta,
+                                                  part, n_parts)
         n = chi.shape[0]
         agg_cap = ctable.agg_cap_for(n)
         inner_n = agg_cap if agg_cap else n

@@ -91,7 +91,17 @@ class CorrectionEngine:
         # bad digest refuses the build and the reload rolls back
         self.state, self.meta, _header = db_format.read_db(
             db_path, to_device=True, no_mmap=no_mmap, verify=verify_db)
-        cutoff = resolve_cutoff(self.state, self.meta, opts)
+        cutoff = resolve_cutoff(self.state, self.meta, opts,
+                                header=_header)
+        # a prefiltered database (ISSUE 14) declares its presence
+        # floor; applying it here keeps serve byte-identical to the
+        # offline CLI over the same database (plain databases declare
+        # nothing — floor 1 is the identity)
+        floor = int((_header.get("prefilter") or {}).get("min_obs", 1))
+        if floor > 1:
+            from ..ops import ctable
+            self.state = ctable.tile_floor(self.state, self.meta,
+                                           floor)
         vlog("Using cutoff of ", cutoff)
         if cutoff == 0 and opts.cutoff is None:
             raise RuntimeError(

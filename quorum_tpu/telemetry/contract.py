@@ -98,6 +98,17 @@ PUSH_META = ("metrics_push_host",)
 ALERT_COUNTERS = ("alerts_fired_total", "alert_rule_errors_total")
 ALERT_GAUGES = ("alert_rules_active",)
 
+# The memory-frugal counting surface (ISSUE 14): a stage-1 document
+# whose meta declares a prefilter mode must carry the prefilter
+# counters (pre-created at setup, so 0 counts); one declaring
+# partitions > 1 must carry the pass counter plus one
+# `partition_distinct{partition="K"}` gauge per partition — a missing
+# gauge means a pass's telemetry (or the pass itself) was dropped.
+PREFILTER_COUNTERS = ("prefilter_dropped_total",
+                      "prefilter_false_pass_total")
+PARTITION_COUNTERS = ("partition_passes_total",)
+PARTITION_GAUGE_PREFIX = "partition_distinct{partition="
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -123,4 +134,6 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(PUSH_COUNTERS)
     names.update(ALERT_COUNTERS)
     names.update(SHARD_REQUIRED_COUNTERS)
+    names.update(PREFILTER_COUNTERS)
+    names.update(PARTITION_COUNTERS)
     return tuple(sorted(names))

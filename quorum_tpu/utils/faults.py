@@ -82,6 +82,10 @@ the disabled cost is a module-global None check):
   shard payload / sharded manifest commits (io/checkpoint.py).
 * ``journal.append`` (``path=``) — after each stage-2 resume-journal
   commit (io/checkpoint.Stage2Journal.commit).
+* ``partition.commit`` (``path=``) — after each partition-pass cursor
+  commit of a ``--partitions`` build (io/checkpoint.
+  Stage1PartitionCursor.save); an ``exit`` here is the torn-partition
+  resume acceptance case.
 
 Determinism: per-spec hit counters under one lock; the same plan over
 the same input fires at exactly the same points, which is what lets
@@ -134,6 +138,10 @@ SITES: dict[str, str] = {
                          "(io/checkpoint.py); carries path=",
     "journal.append": "after each stage-2 resume-journal commit "
                       "(io/checkpoint.Stage2Journal); carries path=",
+    "partition.commit": "after each partition-pass cursor commit of a "
+                        "--partitions build "
+                        "(io/checkpoint.Stage1PartitionCursor); "
+                        "carries path=",
 }
 
 _ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt")

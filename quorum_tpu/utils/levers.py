@@ -99,6 +99,11 @@ _declare(
     "QUORUM_MULTICHIP_K", "int", "24",
     "Mer length for `bench.py --multichip` scaling points.")
 _declare(
+    "QUORUM_PREFILTER", "str", "off",
+    "Default stage-1 singleton-prefilter mode when --prefilter is "
+    "'auto': off, two-pass, or inline; env > autotune profile > off "
+    "(ops/sketch.prefilter_default).")
+_declare(
     "QUORUM_PUSH_HOST", "str", "hostname:pid",
     "Stable per-host identity for `--metrics-push-url` fleet shards "
     "(telemetry/push.py).")
@@ -123,6 +128,11 @@ _declare(
     "QUORUM_S1_OVERLAP", "bool", "1",
     "Sharded stage-1 pack/H2D overlap with the previous batch's "
     "all_to_all exchange; 0 reverts to the serial order.")
+_declare(
+    "QUORUM_SKETCH_BITS", "int", "auto",
+    "log2 of the prefilter sketch's two-bit cell count; env > "
+    "autotune profile > auto-sized at ~8 cells per expected distinct "
+    "mer from -s (ops/sketch.cells_log2_for).")
 _declare(
     "QUORUM_TPU_VERBOSE", "bool", "0",
     "Timestamped verbose logging (vlog) for library callers that "

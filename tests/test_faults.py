@@ -508,7 +508,7 @@ def test_driver_retries_stage2_with_mocked_clock(tmp_path, monkeypatch):
     sleeps = []
     monkeypatch.setattr(quorum_cli, "_sleep", sleeps.append)
     monkeypatch.setattr(quorum_cli.cdb_cli, "main",
-                        lambda argv, handoff=None, batches=None: 0)
+                        lambda argv, handoff=None, batches=None, batches_factory=None: 0)
     ec_argvs = []
 
     def fake_ec(argv, db=None, prepacked=None):
@@ -550,7 +550,7 @@ def test_driver_gives_up_after_retries(tmp_path, monkeypatch):
     reads_path, _r, _q = make_dataset(tmp_path, n_reads=8)
     monkeypatch.setattr(quorum_cli, "_sleep", lambda s: None)
     monkeypatch.setattr(quorum_cli.cdb_cli, "main",
-                        lambda argv, handoff=None, batches=None: 1)
+                        lambda argv, handoff=None, batches=None, batches_factory=None: 1)
     rc = quorum_cli.main(["-s", "64k", "-k", str(K),
                           "-p", str(tmp_path / "qc"),
                           "--stage-retries", "1", reads_path])
@@ -573,7 +573,7 @@ def test_driver_resume_skips_finished_stage1(tmp_path, monkeypatch):
     cdb_calls = []
     monkeypatch.setattr(
         quorum_cli.cdb_cli, "main",
-        lambda argv, handoff=None, batches=None: cdb_calls.append(1) or 0)
+        lambda argv, handoff=None, batches=None, batches_factory=None: cdb_calls.append(1) or 0)
     ec_argvs = []
 
     def fake_ec(argv, db=None, prepacked=None):

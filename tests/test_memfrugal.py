@@ -1,0 +1,694 @@
+"""Memory-frugal counting (ISSUE 14): the singleton prefilter
+(ops/sketch) and the minimizer-partitioned multi-pass build.
+
+Covers the two load-bearing guarantees:
+
+* the two-pass prefiltered table is EXACTLY the full table minus true
+  singletons (plus counted false passes), and stage 2 over it is
+  byte-identical to the unfiltered run at the same presence floor;
+* a --partitions P build's reassembled payload is byte-identical to
+  the single-pass build — including under --devices 2 and across a
+  hard kill -> resume that re-runs only the torn partition.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from quorum_tpu.io import db_format, packing
+from quorum_tpu.models.create_database import extract_observations
+from quorum_tpu.ops import ctable, mer
+from quorum_tpu.ops import sketch as sketch_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+K = 15
+READ_LEN = 80
+N_READS = 512
+BATCH = 256
+QT = 38
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# shared input + built databases (module scope: the CLI builds compile
+# once and every test reads them)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def reads():
+    rng = np.random.default_rng(17)
+    genome = rng.integers(0, 4, size=5000, dtype=np.int8)
+    starts = rng.integers(0, len(genome) - READ_LEN, size=N_READS)
+    idx = starts[:, None] + np.arange(READ_LEN)[None, :]
+    truth = genome[idx]
+    errs = rng.random(truth.shape) < 0.01
+    codes = np.where(errs, (truth + rng.integers(
+        1, 4, size=truth.shape)) % 4, truth).astype(np.int8)
+    quals = np.full(codes.shape, 70, np.uint8)
+    return codes, quals
+
+
+@pytest.fixture(scope="module")
+def fastq_file(reads, tmp_path_factory):
+    from bench import write_fastq
+    d = tmp_path_factory.mktemp("memfrugal")
+    fq = str(d / "reads.fastq")
+    write_fastq(fq, reads[0], reads[1])
+    return fq
+
+
+def _cdb(args):
+    from quorum_tpu.cli import create_database as cdb_cli
+    return cdb_cli.main(args)
+
+
+_COMMON = ["-s", "100k", "-m", str(K), "-b", "7", "-q", str(QT),
+           "--batch-size", str(BATCH)]
+
+
+@pytest.fixture(scope="module")
+def plain_db(fastq_file, tmp_path_factory):
+    d = tmp_path_factory.mktemp("plain")
+    out = str(d / "plain.qdb")
+    assert _cdb(_COMMON + ["-o", out, fastq_file]) == 0
+    return out
+
+
+@pytest.fixture(scope="module")
+def prefiltered_db(fastq_file, tmp_path_factory):
+    d = tmp_path_factory.mktemp("pf")
+    out = str(d / "pf.qdb")
+    metrics = str(d / "pf_metrics.json")
+    assert _cdb(_COMMON + ["-o", out, "--prefilter", "two-pass",
+                           "--metrics", metrics,
+                           "--metrics-interval", "0.001",
+                           fastq_file]) == 0
+    return out, metrics
+
+
+@pytest.fixture(scope="module")
+def partitioned_db(fastq_file, tmp_path_factory):
+    d = tmp_path_factory.mktemp("part")
+    out = str(d / "part.qdb")
+    metrics = str(d / "part_metrics.json")
+    assert _cdb(_COMMON + ["-o", out, "--partitions", "4",
+                           "--metrics", metrics,
+                           "--metrics-interval", "0.001",
+                           fastq_file]) == 0
+    return out, metrics
+
+
+@pytest.fixture(scope="module")
+def obs(reads):
+    """Host truth: every valid canonical observation + totals."""
+    codes, quals = reads
+    chi, clo, q, valid = (np.asarray(a) for a in extract_observations(
+        jnp.asarray(codes), jnp.asarray(quals), K, QT))
+    keys = (chi.astype(np.uint64) << 32) | clo.astype(np.uint64)
+    vm = valid.astype(bool)
+    return keys[vm], q[vm]
+
+
+# ---------------------------------------------------------------------------
+# sketch unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_never_undercounts(obs):
+    keys, q = obs
+    smeta = sketch_mod.SketchMeta(cells_log2=16)
+    sk = sketch_mod.make_sketch(smeta)
+    # three uneven batch splits exercise cross-batch accumulation
+    cuts = [0, len(keys) // 3, len(keys) // 2, len(keys)]
+    for a, b in zip(cuts, cuts[1:]):
+        hq = jnp.asarray((q[a:b] == 1).astype(np.uint32))
+        lq = jnp.asarray((q[a:b] == 0).astype(np.uint32))
+        chi = jnp.asarray((keys[a:b] >> 32).astype(np.uint32))
+        clo = jnp.asarray((keys[a:b] & 0xFFFFFFFF).astype(np.uint32))
+        u = sketch_mod._distinct_lanes(chi, clo, hq, lq,
+                                       jnp.ones((b - a,), bool))
+        sk = sketch_mod._sketch_update_lanes(sk, smeta, u[0], u[1],
+                                             u[2] + u[3], u[4])
+    uk, cnt = np.unique(keys, return_counts=True)
+    vals = np.asarray(sketch_mod.sketch_min(
+        sk, smeta, jnp.asarray((uk >> 32).astype(np.uint32)),
+        jnp.asarray((uk & 0xFFFFFFFF).astype(np.uint32))))
+    # the count-min invariant: never below min(2, true count)
+    assert int((vals < np.minimum(cnt, 2)).sum()) == 0
+    # and a meaningfully small false-pass rate at this density
+    singles = cnt == 1
+    assert (vals[singles] >= 2).mean() < 0.25
+
+
+def test_sketch_geometry_lever(monkeypatch):
+    monkeypatch.setenv("QUORUM_SKETCH_BITS", "18")
+    assert sketch_mod.cells_log2_for(10 ** 9) == 18
+    monkeypatch.delenv("QUORUM_SKETCH_BITS")
+    auto = sketch_mod.cells_log2_for(1 << 20)
+    assert auto == 23  # 8 cells per expected distinct mer
+    assert sketch_mod.cells_log2_for(10 ** 12) == 30  # clamped
+
+
+def test_two_pass_gate_is_exact(reads, obs):
+    """The gated insert drops EXACTLY the observations whose mer the
+    sketch scored < 2 — and every kept mer keeps exact counts."""
+    codes, quals = reads
+    keys, q = obs
+    lengths = np.full((N_READS,), READ_LEN, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths, thresholds=(QT,))
+    smeta = sketch_mod.SketchMeta(cells_log2=18)
+    sk = sketch_mod.make_sketch(smeta)
+    sk, n_obs = sketch_mod.sketch_update_packed(sk, smeta, K, pk, QT)
+    assert int(n_obs) == len(keys)
+    meta = ctable.TileMeta(k=K, bits=7,
+                           rb_log2=ctable.tile_rb_for(8192, K, 7))
+    bs = ctable.make_tile_build(meta)
+    bs, sk, full, _o, d_hq, d_lq = \
+        sketch_mod.tile_insert_reads_packed_gated(
+            bs, meta, sk, smeta, pk, QT, "two-pass")
+    assert not full
+    st = ctable.tile_finalize(bs, meta)
+    # reference: insert observations whose mer scored >= 2
+    uk = np.unique(keys)
+    vals = np.asarray(sketch_mod.sketch_min(
+        sk, smeta, jnp.asarray((uk >> 32).astype(np.uint32)),
+        jnp.asarray((uk & 0xFFFFFFFF).astype(np.uint32))))
+    gate = vals[np.searchsorted(uk, keys)] >= 2
+    assert d_hq + d_lq == int((~gate).sum())
+    bs2 = ctable.make_tile_build(meta)
+    bs2, f2, _p = ctable.tile_insert_observations(
+        bs2, meta, jnp.asarray((keys >> 32).astype(np.uint32)),
+        jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32)),
+        jnp.asarray(q.astype(np.uint32)), jnp.asarray(gate))
+    assert not f2
+    st_ref = ctable.tile_finalize(bs2, meta)
+
+    def ent(s):
+        return sorted(zip(*(a.tolist()
+                            for a in ctable.tile_iterate(s, meta))))
+    assert ent(st) == ent(st_ref)
+
+
+def test_inline_matches_two_pass_when_roomy(reads):
+    """With a collision-free sketch and quality-homogeneous input,
+    inline's retro-credit makes it EXACTLY the two-pass table."""
+    codes, quals = reads
+    lengths = np.full((N_READS,), READ_LEN, np.int32)
+    smeta = sketch_mod.SketchMeta(cells_log2=22)  # roomy: no collisions
+    meta = ctable.TileMeta(k=K, bits=7,
+                           rb_log2=ctable.tile_rb_for(8192, K, 7))
+    tables = {}
+    for mode in ("two-pass", "inline"):
+        sk = sketch_mod.make_sketch(smeta)
+        if mode == "two-pass":
+            for i in range(0, N_READS, BATCH):
+                pk = packing.pack_reads(codes[i:i + BATCH],
+                                        quals[i:i + BATCH],
+                                        lengths[:BATCH],
+                                        thresholds=(QT,))
+                sk, _n = sketch_mod.sketch_update_packed(
+                    sk, smeta, K, pk, QT)
+        bs = ctable.make_tile_build(meta)
+        for i in range(0, N_READS, BATCH):
+            pk = packing.pack_reads(codes[i:i + BATCH],
+                                    quals[i:i + BATCH],
+                                    lengths[:BATCH], thresholds=(QT,))
+            bs, sk, full, _o, _dh, _dl = \
+                sketch_mod.tile_insert_reads_packed_gated(
+                    bs, meta, sk, smeta, pk, QT, mode)
+            assert not full
+        st = ctable.tile_finalize(bs, meta)
+        tables[mode] = sorted(zip(*(
+            a.tolist() for a in ctable.tile_iterate(st, meta))))
+    assert tables["inline"] == tables["two-pass"]
+
+
+# ---------------------------------------------------------------------------
+# minimizers
+# ---------------------------------------------------------------------------
+
+
+def test_minimizer_host_device_parity(reads):
+    codes = reads[0][:3]
+    mv, kvalid = mer.minimizer_kmers(jnp.asarray(codes), K, 7)
+    mv, kvalid = np.asarray(mv), np.asarray(kvalid)
+    for r in range(codes.shape[0]):
+        for p in range(K - 1, READ_LEN, 11):
+            assert kvalid[r, p]
+            seq = "".join("ACGT"[c] for c in codes[r, p - K + 1:p + 1])
+            assert mer.minimizer_py(seq, 7) == int(mv[r, p])
+
+
+def test_minimizer_invalid_windows():
+    codes = np.full((1, 30), 2, np.int8)
+    codes[0, 10] = -1  # N base
+    mv, kvalid = mer.minimizer_kmers(jnp.asarray(codes), K, 7)
+    mv, kvalid = np.asarray(mv), np.asarray(kvalid)
+    assert not kvalid[0, :K - 1].any()       # window not filled
+    assert not kvalid[0, 10:10 + K].any()    # windows holding the N
+    assert kvalid[0, 10 + K]
+    assert (mv[0, ~kvalid[0]] == 0xFFFFFFFF).all()
+    with pytest.raises(ValueError):
+        mer.minimizer_kmers(jnp.asarray(codes), K, 16)
+
+
+# ---------------------------------------------------------------------------
+# partitioning primitives
+# ---------------------------------------------------------------------------
+
+
+def test_partition_mask_disjoint_exhaustive(obs):
+    keys, _q = obs
+    meta = ctable.TileMeta(k=K, bits=7, rb_log2=8)
+    chi = jnp.asarray((keys >> 32).astype(np.uint32))
+    clo = jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32))
+    owners = np.zeros(len(keys), np.int32)
+    hits = np.zeros(len(keys), np.int32)
+    for p in range(4):
+        m = np.asarray(ctable.partition_mask(chi, clo, meta, p, 4))
+        owners[m] = p
+        hits += m.astype(np.int32)
+    assert (hits == 1).all()  # exactly one partition owns each mer
+    # ownership is a pure key function: same key -> same owner
+    uk, inv = np.unique(keys, return_inverse=True)
+    first = np.zeros(len(uk), np.int32)
+    np.maximum.at(first, inv, owners)
+    assert (owners == first[inv]).all()
+
+
+def test_departition_floor_and_reassembly(reads):
+    """Partition passes + departition rebase == the single global
+    build, bit-for-bit after canonical row ordering; tile_floor of
+    the reassembled plane equals tile_floor of the global plane."""
+    codes, quals = reads
+    lengths = np.full((N_READS,), READ_LEN, np.int32)
+    pk = packing.pack_reads(codes, quals, lengths, thresholds=(QT,))
+    P, g = 4, 2
+    lmeta = ctable.TileMeta(k=K, bits=7, rb_log2=7)
+    parts = []
+    for p in range(P):
+        bs = ctable.make_tile_build(lmeta)
+        bs, full, _o = ctable.tile_insert_reads_packed(
+            bs, lmeta, pk, QT, part=p, n_parts=P)
+        assert not full
+        st = ctable.tile_finalize(bs, lmeta)
+        dp, bad = ctable.tile_departition_rows(st, lmeta, g, p)
+        assert not bool(bad)
+        parts.append(np.asarray(dp.rows))
+    gmeta = ctable.TileMeta(k=K, bits=7, rb_log2=7 + g)
+    reassembled = ctable.TileState(
+        jnp.asarray(np.concatenate(parts, axis=0)))
+    bsg = ctable.make_tile_build(gmeta)
+    bsg, full, _o = ctable.tile_insert_reads_packed(bsg, gmeta, pk, QT)
+    assert not full
+    stg = ctable.tile_finalize(bsg, gmeta)
+    c1 = np.asarray(ctable._canonical_rows(reassembled, gmeta).rows)
+    c2 = np.asarray(ctable._canonical_rows(stg, gmeta).rows)
+    assert np.array_equal(c1, c2)
+    f1 = np.asarray(ctable.tile_floor(
+        ctable.TileState(jnp.asarray(c1)), gmeta, 2).rows)
+    f2 = np.asarray(ctable.tile_floor(
+        ctable.TileState(jnp.asarray(c2)), gmeta, 2).rows)
+    assert np.array_equal(f1, f2)
+    # floor 1 is the identity (no copy, no change)
+    assert ctable.tile_floor(stg, gmeta, 1) is stg
+    # host (numpy) floor matches the device floor
+    fh = ctable.tile_floor(ctable.TileState(c1.copy()), gmeta, 2)
+    assert np.array_equal(np.asarray(fh.rows), f1)
+
+
+# ---------------------------------------------------------------------------
+# CLI pipelines
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_payload_parity(plain_db, partitioned_db):
+    out, metrics = partitioned_db
+    assert (db_format.db_payload_bytes(out)
+            == db_format.db_payload_bytes(plain_db))
+    h = db_format.read_header(out)
+    assert h["format"] == db_format.MANIFEST_FORMAT
+    assert h["n_shards"] == 4
+    doc = json.load(open(metrics))
+    assert doc["meta"]["partitions"] == 4
+    assert doc["counters"]["partition_passes_total"] == 4
+    for p in range(4):
+        assert f'partition_distinct{{partition="{p}"}}' in doc["gauges"]
+    # the pass-boundary events + per-pass heartbeat partitions
+    events = [json.loads(ln) for ln in
+              open(metrics.replace(".json", ".events.jsonl"))]
+    passes = [e for e in events if e["event"] == "partition_pass"]
+    assert [e["partition"] for e in passes] == [0, 1, 2, 3]
+    assert all("seconds" in e and "batches" in e for e in passes)
+    beats = [e for e in events if e["event"] == "heartbeat"]
+    assert {e.get("partition") for e in beats} <= {0, 1, 2, 3, None}
+
+
+def test_partitioned_devices2_parity(fastq_file, plain_db, tmp_path):
+    out = str(tmp_path / "part_d2.qdb")
+    assert _cdb(_COMMON + ["-o", out, "--partitions", "2",
+                           "--devices", "2", fastq_file]) == 0
+    assert (db_format.db_payload_bytes(out)
+            == db_format.db_payload_bytes(plain_db))
+
+
+def test_prefilter_table_and_header(plain_db, prefiltered_db, obs):
+    out, metrics = prefiltered_db
+    keys, q = obs
+    uk, cnt = np.unique(keys, return_counts=True)
+    h = db_format.read_header(out)
+    hp = db_format.read_header(plain_db)
+    pf = h["prefilter"]
+    assert pf["mode"] == "two-pass" and pf["min_obs"] == 2
+    # dropped + kept = all distinct; false passes are kept singletons
+    n_singles = int((cnt == 1).sum())
+    assert pf["dropped"] == n_singles - pf["false_pass"]
+    assert h["n_entries"] == len(uk) - pf["dropped"]
+    assert h["n_entries"] < hp["n_entries"]
+    # the header's Poisson stats equal the FULL table's (all-hq input:
+    # every distinct mer is an hq mer here)
+    st, meta, _ = db_format.read_db(plain_db, to_device=False)
+    _occ, d_hq, t_hq = (int(x) for x in db_format.db_stats(st, meta))
+    assert h["poisson_stats"]["distinct_hq"] == d_hq
+    assert h["poisson_stats"]["total_hq"] == t_hq
+    doc = json.load(open(metrics))
+    assert doc["meta"]["prefilter"] == "two-pass"
+    assert doc["counters"]["prefilter_dropped_total"] == pf["dropped"]
+    assert (doc["counters"]["prefilter_false_pass_total"]
+            == pf["false_pass"])
+
+
+def test_prefilter_stage2_parity_at_floor(plain_db, prefiltered_db,
+                                          fastq_file, tmp_path):
+    """THE guarantee: prefiltered DB == unfiltered DB at the same
+    presence floor, .fa and .log byte-identical (auto floor from the
+    DB's own declaration on one side, explicit flag on the other)."""
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    a = str(tmp_path / "floor")
+    b = str(tmp_path / "pf")
+    args = ["--batch-size", str(BATCH)]
+    assert ec_cli.main(args + ["--presence-floor", "2", "-o", a,
+                               plain_db, fastq_file]) == 0
+    assert ec_cli.main(args + ["-o", b, prefiltered_db[0],
+                               fastq_file]) == 0
+    assert (open(a + ".fa", "rb").read()
+            == open(b + ".fa", "rb").read())
+    assert (open(a + ".log", "rb").read()
+            == open(b + ".log", "rb").read())
+
+
+def test_partition_kill_resume_byte_identical(fastq_file, plain_db,
+                                              tmp_path):
+    """Hard os._exit after the second partition commit; --resume
+    re-runs ONLY the torn partitions and the final payload is
+    byte-identical to the single-pass build."""
+    out = str(tmp_path / "kr.qdb")
+    ck = str(tmp_path / "ckpt")
+    metrics = str(tmp_path / "kr_metrics.json")
+    code = (
+        "import sys\n"
+        "from quorum_tpu.cli import create_database as cdb\n"
+        f"sys.exit(cdb.main({_COMMON!r} + ['-o', {out!r}, "
+        f"'--partitions', '4', '--checkpoint-dir', {ck!r}, "
+        f"'--metrics', {metrics!r}] + sys.argv[1:] + "
+        f"[{fastq_file!r}]))\n")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               JAX_COMPILATION_CACHE_DIR="/tmp/quorum_tpu_test_jaxcache",
+               QUORUM_FAULT_PLAN=json.dumps([{
+                   "site": "partition.commit", "at": 2,
+                   "action": "exit", "code": 41}]))
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert res.returncode == 41, res.stderr[-2000:]
+    assert not os.path.exists(out)  # no manifest yet
+    cur = json.load(open(os.path.join(ck, "stage1.partitions.json")))
+    assert [r["shard"] for r in cur["completed"]] == [0, 1]
+    env.pop("QUORUM_FAULT_PLAN")
+    res = subprocess.run([sys.executable, "-c", code, "--resume"],
+                         cwd=REPO, env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert (db_format.db_payload_bytes(out)
+            == db_format.db_payload_bytes(plain_db))
+    doc = json.load(open(metrics))
+    # only the torn partitions (2, 3) ran in the resumed process
+    assert doc["counters"]["partition_passes_total"] == 2
+    # ...but every partition's gauge is present (restored from cursor)
+    for p in range(4):
+        assert f'partition_distinct{{partition="{p}"}}' in doc["gauges"]
+    assert not os.path.exists(
+        os.path.join(ck, "stage1.partitions.json"))
+
+
+def test_partitioned_fsck_pinpoints_and_loader_refuses(
+        partitioned_db, fastq_file, tmp_path, capsys):
+    """A corrupted partition shard is pinpointed by quorum-fsck under
+    its shard-K section and refused by the loader with rc 3 — the
+    partitioned manifest IS the PR 9 sharded format."""
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    from quorum_tpu.cli import fsck as fsck_cli
+    src = partitioned_db[0]
+    d = str(tmp_path / "corrupt")
+    os.makedirs(d)
+    for f in os.listdir(os.path.dirname(src)):
+        if f.startswith(os.path.basename(src)):
+            shutil.copy(os.path.join(os.path.dirname(src), f),
+                        os.path.join(d, f))
+    man = os.path.join(d, os.path.basename(src))
+    shard2 = man + ".shard-2-of-4.qdb"
+    data = bytearray(open(shard2, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(shard2, "wb") as f:  # qlint: disable=raw-artifact-write
+        f.write(bytes(data))
+    rc = fsck_cli.main([man])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "shard-2" in err
+    rc = ec_cli.main(["--batch-size", str(BATCH), "-o",
+                      str(tmp_path / "out"), man, fastq_file])
+    assert rc == 3
+
+
+def test_prefilter_refusals(fastq_file, tmp_path, capsys):
+    out = str(tmp_path / "x.qdb")
+    assert _cdb(_COMMON + ["-o", out, "--partitions", "3",
+                           fastq_file]) == 1
+    assert "power of two" in capsys.readouterr().err
+    assert _cdb(_COMMON + ["-o", out, "--prefilter", "two-pass",
+                           "--devices", "2", fastq_file]) == 1
+    assert "--devices 1" in capsys.readouterr().err
+    assert _cdb(_COMMON + ["-o", out, "--prefilter", "inline",
+                           "--partitions", "2", fastq_file]) == 1
+    assert "two-pass" in capsys.readouterr().err
+    assert _cdb(_COMMON + ["-o", out, "--prefilter", "inline",
+                           "--checkpoint-dir", str(tmp_path / "ck"),
+                           fastq_file]) == 1
+    assert "two-pass" in capsys.readouterr().err
+    assert _cdb(_COMMON + ["-o", out, "--ref-format",
+                           "--partitions", "2", fastq_file]) == 1
+    capsys.readouterr()
+
+
+def test_inline_cli_build_loads(fastq_file, obs, tmp_path):
+    """Inline mode through the CLI: a loadable DB declaring the mode,
+    honoring inline's HARD guarantees — every recurring mer is kept
+    (the sketch never undercounts) with its count within the
+    documented +-1 collision margin, and every absent mer is a true
+    singleton. (Exact equality with two-pass needs a collision-free
+    sketch — test_inline_matches_two_pass_when_roomy.)"""
+    out = str(tmp_path / "inl.qdb")
+    assert _cdb(_COMMON + ["-o", out, "--prefilter", "inline",
+                           fastq_file]) == 0
+    h = db_format.read_header(out)
+    assert h["prefilter"]["mode"] == "inline"
+    keys, _q = obs
+    uk, cnt = np.unique(keys, return_counts=True)
+    st, meta, _hdr = db_format.read_db(out, to_device=False)
+    khi, klo, vals = db_format.db_iterate(st, meta)
+    stored = {(int(h_) << 32) | int(l_): int(v) >> 1
+              for h_, l_, v in zip(khi, klo, vals)}
+    for key, c in zip(uk, cnt):
+        if c >= 2:
+            assert int(key) in stored
+            assert abs(stored[int(key)] - int(c)) <= 1
+    for key in stored:
+        assert cnt[np.searchsorted(uk, np.uint64(key))] >= 1
+    absent = set(int(k) for k in uk) - set(stored)
+    assert all(cnt[np.searchsorted(uk, np.uint64(k))] == 1
+               for k in absent)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / contract units
+# ---------------------------------------------------------------------------
+
+
+def test_partition_cursor_identity_and_digest(tmp_path):
+    from quorum_tpu.io import checkpoint as ckpt_mod
+    d = str(tmp_path)
+    shard = os.path.join(d, "x.shard-0-of-2.qdb")
+    with open(shard, "wb") as f:  # qlint: disable=raw-artifact-write
+        f.write(b"payload-bytes")
+    cur = ckpt_mod.Stage1PartitionCursor(d)
+    rec = {"path": os.path.basename(shard), "shard": 0,
+           "n_entries": 3, "value_bytes": 13, "file_crc32c": 1}
+    ident = {"k": 15, "partitions": 2}
+    cur.save(ident, [rec], d)
+    got = cur.load(ident, d)
+    assert [r["shard"] for r in got] == [0]
+    assert cur.cursor() == 1
+    # identity mismatch = a different run's cursor = fresh build
+    assert cur.load({"k": 16, "partitions": 2}, d) is None
+    # damaged completed shard = loud refusal
+    with open(shard, "ab") as f:  # qlint: disable=raw-artifact-write
+        f.write(b"!")
+    with pytest.raises(ckpt_mod.CheckpointError):
+        cur.load(ident, d)
+    cur.clear()
+    assert cur.cursor() is None
+    # sketch checkpoint round-trips and refuses corruption
+    sk = ckpt_mod.SketchCheckpoint(d)
+    cells = np.arange(64, dtype=np.uint8)
+    sk.save(cells, ident)
+    assert np.array_equal(sk.load(ident), cells)
+    assert sk.load({"k": 9}) is None
+    raw = bytearray(open(sk.path, "rb").read())
+    raw[-1] ^= 0xFF
+    with open(sk.path, "wb") as f:  # qlint: disable=raw-artifact-write
+        f.write(bytes(raw))
+    with pytest.raises(ckpt_mod.CheckpointError):
+        sk.load(ident)
+
+
+def test_metrics_check_memfrugal_names():
+    mc = _load_tool("metrics_check")
+    ok = {"meta": {"prefilter": "two-pass", "partitions": 2},
+          "counters": {"prefilter_dropped_total": 5,
+                       "prefilter_false_pass_total": 0,
+                       "partition_passes_total": 2},
+          "gauges": {'partition_distinct{partition="0"}': 10,
+                     'partition_distinct{partition="1"}': 12}}
+    assert mc._check_memfrugal_names(ok) == []
+    missing = {"meta": ok["meta"], "counters": {},
+               "gauges": {'partition_distinct{partition="0"}': 10}}
+    errs = mc._check_memfrugal_names(missing)
+    assert len(errs) == 4  # 2 prefilter + 1 partition counter + gauge 1
+    off = {"meta": {"prefilter": "off", "partitions": 1},
+           "counters": {}, "gauges": {}}
+    assert mc._check_memfrugal_names(off) == []
+
+
+def test_trace_summary_partition_table(tmp_path, capsys):
+    ts = _load_tool("trace_summary")
+    ev = str(tmp_path / "events.jsonl")
+    with open(ev, "w") as f:  # qlint: disable=raw-artifact-write
+        for line in (
+                {"event": "partition_pass", "t": 1.0,
+                 "partition": "sketch", "n_partitions": 2,
+                 "batches": 3, "seconds": 0.5},
+                {"event": "partition_pass", "t": 2.0, "partition": 0,
+                 "n_partitions": 2, "batches": 3, "distinct": 100,
+                 "seconds": 0.8},
+                {"event": "heartbeat", "t": 2.5, "partition": 1},
+                {"event": "partition_pass", "t": 3.0, "partition": 1,
+                 "n_partitions": 2, "batches": 3, "distinct": 90,
+                 "seconds": 0.7}):
+            f.write(json.dumps(line) + "\n")
+    assert ts.main([ev]) == 0
+    out = capsys.readouterr().out
+    assert "partition passes" in out
+    assert "sketch" in out and "3 pass(es)" in out
+
+
+def test_levers_and_tuning_registration():
+    from quorum_tpu.ops import tuning
+    from quorum_tpu.utils import levers
+    assert "QUORUM_PREFILTER" in levers.CATALOG
+    assert "QUORUM_SKETCH_BITS" in levers.CATALOG
+    assert "QUORUM_PREFILTER" in tuning.LEVER_ENVS
+    assert "QUORUM_SKETCH_BITS" in tuning.CAP_ENVS
+    from quorum_tpu.telemetry import contract
+    pre = contract.precreated_counter_names()
+    for name in ("prefilter_dropped_total", "prefilter_false_pass_total",
+                 "partition_passes_total"):
+        assert name in pre
+    from quorum_tpu.utils import faults
+    assert "partition.commit" in faults.SITES
+
+
+def test_driver_never_replays_truncated_cache(fastq_file, tmp_path,
+                                              monkeypatch):
+    """A multi-pass stage 1 that abandons the driver's caching
+    producer mid-stream (a partition-geometry restart) must not leave
+    a truncated RAM replay cache that stage 2 silently consumes as
+    the whole input (ISSUE 14 review finding)."""
+    from quorum_tpu.cli import quorum as quorum_cli
+
+    def half_consuming_cdb(argv, handoff=None, batches=None,
+                           batches_factory=None):
+        it = batches_factory()
+        next(it)  # consume ONE batch, then abandon the iterator
+        return 0
+
+    seen = {}
+
+    def fake_ec(argv, db=None, prepacked=None):
+        seen["prepacked"] = prepacked
+        return 0
+
+    monkeypatch.setattr(quorum_cli.cdb_cli, "main", half_consuming_cdb)
+    monkeypatch.setattr(quorum_cli.ec_cli, "main", fake_ec)
+    rc = quorum_cli.main(["-s", "64k", "-k", str(K), "-q", "33",
+                          "-p", str(tmp_path / "q"),
+                          "--batch-size", "128", fastq_file])
+    assert rc == 0
+    # the truncated cache must NOT reach stage 2 — None forces the
+    # disk re-parse, which sees every read
+    assert seen["prepacked"] is None
+
+
+def test_partitioned_composition_validated_in_model():
+    """The partitioned builder enforces its own composition rules —
+    a library caller can't get an unfiltered table whose header
+    claims a prefilter ran."""
+    from quorum_tpu.models.create_database import (
+        BuildConfig, _build_database_partitioned)
+    from quorum_tpu.telemetry import NULL, NULL_TRACER
+    with pytest.raises(ValueError, match="inline"):
+        _build_database_partitioned(
+            ["x.fastq"], BuildConfig(k=K, partitions=2,
+                                     prefilter="inline"),
+            "out.qdb", None, None, NULL, NULL_TRACER)
+    with pytest.raises(ValueError, match="devices 1"):
+        _build_database_partitioned(
+            ["x.fastq"], BuildConfig(k=K, partitions=2, devices=2,
+                                     prefilter="two-pass"),
+            "out.qdb", None, None, NULL, NULL_TRACER)
+
+
+def test_prefilter_mode_resolution(monkeypatch):
+    monkeypatch.setenv("QUORUM_PREFILTER", "two-pass")
+    assert sketch_mod.prefilter_default() == "two-pass"
+    monkeypatch.setenv("QUORUM_PREFILTER", "bogus")
+    assert sketch_mod.prefilter_default() == "off"
+    monkeypatch.delenv("QUORUM_PREFILTER")
+    assert sketch_mod.prefilter_default() == "off"

@@ -79,6 +79,9 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     DEVTRACE_META,
     FAULT_COUNTERS,
     INTEGRITY_COUNTERS,
+    PARTITION_COUNTERS,
+    PARTITION_GAUGE_PREFIX,
+    PREFILTER_COUNTERS,
     PUSH_COUNTERS,
     PUSH_META,
     SERVE_FEATURE_COUNTERS,
@@ -118,6 +121,40 @@ def _check_shard_names(doc: dict) -> list[str]:
             errs.append(
                 f"sharded build document meta.{name} must be a list "
                 f"of {n_shards} per-shard values, got {val!r}")
+    return errs
+
+
+def _check_memfrugal_names(doc: dict) -> list[str]:
+    """Memory-frugal counting requirements (ISSUE 14): dispatch on
+    meta.prefilter (a non-off mode must carry the prefilter counters)
+    and meta.partitions (> 1 must carry the pass counter and exactly
+    one partition_distinct gauge per partition)."""
+    errs = []
+    meta = doc.get("meta", {})
+    counters = doc.get("counters", {})
+    mode = meta.get("prefilter")
+    if mode and mode != "off":
+        for name in PREFILTER_COUNTERS:
+            if name not in counters:
+                errs.append(f"document with meta.prefilter={mode!r} "
+                            f"missing counter {name!r}")
+    try:
+        parts = int(meta.get("partitions") or 1)
+    except (TypeError, ValueError):
+        return errs + ["meta.partitions is not an integer"]
+    if parts > 1:
+        for name in PARTITION_COUNTERS:
+            if name not in counters:
+                errs.append(f"document with meta.partitions={parts} "
+                            f"missing counter {name!r}")
+        gauges = doc.get("gauges", {})
+        for p in range(parts):
+            gname = f'{PARTITION_GAUGE_PREFIX}"{p}"}}'
+            if gname not in gauges:
+                errs.append(
+                    f"document with meta.partitions={parts} missing "
+                    f"gauge {gname!r} (a partition pass's telemetry "
+                    "was dropped)")
     return errs
 
 
@@ -338,6 +375,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_fault_names(doc)
         problems = problems + _check_integrity_names(doc)
         problems = problems + _check_shard_names(doc)
+        problems = problems + _check_memfrugal_names(doc)
         problems = problems + _check_hosts_doc(doc)
         problems = problems + _check_devtrace_names(doc)
         problems = problems + _check_push_names(doc)

@@ -21,7 +21,10 @@ document carrying per-host shards under `hosts` (the quorum driver's
   * for hosts/fleet documents: the PER-HOST attribution table
     (wall, host / device-dispatch / device-wait seconds per host,
     slowest host highlighted — the job runs at the slowest host's
-    pace, ISSUE 11), then the aggregate's own tables.
+    pace, ISSUE 11), then the aggregate's own tables;
+  * for a multi-pass stage-1 build's events JSONL (ISSUE 14): the
+    per-pass table from its `partition_pass` events (sketch pass +
+    each partition pass: batches, distinct mers, seconds, share).
 
 `--device PROFILE_DIR` (ISSUE 10) additionally parses the
 jax.profiler trace the run wrote into that directory
@@ -243,6 +246,46 @@ def render_metrics_doc(mpath: str, doc: dict) -> None:
               f"sum={h.get('sum', 0) / div / 1000.0:.3f} s")
 
 
+def load_events(path: str) -> list[dict]:
+    """Event lines of a `--metrics-interval` JSONL stream ({"event":
+    kind, "t": elapsed_s, ...})."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "event" in obj:
+                events.append(obj)
+    return events
+
+
+def partition_table(path: str, events: list[dict]) -> None:
+    """Per-pass time attribution of a multi-pass stage-1 build
+    (ISSUE 14): one row per `partition_pass` event (the sketch pass
+    and each partition pass), with the share of the total pass time —
+    the table that says whether a partitioned build's wall clock is
+    input-bound (flat passes) or skew-bound (one hot partition)."""
+    passes = [e for e in events if e.get("event") == "partition_pass"]
+    total = sum(float(e.get("seconds", 0.0)) for e in passes)
+    print(f"\n== partition passes: {path} ({len(passes)} pass(es), "
+          f"{total:.3f} s) ==")
+    print(f"{'pass':<10} {'batches':>8} {'distinct':>10} "
+          f"{'seconds':>9} {'%passes':>8}")
+    for e in passes:
+        part = str(e.get("partition", "?"))
+        secs = float(e.get("seconds", 0.0))
+        pct = 100.0 * secs / total if total > 0 else 0.0
+        dist = e.get("distinct")
+        print(f"{part:<10} {e.get('batches', 0):>8} "
+              f"{dist if dist is not None else '-':>10} "
+              f"{secs:>9.3f} {pct:>8.1f}")
+
+
 def render_spans_file(path: str) -> None:
     spans = load_spans(path)
     rows, wall = span_table(spans)
@@ -300,7 +343,14 @@ def main(argv=None) -> int:
             render_metrics_doc(path, doc)
         else:
             try:
-                render_spans_file(path)
+                events = load_events(path)
+                if any(e.get("event") == "partition_pass"
+                       for e in events):
+                    # a multi-pass build's events stream: the per-pass
+                    # attribution table (ISSUE 14)
+                    partition_table(path, events)
+                else:
+                    render_spans_file(path)
             except (ValueError, KeyError) as e:
                 print(f"{path}: not a span/metrics/fleet artifact "
                       f"({e})", file=sys.stderr)
