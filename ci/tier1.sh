@@ -77,6 +77,20 @@
 # (quorum_tpu/analysis/tsan.py): an observed A->B / B->A lock
 # acquisition inversion fails the test that saw it.
 #
+# ISSUE 15 adds the trace-contract gate: the compile-budget rules
+# (trace-lever-read, trace-python-branch, jit-unbudgeted,
+# static-argnum-hazard) join quorum-lint --strict, and the pytest
+# pass additionally runs under QUORUM_COMPILE_SENTINEL=1, the
+# runtime compile sentinel (analysis/compile_sentinel.py): every
+# jit-cache miss is ledgered against the declared COMPILE_BUDGET
+# catalog, and a budget overrun, a duplicate compile of an
+# identical signature, or an unbudgeted jit site fails the test
+# that observed it. The telemetry smoke also runs under the
+# sentinel so its stage-1 metrics document carries the compile
+# ledger (compile_events + compiles{site=...}) that the perf-diff
+# gate judges against PERF_BASELINE.json — a recompile regression
+# fails CI like a throughput cliff does.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -88,6 +102,10 @@
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
+#        SKIP_COMPILE_SENTINEL=1  skips the runtime compile sentinel
+#                             (pytest + telemetry smoke run without
+#                             QUORUM_COMPILE_SENTINEL=1; the static
+#                             budget rules still gate via quorum-lint).
 set -o pipefail
 set -u
 
@@ -112,6 +130,16 @@ else
     tsan_env="QUORUM_TSAN=1"
 fi
 
+# the runtime compile sentinel (ISSUE 15) rides the same pytest pass
+# AND the telemetry smoke, so compile-count regressions fail the
+# observing test and land in the perf-diff'd metrics document
+sentinel_env=""
+if [ "${SKIP_COMPILE_SENTINEL:-0}" = "1" ]; then
+    echo "ci/tier1.sh: compile sentinel skipped (SKIP_COMPILE_SENTINEL=1)"
+else
+    sentinel_env="QUORUM_COMPILE_SENTINEL=1"
+fi
+
 # hermetic lever resolution: an ambient autotune profile written by a
 # developer's quorum-autotune run (~/.cache/quorum_tpu/autotune) must
 # not steer the golden/bench runs this script judges — PERF_BASELINE
@@ -122,10 +150,12 @@ export QUORUM_AUTOTUNE_PROFILE="${QUORUM_AUTOTUNE_PROFILE:-}"
 
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
-# $tsan_env is "QUORUM_TSAN=1" unless SKIP_QLINT=1 — the runtime
-# lock-order sanitizer rides the whole pytest pass (unquoted on
-# purpose: empty expands to no arg)
-timeout -k 10 870 env JAX_PLATFORMS=cpu $tsan_env python -m pytest tests/ -q \
+# $tsan_env is "QUORUM_TSAN=1" unless SKIP_QLINT=1, $sentinel_env is
+# "QUORUM_COMPILE_SENTINEL=1" unless SKIP_COMPILE_SENTINEL=1 — the
+# runtime lock-order sanitizer and the compile-budget sentinel ride
+# the whole pytest pass together (unquoted on purpose: empty expands
+# to no arg)
+timeout -k 10 870 env JAX_PLATFORMS=cpu $tsan_env $sentinel_env python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee /tmp/_t1.log
@@ -305,7 +335,9 @@ else
     echo "== telemetry smoke (devtrace + push) =="
     TEL_DIR=$(mktemp -d /tmp/telemetry_smoke.XXXXXX)
     trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "$TEL_DIR"' EXIT
-    env JAX_PLATFORMS=cpu \
+    # $sentinel_env: the smoke's stage-1 run ledgers its compiles
+    # into telemetry_metrics.json for the perf-diff compile gate
+    env JAX_PLATFORMS=cpu $sentinel_env \
         JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
         python tools/telemetry_smoke.py \
         --out-dir "$TEL_DIR" || telemetry_rc=$?

@@ -29,3 +29,21 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
 """
 
 __version__ = "0.5.0"
+
+# Runtime compile sentinel opt-in (ISSUE 15): QUORUM_COMPILE_SENTINEL=1
+# — on in ci/tier1.sh — must wrap jax.jit BEFORE any jit-bearing
+# submodule binds it in a module-level functools.partial decorator,
+# and package import is the one point that precedes them all (the
+# tests' conftest and every CLI entry route through here). Costs one
+# env read when the lever is unset; installs the recording factory
+# (analysis/compile_sentinel.py) when set.
+
+
+def _maybe_install_compile_sentinel() -> None:
+    from .utils import levers
+    if levers.get_bool("QUORUM_COMPILE_SENTINEL"):
+        from .analysis import compile_sentinel
+        compile_sentinel.install()
+
+
+_maybe_install_compile_sentinel()

@@ -20,13 +20,19 @@ thread-swallowed-exception silent push-daemon death (PR 10)
 lock-unguarded-write       serve snapshot races (PR 7)
 lock-order-inversion       + runtime twin in analysis/tsan.py
 unused-definition          refactor orphans
+trace-lever-read           trace-time state baked into executables
+trace-python-branch        TracerBoolConversion / silent recompiles
+jit-unbudgeted             COMPILE_BUDGET drift, both directions
+                           + runtime twin in compile_sentinel.py
+static-argnum-hazard       float/unhashable static args
 =========================  ============================================
 
-Import surface: `run_lint` for tests/tools, `tsan` for the runtime
-sanitizer, `cli.main` for the entry point.
+Import surface: `run_lint` for tests/tools, `tsan` /
+`compile_sentinel` for the runtime sanitizers, `cli.main` for the
+entry point.
 """
 
-from . import tsan  # noqa: F401
+from . import compile_sentinel, tsan  # noqa: F401
 from .core import Finding, Project, run_rules  # noqa: F401
 
 
@@ -34,6 +40,7 @@ def run_lint(root: str, rule_ids=None):
     """Lint the repo at `root` with the full rule set (or a subset);
     returns the surviving findings. The programmatic twin of the CLI
     used by tests and tools."""
-    from . import (rules_deadcode, rules_hotpath, rules_io,  # noqa: F401
-                   rules_locks, rules_registry, rules_threads)
+    from . import (rules_compile, rules_deadcode,  # noqa: F401
+                   rules_hotpath, rules_io, rules_locks,
+                   rules_registry, rules_threads)
     return run_rules(Project(root), rule_ids)

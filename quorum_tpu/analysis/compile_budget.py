@@ -1,0 +1,264 @@
+"""COMPILE_BUDGET: the declared executable-count catalog — every
+``jax.jit`` site in ``quorum_tpu/``, its entry point, and how many
+distinct executables it is allowed to compile (ISSUE 15).
+
+The compilation contracts used to live in docstrings: the serve
+engine promises at most one executable per distinct length bucket
+(serve/engine.py, "Compilation discipline"), the stage-2 extension
+loop one per lane-drain level (models/corrector.py), stage-1 insert
+one per (geometry, wire shape). This catalog is the machine-checked
+form, enforced in both directions like the lever catalog:
+
+* ``quorum-lint``'s ``jit-unbudgeted`` rule fails CI on any jit site
+  missing here, and on any entry whose site is gone;
+* the runtime compile sentinel (``QUORUM_COMPILE_SENTINEL=1``,
+  analysis/compile_sentinel.py) records every jit-cache miss against
+  these keys and fails the observing test when a site exceeds its
+  ``allow`` or compiles the same abstract signature twice without a
+  cache clear (``recreated`` sites — closures re-jitted per
+  build/mesh — are exempt from the duplicate check only);
+* ``quorum-lint --emit-docs`` renders :func:`render_docs` into the
+  README between the ``qlint:budget`` markers.
+
+Keys are ``<relpath>:<qualname>`` of the jitted function — stable
+across line churn. An opaque jit argument (a ``shard_map`` product)
+keys as ``<relpath>:<creating-fn>.<jit>``.
+
+``allow`` bounds DISTINCT abstract signatures per cache epoch (a
+``jax.clear_caches()`` starts a new epoch). The numbers were measured
+over the full tier-1 suite — the worst legitimate test-module epoch —
+then given ~2x headroom; production epochs (one process, one
+geometry) sit far below them. They are regression tripwires, not
+targets.
+"""
+
+from __future__ import annotations
+
+
+class Budget:
+    """One declared jit site: the catalog row."""
+
+    __slots__ = ("site", "entry", "per", "allow", "recreated")
+
+    def __init__(self, site: str, entry: str, per: str, allow: int,
+                 recreated: bool = False):
+        self.site = site
+        self.entry = entry
+        self.per = per
+        self.allow = int(allow)
+        self.recreated = recreated
+
+
+COMPILE_BUDGET: dict[str, Budget] = {}
+
+
+def _declare(site: str, entry: str, per: str, allow: int,
+             recreated: bool = False) -> None:
+    COMPILE_BUDGET[site] = Budget(site, entry, per, allow, recreated)
+
+
+# -- the catalog ----------------------------------------------------------
+# Grouped by module; keep each group alphabetical by qualname.
+
+# ops/ctable.py — flat-table (stage-1 v0) kernels
+_declare(
+    "quorum_tpu/ops/ctable.py:_bucket_rem_jit",
+    "ctable.bucket_rem", "geometry x key-batch shape", 48)
+_declare(
+    "quorum_tpu/ops/ctable.py:_build_round",
+    "ctable.insert_observations claim rounds",
+    "geometry x observation-batch shape", 64)
+_declare(
+    "quorum_tpu/ops/ctable.py:_finish_obs",
+    "ctable.insert_observations epilogue", "observation-batch shape",
+    24)
+_declare(
+    "quorum_tpu/ops/ctable.py:_grow_prep",
+    "ctable.grow re-insert walk", "geometry x chunk length", 24)
+_declare(
+    "quorum_tpu/ops/ctable.py:_prep_obs",
+    "ctable.insert_observations prologue", "observation-batch shape",
+    16)
+_declare(
+    "quorum_tpu/ops/ctable.py:extract_observations_impl",
+    "models/create_database.extract_observations (module-level jit "
+    "of the ctable kernel)", "k x read-batch shape", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:finalize_build",
+    "ctable.finalize_build", "geometry", 16)
+_declare(
+    "quorum_tpu/ops/ctable.py:lookup",
+    "ctable.lookup", "geometry x key-batch shape", 24)
+_declare(
+    "quorum_tpu/ops/ctable.py:table_stats",
+    "ctable.table_stats", "geometry", 8)
+
+# ops/ctable.py — tile-table (stage-1/2 production) kernels
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_compact_rounds",
+    "ctable.tile_insert retry path",
+    "geometry x batch shape x (rounds, cap)", 16)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_floor_jit",
+    "ctable.tile_floor (presence floor, ISSUE 14)",
+    "geometry x floor value", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_grow_prep",
+    "ctable.tile_grow re-insert walk", "geometry x chunk length", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_insert_fused",
+    "ctable.tile_insert (pre-extracted observations)",
+    "geometry x batch shape x (rounds, cap, agg_cap)", 24)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_insert_reads_fused",
+    "ctable.tile_insert_reads (unpacked read batch)",
+    "geometry x read-batch shape x lever caps", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_insert_reads_fused_packed",
+    "ctable.tile_insert_reads (packed wire, the hot stage-1 step)",
+    "geometry x wire shape x lever caps — the ONE per-batch stage-1 "
+    "executable", 24)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_parts_jit",
+    "ctable.tile_lookup_prepared / sketch gating / engine warmup",
+    "geometry x key-batch shape", 16)
+_declare(
+    "quorum_tpu/ops/ctable.py:_tile_round1",
+    "ctable.tile_insert first claim round",
+    "geometry x batch shape", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_compact_device",
+    "ctable.tile_compact_device (sharded export)",
+    "geometry x cap", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_departition_rows",
+    "ctable.tile_departition_rows (--partitions reassembly)",
+    "local geometry x (g, part)", 24)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_export_v4",
+    "io/db_format v4 export", "geometry x cap", 12)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_finalize",
+    "ctable.tile_finalize", "geometry", 12)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_lookup",
+    "ctable.tile_lookup (stage-2 count fetch)",
+    "geometry x key-batch shape", 32)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_rows_device_from_compact",
+    "ctable.tile_rows_device_from_compact (sharded import)",
+    "geometry x compact shape", 16)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_seal",
+    "ctable.tile_seal (build -> query handoff)", "geometry", 8)
+_declare(
+    "quorum_tpu/ops/ctable.py:tile_stats",
+    "ctable.tile_stats", "geometry", 8)
+
+# ops/sketch.py — count-min prefilter kernels (ISSUE 14)
+_declare(
+    "quorum_tpu/ops/sketch.py:_gated_insert_wire",
+    "sketch.gated_insert_wire (stage-2 of the two-pass prefilter / "
+    "khmer-style inline)", "sketch+table geometry x wire shape x "
+    "mode", 12)
+_declare(
+    "quorum_tpu/ops/sketch.py:_sketch_pass_wire",
+    "sketch.sketch_pass_wire (pass-1 count-min update)",
+    "sketch geometry x wire shape", 8)
+_declare(
+    "quorum_tpu/ops/sketch.py:singleton_entries",
+    "sketch.singleton_entries (prefilter audit)", "table geometry",
+    4)
+
+# models/corrector.py — the stage-2 device program
+_declare(
+    "quorum_tpu/models/corrector.py:_bwd_epilogue",
+    "corrector.correct_batch backward-pass merge",
+    "batch shape x uniform flag", 8)
+_declare(
+    "quorum_tpu/models/corrector.py:_correct_device",
+    "corrector.correct_batch (unpacked) — compiles one executable "
+    "per (geometry, batch shape, drain levels); the extension "
+    "loop's lane-drain levels are static by design",
+    "geometry x batch shape x static lever tuple", 32)
+_declare(
+    "quorum_tpu/models/corrector.py:_correct_device_packed",
+    "corrector.correct_batch_packed (the hot serve/offline step; "
+    "serve/engine.py promises at most ONE of these per length "
+    "bucket)", "geometry x wire shape x static lever tuple", 16)
+_declare(
+    "quorum_tpu/models/corrector.py:_pack_finish",
+    "corrector.fetch_finish (full-width result pack)",
+    "batch shape x width", 32)
+_declare(
+    "quorum_tpu/models/corrector.py:_pack_finish_lean",
+    "corrector.fetch_finish (event-driven lean pack)",
+    "batch shape x event cap", 8)
+_declare(
+    "quorum_tpu/models/corrector.py:_rc_prologue",
+    "corrector.correct_batch reverse-complement prologue",
+    "batch shape x uniform flag", 8)
+
+# parallel/tile_sharded.py — mesh closures, re-jitted per build/mesh
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:_try_place_all.<jit>",
+    "tile_sharded grow re-route placement", "mesh x overflow shape",
+    8, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:build_step.<locals>.step",
+    "tile_sharded.build_step (unpacked sharded insert)",
+    "mesh x geometry x batch shape", 24, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:"
+    "build_step_wire.<locals>.step",
+    "tile_sharded.build_step_wire (packed sharded insert — the hot "
+    "--devices N stage-1 step)", "mesh x geometry x wire shape", 8,
+    recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:"
+    "correct_step.<locals>.step",
+    "tile_sharded.correct_step (replicated-table stage 2)",
+    "mesh x geometry x batch shape", 8, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:"
+    "correct_step_routed.<locals>.step",
+    "tile_sharded.correct_step_routed (row-sharded stage 2)",
+    "mesh x geometry x batch shape", 8, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:"
+    "correct_step_wire.<locals>.step",
+    "tile_sharded.correct_step_wire (packed sharded stage 2)",
+    "mesh x geometry x wire shape", 8, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:finalize.<jit>",
+    "tile_sharded.finalize (per-shard counter fold)",
+    "mesh x geometry", 16, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:query_step.<locals>.step",
+    "tile_sharded.query_step (sharded lookup)",
+    "mesh x geometry x key-batch shape", 8, recreated=True)
+_declare(
+    "quorum_tpu/parallel/tile_sharded.py:"
+    "shard_occupancy.<locals>.occ",
+    "tile_sharded.shard_occupancy (load-balance telemetry)",
+    "mesh x geometry", 4, recreated=True)
+
+
+
+def names() -> list[str]:
+    return sorted(COMPILE_BUDGET)
+
+
+def render_docs() -> str:
+    """The README compile-budget table, generated from the catalog
+    (the `quorum-lint --emit-docs` payload)."""
+    lines = [
+        "| Site | Entry point | One executable per | Allowance |",
+        "|---|---|---|---|",
+    ]
+    for key in names():
+        b = COMPILE_BUDGET[key]
+        site = b.site.replace("quorum_tpu/", "")
+        allow = str(b.allow) + (" (re-jitted)" if b.recreated else "")
+        lines.append(f"| `{site}` | {b.entry} | {b.per} | {allow} |")
+    return "\n".join(lines) + "\n"

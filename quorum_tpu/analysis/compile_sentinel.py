@@ -1,0 +1,385 @@
+"""Runtime compile sentinel: the dynamic half of the trace-contract
+tier (ISSUE 15), opt-in via ``QUORUM_COMPILE_SENTINEL=1`` — the
+compile-count twin of the ``QUORUM_TSAN`` lock sanitizer.
+
+The static rules (rules_compile.py) prove every jit site is declared
+in the COMPILE_BUDGET catalog; this module proves the declared
+executable counts HOLD while code actually runs. :func:`install`
+replaces ``jax.jit`` with a recording factory: every jitted function
+whose target (or creation site) lives in ``quorum_tpu/`` is wrapped
+so a jit-cache miss — detected as growth of the function's own
+dispatch cache (``_cache_size``), which jax guarantees grows exactly
+once per distinct abstract signature — lands in a ledger with the
+site key, the abstract shapes, and the acquisition stack. Cache HITS
+cost one C++ attribute call; functions defined outside the package
+(tests, jax internals) are returned unwrapped, zero overhead.
+
+Each recorded compile is checked against the catalog:
+
+* an **unbudgeted** site compiling (a jit added without a catalog
+  entry — belt to the lint's suspenders, for jits constructed via
+  paths the AST can't see) is a violation;
+* a site exceeding its ``allow`` of distinct signatures within one
+  cache epoch (``jax.clear_caches`` starts a new epoch) is a
+  **budget overrun** — the "engine compiles once per length bucket"
+  class of regression;
+* the same ``(site, signature)`` compiling twice in one epoch is a
+  **duplicate compile** — the re-jit-per-call / blown-cache class —
+  unless the site is declared ``recreated`` (mesh closures that are
+  legitimately re-jitted per build).
+
+The conftest autouse gate (tests/conftest.py) fails the test during
+which a violation was first observed, stacks attached — which makes
+"a warm serve answers a second request with zero compiles" and "a
+resumed run re-pays exactly the compiles of its torn partitions"
+enforced invariants rather than docstring comments. Ledger totals
+export into every final metrics document (``compile_events`` counter,
+per-site ``compiles{site=...}`` counters, ``meta.compile_sites``) so
+``tools/perf_diff.py`` gates compile-count regressions against
+``PERF_BASELINE.json`` the same way it gates wall clock.
+
+Like the tsan twin: modules that bound the real ``jax.jit`` before
+:func:`install` keep it (partial coverage is the documented cost of a
+pure-Python sentinel), which is why ``quorum_tpu/__init__`` installs
+at package import when the lever is set — before any jit-bearing
+submodule is imported.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+import weakref
+
+_BOOK = threading.Lock()          # guards the ledger and epoch state
+_EVENTS: list[dict] = []          # every recorded compile, in order
+_VIOLATIONS: list[dict] = []
+_EPOCH = 0                        # budget epoch: _SITE_SIGS lifetime
+_CACHE_GEN = 0                    # bumped ONLY on a real cache clear
+_SITE_SIGS: dict[str, set] = {}   # per-epoch distinct signatures
+_SITE_TOTALS: dict[str, int] = {}  # process-lifetime compile counts
+_INSTANCES: weakref.WeakSet = weakref.WeakSet()  # live wrappers
+_INSTALLED = False
+_REAL_JIT = None
+_REAL_CLEAR = None
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _budget():
+    from .compile_budget import COMPILE_BUDGET
+    return COMPILE_BUDGET
+
+
+def _rel(path: str) -> str:
+    return "quorum_tpu/" + os.path.relpath(
+        path, _PKG_DIR).replace(os.sep, "/")
+
+
+def _site_for(fun, creation_stack) -> str | None:
+    """The ledger key for a jitted callable: ``<relpath>:<qualname>``
+    when the function's code lives in the package, else the first
+    package frame of the creation stack as ``<relpath>:<fn>.<jit>``
+    (shard_map products carry jax-internal code objects), else None —
+    an external jit the sentinel leaves untouched."""
+    code = getattr(fun, "__code__", None)
+    path = getattr(code, "co_filename", "")
+    if path.startswith(_PKG_DIR + os.sep):
+        return f"{_rel(path)}:{fun.__qualname__}"
+    for frame in creation_stack:
+        if frame.filename == _THIS_FILE:
+            continue
+        if frame.filename.startswith(_PKG_DIR + os.sep):
+            return f"{_rel(frame.filename)}:{frame.name}.<jit>"
+    return None
+
+
+def _describe_leaf(leaf) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        desc = f"{dtype}[{','.join(str(d) for d in shape)}]"
+        # the jit cache keys on more than (dtype, shape): a weakly
+        # typed scalar and a committed sharding each compile their
+        # own executable, so the ledger signature must carry them or
+        # legitimate recompiles read as duplicates
+        if getattr(leaf, "weak_type", False):
+            desc += "~"
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            desc += f"@{sharding}"
+            # an explicitly placed (committed) array and an
+            # uncommitted one with the same sharding are distinct
+            # cache entries — observed on the --devices N gather
+            # path, where the sharded build's device_put state
+            # re-pays the export executable
+            if getattr(leaf, "_committed", None) is False:
+                desc += "?"
+        return desc
+    if isinstance(leaf, (bool, int, float, str, bytes)) or leaf is None:
+        return f"{type(leaf).__name__}:{leaf!r}"[:48]
+    # a non-array leaf is a static argument (a frozen geometry/config
+    # dataclass): the jit cache keys on its VALUE (hash/eq), so the
+    # ledger signature must too — the repr carries the fields; long
+    # ones compress to a digest so distinct configs never collide on
+    # a truncation boundary
+    r = repr(leaf)
+    if len(r) > 120:
+        import hashlib
+        r = r[:80] + "#" + hashlib.sha1(r.encode()).hexdigest()[:12]
+    return f"{type(leaf).__name__}:{r}"
+
+
+def _signature(args, kwargs) -> tuple:
+    """Abstract shapes of one call: array leaves by (dtype, shape),
+    everything else (static args, config NamedTuples) by value repr —
+    the same facets the jit cache keys on, flattened."""
+    import jax
+    try:
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:  # noqa: BLE001 - exotic pytrees stay opaque
+        return ("<unflattenable>",)
+    return tuple(_describe_leaf(v) for v in leaves)
+
+
+class _SentinelJit:
+    """Transparent wrapper around one jitted function: delegates the
+    call, then compares the pjit dispatch-cache size against the last
+    observed value — growth is exactly the set of fresh executables
+    this call compiled."""
+
+    __slots__ = ("_inner", "_site", "_gen", "_size", "_lock",
+                 "__weakref__")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+        self._gen = _CACHE_GEN
+        self._size = 0
+        # per-instance floor updates are a read-modify-write;
+        # concurrent dispatches through ONE wrapper (serve handler vs
+        # watchdog warmup share the module-level jits) must not
+        # double-record a compile or misattribute one signature's
+        # compile to another's call
+        self._lock = threading.Lock()
+        _INSTANCES.add(self)
+
+    def __call__(self, *args, **kwargs):
+        try:
+            return self._inner(*args, **kwargs)
+        finally:
+            self._observe(args, kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _observe(self, args, kwargs) -> None:
+        try:
+            n = self._inner._cache_size()
+        except Exception:  # noqa: BLE001 - private API drift: degrade
+            return
+        with self._lock:
+            if self._gen != _CACHE_GEN:
+                # the real jit caches were cleared since our last
+                # look: restart the floor so post-clear compiles
+                # count fresh (a ledger reset() does NOT zero the
+                # floor — the warm cache is still warm, and a hit
+                # must not replay the prior cache size as phantom
+                # compiles)
+                self._gen = _CACHE_GEN
+                self._size = 0
+            if n <= self._size:
+                self._size = n  # hit (or concurrent clear): no event
+                return
+            count = n - self._size
+            self._size = n
+        _record(self._site, _signature(args, kwargs), count)
+
+    def _resync(self) -> None:
+        """Align the floor with the live cache (ledger reset): past
+        compiles are forgotten, not re-reported."""
+        try:
+            n = self._inner._cache_size()
+        except Exception:  # noqa: BLE001 - private API drift
+            return
+        with self._lock:
+            self._gen = _CACHE_GEN
+            self._size = n
+
+
+def _record(site: str, sig: tuple, count: int) -> None:
+    stack = "".join(traceback.format_stack(limit=14)[:-2])
+    budget = _budget().get(site)
+    with _BOOK:
+        _EVENTS.append({"site": site, "signature": sig,
+                        "count": count, "epoch": _EPOCH})
+        _SITE_TOTALS[site] = _SITE_TOTALS.get(site, 0) + count
+        if budget is None:
+            _VIOLATIONS.append({
+                "kind": "unbudgeted", "site": site, "signature": sig,
+                "stack": stack,
+                "detail": "site has no COMPILE_BUDGET entry"})
+            return
+        sigs = _SITE_SIGS.setdefault(site, set())
+        if sig in sigs:
+            if not budget.recreated:
+                _VIOLATIONS.append({
+                    "kind": "duplicate", "site": site,
+                    "signature": sig, "stack": stack,
+                    "detail": "identical abstract signature compiled "
+                              "twice in one cache epoch — the jit "
+                              "cache was bypassed or the function is "
+                              "re-jitted per call"})
+            return
+        sigs.add(sig)
+        if len(sigs) > budget.allow:
+            _VIOLATIONS.append({
+                "kind": "overrun", "site": site, "signature": sig,
+                "stack": stack,
+                "detail": f"{len(sigs)} distinct executables this "
+                          f"epoch exceeds the declared allowance of "
+                          f"{budget.allow} (one per {budget.per})"})
+
+
+def _sentinel_jit(fun=None, **kwargs):
+    """The replacement ``jax.jit``: wraps jits the PACKAGE creates
+    with a recording shim, hands everything else straight back. The
+    budget is about the package's own jit sites — a test ad-hoc
+    jitting a package helper is not a contract violation, so
+    attribution keys on who CALLED jax.jit (the creation frame), not
+    on where the function's code lives."""
+    if fun is None:
+        return lambda f: _sentinel_jit(f, **kwargs)
+    jitted = _REAL_JIT(fun, **kwargs)
+    stack = [f for f in reversed(traceback.extract_stack(limit=12))
+             if f.filename != _THIS_FILE]
+    if not (stack and stack[0].filename.startswith(
+            _PKG_DIR + os.sep)):
+        return jitted  # created outside quorum_tpu/: external
+    site = _site_for(fun, stack)
+    if site is None:
+        return jitted
+    return _SentinelJit(jitted, site)
+
+
+def _sentinel_clear_caches(*args, **kwargs):
+    global _CACHE_GEN
+    out = _REAL_CLEAR(*args, **kwargs)
+    with _BOOK:
+        _CACHE_GEN += 1  # instances re-floor at 0: caches ARE empty
+    new_epoch()
+    return out
+
+
+# -- public surface -------------------------------------------------------
+
+def install() -> None:
+    """Patch ``jax.jit`` (and ``jax.clear_caches``, which starts a
+    new budget epoch) with the recording factory. Must run before the
+    jit-bearing modules are imported — their module-level
+    ``functools.partial(jax.jit, ...)`` decorators bind whatever
+    ``jax.jit`` is at import time (quorum_tpu/__init__ does this when
+    the lever is set)."""
+    global _INSTALLED, _REAL_JIT, _REAL_CLEAR
+    if _INSTALLED:
+        return
+    import jax
+    _REAL_JIT = jax.jit
+    _REAL_CLEAR = jax.clear_caches
+    jax.jit = _sentinel_jit
+    jax.clear_caches = _sentinel_clear_caches
+    _INSTALLED = True
+
+
+def uninstall() -> None:
+    global _INSTALLED
+    if not _INSTALLED:
+        return
+    import jax
+    jax.jit = _REAL_JIT
+    jax.clear_caches = _REAL_CLEAR
+    _INSTALLED = False
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def enabled_by_env() -> bool:
+    from ..utils import levers
+    return levers.get_bool("QUORUM_COMPILE_SENTINEL")
+
+
+def new_epoch() -> None:
+    """Start a fresh budget epoch (the wrapped ``jax.clear_caches``
+    calls this): per-epoch signature sets reset, lifetime totals and
+    the ledger survive."""
+    global _EPOCH
+    with _BOOK:
+        _EPOCH += 1
+        _SITE_SIGS.clear()
+
+
+def events() -> list[dict]:
+    with _BOOK:
+        return list(_EVENTS)
+
+
+def violations() -> list[dict]:
+    with _BOOK:
+        return list(_VIOLATIONS)
+
+
+def site_totals() -> dict[str, int]:
+    """Process-lifetime compile count per site (ledger export)."""
+    with _BOOK:
+        return dict(_SITE_TOTALS)
+
+
+def reset() -> None:
+    """Forget everything (test isolation): ledger, violations,
+    totals, and the per-epoch sets. Live wrappers re-anchor their
+    floors to the CURRENT cache size — the jit caches are still
+    warm, so a post-reset cache hit must record nothing (a zeroed
+    floor would replay the whole prior cache as phantom events)."""
+    global _EPOCH
+    with _BOOK:
+        _EPOCH += 1
+        _SITE_SIGS.clear()
+        _EVENTS.clear()
+        _VIOLATIONS.clear()
+        _SITE_TOTALS.clear()
+    for inst in list(_INSTANCES):
+        inst._resync()
+
+
+def format_violation(v: dict) -> str:
+    sig = ", ".join(v["signature"][:8])
+    if len(v["signature"]) > 8:
+        sig += ", ..."
+    return (f"compile-budget violation [{v['kind']}] at {v['site']}: "
+            f"{v['detail']}\n    signature: ({sig})\n"
+            f"-- compiling call --\n{v['stack']}")
+
+
+def export(reg) -> None:
+    """Stamp the ledger into a metrics registry before its final
+    write: the ``compile_events`` total, one ``compiles{site=...}``
+    counter per site, and ``meta.compile_sites`` — the surface
+    ``tools/perf_diff.py`` gates against PERF_BASELINE.json. Counters
+    are set by delta so a second final write stays idempotent."""
+    if not getattr(reg, "enabled", False):
+        return
+    from ..telemetry.registry import labeled
+    totals = site_totals()
+    total = sum(totals.values())
+    c = reg.counter("compile_events")
+    if total > c.value:
+        c.inc(total - c.value)
+    for site, n in sorted(totals.items()):
+        sc = reg.counter(labeled("compiles", site=site))
+        if n > sc.value:
+            sc.inc(n - sc.value)
+    reg.set_meta(compile_sentinel=1, compile_sites=totals)

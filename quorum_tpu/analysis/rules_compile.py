@@ -1,0 +1,555 @@
+"""Trace-contract rules: enforce the JAX compilation boundary
+statically (ISSUE 15).
+
+The repo's throughput story rests on a compilation contract that was,
+until this tier, stated only in docstrings: the serve engine promises
+"at most one executable per distinct length bucket"
+(serve/engine.py, "Compilation discipline"), the extension loop
+compiles one executable per drain level (models/corrector.py), and
+stage-1 insert one per (geometry, shape). Nothing caught a recompile
+regression except latency on hardware CI doesn't have, and nothing
+caught trace-time hazards until they silently doubled compile counts.
+These rules make the contract lexical; the runtime twin
+(analysis/compile_sentinel.py) makes it observable.
+
+Four rules over every ``jax.jit`` site in ``quorum_tpu/``:
+
+* ``trace-lever-read`` — a ``levers.raw``/``levers.get_bool``, env
+  read, or ``global`` statement inside a jitted body runs at TRACE
+  time: the value is baked into the executable, so flipping the lever
+  later silently steers nothing (and un-keyed trace state is how
+  compile counts double). Resolution belongs in the host wrapper,
+  passed in as a static argument.
+* ``trace-python-branch`` — an ``if``/``while`` (or ternary) on a
+  traced-array-derived name inside a jitted body: either a
+  ``TracerBoolConversionError`` at first trace or, via
+  ``static_argnums`` promotion, a fresh executable per distinct
+  value. Structural tests (``is None``, ``isinstance``, ``.shape``/
+  ``.ndim``/``len()``) are static and exempt.
+* ``jit-unbudgeted`` — every jit site must be declared in the
+  ``COMPILE_BUDGET`` catalog (analysis/compile_budget.py) with its
+  entry point and allowed executable count, checked in BOTH
+  directions like the lever catalog: an undeclared site bypasses the
+  budget, a stale declaration means the table lies.
+* ``static-argnum-hazard`` — a static argument that is a ``float``
+  (cache fragments on bit-identical noise: 0.1 vs 0.1000001 is two
+  executables) or unhashable (``TypeError`` at call time), or a
+  ``static_argnums`` index out of range.
+
+Site keys are ``<relpath>:<qualname>`` — stable across line-number
+churn. A ``jax.jit(expr)`` whose argument is not a local function or
+lambda (e.g. a ``shard_map`` product) keys as
+``<relpath>:<enclosing-fn>.<jit>``, matching what the runtime
+sentinel derives from the creation stack.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted, rule, walk_functions
+
+_JIT_NAMES = ("jax.jit", "jit")
+_PARTIAL_NAMES = ("functools.partial", "partial")
+
+# attribute reads on a traced value that are static at trace time
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+# calls whose result over a traced value is a static python value
+_STATIC_CALLS = ("len", "isinstance", "type", "id")
+
+_UNHASHABLE_ANNOS = ("list", "dict", "set", "bytearray",
+                     "np.ndarray", "numpy.ndarray", "jnp.ndarray",
+                     "jax.Array")
+
+
+def _parse_static(kw_nodes) -> tuple[list[int], list[str]]:
+    """(static_argnums, static_argnames) literals from jit keywords;
+    non-literal specs come back empty (nothing to check)."""
+    nums: list[int] = []
+    names: list[str] = []
+    for k in kw_nodes:
+        if k.arg == "static_argnums":
+            for n in ast.walk(k.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                        n.value, int):
+                    nums.append(n.value)
+        elif k.arg == "static_argnames":
+            for n in ast.walk(k.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str):
+                    names.append(n.value)
+    return nums, names
+
+
+def _jit_decorator(dec) -> tuple[bool, list, int]:
+    """(is_jit, keyword_nodes, lineno) for one decorator node —
+    handles ``@jax.jit``, ``@jax.jit(...)`` and
+    ``@functools.partial(jax.jit, ...)``."""
+    if dotted(dec) in _JIT_NAMES:
+        return True, [], dec.lineno
+    if isinstance(dec, ast.Call):
+        f = dotted(dec.func)
+        if f in _JIT_NAMES:
+            return True, dec.keywords, dec.lineno
+        if f in _PARTIAL_NAMES and dec.args and \
+                dotted(dec.args[0]) in _JIT_NAMES:
+            return True, dec.keywords, dec.lineno
+    return False, [], 0
+
+
+class JitSite:
+    """One discovered jit site: where, what function body it traces
+    (None when the argument is an opaque expression), and which
+    parameter names are static."""
+
+    __slots__ = ("rel", "line", "key", "qual", "fn", "static_nums",
+                 "static_names")
+
+    def __init__(self, rel, line, key, qual, fn, static_nums,
+                 static_names):
+        self.rel = rel
+        self.line = line
+        self.key = key
+        self.qual = qual
+        self.fn = fn
+        self.static_nums = static_nums
+        self.static_names = static_names
+
+    def params(self) -> list[str]:
+        if self.fn is None:
+            return []
+        return [a.arg for a in self.fn.args.args]
+
+    def traced_params(self) -> set[str]:
+        """Parameter names whose values are tracers inside the body
+        (everything not promoted static)."""
+        ps = self.params()
+        static = {ps[i] for i in self.static_nums if 0 <= i < len(ps)}
+        static.update(self.static_names)
+        return {p for p in ps if p not in static}
+
+
+def _enclosing_map(tree):
+    """node-id -> (qualname, bare function name) of the innermost
+    enclosing function, for attributing call-form jit sites."""
+    encl: dict[int, tuple[str, str]] = {}
+
+    def visit(node, qual, name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cq = (qual + child.name) if qual else child.name
+                for n in ast.walk(child):
+                    encl.setdefault(id(n), (cq, child.name))
+                visit(child, cq + ".<locals>.", child.name)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, (qual or "") + child.name + ".", name)
+            else:
+                visit(child, qual, name)
+
+    visit(tree, "", "<module>")
+    return encl
+
+
+def jit_sites(src, global_defs: dict | None = None) -> list[JitSite]:
+    """Every jit site in one SourceFile, keyed the way the runtime
+    sentinel keys its ledger. `global_defs` (name -> (rel, qual,
+    fn-node)) resolves re-exported callables jitted away from their
+    defining module — the sentinel keys those on the def's file, so
+    the static key must too."""
+    if src.tree is None:
+        return []
+    sites: list[JitSite] = []
+    defs = list(walk_functions(src.tree))
+    decorated_ids = set()
+
+    for fn, qual in defs:
+        for dec in fn.decorator_list:
+            is_jit, kws, line = _jit_decorator(dec)
+            if not is_jit:
+                continue
+            nums, names = _parse_static(kws)
+            sites.append(JitSite(src.rel, fn.lineno,
+                                 f"{src.rel}:{qual}", qual, fn,
+                                 nums, names))
+            decorated_ids.add(id(fn))
+
+    encl = _enclosing_map(src.tree)
+    by_name: dict[str, list[tuple[ast.AST, str]]] = {}
+    for fn, qual in defs:
+        by_name.setdefault(fn.name, []).append((fn, qual))
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call) or \
+                dotted(node.func) not in _JIT_NAMES:
+            continue
+        nums, names = _parse_static(node.keywords)
+        encl_qual, encl_name = encl.get(id(node), ("", "<module>"))
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Lambda):
+            prefix = f"{encl_qual}.<locals>." if encl_qual else ""
+            qual = f"{prefix}<lambda>"
+            sites.append(JitSite(src.rel, node.lineno,
+                                 f"{src.rel}:{qual}", qual, arg,
+                                 nums, names))
+            continue
+        if isinstance(arg, ast.Name):
+            cands = by_name.get(arg.id, [])
+            # nearest scope first: a def local to the enclosing
+            # function, else a module-level def of that name
+            local = [(f, q) for f, q in cands
+                     if encl_qual and q.startswith(
+                         encl_qual + ".<locals>.")]
+            pick = local or [(f, q) for f, q in cands
+                             if "." not in q]
+            if pick:
+                fn, qual = pick[0]
+                if id(fn) in decorated_ids:
+                    continue  # jit-of-already-jitted: one site
+                sites.append(JitSite(src.rel, node.lineno,
+                                     f"{src.rel}:{qual}", qual, fn,
+                                     nums, names))
+                continue
+            hit = (global_defs or {}).get(arg.id)
+            if hit is not None:
+                def_rel, def_qual, def_fn = hit
+                sites.append(JitSite(src.rel, node.lineno,
+                                     f"{def_rel}:{def_qual}",
+                                     def_qual, def_fn, nums, names))
+                continue
+        # opaque argument (shard_map product, imported callable):
+        # key on the creating function, like the runtime sentinel
+        qual = f"{encl_name}.<jit>"
+        sites.append(JitSite(src.rel, node.lineno,
+                             f"{src.rel}:{qual}", qual, None,
+                             nums, names))
+    return sites
+
+
+def project_jit_sites(project) -> list[JitSite]:
+    # pure function of the loaded sources, asked for by all four
+    # rules — computed once per Project
+    cached = getattr(project, "_jit_sites_cache", None)
+    if cached is not None:
+        return cached
+    # module-level defs across the package, for re-exported callables
+    # jitted away from home; ambiguous names stay unresolved (the
+    # opaque fallback keys on the creating function instead)
+    global_defs: dict[str, tuple | None] = {}
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        for fn, qual in walk_functions(src.tree):
+            if "." in qual:
+                continue
+            if fn.name in global_defs:
+                global_defs[fn.name] = None  # ambiguous
+            else:
+                global_defs[fn.name] = (src.rel, qual, fn)
+    global_defs = {k: v for k, v in global_defs.items()
+                   if v is not None}
+    sites = []
+    for src in project.package_files():
+        sites.extend(jit_sites(src, global_defs))
+    project._jit_sites_cache = sites
+    return sites
+
+
+def _budget_catalog() -> dict:
+    from .compile_budget import COMPILE_BUDGET
+    return COMPILE_BUDGET
+
+
+# -- trace-lever-read ------------------------------------------------------
+
+_LEVER_CALLS = ("levers.raw", "levers.get_bool")
+_ENV_CALLS = ("os.environ.get", "os.getenv", "environ.get",
+              "getenv")
+
+
+@rule("trace-lever-read",
+      "lever/env read or `global` inside a jitted body (trace-time "
+      "state baked into the executable)")
+def trace_lever_read(project):
+    findings = []
+    for site in project_jit_sites(project):
+        if site.fn is None:
+            continue
+        for node in ast.walk(site.fn):
+            if isinstance(node, ast.Call):
+                fname = call_name(node)
+                if fname in _LEVER_CALLS or fname in _ENV_CALLS:
+                    findings.append(Finding(
+                        "trace-lever-read", site.rel, node.lineno,
+                        f"{fname}(...) inside jitted {site.qual} runs "
+                        "at TRACE time — the value is baked into the "
+                        "executable and later env changes silently "
+                        "steer nothing",
+                        "resolve the lever in the host wrapper and "
+                        "pass the value in as a static argument"))
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, ast.Load):
+                if dotted(node.value) in ("os.environ", "environ"):
+                    findings.append(Finding(
+                        "trace-lever-read", site.rel, node.lineno,
+                        f"os.environ[...] inside jitted {site.qual} "
+                        "is a trace-time read baked into the "
+                        "executable",
+                        "resolve at wrapper level, pass as a static "
+                        "argument"))
+            elif isinstance(node, ast.Global):
+                findings.append(Finding(
+                    "trace-lever-read", site.rel, node.lineno,
+                    f"`global {', '.join(node.names)}` inside jitted "
+                    f"{site.qual}: mutable-global state read at trace "
+                    "time is invisible to the jit cache key",
+                    "thread the value through the call signature "
+                    "(static if it selects code paths)"))
+    return findings
+
+
+# -- trace-python-branch ---------------------------------------------------
+
+def _tainted_name(expr: ast.AST, traced: set[str]) -> str | None:
+    """The first traced Name referenced in a tracer-value-bearing
+    position inside `expr`, or None. Static projections are exempt:
+    `.shape`/`.ndim`/`.dtype`/`.size`, `len()`/`isinstance()`, and
+    `is`/`is not` comparisons (all resolve to python values at trace
+    time)."""
+    parent: dict[int, ast.AST] = {}
+    for node in ast.walk(expr):
+        for child in ast.iter_child_nodes(node):
+            parent.setdefault(id(child), node)
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in traced):
+            continue
+        cur, exempt = node, False
+        while True:
+            p = parent.get(id(cur))
+            if p is None:
+                break
+            if isinstance(p, ast.Attribute) and \
+                    p.attr in _STATIC_ATTRS:
+                exempt = True
+                break
+            if isinstance(p, ast.Call) and cur is not p.func and \
+                    call_name(p) in _STATIC_CALLS:
+                exempt = True
+                break
+            if isinstance(p, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in p.ops):
+                exempt = True
+                break
+            cur = p
+        if not exempt:
+            return node.id
+    return None
+
+
+def _assign_targets(node) -> list[str]:
+    names = []
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for tgt in targets:
+        for leaf in ast.walk(tgt):
+            if isinstance(leaf, ast.Name):
+                names.append(leaf.id)
+    return names
+
+
+def _scan_branches(fn, traced: set[str], rel: str, qual: str,
+                   findings: list) -> None:
+    """Taint-propagate assignments then flag if/while/ternary tests
+    on traced names, recursing into nested defs with their parameters
+    shadowed out."""
+    traced = set(traced)
+    own: list[ast.stmt] = []
+    nested: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn)) if not isinstance(
+        fn, ast.Lambda) else [fn.body]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.append(node)
+            continue
+        own.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+
+    assigns = [n for n in own
+               if isinstance(n, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign))]
+    for _ in range(len(assigns) + 1):
+        grew = False
+        for a in assigns:
+            if a.value is None:
+                continue
+            if _tainted_name(a.value, traced):
+                for t in _assign_targets(a):
+                    if t not in traced:
+                        traced.add(t)
+                        grew = True
+        if not grew:
+            break
+
+    for node in own:
+        test = None
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+        if test is None:
+            continue
+        name = _tainted_name(test, traced)
+        if name is not None:
+            kind = ("while" if isinstance(node, ast.While) else "if")
+            findings.append(Finding(
+                "trace-python-branch", rel, node.lineno,
+                f"python `{kind}` on traced value {name!r} inside "
+                f"jitted {qual}: TracerBoolConversionError at trace "
+                "time, or one fresh executable per distinct value if "
+                "promoted static",
+                "use lax.cond/jnp.where for data-dependent control "
+                "flow, or hoist the decision to the host wrapper as "
+                "a static argument"))
+
+    for sub in nested:
+        params = {a.arg for a in sub.args.args}
+        params.update(a.arg for a in sub.args.kwonlyargs)
+        sub_qual = qual + ".<locals>." + getattr(sub, "name",
+                                                 "<lambda>")
+        _scan_branches(sub, traced - params, rel, sub_qual, findings)
+
+
+@rule("trace-python-branch",
+      "python if/while on a traced-array-derived name inside a "
+      "jitted body")
+def trace_python_branch(project):
+    findings: list[Finding] = []
+    for site in project_jit_sites(project):
+        if site.fn is None:
+            continue
+        _scan_branches(site.fn, site.traced_params(), site.rel,
+                       site.qual, findings)
+    return findings
+
+
+# -- jit-unbudgeted --------------------------------------------------------
+
+_BUDGET_MODULE = "quorum_tpu/analysis/compile_budget.py"
+
+
+@rule("jit-unbudgeted",
+      "jax.jit site missing from COMPILE_BUDGET (or a stale budget "
+      "entry with no live site)")
+def jit_unbudgeted(project):
+    budget = _budget_catalog()
+    findings = []
+    live_keys: set[str] = set()
+    for site in project_jit_sites(project):
+        live_keys.add(site.key)
+        if site.key in budget:
+            continue
+        findings.append(Finding(
+            "jit-unbudgeted", site.rel, site.line,
+            f"jit site {site.key!r} is not declared in the "
+            "COMPILE_BUDGET catalog — its executable count is "
+            "invisible to the compile sentinel and the README table",
+            "declare it (entry point, compile unit, allowed "
+            "executables) in quorum_tpu/analysis/compile_budget.py"))
+    budget_src = project.get(_BUDGET_MODULE)
+    for key in sorted(budget):
+        if key in live_keys:
+            continue
+        line = 1
+        if budget_src is not None:
+            # the key renders as "<file>.py:<qual>" — find the qual
+            # fragment (declarations split the string across lines)
+            frag = key.rsplit(":", 1)[1]
+            for i, text in enumerate(budget_src.lines, 1):
+                if f'"{frag}"' in text or f"{frag}\"" in text:
+                    line = i
+                    break
+        findings.append(Finding(
+            "jit-unbudgeted", _BUDGET_MODULE, line,
+            f"COMPILE_BUDGET declares {key!r} but no live jax.jit "
+            "site matches — the published budget table lies",
+            "remove the stale entry or restore the jit site"))
+    return findings
+
+
+# -- static-argnum-hazard --------------------------------------------------
+
+def _anno_name(node) -> str:
+    if node is None:
+        return ""
+    return dotted(node) if isinstance(
+        node, (ast.Name, ast.Attribute)) else ""
+
+
+@rule("static-argnum-hazard",
+      "float or unhashable static jit argument (cache fragmentation "
+      "/ TypeError)")
+def static_argnum_hazard(project):
+    findings = []
+    for site in project_jit_sites(project):
+        if site.fn is None or isinstance(site.fn, ast.Lambda):
+            if site.static_nums or site.static_names:
+                # nothing to inspect: statics on an opaque callable
+                # can't be validated — that itself is the hazard
+                if site.fn is None:
+                    findings.append(Finding(
+                        "static-argnum-hazard", site.rel, site.line,
+                        f"static arguments on opaque jit site "
+                        f"{site.key!r} cannot be checked against a "
+                        "signature",
+                        "jit a named local function instead"))
+            continue
+        args = site.fn.args
+        params = args.args
+        defaults = list(args.defaults)
+        # right-align defaults onto the positional params
+        dmap: dict[str, ast.AST] = {}
+        for p, d in zip(params[len(params) - len(defaults):],
+                        defaults):
+            dmap[p.arg] = d
+        for i in site.static_nums:
+            if i >= len(params) and not args.vararg:
+                findings.append(Finding(
+                    "static-argnum-hazard", site.rel, site.line,
+                    f"static_argnums index {i} is out of range for "
+                    f"jitted {site.qual} ({len(params)} positional "
+                    "parameter(s))",
+                    "fix the index list — a misaligned static "
+                    "promotes the wrong argument"))
+        static_params = [params[i] for i in site.static_nums
+                         if 0 <= i < len(params)]
+        static_params += [p for p in params
+                          if p.arg in site.static_names]
+        for p in static_params:
+            anno = _anno_name(p.annotation)
+            default = dmap.get(p.arg)
+            if anno == "float" or (isinstance(default, ast.Constant)
+                                   and isinstance(default.value,
+                                                  float)):
+                findings.append(Finding(
+                    "static-argnum-hazard", site.rel, p.lineno,
+                    f"float static argument {p.arg!r} on jitted "
+                    f"{site.qual}: the jit cache keys on exact bits, "
+                    "so near-equal floats compile fresh executables",
+                    "quantize to an int/bool at the wrapper, or make "
+                    "the value traced"))
+            elif anno in _UNHASHABLE_ANNOS or isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    "static-argnum-hazard", site.rel, p.lineno,
+                    f"unhashable static argument {p.arg!r} "
+                    f"({anno or 'mutable default'}) on jitted "
+                    f"{site.qual}: TypeError at the first call",
+                    "pass a hashable (tuple/NamedTuple) or make the "
+                    "argument traced"))
+    return findings

@@ -9,11 +9,15 @@ idle split (PR 10), it just makes the run slower and the telemetry
 wrong. PERF_NOTES round 6 measured exactly this shape binding
 multi-device scaling before the host pipeline was sharded.
 
-``hot-path-sync`` scans the dispatch regions of the four device-loop
-modules (models/create_database.py, models/error_correct.py,
-ops/ctable.py, serve/engine.py). A *dispatch region* is the body of
-any function that calls ``observe_dispatch_wait`` or dispatches under
-``tracer.step(...)``. Inside it, these force or risk a host sync:
+``hot-path-sync`` scans every package module for dispatch regions —
+the scope is DERIVED, not declared: a *dispatch region* is the body
+of any function that calls ``observe_dispatch_wait`` or dispatches
+under ``tracer.step(...)``, wherever it lives. (The rule used to
+scan a hardcoded 4-tuple of modules, which is how the PR-13
+``ops/sketch.py`` sketch loop and the ``parallel/tile_sharded.py``
+shard-step loop went unscanned until ISSUE 15: a new dispatch loop
+joined the perf contract without joining the lint's scope.) Inside a
+region, these force or risk a host sync:
 
 * ``jax.block_until_ready`` / ``jax.device_get`` / ``.item()``
 * ``np.asarray(x)`` and ``bool/int/float(x)`` where ``x`` is a name
@@ -38,12 +42,30 @@ import ast
 
 from .core import Finding, call_name, rule, walk_functions
 
-SCOPE = (
-    "quorum_tpu/models/create_database.py",
-    "quorum_tpu/models/error_correct.py",
-    "quorum_tpu/ops/ctable.py",
-    "quorum_tpu/serve/engine.py",
-)
+
+def scope(project) -> list[str]:
+    """The modules the rule scans: every package file whose AST
+    carries a dispatch-region signal (an ``observe_dispatch_wait``
+    call or a ``with tracer.step(...)`` block). Derived per run so a
+    new dispatch loop is in scope the commit it appears."""
+    rels = []
+    for src in project.package_files():
+        if src.tree is None:
+            continue
+        has_signal = any(
+            (isinstance(n, ast.Call)
+             and call_name(n).endswith("observe_dispatch_wait"))
+            for n in ast.walk(src.tree)) or any(
+            isinstance(n, ast.With) and any(
+                isinstance(item.context_expr, ast.Call)
+                and call_name(item.context_expr).endswith(
+                    "tracer.step")
+                for item in n.items)
+            for n in ast.walk(src.tree))
+        if has_signal:
+            rels.append(src.rel)
+    return sorted(rels)
+
 
 _ALWAYS_SYNC = ("jax.block_until_ready", "block_until_ready",
                 "jax.device_get", "device_get")
@@ -147,7 +169,7 @@ def _find_regions(tree: ast.Module):
       "host sync in a per-batch dispatch loop outside a timer section")
 def hot_path_sync(project):
     findings = []
-    for rel in SCOPE:
+    for rel in scope(project):
         src = project.get(rel)
         if src is None or src.tree is None:
             continue

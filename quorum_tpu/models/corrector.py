@@ -1817,7 +1817,18 @@ def _correct_device(state, tmeta, codes, quals, lengths, cfg: ECConfig,
     """The whole device-side correction of one batch as ONE executable:
     position sweep, anchor scan, rc prologue, event planes, the merged
     extension loop, and the backward epilogue (separate dispatches cost
-    ~25 ms each through the tunnel; see PERF_NOTES.md)."""
+    ~25 ms each through the tunnel; see PERF_NOTES.md).
+
+    The levers arrive RESOLVED (`compact_sweep`, `drain_levels`) as
+    static arguments — the wrappers call the `*_default()` resolvers
+    at dispatch time, so the executable count is one per (geometry,
+    batch shape, lever tuple) and flipping a lever re-keys instead of
+    silently serving a stale trace. That discipline is now enforced:
+    quorum-lint's `trace-lever-read` rejects a resolver call from
+    inside any jitted body, and this site's executable count is
+    budgeted in analysis/compile_budget.COMPILE_BUDGET with the
+    runtime sentinel (`QUORUM_COMPILE_SENTINEL=1`) counting the
+    compiles that actually happen (ISSUE 15)."""
     codes = codes.astype(jnp.int32)
     quals = quals.astype(jnp.int32)
     return _correct_core(state, tmeta, codes, quals, lengths, cfg,

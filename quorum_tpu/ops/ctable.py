@@ -1290,7 +1290,13 @@ def _tile_insert_reads_fused_packed(bstate: TBuildState, meta: TileMeta,
     elementwise [B, L] work at the head of the same executable; the
     synthetic qual plane is bit-equivalent under
     extract_observations_impl's only quality use, the < qual_thresh
-    reset predicate."""
+    reset predicate.
+
+    This is THE per-batch stage-1 executable: one compile per
+    (geometry, wire shape, lever caps), declared in
+    analysis/compile_budget.COMPILE_BUDGET and counted at runtime by
+    the compile sentinel — the golden build compiles it exactly once
+    (PERF_BASELINE.json pins `compiles{site=...}` to 1)."""
     pcodes, nmask, hq, lengths = mer.wire_parts_device(
         wire, b, length, thresholds)
     codes = mer.unpack_codes_device(pcodes, nmask, lengths, length)

@@ -338,7 +338,16 @@ def build_step(mesh: Mesh, meta: TileShardedMeta, qual_thresh: int,
     `shard_inserts` counts the observations each shard accepted this
     step (telemetry). The exact-once grow-retry contract is
     `pending & ~placed` either way (same as the single-chip
-    tile_insert_observations)."""
+    tile_insert_observations).
+
+    Compile accounting (ISSUE 15): the returned `step` is a closure
+    re-jitted per (mesh, geometry) build — its COMPILE_BUDGET entry
+    (and this module's other `.<locals>.step` sites) is declared
+    `recreated`, so the sentinel exempts the identical-signature
+    re-pay while still capping distinct executables per epoch. Call
+    build_step ONCE per build, not per batch — a per-batch call
+    compiles a fresh executable every step and the sentinel's
+    allowance is sized to catch exactly that."""
     S = meta.n_shards
 
     def fn(tag, hq, lq, codes_i8, quals_u8, pending):

@@ -19,6 +19,15 @@ executable per distinct length bucket it ever sees — the
 `engine_compiles` counter is the acceptance signal that a warm server
 answers a second request without recompilation. `warmup()` pays those
 compiles before the first request arrives.
+
+Since ISSUE 15 this contract is ENFORCED, not narrated: the device
+step's executable is budgeted in
+`analysis/compile_budget.COMPILE_BUDGET`
+(`models/corrector.py:_correct_device_packed`), and under
+``QUORUM_COMPILE_SENTINEL=1`` (CI tier-1) every jit-cache miss is
+ledgered — a warm request that compiles fails the observing test
+with the dispatching stack attached, and the serve metrics document
+carries the per-site compile counts for the perf_diff gate.
 """
 
 from __future__ import annotations
@@ -323,13 +332,16 @@ def representative_read(state, meta, length: int,
     best = int(np.argmax(vals >> 1))
     seq = mer.unpack_kmer(int(khi[best]), int(klo[best]), k)
 
-    key_parts = jax.jit(
-        lambda h, l: ctable.tile_key_parts(h, l, meta))
-
     def count(chi: int, clo: int) -> int:
         # one jitted key-parts dispatch + one 512 B row fetch; the
-        # entry-layout match itself lives in ctable.tile_row_lookup
-        addr, rlo, rhi = jax.device_get(key_parts(
+        # entry-layout match itself lives in ctable.tile_row_lookup.
+        # Reuses the module-level _tile_parts_jit executable (meta
+        # static) instead of re-jitting a per-call lambda — watchdog
+        # rebuilds and /reload warmups hit the warm cache instead of
+        # churning one fresh executable per representative_read
+        # (COMPILE_BUDGET, ISSUE 15)
+        addr, rlo, rhi, _p0 = jax.device_get(ctable._tile_parts_jit(
+            meta,
             jnp.asarray([np.uint32(chi)]), jnp.asarray([np.uint32(clo)])))
         return ctable.tile_row_lookup(
             np.asarray(rows[int(addr[0])]), meta, rlo[0], rhi[0]) >> 1

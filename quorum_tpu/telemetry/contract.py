@@ -109,6 +109,16 @@ PREFILTER_COUNTERS = ("prefilter_dropped_total",
 PARTITION_COUNTERS = ("partition_passes_total",)
 PARTITION_GAUGE_PREFIX = "partition_distinct{partition="
 
+# The compile-sentinel surface (ISSUE 15): a document whose meta
+# declares `compile_sentinel` was produced under
+# QUORUM_COMPILE_SENTINEL=1 and must carry the ledger export — the
+# total compile counter plus the per-site map (the per-site
+# `compiles{site="..."}` labeled counters ride along but are not
+# individually required: the set of sites a run touches is workload-
+# shaped).
+COMPILE_COUNTERS = ("compile_events",)
+COMPILE_META = ("compile_sites",)
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -133,6 +143,7 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(DEVTRACE_COUNTERS)
     names.update(PUSH_COUNTERS)
     names.update(ALERT_COUNTERS)
+    names.update(COMPILE_COUNTERS)
     names.update(SHARD_REQUIRED_COUNTERS)
     names.update(PREFILTER_COUNTERS)
     names.update(PARTITION_COUNTERS)

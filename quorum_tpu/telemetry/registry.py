@@ -304,6 +304,15 @@ class MetricsRegistry:
         exporters their final refresh, and close the event sink.
         Returns the path written (None for an exposition-only
         registry, which still flushes exporters and events)."""
+        # compile-sentinel ledger export (ISSUE 15): a run under
+        # QUORUM_COMPILE_SENTINEL=1 stamps its per-site compile
+        # counts into the final document (compile_events counter,
+        # compiles{site=...} counters, meta.compile_sites) so
+        # tools/perf_diff.py gates compile-count regressions like
+        # wall clock. One installed() check when the sentinel is off.
+        from ..analysis import compile_sentinel
+        if compile_sentinel.installed():
+            compile_sentinel.export(self)
         self._notify_exporters(final=True)
         path = path or self.path
         doc = None

@@ -144,6 +144,19 @@ SITES: dict[str, str] = {
                         "carries path=",
 }
 
+def render_docs() -> str:
+    """The README fault-site table, generated from SITES (the
+    `quorum-lint --emit-docs` payload — same contract as the lever
+    table: edit the catalog, not the README)."""
+    lines = [
+        "| Site | Where it fires |",
+        "|---|---|",
+    ]
+    for name in sorted(SITES):
+        lines.append(f"| `{name}` | {SITES[name]} |")
+    return "\n".join(lines) + "\n"
+
+
 _ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt")
 
 _CORRUPT_MODES = ("flip", "zero")

@@ -84,6 +84,12 @@ _declare(
     "Force the stage-2 compacted sibling sweep on (1) or off (0); "
     "unset = autotune profile, else ON on accelerators only.")
 _declare(
+    "QUORUM_COMPILE_SENTINEL", "bool", "0",
+    "Opt-in runtime compile sentinel: wraps jax.jit to ledger every "
+    "jit-cache miss against the COMPILE_BUDGET catalog and fail the "
+    "observing test on an overrun or unbudgeted compile "
+    "(analysis/compile_sentinel.py; on in CI tier-1).")
+_declare(
     "QUORUM_DRAIN_LEVELS", "int", "(backend/profile)",
     "Stage-2 extension-loop lane-drain re-compaction levels (0-2); "
     "unset = autotune profile, else backend-keyed default.")

@@ -45,6 +45,17 @@ import pytest  # noqa: E402
 # interleaving those paths deadlock) FAILS the test that observed it,
 # with both acquisition stacks. Installed before test modules import
 # the serve/telemetry stack so their locks are all wrapped.
+#
+# The compile sentinel (ISSUE 15, QUORUM_COMPILE_SENTINEL=1 — also on
+# in ci/tier1.sh) rides the same import point: importing quorum_tpu
+# here, BEFORE any test module imports the jit-bearing submodules,
+# lets the package __init__ wrap jax.jit so every module-level
+# `functools.partial(jax.jit, ...)` decorator binds the recording
+# factory. Every jit-cache miss is ledgered against the
+# COMPILE_BUDGET catalog (analysis/compile_budget.py); the autouse
+# gate below fails the test that observed an overrun, a duplicate
+# compile, or an unbudgeted site.
+from quorum_tpu.analysis import compile_sentinel as _csent  # noqa: E402
 from quorum_tpu.analysis import tsan as _tsan  # noqa: E402
 
 if _tsan.enabled_by_env():
@@ -67,6 +78,26 @@ def _tsan_inversion_gate():
         pytest.fail("QUORUM_TSAN observed lock-order inversion(s):\n"
                     + "\n".join(_tsan.format_violation(v)
                                 for v in fresh))
+
+
+@pytest.fixture(autouse=True)
+def _compile_budget_gate():
+    """Fail the test during which the compile sentinel first observed
+    a budget violation (QUORUM_COMPILE_SENTINEL=1 runs only): a site
+    exceeding its declared executable allowance, an identical
+    signature compiled twice in one cache epoch, or an unbudgeted
+    jit compiling. The acquisition stack in the report points at the
+    dispatching code."""
+    if not _csent.installed():
+        yield
+        return
+    before = len(_csent.violations())
+    yield
+    fresh = _csent.violations()[before:]
+    if fresh:
+        pytest.fail(
+            "QUORUM_COMPILE_SENTINEL observed budget violation(s):\n"
+            + "\n".join(_csent.format_violation(v) for v in fresh))
 
 
 _last_module = [None]

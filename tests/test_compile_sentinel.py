@@ -1,0 +1,268 @@
+"""Compile-sentinel tests (ISSUE 15): the runtime half of the
+trace-contract tier.
+
+Unit level: the jit wrapper counts cache MISSES only (hits and
+re-entrant calls are free), the budget checks fire with the
+acquisition stack attached (overrun, duplicate-signature,
+unbudgeted), `jax.clear_caches` starts a fresh epoch, and jits
+created OUTSIDE the package come back unwrapped. System level: a
+warm CorrectionEngine answers a second request with zero ledgered
+compiles, and the seeded regression — dropping the bucket from
+warmup — demonstrably shows up as a request-phase compile.
+
+Deliberate violations are made against a monkeypatched budget and
+always reset, so they never leak into the conftest autouse gate.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quorum_tpu.analysis import compile_budget, compile_sentinel as cs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+READS = os.path.join(REPO, "tests", "golden", "reads.fastq")
+
+# a real budgeted key to ledger synthetic events against
+SITE = "quorum_tpu/ops/ctable.py:lookup"
+
+
+@pytest.fixture
+def sentinel(monkeypatch):
+    """Install (if not already via QUORUM_COMPILE_SENTINEL=1) and
+    always reset afterwards so deliberate violations never reach the
+    conftest gate. Budget edits go through monkeypatch on a copied
+    catalog."""
+    was_installed = cs.installed()
+    cs.install()
+    fake = {k: compile_budget.Budget(v.site, v.entry, v.per, v.allow,
+                                     v.recreated)
+            for k, v in compile_budget.COMPILE_BUDGET.items()}
+    monkeypatch.setattr(compile_budget, "COMPILE_BUDGET", fake)
+    try:
+        yield fake
+    finally:
+        cs.reset()
+        if not was_installed:
+            cs.uninstall()
+
+
+def _wrapped(fun, site=SITE, **jit_kw):
+    """A _SentinelJit around a real jitted function, pinned to a
+    budgeted site — the factory's attribution path is exercised by
+    the whole suite running under the sentinel; these tests pin the
+    site so the budget semantics are deterministic."""
+    return cs._SentinelJit(jax.jit(fun, **jit_kw), site)
+
+
+# -- miss/hit counting ----------------------------------------------------
+
+def test_cache_miss_counted_hit_free(sentinel):
+    f = _wrapped(lambda x: x + 1)
+    before = len(cs.events())
+    f(jnp.ones(3))
+    assert len(cs.events()) == before + 1
+    f(jnp.ones(3))                       # cached: no event
+    f(jnp.ones(3))
+    assert len(cs.events()) == before + 1
+    f(jnp.ones(4))                       # new shape: one event
+    events = cs.events()
+    assert len(events) == before + 2
+    assert events[-1]["site"] == SITE
+    assert any("float32[4]" in leaf for leaf in events[-1]["signature"])
+
+
+def test_reentrant_nested_trace_not_double_counted(sentinel):
+    inner = _wrapped(lambda x: x * 2)
+    outer = _wrapped(lambda x: inner(x) + 1,
+                     site="quorum_tpu/ops/ctable.py:tile_lookup")
+    before = len(cs.events())
+    outer(jnp.ones(5))
+    # the inner jit traced under the outer is INLINED into the outer
+    # executable — one ledger event, which is one real executable
+    assert len(cs.events()) == before + 1
+    assert cs.events()[-1]["site"].endswith("tile_lookup")
+    outer(jnp.ones(5))
+    assert len(cs.events()) == before + 1
+    # a later CONCRETE call of the inner compiles its own standalone
+    # executable: second event, at the inner site
+    inner(jnp.ones(5))
+    assert len(cs.events()) == before + 2
+    assert cs.events()[-1]["site"] == SITE
+
+
+def test_clear_caches_starts_new_epoch_no_duplicate(sentinel):
+    f = _wrapped(lambda x: x - 1)
+    f(jnp.ones(2))
+    jax.clear_caches()
+    f(jnp.ones(2))  # legitimate re-pay: new epoch, not a duplicate
+    assert [v for v in cs.violations() if v["kind"] == "duplicate"] \
+        == []
+
+
+def test_reset_resyncs_warm_wrappers(sentinel):
+    # a ledger reset() forgets history but the jit caches stay warm:
+    # a post-reset cache HIT must not replay the wrapper's prior
+    # cache size as phantom compile events (it did, before the floors
+    # were re-anchored on reset — every later test's warm calls
+    # inflated compile_events)
+    f = _wrapped(lambda x: x + 1)
+    for n in (1, 2, 3):
+        f(jnp.ones(n))
+    cs.reset()
+    assert cs.events() == []
+    f(jnp.ones(2))                        # warm hit: nothing to report
+    assert cs.events() == []
+    f(jnp.ones(9))                        # genuinely new: one event
+    assert [e["count"] for e in cs.events()] == [1]
+
+
+def test_external_jit_left_unwrapped(sentinel):
+    # a jit created from test code (outside quorum_tpu/) must come
+    # back raw: the budget is about the package's own sites
+    f = jax.jit(lambda x: x + 1)
+    assert not isinstance(f, cs._SentinelJit)
+    before = len(cs.events())
+    f(jnp.ones(3))
+    assert len(cs.events()) == before
+
+
+def test_wrapper_delegates_attributes(sentinel):
+    def plus(x):
+        return x + 1
+    f = _wrapped(plus)
+    assert f.__wrapped__ is plus  # jax.jit exposes the target
+    f(jnp.ones(2))
+    assert f._cache_size() >= 1
+
+
+# -- budget checks --------------------------------------------------------
+
+def test_budget_overrun_fails_with_stack(sentinel):
+    sentinel[SITE].allow = 2
+    f = _wrapped(lambda x: x + 1)
+    before = len(cs.violations())
+    for n in (1, 2, 3):
+        f(jnp.ones(n))
+    fresh = [v for v in cs.violations()[before:]
+             if v["kind"] == "overrun"]
+    assert len(fresh) == 1
+    v = fresh[0]
+    assert v["site"] == SITE
+    assert "allowance of 2" in v["detail"]
+    report = cs.format_violation(v)
+    assert "test_compile_sentinel" in v["stack"]
+    assert "overrun" in report and SITE in report
+
+
+def test_duplicate_compile_detected_unless_recreated(sentinel):
+    before = len(cs.violations())
+    # two instances of the same non-recreated site compiling the same
+    # signature: the re-jit-per-call bug class
+    _wrapped(lambda x: x + 1)(jnp.ones(3))
+    _wrapped(lambda x: x + 1)(jnp.ones(3))
+    dups = [v for v in cs.violations()[before:]
+            if v["kind"] == "duplicate"]
+    assert len(dups) == 1 and dups[0]["site"] == SITE
+    # the same shape at a `recreated` site is the documented pattern
+    sentinel[SITE].recreated = True
+    before = len(cs.violations())
+    _wrapped(lambda x: x + 2)(jnp.ones(3))
+    _wrapped(lambda x: x + 2)(jnp.ones(3))
+    assert [v for v in cs.violations()[before:]
+            if v["kind"] == "duplicate"] == []
+
+
+def test_unbudgeted_site_is_violation(sentinel):
+    ghost = "quorum_tpu/ops/ctable.py:ghost_kernel"
+    before = len(cs.violations())
+    _wrapped(lambda x: x * 3, site=ghost)(jnp.ones(2))
+    fresh = [v for v in cs.violations()[before:]
+             if v["kind"] == "unbudgeted"]
+    assert len(fresh) == 1 and fresh[0]["site"] == ghost
+
+
+# -- ledger export --------------------------------------------------------
+
+def test_export_stamps_registry(sentinel, tmp_path):
+    import json
+
+    from quorum_tpu.telemetry.registry import MetricsRegistry
+    _wrapped(lambda x: x + 7)(jnp.ones(9))
+    path = str(tmp_path / "m.json")
+    reg = MetricsRegistry(path)
+    reg.write()
+    doc = json.load(open(path))
+    assert doc["counters"]["compile_events"] >= 1
+    assert doc["meta"]["compile_sentinel"] == 1
+    assert SITE in doc["meta"]["compile_sites"]
+    labeled = [k for k in doc["counters"] if k.startswith("compiles{")]
+    assert any(SITE in k for k in labeled)
+    # idempotent: a second final write must not double the counters
+    total = doc["counters"]["compile_events"]
+    reg.write()
+    doc2 = json.load(open(path))
+    assert doc2["counters"]["compile_events"] == total
+
+
+# -- the engine contract: warm serve compiles zero ------------------------
+
+@pytest.fixture(scope="module")
+def warm_engine(tmp_path_factory):
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.serve.engine import CorrectionEngine
+    db = str(tmp_path_factory.mktemp("cs_db") / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, READS])
+    assert rc == 0
+    return CorrectionEngine(db, cutoff=4, rows=16)
+
+
+def _request(engine, length=100):
+    seq = b"ACGT" * (length // 4)
+    return engine.step([("r", seq, b"I" * len(seq))])
+
+
+def test_warm_serve_second_request_zero_compiles(warm_engine):
+    """The engine docstring's promise, enforced: after warmup pays
+    the length bucket, a request and a SECOND request ledger zero
+    compiles (and grow zero engine shapes). Under
+    QUORUM_COMPILE_SENTINEL=1 the ledger assertion is exact; in a
+    plain run the shape-set half still gates."""
+    warm_engine.warmup([100])
+    _request(warm_engine)                    # first real request
+    ledger = len(cs.events()) if cs.installed() else None
+    shapes = warm_engine.compiles
+    _request(warm_engine)                    # THE warm request
+    assert warm_engine.compiles == shapes
+    if ledger is not None:
+        fresh = cs.events()[ledger:]
+        assert fresh == [], (
+            "warm serve request compiled: "
+            + ", ".join(e["site"] for e in fresh))
+
+
+def test_dropped_warmup_bucket_shows_as_request_compile(warm_engine):
+    """The seeded regression of the acceptance criteria: a length
+    bucket the warmup never paid compiles during the REQUEST instead
+    — visible to the sentinel ledger (and the shape set), which is
+    exactly what the conftest gate would flag on a budget breach."""
+    shapes = warm_engine.compiles
+    ledger = len(cs.events()) if cs.installed() else None
+    # 256 maps to a bucket warmup([100]) never touched
+    _request(warm_engine, length=256)
+    assert warm_engine.compiles == shapes + 1
+    if ledger is not None:
+        assert len(cs.events()) > ledger, (
+            "sentinel missed the unwarmed-bucket compile")
+
+
+def test_lever_declared():
+    from quorum_tpu.utils import levers
+    assert "QUORUM_COMPILE_SENTINEL" in levers.CATALOG
+    assert cs.enabled_by_env() == (
+        os.environ.get("QUORUM_COMPILE_SENTINEL", "")
+        .strip().lower() not in ("", "0", "false", "no"))

@@ -342,6 +342,193 @@ class Batcher:
     assert lint(good, "lock-order-inversion") == []
 
 
+JITTY_LEVER_BAD = '''\
+import functools
+import jax
+from .utils import levers
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel(x, cap):
+    if levers.get_bool("QUORUM_TPU_VERBOSE"):
+        return x
+    return x + cap
+'''
+
+JITTY_LEVER_GOOD = '''\
+import functools
+import jax
+from .utils import levers
+
+def kernel(x):
+    verbose = levers.get_bool("QUORUM_TPU_VERBOSE")
+    return _kernel_jit(x, verbose)
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _kernel_jit(x, verbose):
+    return x + (1 if verbose else 0)
+'''
+
+
+def test_trace_lever_read_seeded_and_clean(tmp_path):
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py": JITTY_LEVER_BAD,
+        "quorum_tpu/good.py": JITTY_LEVER_GOOD,
+    })
+    found = lint(root, "trace-lever-read")
+    assert [f.path for f in found] == ["quorum_tpu/bad.py"]
+    assert "TRACE time" in found[0].message
+
+
+def test_trace_lever_read_env_and_global(tmp_path):
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py":
+            'import jax\n'
+            'import os\n'
+            '_MODE = "fast"\n'
+            '@jax.jit\n'
+            'def kernel(x):\n'
+            '    global _MODE\n'
+            '    if os.environ.get("QUORUM_TPU_VERBOSE"):\n'
+            '        return x\n'
+            '    return x\n',
+    })
+    found = lint(root, "trace-lever-read")
+    assert len(found) == 2  # the env read and the global statement
+    assert all(f.path == "quorum_tpu/bad.py" for f in found)
+
+
+BRANCHY_BAD = '''\
+import jax
+
+@jax.jit
+def kernel(x):
+    total = x.sum()
+    if total > 0:
+        return x
+    return -x
+'''
+
+BRANCHY_GOOD = '''\
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel(x, mode, contam=None):
+    if mode == "fast":          # static arg: fine
+        return x
+    if contam is None:          # structural: fine
+        return x * 2
+    if x.shape[0] > 8:          # shape is static at trace time
+        return x * 3
+    if len(x) > 4:              # len() is static too
+        return x * 4
+    return jnp.where(x.sum() > 0, x, -x)
+'''
+
+
+def test_trace_python_branch_seeded_and_clean(tmp_path):
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py": BRANCHY_BAD,
+        "quorum_tpu/good.py": BRANCHY_GOOD,
+    })
+    found = lint(root, "trace-python-branch")
+    assert [f.path for f in found] == ["quorum_tpu/bad.py"]
+    assert "'total'" in found[0].message
+    assert "lax.cond" in found[0].hint
+
+
+def test_trace_python_branch_while_and_nested(tmp_path):
+    # taint flows through assignments and into nested closures; a
+    # nested def's own parameters shadow the traced names
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py":
+            'import jax\n'
+            '@jax.jit\n'
+            'def kernel(x):\n'
+            '    n = x[0]\n'
+            '    while n > 0:\n'
+            '        n = n - 1\n'
+            '    return n\n',
+        "quorum_tpu/good.py":
+            'import jax\n'
+            '@jax.jit\n'
+            'def kernel(x):\n'
+            '    def body(n):\n'
+            '        return n - 1   # n is the lax-body param\n'
+            '    return jax.lax.while_loop(lambda n: n > 0, body,\n'
+            '                              x[0])\n',
+    })
+    found = lint(root, "trace-python-branch")
+    assert [f.path for f in found] == ["quorum_tpu/bad.py"]
+    assert "while" in found[0].message
+
+
+def test_jit_unbudgeted_seeded(tmp_path):
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py":
+            'import jax\n'
+            '@jax.jit\n'
+            'def mystery_kernel(x):\n'
+            '    return x\n',
+    })
+    found = lint(root, "jit-unbudgeted")
+    mine = [f for f in found if f.path == "quorum_tpu/bad.py"]
+    assert len(mine) == 1
+    assert "mystery_kernel" in mine[0].message
+    assert "COMPILE_BUDGET" in mine[0].message
+
+
+def test_jit_unbudgeted_stale_entry_via_monkeypatch(monkeypatch):
+    from quorum_tpu.analysis import compile_budget
+    fake = dict(compile_budget.COMPILE_BUDGET)
+    ghost = "quorum_tpu/ops/ctable.py:qlint_test_ghost_kernel"
+    fake[ghost] = compile_budget.Budget(
+        ghost, "nothing", "nothing", 1)
+    monkeypatch.setattr(compile_budget, "COMPILE_BUDGET", fake)
+    found = run_lint(REPO, ["jit-unbudgeted"])
+    assert [ghost in f.message for f in found] == [True]
+    assert found[0].path == "quorum_tpu/analysis/compile_budget.py"
+
+
+STATIC_BAD = '''\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 9))
+def kernel(x, threshold: float, opts: list, y=None):
+    return x
+'''
+
+STATIC_GOOD = '''\
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def kernel(x, rounds: int, caps: tuple):
+    return x
+'''
+
+
+def test_static_argnum_hazard_seeded_and_clean(tmp_path):
+    root = make_repo(tmp_path, {
+        "quorum_tpu/bad.py": STATIC_BAD,
+        "quorum_tpu/good.py": STATIC_GOOD,
+    })
+    found = lint(root, "static-argnum-hazard")
+    assert {f.path for f in found} == {"quorum_tpu/bad.py"}
+    msgs = " | ".join(f.message for f in found)
+    assert "float static argument 'threshold'" in msgs
+    assert "unhashable static argument 'opts'" in msgs
+    assert "index 9 is out of range" in msgs
+
+
+def test_budget_catalog_matches_repo_sites():
+    """The acceptance shape of the tentpole: the catalog and the live
+    jit sites agree in both directions on the tree that ships."""
+    assert run_lint(REPO, ["jit-unbudgeted"]) == []
+
+
 def test_unused_definition_seeded_and_clean(tmp_path):
     root = make_repo(tmp_path, {
         "quorum_tpu/mod.py":
@@ -416,22 +603,43 @@ def test_cli_baseline_and_strict(tmp_path, capsys):
 
 # -- --emit-docs round trip ------------------------------------------------
 
+ALL_REGIONS_README = (
+    "# t\n\n<!-- qlint:levers -->\nstale\n<!-- /qlint:levers -->\n"
+    "mid\n<!-- qlint:faults -->\nstale2\n<!-- /qlint:faults -->\n"
+    "mid2\n<!-- qlint:budget -->\nstale3\n<!-- /qlint:budget -->\n"
+    "tail\n")
+
+
 def test_emit_docs_round_trip(tmp_path, capsys):
     root = make_repo(tmp_path, {
         "quorum_tpu/clean.py": "x = 1\n",
-        "README.md":
-            "# t\n\n<!-- qlint:levers -->\nstale\n"
-            "<!-- /qlint:levers -->\ntail\n",
+        "README.md": ALL_REGIONS_README,
     })
     assert qlint_main(["--root", root, "--check-docs"]) == 1
     assert qlint_main(["--root", root, "--emit-docs"]) == 0
     text = (tmp_path / "README.md").read_text()
-    assert "QUORUM_TPU_VERBOSE" in text and "stale" not in text
+    # all three catalogs rendered, all stale payloads replaced
+    assert "QUORUM_TPU_VERBOSE" in text      # levers table
+    assert "serve.engine.step" in text       # fault-site table
+    assert "_correct_device_packed" in text  # compile-budget table
+    assert "stale" not in text
     assert text.endswith("tail\n")
     assert qlint_main(["--root", root, "--check-docs"]) == 0
     # idempotent: emitting again changes nothing
     assert qlint_main(["--root", root, "--emit-docs"]) == 0
     assert (tmp_path / "README.md").read_text() == text
+
+
+def test_emit_docs_missing_region_is_loud(tmp_path, capsys):
+    # a README carrying only the levers markers cannot silently pass:
+    # every generated table must have a home (rc 2 names the tag)
+    root = make_repo(tmp_path, {
+        "quorum_tpu/clean.py": "x = 1\n",
+        "README.md": "x\n<!-- qlint:levers -->\n"
+                     "<!-- /qlint:levers -->\n",
+    })
+    assert qlint_main(["--root", root, "--emit-docs"]) == 2
+    assert "qlint:faults" in capsys.readouterr().err
 
 
 # -- the acceptance gate: the REPO ITSELF is clean ------------------------

@@ -73,6 +73,8 @@ from quorum_tpu.telemetry.export import lint_prometheus_text  # noqa: E402
 from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     ALERT_COUNTERS,
     ALERT_GAUGES,
+    COMPILE_COUNTERS,
+    COMPILE_META,
     DEVTRACE_COUNTERS,
     DEVTRACE_GAUGES,
     DEVTRACE_HISTOGRAMS,
@@ -264,6 +266,27 @@ def _check_push_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_compile_names(doc: dict) -> list[str]:
+    """Compile-sentinel requirements (ISSUE 15): dispatch on
+    meta.compile_sentinel — a run under QUORUM_COMPILE_SENTINEL=1
+    exports its jit-compile ledger at final write, so a missing
+    counter means the export regressed and the perf_diff compile
+    gate went quietly vacuous."""
+    meta = doc.get("meta", {})
+    if not meta.get("compile_sentinel"):
+        return []
+    errs = []
+    why = f"meta.compile_sentinel={meta.get('compile_sentinel')!r}"
+    for name in COMPILE_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter {name!r}")
+    for name in COMPILE_META:
+        if not isinstance(meta.get(name), dict):
+            errs.append(f"document with {why} missing (or non-map) "
+                        f"meta.{name}")
+    return errs
+
+
 def _check_fleet_doc(doc: dict) -> list[str]:
     """Fleet-document requirements (tools/push_receiver.py): a
     document stamped meta.fleet must carry the per-host shards under
@@ -382,6 +405,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_fleet_doc(doc)
         problems = problems + _check_alert_names(doc)
         problems = problems + _check_autotune_meta(doc)
+        problems = problems + _check_compile_names(doc)
     return problems
 
 

@@ -72,6 +72,7 @@ _PERF_SHAPES = (
     "timers.*.total_seconds", "timers.*.stages.*.seconds",
     "gauges.*_seconds", "gauges.*gb_per_h*",
     "counters.*_us_total",
+    "counters.compile_events", "counters.compiles{site=*",
     "histograms.*_us.sum", "histograms.*_us.mean",
     "bench.*.speedup*", "bench.*.value", "bench.*_ms",
     "bench.*.base_ms", "bench.*.workers_ms",
@@ -342,6 +343,14 @@ _GEN_RULES: list[tuple[str, dict]] = [
     # devtrace totals: present and nonzero (the device did the work)
     ("counters.device_kernel_us_total", {"min": 1.0, "max_ratio": 8.0}),
     ("counters.device_step_us_total", {"min": 1.0, "max_ratio": 8.0}),
+    # compile-sentinel ledger (ISSUE 15): compile counts are
+    # DETERMINISTIC for a fixed workload, so the bounds stay tight —
+    # a recompile regression is a wrong count, not noise; optional
+    # because plain (sentinel-off) runs don't carry the export
+    ("counters.compile_events",
+     {"min": 1.0, "max_ratio": 1.5, "optional": True}),
+    ("counters.compiles{site=*",
+     {"min": 1.0, "max_ratio": 1.0, "optional": True}),
     # dispatch/wait split histograms: time-like
     ("histograms.*_us.mean", {"max_ratio": 8.0, "optional": True}),
 ]
