@@ -91,6 +91,16 @@
 # gate judges against PERF_BASELINE.json — a recompile regression
 # fails CI like a throughput cliff does.
 #
+# ISSUE 16 adds the flight-recorder gate: tools/flight_smoke.py — a
+# clean golden build must produce ZERO black-box dumps
+# (flight_dumps_total 0, no *.flight.json sibling) while a build
+# killed by a seeded `error` at stage1.insert must leave exactly one
+# sealed dump that metrics_check accepts, whose ring pinpoints the
+# fault site, rendered by trace_summary --flight, and collected by
+# quorum-debug-bundle into a valid postmortem tarball; the recorder's
+# overhead rides the perf-diff gate as an A/B ratio (recorder on vs
+# QUORUM_FLIGHT=0) bounded absolutely in PERF_BASELINE.json.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -99,6 +109,7 @@
 #        SKIP_CHAOS_SOAK=1    skips the serve-resilience chaos gate.
 #        SKIP_FSCK_SMOKE=1    skips the data-integrity fsck gate.
 #        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push/alert gate.
+#        SKIP_FLIGHT_SMOKE=1  skips the flight-recorder gate.
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
@@ -357,6 +368,25 @@ else
     fi
 fi
 
+flight_rc=0
+if [ "${SKIP_FLIGHT_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: flight smoke skipped (SKIP_FLIGHT_SMOKE=1)"
+else
+    # the flight-recorder gate (ISSUE 16): zero dumps on a clean run,
+    # one sealed pinpointing dump on a seeded stage1.insert crash,
+    # bundle round trip; the overhead A/B line feeds perf-diff below
+    echo "== flight-recorder smoke =="
+    FLIGHT_DIR=$(mktemp -d /tmp/flight_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "$FLIGHT_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/flight_smoke.py \
+        --out-dir "$FLIGHT_DIR" || flight_rc=$?
+    if [ "$flight_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: flight-recorder gate FAILED (rc=$flight_rc)" >&2
+    fi
+fi
+
 perf_rc=0
 if [ "${SKIP_PERF_DIFF:-0}" = "1" ]; then
     echo "ci/tier1.sh: perf-diff gate skipped (SKIP_PERF_DIFF=1)"
@@ -372,11 +402,20 @@ else
     # silently vanished metric fails CI like a wrong byte does
     echo "== perf-diff gate =="
     PERF_DIR=$(mktemp -d /tmp/perf_diff.XXXXXX)
-    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "$PERF_DIR"' EXIT
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "${FLIGHT_DIR:-}" "$PERF_DIR"' EXIT
+    # the flight overhead A/B (ISSUE 16) rides along when its smoke
+    # ran: the baseline's `flight` doc entry is optional, so a
+    # SKIP_FLIGHT_SMOKE run still gets a verdict (unquoted on
+    # purpose: empty expands to no arg)
+    flight_doc=""
+    if [ -f "${FLIGHT_DIR:-/nonexistent}/flight_ab.json" ]; then
+        flight_doc="flight=$FLIGHT_DIR/flight_ab.json"
+    fi
     env JAX_PLATFORMS=cpu python tools/perf_diff.py \
         --baseline PERF_BASELINE.json \
         bench_ab="$AB_DIR/bench_ab.json" \
         stage1="$TEL_DIR/telemetry_metrics.json" \
+        $flight_doc \
         --out "$PERF_DIR/perf_verdict.json" -q || perf_rc=$?
     if [ -f "$PERF_DIR/perf_verdict.json" ]; then
         env JAX_PLATFORMS=cpu python tools/metrics_check.py \
@@ -396,5 +435,6 @@ if [ "$bench_rc" -ne 0 ]; then exit "$bench_rc"; fi
 if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
 if [ "$fsck_rc" -ne 0 ]; then exit "$fsck_rc"; fi
 if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
+if [ "$flight_rc" -ne 0 ]; then exit "$flight_rc"; fi
 if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -44,6 +44,7 @@ SCOPE = (
     "quorum_tpu/telemetry/export.py",
     "quorum_tpu/telemetry/alerts.py",
     "quorum_tpu/telemetry/spans.py",
+    "quorum_tpu/telemetry/flight.py",
     "quorum_tpu/telemetry/registry.py",
     "quorum_tpu/utils/faults.py",
     "quorum_tpu/ops/tuning.py",
@@ -59,6 +60,12 @@ LOCK_ORDER = (
     "alerts.AlertEngine._lock",
     "export._LIVE_LOCK",
     "spans.SpanTracer._lock",
+    # the flight ring: its taps run at the TOP of event()/_record(),
+    # OUTSIDE the registry/tracer locks, and dump() (which reads the
+    # registry under its lock) is reached from alert evaluation
+    # holding alerts._lock — so the ring ranks between the feeders
+    # above it and the registry below it
+    "flight.FlightRecorder._lock",
     "registry.MetricsRegistry._lock",
     "faults.FaultPlan._lock",
     "tuning._lock",

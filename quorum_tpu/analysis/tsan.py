@@ -50,6 +50,21 @@ _INSTALLED = False
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
 _TLS = threading.local()
+# flight-recorder tap (ISSUE 16): when a FlightRecorder is installed
+# under QUORUM_TSAN=1, every non-reentrant acquisition's construction
+# site feeds its ring — the lock-acquisition timeline of a wedged run
+# lands in the postmortem dump. One global read per acquire when off.
+_FLIGHT_HOOK = None
+
+
+def set_flight_hook(fn):
+    """Install (or clear, fn=None) the per-acquisition flight tap.
+    Returns the previous hook so nested observability sessions can
+    restore it."""
+    global _FLIGHT_HOOK
+    prev = _FLIGHT_HOOK
+    _FLIGHT_HOOK = fn
+    return prev
 
 
 def _held() -> list:
@@ -176,6 +191,12 @@ class _SanitizedLock:
                         })
                     _EDGES.setdefault(edge, here)
         stack.append((self, site))
+        hook = _FLIGHT_HOOK
+        if hook is not None:
+            try:
+                hook(site)
+            except Exception:  # noqa: BLE001 - the tap never breaks locking
+                pass
 
     def _record_release(self) -> None:
         stack = _held()

@@ -1,0 +1,240 @@
+"""quorum-debug-bundle — one-command postmortem collection
+(ISSUE 16).
+
+A wedged or dead run leaves its evidence scattered: the flight-
+recorder dump next to the metrics document, the events/span JSONL
+streams, the database the run was built against, the environment that
+steered it. Attaching them to a bug report one-by-one loses half of
+it. This tool collects everything into ONE tarball with a typed,
+digest-stamped manifest (schema ``quorum-tpu-debug-bundle/1``,
+telemetry/schema.validate_debug_bundle_manifest):
+
+* every ARTIFACT path given — flight dumps, metrics JSON, events or
+  span JSONL, Chrome traces — classified by content and validated
+  through the shared schema validators (the manifest records each
+  file's problem count, so a truncated artifact is flagged at
+  collection time, not discovered on the other machine);
+* ``--db`` paths get a ``quorum-fsck`` verdict (the full checksum
+  walk), captured as ``fsck.txt`` with its exit status in the
+  manifest;
+* a generated ``config.json``: resolved ``QUORUM_*`` lever values
+  (value vs catalog default), argv, cwd, and the Python version —
+  the environment HALF of a postmortem that the artifacts alone
+  cannot carry.
+
+The manifest itself is sealed (io/integrity crc32c) and every entry
+carries the file's own crc32c, so a bundle shipped across machines
+self-describes what made it in and whether it survived the trip.
+``tools/metrics_check.py`` accepts the manifest (and the flight dump
+inside) by schema dispatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import tarfile
+import time
+
+from ..io import integrity
+from ..telemetry import schema as schema_mod
+from ..utils import levers
+
+
+def _classify(path: str) -> tuple[str, int]:
+    """(kind, problem count) for one artifact, using the same
+    content dispatch tools/metrics_check.py uses — so the manifest's
+    `problems` field means exactly what the CI gate would say."""
+    try:
+        with open(path, encoding="utf-8", errors="strict") as f:
+            text = f.read()
+    except (OSError, UnicodeDecodeError):
+        return "other", 0
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    kind = "other"
+    if isinstance(doc, dict):
+        s = doc.get("schema")
+        if s == schema_mod.FLIGHT_SCHEMA:
+            kind = "flight"
+        elif "traceEvents" in doc:
+            kind = "trace"
+        elif "counters" in doc or s == schema_mod.SCHEMA_VERSION:
+            kind = "metrics"
+    else:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "span" in obj:
+                kind = "spans"
+            elif isinstance(obj, dict) and "event" in obj:
+                kind = "events"
+            break
+    if kind == "other":
+        return kind, 0
+    return kind, len(schema_mod.check_file(path))
+
+
+def _fsck_verdict(paths: list[str]) -> tuple[str, int]:
+    """Run quorum-fsck in-process over `paths`, capturing its full
+    per-section report (stdout + stderr interleaved) and exit
+    status."""
+    from . import fsck as fsck_mod
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(buf):
+        try:
+            rc = fsck_mod.main(list(paths))
+        except Exception as e:  # noqa: BLE001 - verdict, not crash
+            print(f"quorum-fsck crashed: {e!r}", file=buf)
+            rc = 2
+    return buf.getvalue(), rc
+
+
+def _config_doc() -> dict:
+    """The environment half of the postmortem: every declared lever's
+    resolved value next to its catalog default, plus the collection
+    context."""
+    vals = {}
+    for name in levers.names():
+        lv = levers.CATALOG[name]
+        vals[name] = {"value": levers.raw(name),
+                      "default": lv.default, "type": lv.type}
+    return {
+        "argv": list(sys.argv),
+        "cwd": os.getcwd(),
+        "python": sys.version,
+        "collected_unix_s": int(time.time()),
+        "levers": vals,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="quorum-debug-bundle",
+        description="Collect flight dumps, metrics/events/span "
+                    "artifacts, quorum-fsck verdicts, and the "
+                    "resolved configuration into one postmortem "
+                    "tarball with a sealed, typed manifest "
+                    "(quorum-tpu-debug-bundle/1)")
+    p.add_argument("paths", nargs="*", metavar="ARTIFACT",
+                   help="Artifacts to collect: flight dumps "
+                        "(*.flight.json), metrics JSON, events/span "
+                        "JSONL, Chrome traces — classified by "
+                        "content and validated at collection time")
+    p.add_argument("--db", action="append", default=[],
+                   metavar="PATH",
+                   help="Database file / checkpoint directory / "
+                        ".resume.json journal to run quorum-fsck "
+                        "on; the verdict text lands in the bundle "
+                        "as fsck.txt (repeatable)")
+    p.add_argument("--out", default="quorum-debug-bundle.tar.gz",
+                   metavar="TARBALL",
+                   help="Output tarball path (default "
+                        "%(default)s)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Suppress per-file collection lines")
+    args = p.parse_args(argv)
+    if not args.paths and not args.db:
+        p.error("nothing to collect: give at least one ARTIFACT "
+                "or --db PATH")
+
+    files: list[dict] = []
+    payload: list[tuple[str, bytes]] = []
+    used: set[str] = set()
+
+    def arcname(base: str) -> str:
+        name, i = base, 1
+        while name in used:
+            name = f"{i}-{base}"
+            i += 1
+        used.add(name)
+        return name
+
+    def add(path_or_none, base, kind, data, problems,
+            **extra) -> None:
+        name = arcname(base)
+        payload.append((name, data))
+        entry = {"name": name, "kind": kind, "bytes": len(data),
+                 "crc32c": integrity.crc32c(data),
+                 "problems": problems}
+        if path_or_none:
+            entry["source"] = os.path.abspath(path_or_none)
+        entry.update(extra)
+        files.append(entry)
+        if not args.quiet:
+            flag = f", {problems} problem(s)" if problems else ""
+            print(f"  + {name} ({kind}, {len(data)} bytes{flag})")
+
+    missing = 0
+    for path in args.paths:
+        if not os.path.isfile(path):
+            print(f"{path}: missing (skipped)", file=sys.stderr)
+            missing += 1
+            continue
+        kind, problems = _classify(path)
+        with open(path, "rb") as f:
+            data = f.read()
+        add(path, os.path.basename(path), kind, data, problems)
+    if args.db:
+        text, rc = _fsck_verdict(args.db)
+        add(None, "fsck.txt", "fsck", text.encode(), rc,
+            exit_status=rc, checked=[os.path.abspath(d)
+                                     for d in args.db])
+    cfg = json.dumps(_config_doc(), indent=1, sort_keys=True) + "\n"
+    add(None, "config.json", "config", cfg.encode(), 0)
+
+    if not files:
+        print("quorum-debug-bundle: nothing collected",
+              file=sys.stderr)
+        return 2
+
+    manifest = integrity.seal({
+        "schema": schema_mod.DEBUG_BUNDLE_SCHEMA,
+        "meta": {
+            "tool": "quorum-debug-bundle",
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "created_unix_s": int(time.time()),
+            "missing": missing,
+        },
+        "files": files,
+    })
+    for err in schema_mod.validate_debug_bundle_manifest(manifest):
+        # a self-check only: the validator and this writer live in
+        # the same PR, so a disagreement is a bug, not bad input
+        print(f"manifest self-check: {err}", file=sys.stderr)
+    mdata = (json.dumps(manifest, indent=1) + "\n").encode()
+    try:
+        with tarfile.open(args.out, "w:gz") as tar:
+            def addfile(nm: str, data: bytes) -> None:
+                info = tarfile.TarInfo(nm)
+                info.size = len(data)
+                info.mtime = int(time.time())
+                tar.addfile(info, io.BytesIO(data))
+            addfile("MANIFEST.json", mdata)
+            for nm, data in payload:
+                addfile(nm, data)
+    except OSError as e:
+        print(f"{args.out}: {e}", file=sys.stderr)
+        return 1
+    total = sum(f["bytes"] for f in files)
+    print(f"quorum-debug-bundle: {args.out}: {len(files)} file(s), "
+          f"{total} bytes payload"
+          + (f", {missing} missing" if missing else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

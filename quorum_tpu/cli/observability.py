@@ -114,6 +114,7 @@ class ObservabilitySession:
         self.server = None  # exposition endpoint, once started
         self.pusher = None  # MetricsPusher, with --metrics-push-url
         self.alerts = None  # AlertEngine (telemetry/alerts.py)
+        self.flight = None  # FlightRecorder (telemetry/flight.py)
         self.status: str | None = None
         self._at_exit: list = []
         self._profile: str | None = None
@@ -153,6 +154,13 @@ class ObservabilitySession:
             try:
                 fn(reg)
             except Exception:  # noqa: BLE001 - exit hooks never mask exits
+                pass
+        if self.flight is not None:
+            # land ring evictions in flight_events_dropped_total
+            # BEFORE the final write, so the document carries them
+            try:
+                self.flight.flush_drop_counter()
+            except Exception:  # noqa: BLE001 - forensics never mask exits
                 pass
         recorded = self._record_devtrace()
         if not ok:
@@ -215,6 +223,7 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                 obs.status = "error"
     """
     from ..io import integrity
+    from ..telemetry import flight as flight_mod
     from ..telemetry import registry_for, tracer_for
     from ..telemetry import export as export_mod
 
@@ -241,6 +250,21 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     tracer = tracer_for(trace_spans)
     obs = ObservabilitySession(reg, tracer)
     obs._profile = profile
+    # the flight recorder (ISSUE 16): always-on in every entry point.
+    # Taps point the registry's event sink and the span tracer at the
+    # ring (no new call sites); install() makes it the process-current
+    # recorder so serve internals / alert rules / SIGUSR1 reach it.
+    obs.flight = flight_mod.FlightRecorder(
+        reg, out_path=flight_mod.default_out_path(metrics))
+    flight_token = flight_mod.install(obs.flight)
+    if obs.flight.enabled:
+        if reg.enabled:
+            reg.flight = obs.flight
+            # declares the surface: metrics_check requires the
+            # flight counters whenever a document carries this
+            reg.set_meta(flight=True)
+        if tracer.enabled:
+            tracer.flight = obs.flight
     if reg.enabled:
         # the alert engine (telemetry/alerts.py): built-in rules plus
         # the serve SLO set for serve registries, overridden by the
@@ -285,11 +309,31 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                               and push_interval > 0
                               else DEFAULT_PERIOD_S))
             yield obs
-        except BaseException:
+        except BaseException as e:
+            # the black box's primary trigger: the dump (ring, all-
+            # thread stacks, levers, registry snapshot) lands BEFORE
+            # the final write so flight_dumps_total rides the error
+            # document; forensics must never mask the real failure
+            try:
+                obs.flight.dump("exception", detail=repr(e))
+            except Exception:  # noqa: BLE001 - never mask the exit
+                pass
             obs._finalize(ok=False)
             raise
+        if obs.status == "error":
+            # entry points report many failures through a return code
+            # (their catch blocks map RuntimeError/OSError to rc 1) —
+            # an error-status exit is a dying run all the same, and
+            # the ring still holds the fault/exception events that
+            # explain it
+            try:
+                obs.flight.dump("error", detail="run exited with "
+                                                "status=error")
+            except Exception:  # noqa: BLE001 - never mask the status
+                pass
         obs._finalize(ok=True)
     finally:
+        flight_mod.uninstall(flight_token)
         integrity.install_registry(prev_integrity)
         # span + endpoint teardown on EVERY exit: the Chrome trace of
         # an interrupted run is exactly when it's needed, and the

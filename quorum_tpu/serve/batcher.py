@@ -67,7 +67,7 @@ import threading
 import time
 from concurrent.futures import Future
 
-from ..telemetry import NULL, labeled
+from ..telemetry import NULL, flight, labeled
 from ..utils.vlog import vlog
 
 PRIORITIES = ("interactive", "bulk")
@@ -192,6 +192,9 @@ class DynamicBatcher:
         self._draining = False
         self._closed = False
         self._dead = False  # dispatcher exited (drain or death)
+        # the batch the dispatcher is running RIGHT NOW (empty between
+        # steps): drain forensics read it for meta.drained_ids
+        self._inflight: list[_Request] = []
         self._consecutive_failures = 0
         # feature counters exist from setup (value 0 counts): a serve
         # metrics document must show the watchdog/hedging surface even
@@ -339,6 +342,17 @@ class DynamicBatcher:
                     or self._consecutive_failures
                     < self.max_consecutive_failures)
 
+    def pending_rids(self) -> list[str]:
+        """Request ids admitted but not yet resolved — the batch on
+        the device right now plus both lane backlogs, in dispatch
+        order. The server's drain path stamps this as
+        `meta.drained_ids` so a postmortem can name exactly which
+        requests a SIGTERM caught in flight."""
+        with self._lock:
+            reqs = list(self._inflight)
+            reqs += [r for q in self._lanes.values() for r in q]
+        return [r.rid for r in reqs if r.rid]
+
     # -- drain / shutdown -------------------------------------------------
     def drain(self, timeout: float | None = None) -> bool:
         """Stop admitting, flush everything already admitted, stop the
@@ -396,6 +410,10 @@ class DynamicBatcher:
             # print a traceback nobody handles while clients hang
             self.registry.counter("dispatcher_crashes").inc()
             vlog("quorum-serve dispatcher died: ", e)
+            try:
+                flight.try_dump("dispatcher_crash", detail=repr(e))
+            except Exception:  # noqa: BLE001 - never mask the crash  # qlint: disable=thread-swallowed-exception - best-effort forensics; the crash is already counted (dispatcher_crashes) above
+                pass
         finally:
             # EVERY dispatcher exit path — clean drain or a bug in the
             # loop itself — must fail the queued futures immediately:
@@ -429,6 +447,7 @@ class DynamicBatcher:
                     if not self._qlen_locked():
                         continue
                 taken = self._take_locked()
+                self._inflight = taken
             try:
                 self._run_batch(taken, reg)
             except BaseException as e:  # noqa: BLE001 - watchdog
@@ -442,6 +461,9 @@ class DynamicBatcher:
                         n += 1
                 if n:
                     reg.counter("requests_failed").inc(n)
+            finally:
+                with self._lock:
+                    self._inflight = []
 
     def _shutdown_pending(self) -> None:
         err = RuntimeError("quorum-serve dispatcher exited")
@@ -516,6 +538,17 @@ class DynamicBatcher:
         reg.counter("engine_step_timeouts").inc()
         vlog("quorum-serve watchdog: abandoning engine step after ",
              self.step_timeout_s, " s")
+        # the black-box moment: the hung `quorum-serve-step` thread is
+        # still alive (daemon, abandoned), so the dump's all-thread
+        # stacks show exactly WHERE the engine step wedged
+        try:
+            flight.try_dump(
+                "watchdog", site="serve.engine.step",
+                detail=("engine step exceeded "
+                        f"{self.step_timeout_s * 1e3:.0f} ms; hung "
+                        "thread quorum-serve-step abandoned"))
+        except Exception:  # noqa: BLE001 - never mask the timeout
+            pass
         if self.engine_factory is None:
             return
         gen_at_timeout = self.generation

@@ -71,7 +71,7 @@ import uuid
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from ..io import fastq
-from ..telemetry import NULL
+from ..telemetry import NULL, flight
 from ..telemetry import export as export_mod
 from ..utils import faults
 from ..utils.vlog import vlog
@@ -167,6 +167,8 @@ class CorrectionServer:
                     # replica instead of the process dying silently
                     self._reply_json(200 if h.get("healthy", True)
                                      else 503, h)
+                elif route == "/debug/flight":
+                    outer._handle_debug_flight(self)
                 else:
                     self._reply_json(404, {"error": "not found"})
 
@@ -493,6 +495,26 @@ class CorrectionServer:
         handler._reply_json(200, {"status": "reloaded",
                                   "generation": gen})
 
+    # -- forensics ---------------------------------------------------------
+    def _handle_debug_flight(self, handler) -> None:
+        """GET /debug/flight: a live flight-recorder snapshot (ring
+        contents + all-thread stacks + resolved levers) from a still-
+        running replica — the wedged-but-not-dead case, where no dump
+        trigger has fired yet. Loopback-only: thread stacks and lever
+        values are operator forensics, not a public surface."""
+        ip = handler.client_address[0]
+        if ip not in ("127.0.0.1", "::1") and not ip.startswith("127."):
+            handler._reply_json(403, {"error": "loopback only"})
+            return
+        rec = flight.current()
+        if rec is None or not rec.enabled:
+            handler._reply_json(404, {"error": "no flight recorder"})
+            return
+        try:
+            handler._reply_json(200, rec.snapshot())
+        except Exception as e:  # noqa: BLE001 - forensics, not liveness
+            handler._reply_json(500, {"error": repr(e)})
+
     # -- health / lifecycle -----------------------------------------------
     def health(self) -> dict:
         with self._req_lock:
@@ -540,6 +562,15 @@ class CorrectionServer:
         self._drain_started.set()
 
         def _drain():
+            # name what the drain caught in flight BEFORE flushing it:
+            # the final document's meta.drained_ids tells an operator
+            # which requests a SIGTERM interrupted (empty on an idle
+            # drain), matched by X-Quorum-Request-Id on the client side
+            try:
+                self.registry.set_meta(
+                    drained_ids=self.batcher.pending_rids())
+            except Exception:  # noqa: BLE001 - forensics never block drain  # qlint: disable=thread-swallowed-exception - best-effort forensics meta; the drain outcome itself is reported below either way
+                pass
             # the meta stamp records what ACTUALLY happened: False
             # means the grace period expired with work unflushed — a
             # lossy shutdown must not read as a clean one downstream

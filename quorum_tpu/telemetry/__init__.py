@@ -9,6 +9,7 @@ when no path is given, so instrumentation is zero-cost when disabled.
 See registry.py for the model and schema.py for the document formats.
 """
 
+from . import flight
 from .alerts import (AlertEngine, DEFAULT_RULES, DEFAULT_SERVE_RULES,
                      load_rules, merge_rules)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -22,6 +23,7 @@ from .schema import (SCHEMA_VERSION, check_file, metric_line,
 from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
+    "flight",
     "AlertEngine", "DEFAULT_RULES", "DEFAULT_SERVE_RULES",
     "load_rules", "merge_rules",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",

@@ -265,6 +265,10 @@ class _Rule:
             raise ValueError(f"rule {self.name!r}: unknown type "
                              f"{self.type!r} (one of {_RULE_TYPES})")
         self.severity = str(spec.get("severity", "warn"))
+        # `dump: true` — a firing transition additionally triggers a
+        # flight-recorder crash dump (telemetry/flight.py): the alert
+        # that says "this run is dying" also captures why
+        self.dump = bool(spec.get("dump"))
         self.firing = False
         self.fired_count = 0
         self.error_reported = False
@@ -536,6 +540,14 @@ class AlertEngine:
                               severity=rule.severity,
                               value=round(float(value), 6),
                               detail=detail)
+                    if rule.dump:
+                        try:
+                            from . import flight as flight_mod
+                            flight_mod.try_dump(
+                                "alert", detail=detail,
+                                site=rule.name)
+                        except Exception:  # noqa: BLE001 - alerts never kill runs
+                            pass
                 elif not cond and rule.firing:
                     rule.firing = False
                     reg.gauge(labeled("alerts_firing",

@@ -119,6 +119,13 @@ PARTITION_GAUGE_PREFIX = "partition_distinct{partition="
 COMPILE_COUNTERS = ("compile_events",)
 COMPILE_META = ("compile_sites",)
 
+# The flight-recorder surface (ISSUE 16): a document whose meta
+# declares `flight` (the recorder was installed and enabled) must
+# carry the dump/drop counters — pre-created by FlightRecorder at
+# construction, so a clean zero-dump run still proves the black box
+# was armed.
+FLIGHT_COUNTERS = ("flight_dumps_total", "flight_events_dropped_total")
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -144,6 +151,7 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(PUSH_COUNTERS)
     names.update(ALERT_COUNTERS)
     names.update(COMPILE_COUNTERS)
+    names.update(FLIGHT_COUNTERS)
     names.update(SHARD_REQUIRED_COUNTERS)
     names.update(PREFILTER_COUNTERS)
     names.update(PARTITION_COUNTERS)

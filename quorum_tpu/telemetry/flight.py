@@ -1,0 +1,369 @@
+"""Flight recorder: a black box for wedged and dying runs (ISSUE 16).
+
+The telemetry stack observes runs through final documents and
+heartbeats — but the moments that matter most are exactly the ones
+where neither arrives: a wedged dispatch loop, a watchdog-killed
+engine step, an uncaught exception mid-batch. Dapper-style always-on
+bounded-overhead tracing (PAPERS.md) is the blueprint: keep the last
+window of truth resident at near-zero cost, dump it only when
+something goes wrong.
+
+:class:`FlightRecorder` is a lock-light ring buffer (fixed-capacity
+deque) of recent telemetry: every registry event (run manifest,
+heartbeats, checkpoint cursors, serve request-phase transitions,
+fault injections), span open/close edges, per-batch dispatch/wait
+samples, and — under ``QUORUM_TSAN=1`` — lock acquisitions. It is
+installed by ``cli/observability.observability()`` in every entry
+point and fed by taps inside the existing event sink and span tracer
+(``MetricsRegistry.event`` / ``SpanTracer._record``), so instrumented
+code needs no new call sites.
+
+On a trigger — an uncaught exception in the observability umbrella, a
+serve watchdog ``EngineStepTimeout`` or dispatcher crash, an alert
+rule with ``dump: true``, or ``SIGUSR1`` — :meth:`FlightRecorder.dump`
+writes an atomic, sealed (io/integrity crc32c), self-describing dump
+document (schema ``quorum-tpu-flight/1``): the ring contents,
+all-thread Python stacks (``sys._current_frames``), resolved lever
+values, the active autotune profile, and a registry snapshot. Exactly
+one dump lands per incident (the first trigger wins; ``SIGUSR1``
+forces). ``quorum-serve`` additionally snapshots a live replica via
+loopback-only ``GET /debug/flight``; ``tools/trace_summary.py
+--flight`` renders a dump as a timeline with the triggering thread
+highlighted; ``quorum-debug-bundle`` collects dump + metrics + fsck
+verdicts into one postmortem tarball.
+
+Levers: ``QUORUM_FLIGHT`` (0 disables the recorder entirely),
+``QUORUM_FLIGHT_RING`` (ring capacity), ``QUORUM_FLIGHT_DIR`` (dump
+directory override). Contract counters: ``flight_dumps_total`` /
+``flight_events_dropped_total`` (telemetry/contract.py). The ring
+lock is ranked in analysis/rules_locks.LOCK_ORDER; taps run OUTSIDE
+the registry/tracer locks so the ring lock never nests inside them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from ..utils import faults, levers
+
+DUMP_SCHEMA = "quorum-tpu-flight/1"
+BUNDLE_SCHEMA = "quorum-tpu-debug-bundle/1"
+DEFAULT_RING = 4096
+
+
+def default_out_path(metrics_path: str | None) -> str | None:
+    """Where a dump lands: ``QUORUM_FLIGHT_DIR`` when set (one file
+    per pid, so fleet hosts sharing a directory never collide), else
+    the `--metrics` sibling ``<base>.flight.json``, else None — a run
+    with no metrics path and no explicit directory has nowhere
+    durable to dump, so triggers only feed the in-memory ring (still
+    served by ``GET /debug/flight``)."""
+    d = levers.raw("QUORUM_FLIGHT_DIR")
+    if d:
+        return os.path.join(d, f"flight-{os.getpid()}.json")
+    if metrics_path:
+        base = (metrics_path[:-5] if metrics_path.endswith(".json")
+                else metrics_path)
+        return base + ".flight.json"
+    return None
+
+
+class FlightRecorder:
+    """One per observability session. `record()` is the only hot
+    surface: a TLS re-entrancy check, one small dict build, and one
+    deque append under `_lock` — per-event/per-span/per-batch cost,
+    never per-base. Everything expensive (stack walks, lever
+    resolution, sealing, IO) happens only in `dump()`."""
+
+    def __init__(self, registry, out_path: str | None = None,
+                 capacity: int | None = None):
+        self.enabled = levers.get_bool("QUORUM_FLIGHT", True)
+        if capacity is None:
+            try:
+                capacity = int(levers.raw("QUORUM_FLIGHT_RING")
+                               or DEFAULT_RING)
+            except ValueError:
+                capacity = DEFAULT_RING
+        self.capacity = max(16, capacity)
+        self.out_path = out_path
+        self.registry = registry
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0               # total records offered
+        self._dropped_flushed = 0   # drops already counted
+        self._dumped = False
+        self.last_dump_path: str | None = None
+        self._tls = threading.local()
+        # contract counters pre-created so a clean run's final
+        # document carries them at 0 (telemetry/contract.py)
+        self._dumps = registry.counter("flight_dumps_total")
+        self._drops = registry.counter("flight_events_dropped_total")
+
+    @contextlib.contextmanager
+    def _held(self):
+        """Take the ring lock with the TLS re-entrancy flag raised:
+        under QUORUM_TSAN=1 the lock hook observes this very
+        acquisition and re-enters :meth:`record` on the same thread,
+        which must bail out (the record() guard), never block on the
+        lock it is reporting. EVERY internal acquisition of
+        ``_lock`` goes through here or through record() itself."""
+        tls = self._tls
+        prev = getattr(tls, "busy", False)
+        tls.busy = True
+        try:
+            with self._lock:
+                yield
+        finally:
+            tls.busy = prev
+
+    # -- the hot surface ---------------------------------------------------
+    def record(self, kind: str, name: str, **fields) -> None:
+        """Append one ring entry. Values are stored by reference and
+        sanitized to scalars only at dump time. Re-entrancy (a tap
+        firing while a record is already in flight on this thread —
+        the TSAN hook observing the ring lock's own acquisition) is a
+        silent drop, never a deadlock."""
+        if not self.enabled:
+            return
+        tls = self._tls
+        if getattr(tls, "busy", False):
+            return
+        tls.busy = True
+        try:
+            obj = {"t": round(time.perf_counter() - self._t0, 6),
+                   "kind": kind, "name": name,
+                   "tid": threading.get_ident()}
+            if fields:
+                obj.update(fields)
+            with self._lock:
+                self._seq += 1
+                self._ring.append(obj)
+        finally:
+            tls.busy = False
+
+    # -- snapshots ---------------------------------------------------------
+    def _sanitize(self, entries: list) -> list:
+        from .registry import _scalar
+        return [{k: _scalar(v) for k, v in e.items()} for e in entries]
+
+    def _thread_stacks(self) -> list[dict]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append({
+                "name": names.get(ident, "<unknown>"),
+                "tid": ident,
+                "stack": [ln.rstrip("\n") for ln in
+                          traceback.format_stack(frame)],
+            })
+        return out
+
+    def _lever_values(self) -> dict:
+        vals = {}
+        for name in levers.names():
+            lv = levers.CATALOG[name]
+            env = levers.raw(name)
+            vals[name] = {"value": env, "default": lv.default}
+        return vals
+
+    def _autotune_profile(self) -> dict:
+        prof: dict = {}
+        try:
+            from ..ops import tuning
+            path = tuning.active_profile_path()
+            if path:
+                prof["path"] = path
+                with open(path) as f:
+                    prof["profile"] = json.load(f)
+        except Exception:  # noqa: BLE001 - forensics never kill dumps
+            pass
+        return prof
+
+    def snapshot(self, trigger: dict | None = None) -> dict:
+        """The full (unsealed) dump document — also what
+        ``GET /debug/flight`` serves from a live replica."""
+        with self._held():
+            ring = list(self._ring)
+            seq = self._seq
+        doc = {
+            "schema": DUMP_SCHEMA,
+            "meta": {
+                "pid": os.getpid(),
+                "argv": list(sys.argv),
+                "capacity": self.capacity,
+                "stage": self.registry.as_dict().get(
+                    "meta", {}).get("stage"),
+            },
+            "trigger": trigger or {"kind": "snapshot",
+                                   "thread": threading.current_thread().name,
+                                   "tid": threading.get_ident(),
+                                   "t": round(time.perf_counter()
+                                              - self._t0, 6)},
+            "ring": self._sanitize(ring),
+            "dropped": max(0, seq - len(ring)),
+            "threads": self._thread_stacks(),
+            "levers": self._lever_values(),
+            "autotune": self._autotune_profile(),
+            "registry": self.registry.as_dict(),
+        }
+        return doc
+
+    def _make_trigger(self, kind: str, detail: str,
+                      site: str | None) -> dict:
+        trig = {
+            "kind": kind,
+            "detail": detail,
+            "thread": threading.current_thread().name,
+            "tid": threading.get_ident(),
+            "t": round(time.perf_counter() - self._t0, 6),
+        }
+        if site:
+            trig["site"] = site
+        exc = sys.exc_info()[1]
+        if exc is not None:
+            trig["exception"] = repr(exc)
+            trig["exc_stack"] = [
+                ln.rstrip("\n")
+                for ln in traceback.format_exception(exc)]
+        return trig
+
+    # -- the cold surface --------------------------------------------------
+    def dump(self, kind: str, detail: str = "",
+             site: str | None = None, force: bool = False,
+             out_path: str | None = None) -> str | None:
+        """Write the sealed dump document (atomic replace). Exactly
+        one dump lands per incident: the first trigger wins and later
+        ones return the existing path — an operator `SIGUSR1`
+        (`force=True`) overrides. Returns the path written, or None
+        when the recorder is disabled or has nowhere to write."""
+        if not self.enabled:
+            return None
+        out = out_path or self.out_path
+        if not out:
+            # still note the trigger in the ring: a later /debug/flight
+            # snapshot of a pathless replica shows what fired
+            self.record("trigger", kind, detail=detail, site=site)
+            return None
+        with self._held():
+            if self._dumped and not force:
+                return self.last_dump_path
+            self._dumped = True
+        from ..io import integrity
+        from .registry import atomic_write
+        doc = self.snapshot(self._make_trigger(kind, detail, site))
+        doc = integrity.seal(doc)
+        atomic_write(out, json.dumps(doc, indent=1) + "\n")
+        self.last_dump_path = out
+        self._dumps.inc()
+        self.flush_drop_counter()
+        self.registry.event("flight_dump", path=out, trigger=kind,
+                            site=site or "")
+        faults.inject("flight.dump", path=out)
+        return out
+
+    def flush_drop_counter(self) -> None:
+        """Land ring evictions in `flight_events_dropped_total` —
+        called at dump time and at session teardown, so a clean run's
+        final document says how much history the window forgot."""
+        with self._held():
+            dropped = max(0, self._seq - len(self._ring))
+            delta = dropped - self._dropped_flushed
+            self._dropped_flushed = dropped
+        if delta > 0:
+            self._drops.inc(delta)
+
+
+# -- ambient installation --------------------------------------------------
+# One recorder is "current" per process (nested observability blocks —
+# the driver's stage children — stack and restore, like
+# io/integrity.install_registry). Serve internals (watchdog,
+# dispatcher-crash handler, /debug/flight, alert dump rules) reach it
+# through current() so no constructor threading is needed.
+
+_CURRENT: FlightRecorder | None = None
+
+
+def current() -> FlightRecorder | None:
+    return _CURRENT
+
+
+def try_dump(kind: str, detail: str = "", site: str | None = None,
+             force: bool = False) -> str | None:
+    """Dump via the current recorder; IO/forensics failures never
+    propagate into the triggering path (a dying run must keep dying
+    for its real reason). A seeded `flight.dump` fault does propagate
+    — that is the point of the site."""
+    rec = _CURRENT
+    if rec is None:
+        return None
+    try:
+        return rec.dump(kind, detail=detail, site=site, force=force)
+    except faults.FaultError:
+        raise
+    except Exception:  # noqa: BLE001 - forensics never kill runs
+        return None
+
+
+def _sigusr1(_signum, _frame) -> None:
+    try:
+        try_dump("sigusr1", detail="operator SIGUSR1", force=True)
+    except Exception:  # noqa: BLE001 - signal handlers never raise
+        pass
+
+
+def install(rec: FlightRecorder):
+    """Make `rec` the process-current recorder: SIGUSR1 dumps it and,
+    under QUORUM_TSAN=1, lock acquisitions feed its ring. Returns an
+    opaque token for :func:`uninstall` (nest/restore)."""
+    global _CURRENT
+    prev = _CURRENT
+    _CURRENT = rec
+    prev_handler = None
+    if rec.enabled and hasattr(signal, "SIGUSR1"):
+        try:
+            prev_handler = signal.getsignal(signal.SIGUSR1)
+            signal.signal(signal.SIGUSR1, _sigusr1)
+        except (ValueError, OSError):
+            prev_handler = None  # not the main thread
+    prev_hook = None
+    if rec.enabled:
+        try:
+            from ..analysis import tsan
+            if tsan.installed():
+                prev_hook = tsan.set_flight_hook(
+                    lambda site: rec.record("lock", site))
+        except Exception:  # noqa: BLE001 - sanitizer hook is best-effort
+            prev_hook = None
+    return (prev, prev_handler, prev_hook)
+
+
+def uninstall(token) -> None:
+    global _CURRENT
+    prev, prev_handler, prev_hook = token
+    rec = _CURRENT
+    if rec is not None:
+        try:
+            rec.flush_drop_counter()
+        except Exception:  # noqa: BLE001 - teardown never raises
+            pass
+    _CURRENT = prev
+    if prev_handler is not None and hasattr(signal, "SIGUSR1"):
+        try:
+            signal.signal(signal.SIGUSR1, prev_handler)
+        except (ValueError, OSError):
+            pass
+    try:
+        from ..analysis import tsan
+        if tsan.installed():
+            tsan.set_flight_hook(prev_hook)
+    except Exception:  # noqa: BLE001 - sanitizer hook is best-effort
+        pass

@@ -173,6 +173,12 @@ class MetricsRegistry:
         self._t0 = time.perf_counter()
         self._last_beat = -1e18
         self._exporters: list = []
+        # flight-recorder tap (ISSUE 16): observability() points this
+        # at the session's FlightRecorder so every event feeds the
+        # forensic ring — BEFORE the events-path gate (the ring wants
+        # history even when no JSONL sink is configured) and outside
+        # self._lock (the ring lock never nests inside the registry's)
+        self.flight = None
 
     # -- metric accessors (get-or-create) --------------------------------
     def counter(self, name: str) -> Counter:
@@ -209,7 +215,12 @@ class MetricsRegistry:
     # -- JSONL event sink -------------------------------------------------
     def event(self, kind: str, **fields) -> None:
         """Append one event line; no-op unless an events path is
-        configured (heartbeat_s > 0 or explicit events_path)."""
+        configured (heartbeat_s > 0 or explicit events_path). The
+        flight tap fires either way — the ring is the always-on
+        bounded sink the JSONL stream is the durable one of."""
+        fl = self.flight
+        if fl is not None:
+            fl.record("event", kind, **fields)
         if not self.events_path:
             return
         obj = {"event": kind, "t": round(self.elapsed(), 3)}
@@ -337,6 +348,7 @@ class NullRegistry:
     enabled = False
     path = None
     events_path = None
+    flight = None
 
     def counter(self, name):
         return _NULL_COUNTER
@@ -451,6 +463,13 @@ def observe_dispatch_wait(reg, prefix: str, t0: float, t1: float,
         reg.histogram(f"{prefix}_dispatch_us").observe(
             int((t1 - t0) * 1e6))
         reg.histogram(f"{prefix}_wait_us").observe(int((t2 - t1) * 1e6))
+        fl = reg.flight
+        if fl is not None:
+            # per-batch dispatch/wait sample into the flight ring: a
+            # pure-Python append, no device sync (rules_hotpath-safe)
+            fl.record("dispatch", prefix,
+                      dispatch_us=int((t1 - t0) * 1e6),
+                      wait_us=int((t2 - t1) * 1e6))
 
 
 # jax.monitoring offers register but no unregister, so exactly ONE

@@ -72,6 +72,10 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
                "histograms", "timers"}
     if not _nested:
         allowed.add("hosts")
+        # fleet documents (tools/push_receiver.py) may carry receiver-
+        # side lifecycle events — staleness alerts a silent host
+        # cannot write into its own (absent) document (ISSUE 16)
+        allowed.add("events")
     unknown = set(doc) - allowed
     if unknown:
         errs.append(f"unknown top-level keys {sorted(unknown)}")
@@ -84,6 +88,13 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
             for hk, hdoc in doc["hosts"].items():
                 errs.extend(f"hosts[{hk!r}]: {e}" for e in
                             validate_metrics(hdoc, _nested=True))
+    if not _nested and "events" in doc:
+        if not isinstance(doc["events"], list):
+            errs.append("events is not a list")
+        else:
+            for i, ev in enumerate(doc["events"]):
+                errs.extend(f"events[{i}]: {e}" for e in
+                            validate_events_line(ev))
 
     for k, v in doc["meta"].items():
         ok = (_is_scalar(v)
@@ -303,6 +314,182 @@ def validate_perf_diff(doc) -> list[str]:
     return errs
 
 
+# the flight-recorder dump document (telemetry/flight.py, ISSUE 16)
+# and the quorum-debug-bundle manifest that packages one
+FLIGHT_SCHEMA = "quorum-tpu-flight/1"
+DEBUG_BUNDLE_SCHEMA = "quorum-tpu-debug-bundle/1"
+
+# what a bundle entry can be; "other" keeps the manifest open to
+# operator-supplied extras without a schema bump
+BUNDLE_FILE_KINDS = ("flight", "metrics", "events", "spans", "trace",
+                     "fsck", "config", "other")
+
+
+def _flight_seal_errors(doc) -> list[str]:
+    """A flight dump MUST be sealed (unlike pre-v5 metrics artifacts,
+    where the seal is optional): the dump is the black box an operator
+    reads AFTER the process died, so an unsealed or altered one is
+    exactly the artifact that cannot be trusted."""
+    from ..io.integrity import SEAL_FIELD, crc32c
+    want = doc.get(SEAL_FIELD)
+    if not isinstance(want, int) or isinstance(want, bool):
+        return [f"missing/non-int seal field {SEAL_FIELD!r} "
+                "(flight dumps are always sealed)"]
+    body = json.dumps({k: v for k, v in doc.items()
+                       if k != SEAL_FIELD}, sort_keys=True).encode()
+    got = crc32c(body)
+    if got != want:
+        return [f"seal mismatch: computed crc32c {got:#010x} != "
+                f"recorded {want:#010x} — the dump was altered after "
+                "it was written"]
+    return []
+
+
+def validate_flight_dump(doc) -> list[str]:
+    """Validate a flight-recorder crash dump (FlightRecorder.dump):
+    trigger identity (kind/thread/tid), ring entries as scalar-valued
+    timeline records, all-thread stacks, the embedded registry
+    snapshot as a well-formed metrics document, and the mandatory
+    integrity seal (recomputed, not just present)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["flight dump is not a JSON object"]
+    if doc.get("schema") != FLIGHT_SCHEMA:
+        errs.append(f"schema is {doc.get('schema')!r}, "
+                    f"expected {FLIGHT_SCHEMA!r}")
+    meta = doc.get("meta")
+    if not isinstance(meta, dict):
+        errs.append("missing/non-object 'meta' section")
+    else:
+        if not isinstance(meta.get("pid"), int):
+            errs.append("meta.pid missing/non-int")
+        if not (isinstance(meta.get("argv"), list) and all(
+                isinstance(a, str) for a in meta["argv"])):
+            errs.append("meta.argv must be a list of strings")
+        if not isinstance(meta.get("capacity"), int) \
+                or meta.get("capacity", 0) < 1:
+            errs.append("meta.capacity missing/non-positive")
+    trig = doc.get("trigger")
+    if not isinstance(trig, dict):
+        errs.append("missing/non-object 'trigger' section")
+    else:
+        if not isinstance(trig.get("kind"), str) or not trig.get("kind"):
+            errs.append("trigger.kind missing/empty")
+        if not isinstance(trig.get("thread"), str) \
+                or not trig.get("thread"):
+            errs.append("trigger.thread missing/empty (the dump must "
+                        "name the triggering thread)")
+        if not isinstance(trig.get("tid"), int):
+            errs.append("trigger.tid missing/non-int")
+        if not _is_number(trig.get("t")):
+            errs.append("trigger.t missing/non-numeric")
+        for k in ("site", "detail", "exception"):
+            if k in trig and not isinstance(trig[k], str):
+                errs.append(f"trigger.{k} is not a string")
+    ring = doc.get("ring")
+    if not isinstance(ring, list):
+        errs.append("missing/non-list 'ring' section")
+    else:
+        for i, e in enumerate(ring):
+            if not isinstance(e, dict):
+                errs.append(f"ring[{i}] is not an object")
+                continue
+            if not _is_number(e.get("t")):
+                errs.append(f"ring[{i}].t missing/non-numeric")
+            for k in ("kind", "name"):
+                if not isinstance(e.get(k), str) or not e.get(k):
+                    errs.append(f"ring[{i}].{k} missing/empty")
+            if not isinstance(e.get("tid"), int):
+                errs.append(f"ring[{i}].tid missing/non-int")
+            for k, v in e.items():
+                if not _is_scalar(v):
+                    errs.append(f"ring[{i}].{k} is not scalar")
+    if not (isinstance(doc.get("dropped"), int)
+            and not isinstance(doc.get("dropped"), bool)
+            and doc.get("dropped", -1) >= 0):
+        errs.append("'dropped' must be a non-negative int")
+    threads = doc.get("threads")
+    if not isinstance(threads, list):
+        errs.append("missing/non-list 'threads' section")
+    else:
+        for i, t in enumerate(threads):
+            if not isinstance(t, dict):
+                errs.append(f"threads[{i}] is not an object")
+                continue
+            if not isinstance(t.get("name"), str):
+                errs.append(f"threads[{i}].name missing")
+            if not isinstance(t.get("tid"), int):
+                errs.append(f"threads[{i}].tid missing/non-int")
+            if not (isinstance(t.get("stack"), list) and all(
+                    isinstance(s, str) for s in t["stack"])):
+                errs.append(f"threads[{i}].stack must be a list of "
+                            "strings")
+    if not isinstance(doc.get("levers"), dict):
+        errs.append("missing/non-object 'levers' section")
+    if not isinstance(doc.get("autotune"), dict):
+        errs.append("missing/non-object 'autotune' section")
+    reg = doc.get("registry")
+    if not isinstance(reg, dict):
+        errs.append("missing/non-object 'registry' section")
+    else:
+        errs.extend(f"registry: {e}" for e in validate_metrics(reg))
+    errs.extend(_flight_seal_errors(doc))
+    return errs
+
+
+def validate_debug_bundle_manifest(doc) -> list[str]:
+    """Validate a quorum-debug-bundle manifest: what the tarball
+    holds, each entry typed, sized, and digest-stamped, so a bundle
+    shipped across machines self-describes what made it into the
+    postmortem (and what was missing at collection time)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["bundle manifest is not a JSON object"]
+    if doc.get("schema") != DEBUG_BUNDLE_SCHEMA:
+        errs.append(f"schema is {doc.get('schema')!r}, "
+                    f"expected {DEBUG_BUNDLE_SCHEMA!r}")
+    meta = doc.get("meta")
+    if not isinstance(meta, dict):
+        errs.append("missing/non-object 'meta' section")
+    else:
+        for k, v in meta.items():
+            ok = (_is_scalar(v)
+                  or (isinstance(v, list)
+                      and all(_is_scalar(x) for x in v)))
+            if not ok:
+                errs.append(f"meta[{k!r}] is not scalar/list")
+    files = doc.get("files")
+    if not isinstance(files, list):
+        errs.append("missing/non-list 'files' section")
+        return errs
+    if not files:
+        errs.append("'files' is empty — a bundle must hold at least "
+                    "the artifact that motivated it")
+    for i, f in enumerate(files):
+        if not isinstance(f, dict):
+            errs.append(f"files[{i}] is not an object")
+            continue
+        if not isinstance(f.get("name"), str) or not f.get("name"):
+            errs.append(f"files[{i}].name missing/empty")
+        if f.get("kind") not in BUNDLE_FILE_KINDS:
+            errs.append(f"files[{i}].kind must be one of "
+                        f"{BUNDLE_FILE_KINDS}, got {f.get('kind')!r}")
+        if not (isinstance(f.get("bytes"), int)
+                and not isinstance(f.get("bytes"), bool)
+                and f.get("bytes", -1) >= 0):
+            errs.append(f"files[{i}].bytes must be a non-negative int")
+        if not isinstance(f.get("crc32c"), int) \
+                or isinstance(f.get("crc32c"), bool):
+            errs.append(f"files[{i}].crc32c missing/non-int")
+        if "problems" in f and not (
+                isinstance(f["problems"], int)
+                and not isinstance(f["problems"], bool)
+                and f["problems"] >= 0):
+            errs.append(f"files[{i}].problems must be a non-negative "
+                        "int")
+    return errs
+
+
 def validate_bench_line(obj) -> list[str]:
     """Validate one parsed bench-style metric line (the `metric_line`
     output format: `metric` (str) plus scalar fields)."""
@@ -335,6 +522,10 @@ def check_file(path: str) -> list[str]:
         doc = None
     if isinstance(doc, dict) and doc.get("schema") == PERF_DIFF_SCHEMA:
         return validate_perf_diff(doc)
+    if isinstance(doc, dict) and doc.get("schema") == FLIGHT_SCHEMA:
+        return validate_flight_dump(doc)
+    if isinstance(doc, dict) and doc.get("schema") == DEBUG_BUNDLE_SCHEMA:
+        return validate_debug_bundle_manifest(doc)
     if (isinstance(doc, dict)
             and ("schema" in doc or "counters" in doc)
             and "metric" not in doc and "event" not in doc):

@@ -96,6 +96,9 @@ class SpanTracer:
         self._dropped = 0
         self._tids: dict[int, int] = {}
         self._closed = False
+        # flight-recorder tap (ISSUE 16): open/close edges feed the
+        # forensic ring, outside self._lock (see registry.py)
+        self.flight = None
 
     # -- internals --------------------------------------------------------
     def _stack(self) -> list:
@@ -115,6 +118,9 @@ class SpanTracer:
 
     def _record(self, name: str, sid: int, parent: int | None,
                 ts: float, dur: float, attrs: dict) -> None:
+        fl = self.flight
+        if fl is not None:
+            fl.record("span", name, sid=sid, dur=round(dur, 6))
         obj = {"span": name, "id": sid, "parent": parent,
                "tid": self._tid(),
                "ts": round(ts, 6), "dur": round(dur, 6)}
@@ -147,6 +153,9 @@ class SpanTracer:
         sid = next(self._ids)
         parent = stack[-1] if stack else None
         stack.append(sid)
+        fl = self.flight
+        if fl is not None:
+            fl.record("span_open", name, sid=sid)
         if step is not None:
             attrs = dict(attrs, step=step)
         t0 = time.perf_counter()

@@ -86,6 +86,10 @@ the disabled cost is a module-global None check):
   commit of a ``--partitions`` build (io/checkpoint.
   Stage1PartitionCursor.save); an ``exit`` here is the torn-partition
   resume acceptance case.
+* ``flight.dump`` (``path=``) — after a flight-recorder crash dump
+  commits (telemetry/flight.py); an ``error`` here tests the
+  dump-landed-but-trigger-path-broke case, a ``corrupt`` damages the
+  sealed dump fsck must flag.
 
 Determinism: per-spec hit counters under one lock; the same plan over
 the same input fires at exactly the same points, which is what lets
@@ -142,6 +146,9 @@ SITES: dict[str, str] = {
                         "--partitions build "
                         "(io/checkpoint.Stage1PartitionCursor); "
                         "carries path=",
+    "flight.dump": "after a flight-recorder crash dump commits "
+                   "(telemetry/flight.FlightRecorder.dump); carries "
+                   "path=",
 }
 
 def render_docs() -> str:
@@ -286,6 +293,19 @@ class FaultPlan:
     def _act(self, spec: FaultSpec, site: str, batch, path=None) -> None:
         where = site if batch is None else f"{site}@batch={batch}"
         msg = spec.message or f"injected fault at {where}"
+        # black-box breadcrumb (ISSUE 16): a firing fault is exactly
+        # the history a postmortem dump needs, and for raising/exit
+        # actions nothing downstream gets a chance to log it. Only
+        # runs under an installed plan ever reach here, so production
+        # dispatch loops pay nothing.
+        try:
+            from ..telemetry import flight
+            rec = flight.current()
+            if rec is not None:
+                rec.record("fault", site, action=spec.action,
+                           batch=batch)
+        except Exception:  # noqa: BLE001 - forensics never mask faults
+            pass
         if spec.action == "sleep":
             time.sleep(spec.seconds)
             return

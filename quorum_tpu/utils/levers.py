@@ -99,6 +99,19 @@ _declare(
     "env fallback behind `--fault-plan`, how subprocesses under test "
     "inherit a plan (utils/faults.py).")
 _declare(
+    "QUORUM_FLIGHT", "bool", "1",
+    "The always-on flight recorder (telemetry/flight.py): 0 disables "
+    "the ring taps and crash dumps entirely (the perf A/B control).")
+_declare(
+    "QUORUM_FLIGHT_DIR", "path", "(metrics sibling)",
+    "Directory for flight-recorder crash dumps (one "
+    "`flight-<pid>.json` per process); unset = next to the "
+    "`--metrics` document as `<base>.flight.json`.")
+_declare(
+    "QUORUM_FLIGHT_RING", "int", "4096",
+    "Flight-recorder ring capacity (recent telemetry events, span "
+    "edges, dispatch samples retained for the postmortem dump).")
+_declare(
     "QUORUM_MULTICHIP_BATCH", "int", "128",
     "Batch rows for `bench.py --multichip` scaling points.")
 _declare(

@@ -1,0 +1,564 @@
+"""Flight recorder + crash forensics (ISSUE 16): the ring buffer,
+dump-on-trigger semantics, the dump/bundle schema validators, the
+metrics_check gate, trace_summary --flight rendering, the
+quorum-debug-bundle round trip, and the push-receiver staleness
+alerting that rides the same PR."""
+
+import importlib.util
+import json
+import os
+import tarfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from quorum_tpu.telemetry import MetricsRegistry, flight
+from quorum_tpu.telemetry.schema import (FLIGHT_SCHEMA,
+                                         validate_debug_bundle_manifest,
+                                         validate_flight_dump,
+                                         validate_metrics)
+from quorum_tpu.telemetry.spans import SpanTracer
+from quorum_tpu.utils import faults
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _recorder(tmp_path, **kw):
+    reg = MetricsRegistry()
+    out = str(tmp_path / "dump.flight.json")
+    rec = flight.FlightRecorder(reg, out_path=out, **kw)
+    return reg, rec, out
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+def test_ring_evicts_and_counts_drops(tmp_path):
+    reg, rec, _ = _recorder(tmp_path, capacity=16)
+    for i in range(20):
+        rec.record("event", f"e{i}", i=i)
+    snap = rec.snapshot()
+    assert len(snap["ring"]) == 16
+    assert snap["dropped"] == 4
+    # the oldest entries are the evicted ones
+    assert snap["ring"][0]["name"] == "e4"
+    rec.flush_drop_counter()
+    assert reg.as_dict()["counters"][
+        "flight_events_dropped_total"] == 4
+    # flushing again without new evictions adds nothing
+    rec.flush_drop_counter()
+    assert reg.as_dict()["counters"][
+        "flight_events_dropped_total"] == 4
+
+
+def test_capacity_floor_and_lever(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUORUM_FLIGHT_RING", "64")
+    reg, rec, _ = _recorder(tmp_path)
+    assert rec.capacity == 64
+    # explicit capacity wins over the lever, floored at 16
+    _, rec2, _ = _recorder(tmp_path, capacity=2)
+    assert rec2.capacity == 16
+
+
+def test_disabled_recorder_is_inert(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUORUM_FLIGHT", "0")
+    reg, rec, out = _recorder(tmp_path)
+    assert not rec.enabled
+    rec.record("event", "e")
+    assert rec.dump("exception", detail="boom") is None
+    assert not os.path.exists(out)
+    assert reg.as_dict()["counters"]["flight_dumps_total"] == 0
+
+
+def test_record_is_reentrancy_safe(tmp_path):
+    # a tap firing while a record is already in flight on the same
+    # thread (the TSAN hook observing the ring lock itself) must be
+    # dropped, not deadlock
+    reg, rec, _ = _recorder(tmp_path)
+    orig_append = rec._ring.append
+
+    def reentrant_append(obj):
+        rec.record("lock", "flight.FlightRecorder._lock")
+        orig_append(obj)
+
+    rec._ring = type("R", (), {"append": staticmethod(reentrant_append),
+                               "__len__": lambda self: 0,
+                               "__iter__": lambda self: iter(())})()
+    rec.record("event", "outer")  # returns, no deadlock/recursion
+
+
+def test_cold_surfaces_are_reentrancy_safe(tmp_path):
+    # the TSAN hook fires on EVERY ring-lock acquisition, including
+    # the recorder's own cold surfaces (flush_drop_counter at
+    # teardown, snapshot/dump at trigger time) — each re-enters
+    # record() on the same thread and must bail out, not block on
+    # the lock it is reporting (the tier-1 QUORUM_TSAN=1 deadlock)
+    import threading
+
+    reg, rec, out = _recorder(tmp_path)
+    real_lock = rec._lock
+
+    class HookedLock:
+        def acquire(self, *a, **kw):
+            rec.record("lock", "flight.FlightRecorder._lock")
+            return real_lock.acquire(*a, **kw)
+
+        def release(self):
+            real_lock.release()
+
+        def __enter__(self):
+            self.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self.release()
+            return False
+
+    rec.record("event", "before")
+    rec._lock = HookedLock()
+    rec.flush_drop_counter()            # would deadlock unguarded
+    snap = rec.snapshot()
+    assert any(e["name"] == "before" for e in snap["ring"])
+    assert rec.dump("exception", detail="boom") == out
+    # the hooked lock was never re-entered: the guard dropped the
+    # hook's record instead of blocking, and the dump completed
+    assert not real_lock.locked()
+    assert threading.current_thread() is threading.main_thread()
+
+
+# ---------------------------------------------------------------------------
+# taps: the existing sinks feed the ring with no new call sites
+# ---------------------------------------------------------------------------
+
+def test_registry_event_tap(tmp_path):
+    reg, rec, _ = _recorder(tmp_path)
+    reg.flight = rec
+    reg.event("heartbeat", bases=100)
+    ring = rec.snapshot()["ring"]
+    assert ring[-1]["kind"] == "event"
+    assert ring[-1]["name"] == "heartbeat"
+    assert ring[-1]["bases"] == 100
+
+
+def test_span_tracer_tap(tmp_path):
+    reg, rec, _ = _recorder(tmp_path)
+    tracer = SpanTracer(None)
+    tracer.flight = rec
+    with tracer.step("stage1_insert", 3, reads=7):
+        pass
+    kinds = [(e["kind"], e["name"]) for e in rec.snapshot()["ring"]]
+    assert ("span_open", "stage1_insert") in kinds
+    assert ("span", "stage1_insert") in kinds
+
+
+def test_fault_firing_leaves_breadcrumb(tmp_path):
+    reg, rec, _ = _recorder(tmp_path)
+    token = flight.install(rec)
+    try:
+        faults.install(faults.FaultPlan.parse(
+            {"site": "stage1.insert", "action": "error"}), "t-crumb")
+        with pytest.raises(faults.FaultError):
+            faults.inject("stage1.insert", batch=5)
+    finally:
+        faults.reset()
+        flight.uninstall(token)
+    ring = rec.snapshot()["ring"]
+    crumb = [e for e in ring if e["kind"] == "fault"]
+    assert crumb and crumb[-1]["name"] == "stage1.insert"
+    assert crumb[-1]["action"] == "error"
+    assert crumb[-1]["batch"] == 5
+
+
+# ---------------------------------------------------------------------------
+# dumps
+# ---------------------------------------------------------------------------
+
+def test_dump_is_sealed_valid_and_once_per_incident(tmp_path):
+    reg, rec, out = _recorder(tmp_path)
+    reg.flight = rec
+    reg.event("checkpoint", cursor=42)
+    path = rec.dump("watchdog", detail="step wedged",
+                    site="serve.engine.step")
+    assert path == out
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_flight_dump(doc) == []
+    assert doc["schema"] == FLIGHT_SCHEMA
+    trig = doc["trigger"]
+    assert trig["kind"] == "watchdog"
+    assert trig["site"] == "serve.engine.step"
+    assert trig["thread"] == threading.current_thread().name
+    assert any(e["name"] == "checkpoint" for e in doc["ring"])
+    assert any(t["tid"] == trig["tid"] for t in doc["threads"])
+    assert "QUORUM_FLIGHT" in doc["levers"]
+    assert validate_metrics(doc["registry"]) == []
+    # first trigger wins: a second dump is a no-op returning the path
+    assert rec.dump("exception", detail="later") == out
+    assert reg.as_dict()["counters"]["flight_dumps_total"] == 1
+    with open(out) as f:
+        assert json.load(f)["trigger"]["kind"] == "watchdog"
+    # ... unless forced (the operator's SIGUSR1)
+    assert rec.dump("sigusr1", force=True) == out
+    assert reg.as_dict()["counters"]["flight_dumps_total"] == 2
+
+
+def test_dump_without_path_stays_in_ring(tmp_path):
+    reg = MetricsRegistry()
+    rec = flight.FlightRecorder(reg, out_path=None)
+    assert rec.dump("watchdog", site="serve.engine.step") is None
+    ring = rec.snapshot()["ring"]
+    assert ring[-1]["kind"] == "trigger"
+    assert ring[-1]["site"] == "serve.engine.step"
+    assert reg.as_dict()["counters"]["flight_dumps_total"] == 0
+
+
+def test_dump_captures_exception_context(tmp_path):
+    reg, rec, out = _recorder(tmp_path)
+    try:
+        raise ValueError("kaboom")
+    except ValueError:
+        rec.dump("exception", detail="umbrella")
+    with open(out) as f:
+        trig = json.load(f)["trigger"]
+    assert "kaboom" in trig["exception"]
+    assert any("kaboom" in ln for ln in trig["exc_stack"])
+
+
+def test_default_out_path(monkeypatch, tmp_path):
+    monkeypatch.delenv("QUORUM_FLIGHT_DIR", raising=False)
+    assert flight.default_out_path("run/metrics.json") == \
+        "run/metrics.flight.json"
+    assert flight.default_out_path(None) is None
+    monkeypatch.setenv("QUORUM_FLIGHT_DIR", str(tmp_path))
+    p = flight.default_out_path("run/metrics.json")
+    assert p == str(tmp_path / f"flight-{os.getpid()}.json")
+
+
+def test_install_nesting_and_try_dump(tmp_path):
+    assert flight.current() is None
+    assert flight.try_dump("watchdog") is None  # no recorder: no-op
+    reg1, rec1, _ = _recorder(tmp_path)
+    reg2, rec2, out2 = _recorder(tmp_path / "inner")
+    os.makedirs(tmp_path / "inner", exist_ok=True)
+    t1 = flight.install(rec1)
+    t2 = flight.install(rec2)
+    try:
+        assert flight.current() is rec2
+        assert flight.try_dump("exception", detail="x") == out2
+    finally:
+        flight.uninstall(t2)
+        assert flight.current() is rec1
+        flight.uninstall(t1)
+    assert flight.current() is None
+
+
+def test_try_dump_reraises_the_fault_site(tmp_path):
+    reg, rec, out = _recorder(tmp_path)
+    token = flight.install(rec)
+    try:
+        faults.install(faults.FaultPlan.parse(
+            {"site": "flight.dump", "action": "error"}), "t-site")
+        with pytest.raises(faults.FaultError):
+            flight.try_dump("watchdog", site="serve.engine.step")
+    finally:
+        faults.reset()
+        flight.uninstall(token)
+    # the dump itself landed before the injected post-write failure
+    assert os.path.exists(out)
+
+
+def test_sigusr1_handler_forces_a_dump(tmp_path):
+    reg, rec, out = _recorder(tmp_path)
+    token = flight.install(rec)
+    try:
+        rec.dump("watchdog")
+        flight._sigusr1(None, None)  # the handler body, sans signal
+    finally:
+        flight.uninstall(token)
+    assert reg.as_dict()["counters"]["flight_dumps_total"] == 2
+    with open(out) as f:
+        assert json.load(f)["trigger"]["kind"] == "sigusr1"
+
+
+# ---------------------------------------------------------------------------
+# schema validators + the metrics_check gate
+# ---------------------------------------------------------------------------
+
+def test_validate_flight_dump_requires_the_seal(tmp_path):
+    reg, rec, out = _recorder(tmp_path)
+    rec.dump("error")
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_flight_dump(doc) == []
+    # tampering after the write must be detected
+    doc["dropped"] += 1
+    assert any("seal mismatch" in e for e in validate_flight_dump(doc))
+    # an unsealed dump is invalid even if otherwise well-formed
+    doc["dropped"] -= 1
+    del doc["crc32c"]
+    assert any("seal" in e for e in validate_flight_dump(doc))
+
+
+def test_validate_flight_dump_shape_errors():
+    assert validate_flight_dump([]) != []
+    errs = validate_flight_dump({"schema": "nope"})
+    assert any("schema" in e for e in errs)
+    assert any("trigger" in e for e in errs)
+    assert any("ring" in e for e in errs)
+
+
+def test_validate_debug_bundle_manifest():
+    from quorum_tpu.io import integrity
+    good = integrity.seal({
+        "schema": "quorum-tpu-debug-bundle/1",
+        "meta": {"tool": "quorum-debug-bundle", "pid": 1,
+                 "argv": ["x"], "created_unix_s": 0, "missing": 0},
+        "files": [{"name": "dump.flight.json", "kind": "flight",
+                   "bytes": 10, "crc32c": 7, "problems": 0}],
+    })
+    assert validate_debug_bundle_manifest(good) == []
+    bad_kind = dict(good)
+    bad_kind["files"] = [dict(good["files"][0], kind="selfie")]
+    assert any("kind" in e
+               for e in validate_debug_bundle_manifest(bad_kind))
+    empty = dict(good, files=[])
+    assert any("empty" in e
+               for e in validate_debug_bundle_manifest(empty))
+
+
+def test_check_file_dispatches_flight_and_bundle(tmp_path):
+    from quorum_tpu.telemetry import check_file
+    reg, rec, out = _recorder(tmp_path)
+    rec.dump("error")
+    assert check_file(out) == []
+    # a tampered dump fails through the same dispatch
+    with open(out) as f:
+        doc = json.load(f)
+    doc["dropped"] += 1
+    bad = tmp_path / "bad.flight.json"
+    bad.write_text(json.dumps(doc))
+    assert check_file(str(bad)) != []
+
+
+def test_metrics_check_serve_stage_dump_not_held_to_serve_names(
+        tmp_path):
+    # a serve run's flight dump carries meta.stage == "serve" (the
+    # dying run's stage), but it is a forensics artifact, NOT a final
+    # serve document: metrics_check must validate it by its own
+    # schema and not demand the serve counter contract of it (the
+    # chaos_soak watchdog-dump regression)
+    mc = _tool("metrics_check")
+    reg, rec, out = _recorder(tmp_path)
+    rec.record("event", "heartbeat")
+    rec.dump("watchdog", detail="engine step wedged",
+             site="serve.engine.step")
+    with open(out) as f:
+        doc = json.load(f)
+    doc["meta"]["stage"] = "serve"
+    from quorum_tpu.io import integrity
+    doc.pop("crc32c", None)
+    sealed = integrity.seal(doc)
+    out2 = tmp_path / "serve_run.flight.json"
+    out2.write_text(json.dumps(sealed))
+    assert mc._check_with_serve_names(str(out2)) == []
+
+
+def test_metrics_check_requires_flight_counters_when_declared():
+    mc = _tool("metrics_check")
+    doc = {"schema": "quorum-tpu-metrics/1",
+           "meta": {"flight": True},
+           "counters": {}, "gauges": {}, "histograms": {},
+           "timers": {}}
+    probs = mc._check_flight_names(doc)
+    assert any("flight_dumps_total" in p for p in probs)
+    doc["counters"] = {"flight_dumps_total": 0,
+                       "flight_events_dropped_total": 0}
+    assert mc._check_flight_names(doc) == []
+    # undeclared documents are not held to it
+    assert mc._check_flight_names(
+        {"meta": {}, "counters": {}}) == []
+
+
+def test_validate_metrics_events_section():
+    base = {"schema": "quorum-tpu-metrics/1", "meta": {},
+            "counters": {}, "gauges": {}, "histograms": {},
+            "timers": {}}
+    ev = {"event": "alert", "t": 1.5, "rule": "fleet_host_stale",
+          "state": "firing", "host": "h:1", "value": 2.0,
+          "detail": "no push for 2.0s", "severity": "warn"}
+    assert validate_metrics(dict(base, events=[ev])) == []
+    # a malformed alert event is flagged in place
+    bad = dict(ev, state="panicking")
+    errs = validate_metrics(dict(base, events=[bad]))
+    assert any("events[0]" in e for e in errs)
+    # nested host shards may NOT carry events
+    nested = dict(base, hosts={"h:1": dict(base, events=[ev])})
+    assert any("unknown top-level keys" in e
+               for e in validate_metrics(nested))
+
+
+# ---------------------------------------------------------------------------
+# trace_summary --flight
+# ---------------------------------------------------------------------------
+
+def test_trace_summary_renders_flight_dump(tmp_path, capsys):
+    reg, rec, out = _recorder(tmp_path)
+    reg.flight = rec
+    reg.event("heartbeat", bases=9)
+    rec.record("dispatch", "stage1", dispatch_us=10, wait_us=2)
+    rec.dump("watchdog", detail="engine step exceeded 100 ms",
+             site="serve.engine.step")
+    ts = _tool("trace_summary")
+    assert ts.main(["--flight", out]) == 0
+    text = capsys.readouterr().out
+    assert "trigger: watchdog site=serve.engine.step" in text
+    assert "heartbeat" in text
+    assert "dispatch_us=10" in text
+    assert "triggering thread" in text
+
+
+def test_trace_summary_flight_window_filters(tmp_path, capsys):
+    reg, rec, out = _recorder(tmp_path)
+    rec.record("event", "ancient")
+    rec._ring[0]["t"] = 0.0
+    rec.record("event", "recent")
+    rec._ring[1]["t"] = 100.0
+    rec.dump("error")
+    ts = _tool("trace_summary")
+    assert ts.main(["--flight", "--last-s", "5", out]) == 0
+    text = capsys.readouterr().out
+    assert "recent" in text
+    assert "ancient" not in text
+
+
+def test_trace_summary_flight_rejects_non_dump(tmp_path, capsys):
+    p = tmp_path / "metrics.json"
+    p.write_text(json.dumps(
+        {"schema": "quorum-tpu-metrics/1", "meta": {}, "counters": {},
+         "gauges": {}, "histograms": {}, "timers": {}}))
+    ts = _tool("trace_summary")
+    assert ts.main(["--flight", str(p)]) == 1
+    assert "not a flight dump" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# quorum-debug-bundle
+# ---------------------------------------------------------------------------
+
+def test_debug_bundle_round_trip(tmp_path):
+    from quorum_tpu.cli import debug_bundle
+    reg, rec, dump = _recorder(tmp_path)
+    rec.dump("error", detail="died")
+    metrics = tmp_path / "metrics.json"
+    reg.write(str(metrics))
+    gone = tmp_path / "vanished.json"
+    bundle = tmp_path / "postmortem.tar.gz"
+    rc = debug_bundle.main([dump, str(metrics), str(gone),
+                            "--out", str(bundle), "-q"])
+    assert rc == 0
+    with tarfile.open(bundle) as tar:
+        names = tar.getnames()
+        manifest = json.load(tar.extractfile("MANIFEST.json"))
+        for entry in manifest["files"]:
+            data = tar.extractfile(entry["name"]).read()
+            assert len(data) == entry["bytes"]
+    assert validate_debug_bundle_manifest(manifest) == []
+    assert manifest["meta"]["missing"] == 1
+    kinds = {e["kind"] for e in manifest["files"]}
+    assert {"flight", "metrics", "config"} <= kinds
+    flight_entry = next(e for e in manifest["files"]
+                        if e["kind"] == "flight")
+    assert flight_entry["problems"] == 0
+    cfg = next(e for e in manifest["files"] if e["kind"] == "config")
+    assert cfg["name"] in names
+
+
+def test_debug_bundle_needs_something_to_collect():
+    from quorum_tpu.cli import debug_bundle
+    with pytest.raises(SystemExit):
+        debug_bundle.main([])
+
+
+# ---------------------------------------------------------------------------
+# push-receiver staleness alerting (satellite a)
+# ---------------------------------------------------------------------------
+
+def _host_doc():
+    return {"schema": "quorum-tpu-metrics/1", "meta": {},
+            "counters": {"reads": 1}, "gauges": {}, "histograms": {},
+            "timers": {}}
+
+
+def test_push_receiver_staleness_fires_and_heals(tmp_path):
+    pr = _tool("push_receiver")
+    out = tmp_path / "fleet.json"
+    rx = pr.PushReceiver(out_path=str(out), port=0, quiet=True,
+                         stale_after_s=0.25)
+    try:
+        body = json.dumps(_host_doc()).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rx.port}/push/final", data=body,
+            headers={"X-Quorum-Host": "h:1"})
+        urllib.request.urlopen(req, timeout=10).read()
+        # armed but fresh: not stale
+        h = rx.health()
+        assert h["stale_after_s"] == 0.25
+        assert h["stale_hosts"] == []
+        # go silent past the threshold: the ticker fires the rule
+        deadline = time.monotonic() + 10
+        while rx.health()["stale_hosts"] != ["h:1"]:
+            assert time.monotonic() < deadline, "never fired"
+            time.sleep(0.05)
+        text = rx._own_metrics_text()
+        assert 'fleet_host_stale{host="h:1"} 1' in text
+        events = rx.alert_events
+        assert events[-1]["rule"] == "fleet_host_stale"
+        assert events[-1]["state"] == "firing"
+        # the alert event rides the on-disk fleet document
+        deadline = time.monotonic() + 10
+        while True:
+            fleet = json.loads(out.read_text())
+            if fleet.get("events"):
+                break
+            assert time.monotonic() < deadline, "event never landed"
+            time.sleep(0.05)
+        assert validate_metrics(fleet) == []
+        assert fleet["events"][-1]["state"] == "firing"
+        # the host returns: the rule heals
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{rx.port}/push/final", data=body,
+                headers={"X-Quorum-Host": "h:1"}),
+            timeout=10).read()
+        assert rx.health()["stale_hosts"] == []
+        assert 'fleet_host_stale{host="h:1"} 0' \
+            in rx._own_metrics_text()
+        states = [e["state"] for e in rx.alert_events]
+        assert states.count("firing") == 1
+        assert states.count("healed") == 1
+    finally:
+        rx.close()
+
+
+def test_push_receiver_without_threshold_is_unchanged(tmp_path):
+    pr = _tool("push_receiver")
+    rx = pr.PushReceiver(port=0, quiet=True)
+    try:
+        h = rx.health()
+        assert "stale_hosts" not in h
+        assert "fleet_host_stale" not in rx._own_metrics_text()
+        assert rx._ticker is None
+    finally:
+        rx.close()

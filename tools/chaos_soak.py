@@ -10,7 +10,10 @@ fault plan, and assert the invariants that define the tier:
   * a `hang` fault in the engine step is contained by the watchdog:
     only that request fails, the engine generation bumps
     (`engine_restarts_total`), and the next request succeeds on the
-    rebuilt engine,
+    rebuilt engine — and the watchdog leaves exactly ONE sealed
+    flight-recorder dump (telemetry/flight.py, ISSUE 16) naming the
+    hung `serve.engine.step` with the abandoned step thread's stack;
+    the clean drain at the end must NOT add another,
   * consecutive injected step failures flip /healthz to 503 and a
     clean request heals it back to 200,
   * an ambiguous batch failure is hedged: innocent batchmates of a
@@ -33,6 +36,8 @@ Artifacts land in --out-dir:
                        it, including SERVE_FEATURE_COUNTERS)
   chaos_scrape.prom  — a /metrics scrape taken mid-soak
                        (metrics_check --prom gates it)
+  chaos_metrics.flight.json — the watchdog's black-box dump from the
+                       hang phase (metrics_check gates it by schema)
 
 Exit 0 = all invariants held. Deterministic for a fixed --seed: the
 phase plans are fixed and the storm's fault plan derives from the
@@ -230,6 +235,37 @@ def main(argv=None) -> int:
         if probe_parity("phase 2 (rebuilt engine)", "read1"):
             return 1
         faults.release_hangs()
+
+        # the watchdog is a flight-recorder trigger (ISSUE 16): the
+        # hang must leave exactly one sealed black-box dump next to
+        # the metrics document, pinpointing the wedged engine step
+        flight_path = metrics_path[:-len(".json")] + ".flight.json"
+        fdeadline = time.perf_counter() + 10
+        while not os.path.exists(flight_path):
+            if time.perf_counter() > fdeadline:
+                return _fail("phase 2: watchdog fired but no flight "
+                             f"dump at {flight_path}")
+            time.sleep(0.05)
+        with open(flight_path) as f:
+            fdoc = json.load(f)
+        trig = fdoc.get("trigger", {})
+        if trig.get("kind") != "watchdog":
+            return _fail(f"phase 2: flight trigger kind "
+                         f"{trig.get('kind')!r} (want 'watchdog')")
+        if trig.get("site") != "serve.engine.step":
+            return _fail(f"phase 2: flight trigger site "
+                         f"{trig.get('site')!r} "
+                         "(want 'serve.engine.step')")
+        if "quorum-serve-step" not in trig.get("detail", ""):
+            return _fail("phase 2: flight trigger does not name the "
+                         f"hung step thread: {trig.get('detail')!r}")
+        # the abandoned step thread was still alive at dump time, so
+        # the all-thread stacks must show WHERE it wedged
+        if not any(t.get("name", "").startswith("quorum-serve-step")
+                   for t in fdoc.get("threads", [])):
+            return _fail("phase 2: flight dump lacks the hung "
+                         "quorum-serve-step thread's stack")
+        print(f"[chaos_soak] phase 2: flight dump -> {flight_path}")
 
         # -- phase 3: health flips under consecutive failures, heals -------
         print("[chaos_soak] phase 3: consecutive failures flip "
@@ -465,6 +501,24 @@ def main(argv=None) -> int:
         return _fail("metrics_check rejected the final document")
     if mc.main(["--prom", scrape_path]) != 0:
         return _fail("metrics_check --prom rejected the scrape")
+    # the flight dump itself is a gated artifact: schema + seal via
+    # the same metrics_check dispatch CI uses
+    if mc.main([flight_path]) != 0:
+        return _fail("metrics_check rejected the flight dump")
+    # exactly ONE incident, and the clean drain added no dump: the
+    # phase-2 watchdog dump is the only one (first-trigger-wins), and
+    # phases 3-7's contained failures plus the quiesce drain must not
+    # have produced another
+    if counters.get("flight_dumps_total", 0) != 1:
+        return _fail("final doc: flight_dumps_total="
+                     f"{counters.get('flight_dumps_total')} (want "
+                     "exactly 1: the phase-2 watchdog incident; a "
+                     "clean drain must not dump)")
+    stray = [n for n in os.listdir(out_dir)
+             if n.endswith(".flight.json")
+             and os.path.join(out_dir, n) != flight_path]
+    if stray:
+        return _fail(f"clean drain left stray flight dumps: {stray}")
 
     print(f"[chaos_soak] OK: all invariants held (seed {args.seed}); "
           f"final metrics -> {metrics_path}")

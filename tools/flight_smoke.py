@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Flight-recorder smoke for CI (ISSUE 16, ci/tier1.sh): the black
+box must dump when a run dies, stay silent when it doesn't, and cost
+~nothing while it waits.
+
+Four gates in one tool:
+
+1. **Steady state**: a clean golden database build with the recorder
+   on (its default) must produce ZERO flight dumps — no
+   ``*.flight.json`` sibling, ``flight_dumps_total`` 0 in the final
+   document (which declares ``meta.flight``, so metrics_check
+   requires the contract counters to be present at all).
+
+2. **Overhead A/B**: the same build timed recorder-on vs
+   ``QUORUM_FLIGHT=0``, emitted as a BENCH metric line
+   (``flight_overhead``: ``base_ms`` / ``flight_ms`` /
+   ``overhead_ratio``) into ``flight_ab.json`` for the perf-diff gate
+   — PERF_BASELINE.json bounds the ratio ABSOLUTELY (machine-
+   independent), so a recorder that starts costing real time fails CI
+   like a throughput cliff.
+
+3. **Seeded crash**: the golden build killed by a fault-plan
+   ``error`` at ``stage1.insert`` must exit nonzero AND leave exactly
+   one sealed dump (``<metrics>.flight.json``) that passes the
+   schema/seal validation via tools/metrics_check.py, whose trigger
+   records the dying run (kind ``error``) and whose ring holds the
+   ``fault`` breadcrumb naming ``stage1.insert`` — the black box
+   pinpoints the site that killed the run. ``trace_summary --flight``
+   must render it (timeline + triggering thread).
+
+4. **Postmortem bundle**: ``quorum-debug-bundle`` over the crash
+   dump + error document + the steady run's database must produce a
+   tarball whose sealed manifest validates, classifies the artifacts
+   (flight/metrics), and carries a quorum-fsck verdict + config.
+
+Artifacts land in --out-dir:
+  steady_metrics.json        — the clean run (metrics_check gates it)
+  crash_metrics.json         — the killed run's error document
+  crash_metrics.flight.json  — the black-box dump (metrics_check
+                               gates it by schema)
+  flight_ab.json             — the overhead metric line (perf_diff
+                               judges it against PERF_BASELINE.json)
+
+Exit 0 = all gates held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import sys
+import tarfile
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def _fail(msg: str) -> int:
+    print(f"[flight_smoke] FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Flight-recorder smoke: zero dumps on a clean "
+                    "golden run, a sealed pinpointing dump on a "
+                    "seeded stage1.insert crash, bounded ring "
+                    "overhead (A/B), and a debug-bundle round trip "
+                    "(ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Artifact directory (default: a temp dir)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="flight_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import debug_bundle
+    from quorum_tpu.telemetry import schema as schema_mod
+    from quorum_tpu.utils import faults
+
+    mc = _load_tool("metrics_check")
+    ts = _load_tool("trace_summary")
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    cdb_args = ["-s", "64k", "-m", "13", "-b", "7", "-q", "38"]
+
+    def build(db: str, metrics: str | None) -> int:
+        a = list(cdb_args) + ["-o", db]
+        if metrics:
+            a += ["--metrics", metrics]
+        return cdb_cli.main(a + [reads])
+
+    # -- gate 1: steady state — a clean run must not dump -------------------
+    print("[flight_smoke] gate 1: clean golden build (recorder on)")
+    db = os.path.join(out_dir, "db.jf")
+    steady_metrics = os.path.join(out_dir, "steady_metrics.json")
+    if build(db, steady_metrics) != 0:
+        return _fail("gate 1: clean build failed")
+    steady_dump = steady_metrics[:-len(".json")] + ".flight.json"
+    if os.path.exists(steady_dump):
+        return _fail(f"gate 1: clean run dumped: {steady_dump}")
+    with open(steady_metrics) as f:
+        doc = json.load(f)
+    if doc.get("meta", {}).get("flight") is not True:
+        return _fail("gate 1: final document does not declare "
+                     "meta.flight")
+    if doc.get("counters", {}).get("flight_dumps_total") != 0:
+        return _fail("gate 1: flight_dumps_total="
+                     f"{doc.get('counters', {}).get('flight_dumps_total')}"
+                     " (want 0 on a clean run)")
+    if mc.main([steady_metrics]) != 0:
+        return _fail("gate 1: metrics_check rejected the steady doc")
+
+    # -- gate 2: overhead A/B — the ring must be ~free ----------------------
+    # gate 1 was the warmup: it paid the JIT compile, so both timed
+    # builds below hit a warm cache and measure the recorder alone.
+    # Absolute ratio bounds live in PERF_BASELINE.json: wall clock is
+    # machine-dependent, the RATIO is not.
+    print("[flight_smoke] gate 2: overhead A/B (QUORUM_FLIGHT=0 base)")
+    t0 = time.perf_counter()
+    rc = build(os.path.join(out_dir, "db_flight.jf"), None)
+    flight_ms = (time.perf_counter() - t0) * 1e3
+    if rc != 0:
+        return _fail("gate 2: recorder-on build failed")
+    prev = os.environ.get("QUORUM_FLIGHT")
+    os.environ["QUORUM_FLIGHT"] = "0"
+    try:
+        t0 = time.perf_counter()
+        rc = build(os.path.join(out_dir, "db_base.jf"), None)
+        base_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        if prev is None:
+            os.environ.pop("QUORUM_FLIGHT", None)
+        else:
+            os.environ["QUORUM_FLIGHT"] = prev
+    if rc != 0:
+        return _fail("gate 2: QUORUM_FLIGHT=0 build failed")
+    ab_path = os.path.join(out_dir, "flight_ab.json")
+    line = {"metric": "flight_overhead",
+            "base_ms": round(base_ms, 3),
+            "flight_ms": round(flight_ms, 3),
+            "overhead_ratio": round(flight_ms / base_ms, 4)}
+    with open(ab_path, "w") as f:
+        f.write(json.dumps(line) + "\n")
+    print(f"[flight_smoke] gate 2: base={base_ms:.0f}ms "
+          f"flight={flight_ms:.0f}ms "
+          f"ratio={line['overhead_ratio']:.3f}")
+    if mc.main(["--require-metric", "flight_overhead", ab_path]) != 0:
+        return _fail("gate 2: metrics_check rejected flight_ab.json")
+
+    # -- gate 3: seeded crash — the black box must pinpoint it --------------
+    print("[flight_smoke] gate 3: fault-plan error at stage1.insert")
+    crash_metrics = os.path.join(out_dir, "crash_metrics.json")
+    faults.install(faults.FaultPlan.parse(
+        {"site": "stage1.insert", "action": "error"}), "flight-smoke")
+    try:
+        rc = build(os.path.join(out_dir, "db_crash.jf"), crash_metrics)
+    finally:
+        faults.reset()
+    if rc == 0:
+        return _fail("gate 3: the seeded crash run succeeded")
+    dump_path = crash_metrics[:-len(".json")] + ".flight.json"
+    if not os.path.exists(dump_path):
+        return _fail(f"gate 3: no flight dump at {dump_path}")
+    with open(dump_path) as f:
+        fdoc = json.load(f)
+    errs = schema_mod.validate_flight_dump(fdoc)
+    if errs:
+        return _fail(f"gate 3: dump invalid: {errs[:3]}")
+    trig = fdoc.get("trigger", {})
+    if trig.get("kind") != "error":
+        return _fail(f"gate 3: trigger kind {trig.get('kind')!r} "
+                     "(want 'error': a run that exited "
+                     "status=error)")
+    # the ring's fault breadcrumb is the pinpoint: the site that
+    # killed the run, recorded by the injection itself
+    hits = [e for e in fdoc.get("ring", [])
+            if e.get("kind") == "fault"
+            and e.get("name") == "stage1.insert"]
+    if not hits:
+        return _fail("gate 3: ring carries no fault breadcrumb for "
+                     "stage1.insert")
+    if not any(t.get("tid") == hits[-1].get("tid")
+               for t in fdoc.get("threads", [])):
+        return _fail("gate 3: dump lacks the faulting thread's stack")
+    if mc.main([dump_path]) != 0:
+        return _fail("gate 3: metrics_check rejected the dump")
+    with open(crash_metrics) as f:
+        cdoc = json.load(f)
+    if cdoc.get("counters", {}).get("flight_dumps_total") != 1:
+        return _fail("gate 3: error doc flight_dumps_total="
+                     f"{cdoc.get('counters', {}).get('flight_dumps_total')}"
+                     " (want exactly 1)")
+    if mc.main([crash_metrics]) != 0:
+        return _fail("gate 3: metrics_check rejected the error doc")
+    # the operator view must render: timeline + triggering thread
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = ts.main(["--flight", dump_path])
+    text = buf.getvalue()
+    if rc != 0:
+        return _fail(f"gate 3: trace_summary --flight rc={rc}")
+    if "stage1.insert" not in text or "trigger" not in text.lower():
+        return _fail("gate 3: trace_summary --flight render lacks "
+                     "the trigger/fault site")
+    print("[flight_smoke] gate 3: dump pinpoints stage1.insert "
+          f"({len(fdoc.get('ring', []))} ring entries)")
+
+    # -- gate 4: postmortem bundle round trip -------------------------------
+    print("[flight_smoke] gate 4: quorum-debug-bundle round trip")
+    bundle = os.path.join(out_dir, "postmortem.tar.gz")
+    rc = debug_bundle.main([dump_path, crash_metrics,
+                            "--db", db, "--out", bundle, "-q"])
+    if rc != 0:
+        return _fail(f"gate 4: quorum-debug-bundle rc={rc}")
+    with tarfile.open(bundle) as tar:
+        names = tar.getnames()
+        mf = tar.extractfile("MANIFEST.json")
+        manifest = json.load(mf)
+    errs = schema_mod.validate_debug_bundle_manifest(manifest)
+    if errs:
+        return _fail(f"gate 4: manifest invalid: {errs[:3]}")
+    kinds = {e["kind"] for e in manifest["files"]}
+    if not {"flight", "metrics", "fsck", "config"} <= kinds:
+        return _fail(f"gate 4: manifest kinds {sorted(kinds)} "
+                     "(want flight/metrics/fsck/config)")
+    by_kind = {e["kind"]: e for e in manifest["files"]}
+    if by_kind["flight"]["problems"] != 0:
+        return _fail("gate 4: the collected dump was flagged "
+                     f"({by_kind['flight']['problems']} problems)")
+    if by_kind["fsck"]["exit_status"] != 0:
+        return _fail("gate 4: fsck verdict nonzero on the clean db")
+    missing = [e["name"] for e in manifest["files"]
+               if e["name"] not in names]
+    if missing:
+        return _fail(f"gate 4: manifest names absent files: {missing}")
+
+    print(f"[flight_smoke] OK: silent when clean, pinpointing when "
+          f"killed; artifacts -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

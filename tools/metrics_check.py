@@ -36,7 +36,12 @@ shards matching meta.fleet_hosts. A document declaring alert rules
 active (meta.alert_rules, ISSUE 11) must carry the alert engine's
 counters/gauges with `alerts_firing{rule=}` values in {0, 1} naming
 declared rules; `meta.autotune_profile`, when present, must be a
-non-empty path. perf_diff verdict documents
+non-empty path. A document declaring meta.flight (the flight
+recorder was installed and enabled, ISSUE 16) must carry the
+dump/drop counters (FLIGHT_COUNTERS); flight dump documents
+(quorum-tpu-flight/1) and debug-bundle manifests
+(quorum-tpu-debug-bundle/1) validate through their own schema
+validators, seal recomputed. perf_diff verdict documents
 (quorum-tpu-perf-diff/1) validate for internal coherence (verdict
 vs regression list vs per-metric ok flags). `request` and `alert`
 lifecycle events in events JSONL are held to their richer contracts
@@ -80,6 +85,7 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     DEVTRACE_HISTOGRAMS,
     DEVTRACE_META,
     FAULT_COUNTERS,
+    FLIGHT_COUNTERS,
     INTEGRITY_COUNTERS,
     PARTITION_COUNTERS,
     PARTITION_GAUGE_PREFIX,
@@ -287,6 +293,24 @@ def _check_compile_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_flight_names(doc: dict) -> list[str]:
+    """Flight-recorder requirements (ISSUE 16): dispatch on
+    meta.flight — observability() stamps it when the recorder is
+    installed and enabled, and FlightRecorder pre-creates both
+    counters at construction, so a missing name means the black box
+    silently disarmed (a clean zero-dump run still carries them
+    at 0)."""
+    meta = doc.get("meta", {})
+    if not meta.get("flight"):
+        return []
+    errs = []
+    why = f"meta.flight={meta.get('flight')!r}"
+    for name in FLIGHT_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter {name!r}")
+    return errs
+
+
 def _check_fleet_doc(doc: dict) -> list[str]:
     """Fleet-document requirements (tools/push_receiver.py): a
     document stamped meta.fleet must carry the per-host shards under
@@ -392,6 +416,14 @@ def _check_with_serve_names(path: str) -> list[str]:
         return problems
     if not isinstance(doc, dict):
         return problems
+    from quorum_tpu.telemetry.schema import SCHEMA_VERSION
+    if (isinstance(doc.get("schema"), str)
+            and doc["schema"] != SCHEMA_VERSION):
+        # a flight dump / debug-bundle manifest / perf-diff verdict:
+        # its own schema validator ran in check_file, and its meta
+        # (pid/argv/stage of the dying run) must not pull the final-
+        # document counter contracts onto a forensics artifact
+        return problems
     if doc.get("meta", {}).get("stage") == "serve":
         problems = problems + _check_serve_names(doc)
     if "meta" in doc:
@@ -406,6 +438,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_alert_names(doc)
         problems = problems + _check_autotune_meta(doc)
         problems = problems + _check_compile_names(doc)
+        problems = problems + _check_flight_names(doc)
     return problems
 
 

@@ -20,7 +20,14 @@ its final metrics JSON document to `/push/final`, both stamped with an
   (seconds since each host's last push), the fleet-level signal a
   silent host can't suppress; the same staleness rides `GET /metrics`
   as `quorum_tpu_push_doc_age_seconds{host=...}` gauges so an
-  absence-style alert rule can watch it (ISSUE 11).
+  absence-style alert rule can watch it (ISSUE 11);
+* with `--stale-after-s S`, evaluates that absence rule ITSELF
+  (telemetry/alerts.py semantics: arm on first push, fire once silent
+  past S, heal on return): each armed host gets a 0/1
+  `fleet_host_stale{host=...}` gauge at `GET /metrics`, firing hosts
+  are listed under `stale_hosts` in `/healthz`, and every transition
+  appends an `alert` event to the fleet document's `events` section —
+  the one record the silent host cannot write itself (ISSUE 16).
 
 Usage: python tools/push_receiver.py --port 9200 --out fleet.json
 
@@ -78,7 +85,8 @@ class PushReceiver:
 
     def __init__(self, out_path: str | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 quiet: bool = True):
+                 quiet: bool = True,
+                 stale_after_s: float | None = None):
         import http.server
 
         self.out_path = out_path
@@ -90,6 +98,17 @@ class PushReceiver:
         self.pushes = 0
         self.final_pushes = 0
         self._t0 = time.perf_counter()
+        # fleet staleness alerting (ISSUE 16): absence-rule semantics
+        # from telemetry/alerts.py — a host ARMS on its first push
+        # (only hosts in _last_seen are watched), FIRES once silent
+        # past the threshold, HEALS when it pushes again; each
+        # transition appends one alert-shaped event that rides the
+        # fleet document (the silent host cannot write it itself)
+        self.stale_after_s = (float(stale_after_s)
+                              if stale_after_s else None)
+        self._stale: dict[str, bool] = {}     # host -> firing
+        self._alert_events: list[dict] = []
+        self._stop = threading.Event()
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -187,6 +206,12 @@ class PushReceiver:
             kwargs={"poll_interval": 0.1},
             name="quorum-push-receiver", daemon=True)
         self._thread.start()
+        self._ticker = None
+        if self.stale_after_s is not None:
+            self._ticker = threading.Thread(
+                target=self._stale_loop,
+                name="quorum-push-staleness", daemon=True)
+            self._ticker.start()
 
     # -- push handling ----------------------------------------------------
     def _on_text(self, host_id: str, body: bytes) -> None:
@@ -200,14 +225,61 @@ class PushReceiver:
             self._finals[host_id] = doc
             self._last_seen[host_id] = time.perf_counter()
             self.final_pushes += 1
-            fleet = merge_fleet(self._finals)
-            self._fleet = fleet
-            # write INSIDE the lock: ThreadingHTTPServer handles
-            # concurrent finals, and a stale snapshot written last
-            # would silently drop the other host from the on-disk doc
-            if self.out_path:
-                atomic_write(self.out_path,
-                             json.dumps(fleet, indent=1) + "\n")
+            self._rebuild_fleet_locked()
+
+    def _rebuild_fleet_locked(self) -> None:
+        """Re-merge and re-write the fleet document (caller holds the
+        lock): the alert-event ledger rides every snapshot, so a host
+        that went stale AFTER its final push still shows in the
+        on-disk document."""
+        if not self._finals:
+            return
+        fleet = merge_fleet(self._finals)
+        if self._alert_events:
+            fleet["events"] = [dict(e) for e in self._alert_events]
+        self._fleet = fleet
+        # write INSIDE the lock: ThreadingHTTPServer handles
+        # concurrent finals, and a stale snapshot written last
+        # would silently drop the other host from the on-disk doc
+        if self.out_path:
+            atomic_write(self.out_path,
+                         json.dumps(fleet, indent=1) + "\n")
+
+    # -- staleness alerting (ISSUE 16) ------------------------------------
+    def _check_stale_locked(self, now: float) -> bool:
+        """One absence-rule evaluation over every armed host (caller
+        holds the lock). Returns True when any host transitioned
+        (fired or healed) — the signal to re-write the fleet doc."""
+        if self.stale_after_s is None:
+            return False
+        changed = False
+        for h, last in self._last_seen.items():
+            age = now - last
+            firing = age > self.stale_after_s
+            if firing == self._stale.get(h, False):
+                continue
+            changed = True
+            self._stale[h] = firing
+            state = "firing" if firing else "healed"
+            detail = (f"no push for {age:.1f}s "
+                      f"(> {self.stale_after_s:g}s)" if firing
+                      else "pushing again")
+            self._alert_events.append({
+                "event": "alert", "t": round(now - self._t0, 3),
+                "rule": "fleet_host_stale", "state": state,
+                "host": h, "value": round(age, 3),
+                "detail": detail, "severity": "warn"})
+        return changed
+
+    def _stale_loop(self) -> None:
+        """The staleness ticker: absence rules need a clock, not a
+        push — the whole point is noticing the push that DIDN'T
+        come."""
+        interval = max(0.05, min(1.0, self.stale_after_s / 4.0))
+        while not self._stop.wait(interval):
+            with self._lock:
+                if self._check_stale_locked(time.perf_counter()):
+                    self._rebuild_fleet_locked()
 
     # -- introspection ----------------------------------------------------
     def doc_ages(self) -> dict[str, float]:
@@ -224,7 +296,7 @@ class PushReceiver:
     def health(self) -> dict:
         ages = self.doc_ages()
         with self._lock:
-            return {
+            h = {
                 "status": "ok",
                 "uptime_s": round(time.perf_counter() - self._t0, 3),
                 "hosts": len(self._texts),
@@ -234,6 +306,18 @@ class PushReceiver:
                 # scraper notices its series went stale
                 "doc_age_s": ages,
             }
+            if self.stale_after_s is not None:
+                # evaluate NOW so the answer is current, and re-write
+                # the fleet doc on a transition — whichever observer
+                # (ticker, scrape, healthz) sees it first must not
+                # strand the alert event off-disk
+                if self._check_stale_locked(time.perf_counter()):
+                    self._rebuild_fleet_locked()
+                h["stale_after_s"] = self.stale_after_s
+                h["stale_hosts"] = sorted(
+                    host for host, firing in self._stale.items()
+                    if firing)
+            return h
 
     def _own_metrics_text(self) -> str:
         """The receiver's OWN gauges, appended to the fleet
@@ -246,6 +330,18 @@ class PushReceiver:
             lines.append(
                 f'quorum_tpu_push_doc_age_seconds{{host="{hv}"}} {age}')
         with self._lock:
+            if self.stale_after_s is not None:
+                # the 0/1 verdict next to the raw age: a threshold
+                # rule can watch the gauge directly instead of
+                # re-deriving the absence semantics from doc_age
+                if self._check_stale_locked(time.perf_counter()):
+                    self._rebuild_fleet_locked()
+                lines.append("# TYPE fleet_host_stale gauge")
+                for h in sorted(self._stale):
+                    hv = h.replace("\\", "\\\\").replace('"', '\\"')
+                    lines.append(
+                        f'fleet_host_stale{{host="{hv}"}} '
+                        f'{1 if self._stale[h] else 0}')
             lines.append("# TYPE quorum_tpu_push_hosts gauge")
             lines.append(f"quorum_tpu_push_hosts {len(self._texts)}")
             lines.append("# TYPE quorum_tpu_push_final_hosts gauge")
@@ -263,7 +359,15 @@ class PushReceiver:
         with self._lock:
             return sorted(self._finals)
 
+    @property
+    def alert_events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._alert_events]
+
     def close(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
@@ -280,12 +384,19 @@ def main(argv=None) -> int:
     p.add_argument("--out", metavar="path", default=None,
                    help="Re-write the aggregated fleet document here "
                         "after every final push (atomic replace)")
-    p.add_argument("-v", "--verbose", action="store_true",
-                   help="Log each push to stderr")
+    p.add_argument("--stale-after-s", type=float, default=None,
+                   metavar="S",
+                   help="Fire a per-host fleet_host_stale{host=} "
+                        "gauge (and an alert event in the fleet "
+                        "document) when a host that has pushed "
+                        "before goes silent for more than S seconds "
+                        "(absence-rule semantics: arm on first push, "
+                        "fire past the threshold, heal on return)")
     args = p.parse_args(argv)
 
     rx = PushReceiver(out_path=args.out, host=args.host,
-                      port=args.port, quiet=not args.verbose)
+                      port=args.port, quiet=not args.verbose,
+                      stale_after_s=args.stale_after_s)
     print(f"push_receiver: listening on {rx.host}:{rx.port}"
           + (f", fleet -> {args.out}" if args.out else ""), flush=True)
     try:

@@ -38,6 +38,13 @@ host), plus the top-K kernels by device time. This is the table that
 says whether the sweep, the extension loop, or the exchange is on
 the roofline — the host dispatch/wait split alone cannot.
 
+`--flight` (ISSUE 16) renders flight-recorder crash dumps
+(`quorum-tpu-flight/1`, telemetry/flight.py): the trigger line (what
+fired, at which site, on which thread), the ring as a timeline —
+optionally only the last `--last-s SECONDS` before the trigger — with the
+triggering thread's rows marked and its Python stack printed in
+full. Dumps are auto-detected by schema even without the flag.
+
 This is the quick look a BENCH run's time budget needs; for the
 timeline view load the `.trace.json` twin in Perfetto or
 `chrome://tracing`.
@@ -286,6 +293,71 @@ def partition_table(path: str, events: list[dict]) -> None:
               f"{secs:>9.3f} {pct:>8.1f}")
 
 
+FLIGHT_SCHEMA = "quorum-tpu-flight/1"
+
+
+def render_flight_dump(path: str, doc: dict,
+                       last_s: float | None = None) -> None:
+    """The postmortem view of a flight-recorder dump (ISSUE 16): the
+    trigger line first (what fired, where, on which thread), then the
+    ring as a timeline — optionally only the last `last_s` seconds
+    before the trigger — with the triggering thread's rows marked, and
+    finally that thread's Python stack (plus one line per other
+    thread). This is the `quorum-tpu-flight/1` twin of the span
+    tables: what the process was doing when it died or wedged."""
+    trig = doc.get("trigger", {})
+    ring = [e for e in doc.get("ring", []) if isinstance(e, dict)]
+    trig_tid = trig.get("tid")
+    t_end = max([float(e.get("t", 0.0)) for e in ring]
+                + [float(trig.get("t", 0.0))] or [0.0])
+    shown = ring
+    if last_s is not None and last_s > 0:
+        shown = [e for e in ring
+                 if float(e.get("t", 0.0)) >= t_end - last_s]
+    print(f"== flight dump: {path} ({len(ring)} ring entries, "
+          f"{doc.get('dropped', 0)} dropped, "
+          f"{len(doc.get('threads', []))} thread(s)) ==")
+    site = f" site={trig.get('site')}" if trig.get("site") else ""
+    print(f"trigger: {trig.get('kind', '?')}{site} on thread "
+          f"{trig.get('thread', '?')!r} (tid {trig_tid}) "
+          f"at t={float(trig.get('t', 0.0)):.3f}s")
+    if trig.get("detail"):
+        print(f"  detail: {trig['detail']}")
+    if trig.get("exception"):
+        print(f"  exception: {trig['exception']}")
+    window = (f"last {last_s:g} s"
+              if last_s is not None and last_s > 0 else "full ring")
+    print(f"\ntimeline ({window}, {len(shown)} entries; "
+          "* = triggering thread):")
+    print(f"{'t':>10} {'':1} {'tid':>8} {'kind':<10} {'name':<26} "
+          "fields")
+    for e in shown:
+        mark = "*" if trig_tid is not None \
+            and e.get("tid") == trig_tid else " "
+        extras = {k: v for k, v in e.items()
+                  if k not in ("t", "kind", "name", "tid")}
+        fields = " ".join(f"{k}={v}" for k, v in extras.items())
+        print(f"{float(e.get('t', 0.0)):>10.3f} {mark} "
+              f"{e.get('tid', '?'):>8} {str(e.get('kind', '?')):<10} "
+              f"{str(e.get('name', '?')):<26} {fields}")
+    threads = [t for t in doc.get("threads", [])
+               if isinstance(t, dict)]
+    culprit = next((t for t in threads if t.get("tid") == trig_tid),
+                   None)
+    if culprit is not None:
+        print(f"\ntriggering thread {culprit.get('name', '?')!r} "
+              f"(tid {trig_tid}) stack:")
+        for frame in culprit.get("stack", []):
+            for ln in frame.splitlines():
+                print(f"  {ln}")
+    others = [t for t in threads if t is not culprit]
+    if others:
+        print(f"\nother threads ({len(others)}):")
+        for t in others:
+            print(f"  {t.get('name', '?')!r} (tid {t.get('tid')}, "
+                  f"{len(t.get('stack', []))} frame(s))")
+
+
 def render_spans_file(path: str) -> None:
     spans = load_spans(path)
     rows, wall = span_table(spans)
@@ -309,6 +381,18 @@ def main(argv=None) -> int:
                         "(--metrics), or hosts/fleet documents "
                         "(.hosts.json, push_receiver --out) — "
                         "dispatched on content")
+    p.add_argument("--flight", action="store_true",
+                   help="Render FILEs as flight-recorder dumps "
+                        "(quorum-tpu-flight/1): the trigger, the ring "
+                        "timeline, the triggering thread highlighted "
+                        "with its stack. Dumps are also auto-detected "
+                        "by schema without this flag; the flag "
+                        "additionally REQUIRES each FILE to be a dump")
+    p.add_argument("--last-s", type=float, default=None,
+                   metavar="SECONDS",
+                   help="With --flight: only the last SECONDS of the "
+                        "ring timeline before the trigger (default: "
+                        "the full ring)")
     p.add_argument("--device", metavar="PROFILE_DIR", default=None,
                    help="Parse the jax.profiler trace in this "
                         "--profile directory and print the device-"
@@ -329,8 +413,15 @@ def main(argv=None) -> int:
             doc = json.loads(text)
         except ValueError:
             doc = None
-        if isinstance(doc, dict) and isinstance(doc.get("hosts"),
-                                                dict):
+        if isinstance(doc, dict) and doc.get("schema") == FLIGHT_SCHEMA:
+            render_flight_dump(path, doc, args.last_s)
+        elif args.flight:
+            print(f"{path}: not a flight dump "
+                  f"(schema {doc.get('schema') if isinstance(doc, dict) else None!r}, "
+                  f"expected {FLIGHT_SCHEMA!r})", file=sys.stderr)
+            return 1
+        elif isinstance(doc, dict) and isinstance(doc.get("hosts"),
+                                                  dict):
             # a multi-host aggregate (driver .hosts.json or a
             # push-receiver fleet document): per-host table first,
             # then the aggregate's own tables
