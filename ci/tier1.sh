@@ -101,6 +101,18 @@
 # overhead rides the perf-diff gate as an A/B ratio (recorder on vs
 # QUORUM_FLIGHT=0) bounded absolutely in PERF_BASELINE.json.
 #
+# ISSUE 17 adds the accuracy-regression gate: tools/quality_diff.py
+# rebuilds the golden pipeline, asserts the correction-quality
+# scorecard (`quality` section) is byte-identical across two runs,
+# and judges it EXACTLY against the committed QUALITY_BASELINE.json
+# (deterministic pipeline, every metric pinned min==max) — then a
+# negative control with a seeded accuracy bug (--seed-regression
+# floor: the presence floor misapplied to the golden DB) must FAIL
+# the same gate, proving it catches accuracy movement, not just
+# schema drift. The input-drift half (contaminant burst firing
+# `contam_spike` with a sealed flight dump naming the rule, serve
+# quality-header parity) rides the telemetry smoke above.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -111,6 +123,7 @@
 #        SKIP_TELEMETRY_SMOKE=1  skips the devtrace/push/alert gate.
 #        SKIP_FLIGHT_SMOKE=1  skips the flight-recorder gate.
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
+#        SKIP_QUALITY_DIFF=1  skips the accuracy-regression gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
 #        SKIP_COMPILE_SENTINEL=1  skips the runtime compile sentinel
@@ -426,6 +439,48 @@ else
     fi
 fi
 
+quality_rc=0
+if [ "${SKIP_QUALITY_DIFF:-0}" = "1" ]; then
+    echo "ci/tier1.sh: quality-diff gate skipped (SKIP_QUALITY_DIFF=1)"
+else
+    # the accuracy-regression gate (ISSUE 17): golden scorecard
+    # byte-determinism + exact match against the committed baseline,
+    # then the seeded-regression negative control (must exit 1)
+    echo "== quality-diff gate =="
+    QUAL_DIR=$(mktemp -d /tmp/quality_diff.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "${FLIGHT_DIR:-}" "${PERF_DIR:-}" "$QUAL_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/quality_diff.py --golden \
+        --baseline QUALITY_BASELINE.json \
+        --out "$QUAL_DIR/quality_verdict.json" -q || quality_rc=$?
+    if [ -f "$QUAL_DIR/quality_verdict.json" ]; then
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$QUAL_DIR/quality_verdict.json" || quality_rc=1
+    fi
+    if [ "$quality_rc" -eq 0 ]; then
+        echo "== quality-diff negative control (seeded regression) =="
+        neg_rc=0
+        env JAX_PLATFORMS=cpu \
+            JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+            python tools/quality_diff.py --golden \
+            --seed-regression floor \
+            --baseline QUALITY_BASELINE.json \
+            --out "$QUAL_DIR/quality_negative.json" -q \
+            > "$QUAL_DIR/negative.log" 2>&1 || neg_rc=$?
+        if [ "$neg_rc" -ne 1 ]; then
+            echo "ci/tier1.sh: seeded accuracy regression did NOT" \
+                 "fail the quality gate (rc=$neg_rc, want 1)" >&2
+            quality_rc=1
+        else
+            echo "seeded regression correctly failed the gate (rc=1)"
+        fi
+    fi
+    if [ "$quality_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: quality-diff gate FAILED (rc=$quality_rc)" >&2
+    fi
+fi
+
 if [ "$qlint_rc" -ne 0 ]; then exit "$qlint_rc"; fi
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
@@ -437,4 +492,5 @@ if [ "$fsck_rc" -ne 0 ]; then exit "$fsck_rc"; fi
 if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
 if [ "$flight_rc" -ne 0 ]; then exit "$flight_rc"; fi
 if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
+if [ "$quality_rc" -ne 0 ]; then exit "$quality_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -7,16 +7,27 @@ Telemetry (ISSUE 3 satellite): same observability surface as the main
 CLIs — `--metrics` records a `distinct_mers` counter and
 `max_count` / `nonempty_bins` gauges; stdout stays
 reference-identical.
+
+`--json PATH` (ISSUE 17 satellite): a schema-versioned sidecar
+(`quorum-tpu-histo/1`) carrying the same bins as machine-readable
+rows plus summary stats — including the coverage-mode fit the
+quality scorecard's coverage model uses
+(telemetry/quality.coverage_from_histo) — so operators and tools
+consume the spectrum without parsing stdout.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 import numpy as np
 
 from ..io import db_format
+from ..telemetry import quality
+from ..telemetry.registry import atomic_write
+from ..telemetry.schema import HISTO_SCHEMA
 from .observability import add_observability_args, observability
 
 HLEN = 1001
@@ -38,8 +49,35 @@ def build_parser() -> argparse.ArgumentParser:
         description="Histogram of mer counts split by the quality bit.",
     )
     add_observability_args(p, metrics=True)
+    p.add_argument("--json", metavar="path", default=None,
+                   help="Also write the histogram as a "
+                        "schema-versioned JSON sidecar "
+                        "(quorum-tpu-histo/1): bins as [count, "
+                        "n_lowqual, n_highqual] rows plus summary "
+                        "stats including the fitted coverage mode")
     p.add_argument("db", help="Mer database")
     return p
+
+
+def histo_doc(out: np.ndarray) -> dict:
+    """The `--json` sidecar document for one computed histogram:
+    non-empty bins as rows (count ascending, mirroring stdout), and
+    the summary stats computed UNCONDITIONALLY — unlike the registry
+    telemetry, the sidecar is its own artifact, not gated on
+    --metrics."""
+    bins = [[int(i), int(out[i, 0]), int(out[i, 1])]
+            for i in range(out.shape[0]) if out[i, 0] or out[i, 1]]
+    occupied = [row[0] for row in bins]
+    return {
+        "schema": HISTO_SCHEMA,
+        "bins": bins,
+        "stats": {
+            "distinct_total": int(out.sum()),
+            "distinct_nonempty": len(bins),
+            "max_count": max(occupied) if occupied else 0,
+            "coverage_mode": quality.coverage_from_histo(bins),
+        },
+    }
 
 
 def main(argv=None) -> int:
@@ -73,6 +111,14 @@ def main(argv=None) -> int:
             if out[i, 0] or out[i, 1]:
                 print(f"{i} {out[i, 0]} {out[i, 1]}")
                 nonempty += 1
+        if args.json:
+            doc = histo_doc(out)
+            atomic_write(args.json,
+                         json.dumps(doc, indent=1) + "\n")
+            if reg.enabled:
+                reg.set_meta(histo_json=args.json)
+                reg.gauge("coverage_mode").set(
+                    doc["stats"]["coverage_mode"])
         if reg.enabled:
             total = int(out.sum())
             reg.counter("distinct_mers").inc(total)

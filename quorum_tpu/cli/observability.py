@@ -115,6 +115,7 @@ class ObservabilitySession:
         self.pusher = None  # MetricsPusher, with --metrics-push-url
         self.alerts = None  # AlertEngine (telemetry/alerts.py)
         self.flight = None  # FlightRecorder (telemetry/flight.py)
+        self.quality = None  # QualityScorecard (telemetry/quality.py)
         self.status: str | None = None
         self._at_exit: list = []
         self._profile: str | None = None
@@ -142,6 +143,15 @@ class ObservabilitySession:
         reg = self.registry
         if not reg.enabled:
             return
+        if self.quality is not None:
+            # close the last (possibly short) rate window BEFORE the
+            # alert engine's final evaluate: a drift/contam firing
+            # transition at close still lands its alert event and
+            # dump: true flight capture while the sinks are open
+            try:
+                self.quality.tick(final=True)
+            except Exception:  # noqa: BLE001 - telemetry never masks exits
+                pass
         if self.alerts is not None:
             # stop the ticker BEFORE the final write: a closed engine
             # never lands another event, so nothing can reopen (and
@@ -266,13 +276,24 @@ def observability(metrics: str | None = None, interval: float = 0.0,
         if tracer.enabled:
             tracer.flight = obs.flight
     if reg.enabled:
+        # the quality scorecard (telemetry/quality.py, ISSUE 17):
+        # installed BEFORE the alert engine so its exporter runs
+        # first on each heartbeat — the engine's evaluate sees the
+        # freshly-closed window's gauges, not last window's. Hooks
+        # reg.quality (the final document's `quality` section) and
+        # pre-creates the quality_* gauges at quiet values, so the
+        # drift rules below stay silent until a data window closes.
+        from ..telemetry import quality as quality_mod
+        obs.quality = quality_mod.QualityScorecard(reg)
         # the alert engine (telemetry/alerts.py): built-in rules plus
-        # the serve SLO set for serve registries, overridden by the
+        # the input-drift set (quiet off the data plane), plus the
+        # serve SLO set for serve registries, overridden by the
         # --alert-rules file. A bad file costs a loud stderr line and
         # a counted rule error, never the run — but the defaults keep
         # watching either way.
         from ..telemetry import alerts as alerts_mod
-        rule_sets = [alerts_mod.DEFAULT_RULES]
+        rule_sets = [alerts_mod.DEFAULT_RULES,
+                     alerts_mod.DEFAULT_QUALITY_RULES]
         if meta.get("stage") == "serve":
             rule_sets.append(alerts_mod.DEFAULT_SERVE_RULES)
         if alert_rules:

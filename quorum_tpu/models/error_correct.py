@@ -36,7 +36,7 @@ from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops import ctable
 from ..ops.poisson import compute_poisson_cutoff
-from ..telemetry import observe_dispatch_wait
+from ..telemetry import observe_dispatch_wait, quality
 from ..utils import faults
 from ..utils.pipeline import AsyncWriter, ReorderingPool, prefetch
 from ..utils.profiling import StageTimer, trace
@@ -56,8 +56,40 @@ REASON_SLUGS = {
 }
 
 
+def _tally_log(log: str, outcome: dict) -> int:
+    """Decode one edit-log string (space-separated ``pos:sub:X-Y`` /
+    ``pos:3_trunc`` / ``pos:5_trunc`` entries, err_log.hpp semantics)
+    into the outcome tally, bucketing each event's read-cycle
+    position for the quality spectra (telemetry/quality.py). Returns
+    the substitution count — the same number the old
+    ``log.count(":sub:")`` derivation produced, so the counter parity
+    the golden tests assert is preserved by construction."""
+    ns = 0
+    for ent in log.split():
+        pos_s, _, kind = ent.partition(":")
+        try:
+            bucket = quality.position_bucket(int(pos_s))
+        except ValueError:  # pragma: no cover - malformed entry
+            continue
+        if kind.startswith("sub:"):
+            ns += 1
+            d = outcome["sub_pos"]
+        elif kind == "3_trunc":
+            outcome["t3"] += 1
+            d = outcome["t3_pos"]
+        elif kind == "5_trunc":
+            outcome["t5"] += 1
+            d = outcome["t5_pos"]
+        else:  # pragma: no cover - unknown entry kind
+            continue
+        d[bucket] = d.get(bucket, 0) + 1
+    outcome["subs"] += ns
+    return ns
+
+
 def render_result(hdr: str, r, cfg: ECConfig,
-                  outcome: dict | None = None) -> tuple[str, str]:
+                  outcome: dict | None = None,
+                  maxe: int | None = None) -> tuple[str, str]:
     """One read's exact output surfaces: the `.fa` text and `.log`
     text the reference writes for result `r` (error_correct_reads.cc
     :246-341; empty strings where the read contributes nothing to a
@@ -66,14 +98,18 @@ def render_result(hdr: str, r, cfg: ECConfig,
     `POST /correct` byte-identical to `quorum_error_correct_reads` by
     construction. `outcome`, when given, accumulates the per-read
     outcome tallies (err_log.hpp semantics) that feed the telemetry
-    counters: keys subs/t3/t5/hist/skips, as built by
-    `new_outcome()`."""
+    counters: keys subs/t3/t5/hist/skips plus the bucketed position
+    spectra sub_pos/t3_pos/t5_pos, as built by `new_outcome()`.
+    `maxe`, when given, bounds the per-read substitution count
+    recorded in `hist` at the config's max-error budget (shared
+    quality.bounded clamp — Prometheus exposition must not see
+    unbounded histogram values)."""
     if r.ok:
         if outcome is not None:
-            ns = r.fwd_log.count(":sub:") + r.bwd_log.count(":sub:")
-            outcome["subs"] += ns
-            outcome["t3"] += r.fwd_log.count(":3_trunc")
-            outcome["t5"] += r.bwd_log.count(":5_trunc")
+            ns = _tally_log(r.fwd_log, outcome)
+            ns += _tally_log(r.bwd_log, outcome)
+            if maxe is not None:
+                ns = quality.bounded(ns, maxe)
             outcome["hist"][ns] = outcome["hist"].get(ns, 0) + 1
         return f">{hdr} {r.fwd_log} {r.bwd_log}\n{r.seq}\n", ""
     if outcome is not None:
@@ -84,8 +120,37 @@ def render_result(hdr: str, r, cfg: ECConfig,
 
 
 def new_outcome() -> dict:
-    """A fresh per-read outcome tally for `render_result`."""
-    return {"subs": 0, "t3": 0, "t5": 0, "hist": {}, "skips": {}}
+    """A fresh per-read outcome tally for `render_result`: scalar
+    event counts (subs/t3/t5), the per-read substitution histogram
+    (hist), the skip-reason breakdown (skips), and the bucketed
+    read-cycle position spectra (sub_pos/t3_pos/t5_pos) the quality
+    scorecard renders (ISSUE 17)."""
+    return {"subs": 0, "t3": 0, "t5": 0, "hist": {}, "skips": {},
+            "sub_pos": {}, "t3_pos": {}, "t5_pos": {}}
+
+
+def precreate_outcome_counters(reg) -> None:
+    """Pre-create the full data-plane outcome surface at setup so
+    zero-valued names still land in the final document (the PR-7
+    zero-count lesson): every `skipped_<slug>` REASON_SLUGS counter
+    plus the "other" fallback, the event counters, and the quality
+    histograms. Both stage-2 paths call this — the offline pipeline
+    (_run_ec) and the serve engine — which is what lets
+    telemetry/contract.QUALITY_COUNTERS require the names whenever
+    meta declares a stage-2 document."""
+    if not getattr(reg, "enabled", False):
+        return
+    reg.counter("substitutions")
+    reg.counter("truncations_3p")
+    reg.counter("truncations_5p")
+    reg.counter("skipped_contaminant")
+    reg.counter("skipped_no_anchor")
+    reg.counter("skipped_homopolymer")
+    reg.counter("skipped_other")
+    reg.histogram("substitutions_per_read")
+    reg.histogram("sub_pos_bucket")
+    reg.histogram("trunc_cycle_3p")
+    reg.histogram("trunc_cycle_5p")
 
 
 def record_outcome(reg, outcome: dict) -> None:
@@ -98,6 +163,12 @@ def record_outcome(reg, outcome: dict) -> None:
     hist = reg.histogram("substitutions_per_read")
     for v, n in outcome["hist"].items():
         hist.observe(v, n)
+    for name, key in (("sub_pos_bucket", "sub_pos"),
+                      ("trunc_cycle_3p", "t3_pos"),
+                      ("trunc_cycle_5p", "t5_pos")):
+        spectrum = reg.histogram(name)
+        for v, n in outcome[key].items():
+            spectrum.observe(v, n)
     for slug, n in outcome["skips"].items():
         reg.counter(f"skipped_{slug}").inc(n)
 
@@ -136,7 +207,7 @@ def render_batch_host(batch, buf, b: int, l: int, maxe: int,
     # render_result never sees an outcome dict
     outcome = new_outcome() if count_outcomes else None
     for hdr, r in zip(batch.headers, results):
-        fa, lg = render_result(hdr, r, cfg, outcome)
+        fa, lg = render_result(hdr, r, cfg, outcome, maxe=maxe)
         if r.ok:
             n_corr += 1
             bases_out += r.end - r.start
@@ -355,6 +426,11 @@ def _run_ec(db_path: str, sequences: Sequence[str],
             "--checkpoint-every requires -o PREFIX and is "
             "incompatible with --gzip (a gzip stream cannot be "
             "truncated back to a commit point)")
+    # before the DB load: the doc declares stage=error_correct from
+    # the umbrella, so the full outcome surface must land (as zeros)
+    # even when the load refuses the database — metrics_check holds
+    # every stage-2 document to the quality contract
+    precreate_outcome_counters(reg)
     vlog("Loading mer database")
     if db is not None:
         # in-process handoff from stage 1: the table is already device
@@ -410,6 +486,14 @@ def _run_ec(db_path: str, sequences: Sequence[str],
              " (count-below-floor mers treated as absent)")
     if reg.enabled:
         reg.set_meta(presence_floor=floor)
+        # the DB header's coverage statistic (ISSUE 13 poisson_stats)
+        # feeds the scorecard's coverage model: mean hq multiplicity
+        # predicts the trusted-anchor rate (1 - e^-c), which the
+        # coverage_drop drift rule compares against observation
+        ps = (header or {}).get("poisson_stats")
+        if ps and ps.get("distinct_hq"):
+            reg.set_meta(coverage_mean=round(
+                float(ps["total_hq"]) / float(ps["distinct_hq"]), 4))
 
     if cfg_in is not None:
         cfg = cfg_in

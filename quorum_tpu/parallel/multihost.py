@@ -216,6 +216,13 @@ def merge_host_docs(docs: Sequence[dict]) -> dict:
                     ms["seconds"] + sv.get("seconds", 0.0), 6)
                 ms["calls"] += sv.get("calls", 0)
                 ms["units"] += sv.get("units", 0)
+    if merged["meta"].get("quality"):
+        # the aggregate's quality scorecard (ISSUE 17): a pure
+        # function of the summed counters/histograms, so the fleet-
+        # level section is RECOMPUTED from the merge rather than
+        # merged itself — shard sections stay under `hosts`
+        from ..telemetry import quality
+        merged["quality"] = quality.section_from_doc(merged)
     return merged
 
 

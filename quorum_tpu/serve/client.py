@@ -31,7 +31,11 @@ class ServeResult:
     `request_id` echoes the server's `X-Quorum-Request-Id` (every
     response carries one); `phases` is the server-side phase
     breakdown from `X-Quorum-Phases` (admission/queue/device/hedge/
-    render/total µs, lane, bisected/hedged — 200 responses only)."""
+    render/total µs, lane, bisected/hedged — 200 responses only);
+    `quality` is the per-request correction-quality summary from
+    `X-Quorum-Quality` (reads/corrected/skipped/subs/truncations,
+    ISSUE 17 — 200 responses only; sums across requests reconcile
+    with the server's final metrics document)."""
 
     status: int
     fa: str = ""
@@ -43,10 +47,11 @@ class ServeResult:
     error: str = ""
     request_id: str = ""
     phases: dict | None = None
+    quality: dict | None = None
 
 
-def _parse_phases(resp) -> dict | None:
-    raw = resp.headers.get("X-Quorum-Phases")
+def _parse_json_header(resp, name: str) -> dict | None:
+    raw = resp.headers.get(name)
     if not raw:
         return None
     try:
@@ -54,6 +59,10 @@ def _parse_phases(resp) -> dict | None:
     except ValueError:
         return None
     return doc if isinstance(doc, dict) else None
+
+
+def _parse_phases(resp) -> dict | None:
+    return _parse_json_header(resp, "X-Quorum-Phases")
 
 
 class ServeClient:
@@ -116,19 +125,21 @@ class ServeClient:
             return ServeResult(status=resp.status, retry_after_s=retry,
                                error=err, request_id=rid)
         phases = _parse_phases(resp)
+        qual = _parse_json_header(resp, "X-Quorum-Quality")
         if want_log:
             doc = json.loads(data.decode())
             return ServeResult(status=200, fa=doc["fa"], log=doc["log"],
                                reads=doc["reads"],
                                corrected=doc["corrected"],
                                skipped=doc["skipped"],
-                               request_id=rid, phases=phases)
+                               request_id=rid, phases=phases,
+                               quality=qual)
         return ServeResult(
             status=200, fa=data.decode(),
             reads=int(resp.headers.get("X-Quorum-Reads", 0)),
             corrected=int(resp.headers.get("X-Quorum-Corrected", 0)),
             skipped=int(resp.headers.get("X-Quorum-Skipped", 0)),
-            request_id=rid, phases=phases)
+            request_id=rid, phases=phases, quality=qual)
 
     def correct_with_retry(self, fastq_text: str | bytes,
                            deadline_ms: float | None = None,

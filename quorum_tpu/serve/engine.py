@@ -46,8 +46,10 @@ from ..models.corrector import (correct_batch_packed, fetch_finish,
                                 finish_batch_host)
 from ..models.ec_config import ECConfig
 from ..models.error_correct import (ECOptions, new_outcome,
-                                    pack_for_stage2, record_outcome,
-                                    render_result, resolve_cutoff)
+                                    pack_for_stage2,
+                                    precreate_outcome_counters,
+                                    record_outcome, render_result,
+                                    resolve_cutoff)
 from ..telemetry import NULL, NULL_TRACER, observe_dispatch_wait
 from ..utils import faults
 from ..utils.vlog import vlog
@@ -144,6 +146,16 @@ class CorrectionEngine:
         self._warm: tuple[int, ...] = ()
         registry.gauge("cutoff").set(cutoff)
         registry.set_meta(db=db_path, rows=self.rows, cutoff=cutoff)
+        # the data-plane quality surface (ISSUE 17): zero-count skip
+        # reasons land in the serve document too, and the header's
+        # coverage statistic arms the scorecard's coverage model
+        precreate_outcome_counters(registry)
+        if getattr(registry, "enabled", False):
+            ps = (_header or {}).get("poisson_stats")
+            if ps and ps.get("distinct_hq"):
+                registry.set_meta(coverage_mean=round(
+                    float(ps["total_hq"]) / float(ps["distinct_hq"]),
+                    4))
 
     # -- device step ------------------------------------------------------
     def step(self, records, _warmup: bool = False) -> list[tuple[str, str]]:
@@ -207,7 +219,8 @@ class CorrectionEngine:
             out: list[tuple[str, str]] = []
             n_corr = 0
             for hdr, r in zip(batch.headers, results):
-                out.append(render_result(hdr, r, self.cfg, outcome))
+                out.append(render_result(hdr, r, self.cfg, outcome,
+                                         maxe=maxe))
                 if r.ok:
                     n_corr += 1
         if reg.enabled:

@@ -73,6 +73,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from ..io import fastq
 from ..telemetry import NULL, flight
 from ..telemetry import export as export_mod
+from ..telemetry import quality as quality_mod
 from ..utils import faults
 from ..utils.vlog import vlog
 from .batcher import PRIORITIES, DeadlineExceeded, Draining, QueueFull
@@ -257,13 +258,16 @@ class CorrectionServer:
 
     def _lifecycle(self, rid: str, lane: str, status: int, t_req0: float,
                    reads: int = 0, req=None, admission_us: int | None = None,
-                   render_us: int = 0) -> dict:
+                   render_us: int = 0, quality: dict | None = None) -> dict:
         """Emit the request's ONE lifecycle event (ISSUE 10): every
         terminal status, with the phase ledger when the request got
         far enough to have one. Phases are disjoint sub-intervals of
         the request's wall time, so their sum is <= total_us. Returns
         the phase dict (the 200 path reuses it for the
-        `X-Quorum-Phases` response header)."""
+        `X-Quorum-Phases` response header). `quality` (the 200 path's
+        per-request tally, quality.summarize_results) rides along as
+        q_* fields, so the request ledger attributes corrections per
+        request the way it already attributes time (ISSUE 17)."""
         total_us = int((time.perf_counter() - t_req0) * 1e6)
         ph = {"admission_us": (admission_us if admission_us is not None
                                else total_us),
@@ -276,8 +280,14 @@ class CorrectionServer:
                       hedge_us=int(req.hedge_us),
                       lane=req.lane, bisected=bool(req.bisected),
                       hedged=bool(req.hedged))
+        qf = {}
+        if quality is not None:
+            qf = {"q_corrected": quality["corrected"],
+                  "q_skipped": quality["skipped"],
+                  "q_subs": quality["subs"],
+                  "q_t3": quality["t3"], "q_t5": quality["t5"]}
         self.registry.event("request", request_id=rid, status=status,
-                            reads=reads, **ph)
+                            reads=reads, **ph, **qf)
         if status == 200 and self.registry.enabled:
             # the latency-SLO feed (telemetry/alerts.py): end-to-end
             # time of SERVED requests, log-quantized so the exact-
@@ -419,10 +429,15 @@ class CorrectionServer:
         log = "".join(r[1] for r in results)
         corrected = sum(1 for r in results if r[0] and not r[1])
         skipped = sum(1 for r in results if r[1])
+        # the per-request quality tally (ISSUE 17): decoded from the
+        # same rendered text the client receives, so the header sums
+        # reconcile exactly against the serve document's outcome
+        # counters (the parity telemetry_smoke asserts)
+        q = quality_mod.summarize_results(results)
         render_us = int((time.perf_counter() - t_render) * 1e6)
         ph = self._lifecycle(rid, lane, 200, t_req0, reads=len(records),
                              req=req, admission_us=admission_us,
-                             render_us=render_us)
+                             render_us=render_us, quality=q)
         counts = {"X-Quorum-Reads": len(records),
                   "X-Quorum-Corrected": corrected,
                   "X-Quorum-Skipped": skipped,
@@ -430,7 +445,10 @@ class CorrectionServer:
                   # quorum-serve-bench reports queue wait vs device
                   # time per request from this header alone
                   "X-Quorum-Phases": json.dumps(
-                      ph, separators=(",", ":"))}
+                      ph, separators=(",", ":")),
+                  # the per-request quality summary, client-readable
+                  "X-Quorum-Quality": json.dumps(
+                      q, separators=(",", ":"), sort_keys=True)}
         if _flag(params, "log"):
             handler._reply_json(200, {
                 "fa": fa, "log": log, "reads": len(records),

@@ -10,8 +10,11 @@ See registry.py for the model and schema.py for the document formats.
 """
 
 from . import flight
-from .alerts import (AlertEngine, DEFAULT_RULES, DEFAULT_SERVE_RULES,
+from . import quality
+from .alerts import (AlertEngine, DEFAULT_QUALITY_RULES,
+                     DEFAULT_RULES, DEFAULT_SERVE_RULES,
                      load_rules, merge_rules)
+from .quality import QualityScorecard
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        NULL, NullRegistry, labeled,
                        observe_dispatch_wait, registry_for,
@@ -19,18 +22,20 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
 from .schema import (SCHEMA_VERSION, check_file, metric_line,
                      validate_bench_line, validate_chrome_trace,
                      validate_events_line, validate_metrics,
-                     validate_span_line)
+                     validate_quality, validate_span_line)
 from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
-    "flight",
-    "AlertEngine", "DEFAULT_RULES", "DEFAULT_SERVE_RULES",
-    "load_rules", "merge_rules",
+    "flight", "quality",
+    "AlertEngine", "DEFAULT_QUALITY_RULES", "DEFAULT_RULES",
+    "DEFAULT_SERVE_RULES", "load_rules", "merge_rules",
+    "QualityScorecard",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
     "NullRegistry", "labeled", "observe_dispatch_wait", "registry_for",
     "track_jax_compile_cache",
     "SCHEMA_VERSION", "check_file", "metric_line",
     "validate_bench_line", "validate_chrome_trace",
-    "validate_events_line", "validate_metrics", "validate_span_line",
+    "validate_events_line", "validate_metrics", "validate_quality",
+    "validate_span_line",
     "NULL_TRACER", "NullTracer", "SpanTracer", "tracer_for",
 ]

@@ -140,6 +140,37 @@ DEFAULT_SERVE_RULES = [
 ]
 
 
+# the input-drift surface (appended on EVERY instrumented run, ISSUE
+# 17): threshold rules over the windowed `quality_*` gauges a
+# QualityScorecard refreshes per batch window. The scorecard
+# pre-creates every gauge at its QUIET value (rates 0, ratios 1.0)
+# and stage-1 builds close no data windows, so the rules cost
+# nothing where they cannot apply; on a registry with no scorecard
+# at all the metrics are absent, which also keeps threshold rules
+# quiet. All three dump: a quality regression mid-run is exactly the
+# trajectory the flight ring should preserve (ISSUE 16).
+DEFAULT_QUALITY_RULES = [
+    # the worst normalized deviation of any windowed rate from its
+    # EWMA baseline — 4.0 means "this window sits 4 baselines away",
+    # loose enough for shot noise on small windows, tight enough that
+    # a chemistry change or bad flowcell tile pages within a window
+    {"name": "quality_drift", "type": "threshold",
+     "metric": "gauges.quality_drift_score", "op": ">", "value": 4.0,
+     "severity": "warn", "dump": True},
+    # more than 20% of a window's reads hitting the contaminant
+    # screen is a library-prep or sample-swap event, not noise
+    {"name": "contam_spike", "type": "threshold",
+     "metric": "gauges.quality_contam_rate", "op": ">", "value": 0.2,
+     "severity": "page", "dump": True},
+    # observed trusted-anchor rate below half of what the DB header's
+    # poisson_stats predict: the reads do not match the database
+    # (wrong reference DB, or coverage collapsed)
+    {"name": "coverage_drop", "type": "threshold",
+     "metric": "gauges.quality_coverage_ratio", "op": "<",
+     "value": 0.5, "severity": "page", "dump": True},
+]
+
+
 def latency_bucket_us(us) -> int:
     """Quarter-octave log quantization for latency histograms: four
     buckets per power of two, <= ~160 distinct keys from 1 µs to

@@ -126,6 +126,40 @@ COMPILE_META = ("compile_sites",)
 # was armed.
 FLIGHT_COUNTERS = ("flight_dumps_total", "flight_events_dropped_total")
 
+# The correction-quality surface (ISSUE 17): the data-plane outcome
+# names every stage-2 path (offline drain loop and serve engine)
+# pre-creates via models/error_correct.precreate_outcome_counters —
+# one `skipped_<slug>` counter per REASON_SLUGS slug plus the
+# "other" fallback, so zero-count reasons still land in the final
+# document (the PR-7 zero-count lesson). A document whose
+# meta.stage is "error_correct" or "serve" must carry all of them.
+QUALITY_COUNTERS = (
+    "substitutions",
+    "truncations_3p",
+    "truncations_5p",
+    "skipped_contaminant",
+    "skipped_no_anchor",
+    "skipped_homopolymer",
+    "skipped_other",
+)
+QUALITY_HISTOGRAMS = ("substitutions_per_read", "sub_pos_bucket",
+                      "trunc_cycle_3p", "trunc_cycle_5p")
+# The live scorecard surface: a document whose meta declares
+# `quality` (a QualityScorecard was installed) must carry the
+# windowed-rate/drift gauges the quality alert rules read — the
+# scorecard sets them to quiet values at construction, so they exist
+# before the first window closes — plus a top-level `quality`
+# section (schema-validated by telemetry/schema.validate_quality).
+QUALITY_GAUGES = (
+    "quality_corrections_per_read",
+    "quality_skip_rate",
+    "quality_trunc_rate",
+    "quality_contam_rate",
+    "quality_anchor_rate",
+    "quality_coverage_ratio",
+    "quality_drift_score",
+)
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -155,4 +189,5 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(SHARD_REQUIRED_COUNTERS)
     names.update(PREFILTER_COUNTERS)
     names.update(PARTITION_COUNTERS)
+    names.update(QUALITY_COUNTERS)
     return tuple(sorted(names))

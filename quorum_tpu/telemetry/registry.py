@@ -179,6 +179,12 @@ class MetricsRegistry:
         # history even when no JSONL sink is configured) and outside
         # self._lock (the ring lock never nests inside the registry's)
         self.flight = None
+        # quality-scorecard tap (ISSUE 17): observability() installs a
+        # QualityScorecard which sets this; as_dict() then derives the
+        # `quality` section from the document's own serialized
+        # counters/histograms — a pure function of the built sections,
+        # so the hook never re-enters the (non-reentrant) registry lock
+        self.quality = None
 
     # -- metric accessors (get-or-create) --------------------------------
     def counter(self, name: str) -> Counter:
@@ -293,7 +299,7 @@ class MetricsRegistry:
     # -- output -----------------------------------------------------------
     def as_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "schema": SCHEMA_VERSION,
                 "meta": dict(self.meta),
                 "counters": {k: c.value
@@ -309,6 +315,14 @@ class MetricsRegistry:
                     for k, h in sorted(self._hists.items())},
                 "timers": dict(self.timers),
             }
+            if self.quality is not None:
+                # derived from the sections built above, NOT from the
+                # live metric maps: snapshot_from is pure (quality.
+                # section_from_doc), so it cannot deadlock on
+                # self._lock and the section is byte-deterministic
+                # whenever the counters are
+                out["quality"] = self.quality.snapshot_from(out)
+            return out
 
     def write(self, path: str | None = None) -> str | None:
         """Write the final metrics JSON (atomic replace), give live
@@ -349,6 +363,7 @@ class NullRegistry:
     path = None
     events_path = None
     flight = None
+    quality = None
 
     def counter(self, name):
         return _NULL_COUNTER

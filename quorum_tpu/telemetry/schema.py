@@ -70,6 +70,11 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
             errs.append(f"missing or non-object section {key!r}")
     allowed = {"schema", "meta", "counters", "gauges",
                "histograms", "timers"}
+    # the correction-quality section (ISSUE 17): derived by
+    # MetricsRegistry.as_dict from the document's own counters when a
+    # QualityScorecard is installed — per-host shard documents carry
+    # their own, so it is allowed nested too
+    allowed.add("quality")
     if not _nested:
         allowed.add("hosts")
         # fleet documents (tools/push_receiver.py) may carry receiver-
@@ -95,6 +100,9 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
             for i, ev in enumerate(doc["events"]):
                 errs.extend(f"events[{i}]: {e}" for e in
                             validate_events_line(ev))
+    if "quality" in doc:
+        errs.extend(f"quality: {e}" for e in
+                    validate_quality(doc["quality"]))
 
     for k, v in doc["meta"].items():
         ok = (_is_scalar(v)
@@ -143,10 +151,84 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
     return errs
 
 
+# the correction-quality section (telemetry/quality.py, ISSUE 17):
+# what MetricsRegistry.as_dict derives from the document's own
+# counters/histograms when a QualityScorecard is installed
+QUALITY_SCHEMA = "quorum-tpu-quality/1"
+
+# the quality-section count maps (histogram `counts` re-keyed
+# deterministically by quality._sorted_counts)
+_QUALITY_COUNT_MAPS = ("sub_pos_spectrum", "substitutions_per_read",
+                       "trunc_cycle_3p", "trunc_cycle_5p",
+                       "skip_reasons")
+_QUALITY_COUNTS = ("reads", "corrected", "skipped", "substitutions",
+                   "truncations_3p", "truncations_5p")
+_QUALITY_RATES = ("anchor_rate", "contam_rate",
+                  "corrections_per_read", "skip_rate",
+                  "trunc_rate_3p", "trunc_rate_5p")
+
+
+def validate_quality(q) -> list[str]:
+    """Validate a `quality` section (quality.section_from_doc):
+    schema stamp, non-negative counts, the full rate set as numbers
+    in sane ranges, count maps of non-negative ints, and — when the
+    producing run knew its DB coverage — a coherent `coverage`
+    sub-object."""
+    errs: list[str] = []
+    if not isinstance(q, dict):
+        return ["quality section is not a JSON object"]
+    if q.get("schema") != QUALITY_SCHEMA:
+        errs.append(f"schema is {q.get('schema')!r}, "
+                    f"expected {QUALITY_SCHEMA!r}")
+    for k in _QUALITY_COUNTS:
+        v = q.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{k!r} must be a non-negative int, got {v!r}")
+    rates = q.get("rates")
+    if not isinstance(rates, dict):
+        errs.append("missing/non-object 'rates'")
+    else:
+        for k in _QUALITY_RATES:
+            v = rates.get(k)
+            if not _is_number(v) or v < 0:
+                errs.append(f"rates[{k!r}] must be a non-negative "
+                            f"number, got {v!r}")
+    if not (isinstance(q.get("spectrum_cycles_per_bucket"), int)
+            and q.get("spectrum_cycles_per_bucket", 0) > 0):
+        errs.append("'spectrum_cycles_per_bucket' must be a positive "
+                    "int")
+    for mk in _QUALITY_COUNT_MAPS:
+        m = q.get(mk)
+        if not isinstance(m, dict):
+            errs.append(f"missing/non-object {mk!r}")
+            continue
+        for bk, bn in m.items():
+            if not isinstance(bk, str) or not isinstance(bn, int) \
+                    or isinstance(bn, bool) or bn < 0:
+                errs.append(f"{mk}[{bk!r}] malformed")
+    cov = q.get("coverage")
+    if cov is not None:
+        if not isinstance(cov, dict):
+            errs.append("'coverage' is not an object")
+        else:
+            for k in ("predicted_mean", "predicted_anchor_rate"):
+                if not _is_number(cov.get(k)) or cov.get(k, -1) < 0:
+                    errs.append(f"coverage[{k!r}] must be a "
+                                "non-negative number")
+    return errs
+
+
 # the serve request lifecycle event (ISSUE 10): one per terminal
 # status, with disjoint phase durations in microseconds
 REQUEST_EVENT_PHASES = ("admission_us", "queue_us", "device_us",
                         "hedge_us", "render_us", "total_us")
+
+# per-request quality tallies (ISSUE 17): optional on a request event
+# (the 200 path stamps them; error paths have no render output), but
+# when present they must be non-negative ints — the ledger's quality
+# phases reconcile against the final document's outcome counters
+REQUEST_EVENT_QUALITY = ("q_corrected", "q_skipped", "q_subs",
+                         "q_t3", "q_t5")
 
 # the alert lifecycle event (telemetry/alerts.py, ISSUE 11): one per
 # firing->healed transition of a rule
@@ -185,6 +267,12 @@ def _validate_request_event(obj) -> list[str]:
             errs.append(f"request event missing/non-numeric {k!r}")
         elif v < 0:
             errs.append(f"request event {k!r} is negative")
+    for k in REQUEST_EVENT_QUALITY:
+        if k in obj:
+            v = obj[k]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"request event {k!r} must be a "
+                            "non-negative int when present")
     return errs
 
 
@@ -262,20 +350,24 @@ def validate_chrome_trace(doc) -> list[str]:
 
 # the perf-regression verdict document (tools/perf_diff.py, ISSUE 11)
 PERF_DIFF_SCHEMA = "quorum-tpu-perf-diff/1"
+# the accuracy-regression verdict document (tools/quality_diff.py,
+# ISSUE 17) — same diff-verdict shape, its own schema stamp
+QUALITY_DIFF_SCHEMA = "quorum-tpu-quality-diff/1"
 
 
-def validate_perf_diff(doc) -> list[str]:
-    """Validate a perf_diff verdict document: verdict/checked/
-    regressions coherent, per-metric entries carrying ok flags. The
-    verdict must AGREE with the regression list — a 'pass' document
-    listing regressions (or vice versa) means the gate's output was
-    hand-altered or the tool broke."""
+def validate_perf_diff(doc, schema: str = PERF_DIFF_SCHEMA) -> list[str]:
+    """Validate a diff verdict document (perf_diff and, via the
+    `schema` arg, quality_diff — both tools share the shape):
+    verdict/checked/regressions coherent, per-metric entries carrying
+    ok flags. The verdict must AGREE with the regression list — a
+    'pass' document listing regressions (or vice versa) means the
+    gate's output was hand-altered or the tool broke."""
     errs: list[str] = []
     if not isinstance(doc, dict):
-        return ["perf-diff document is not a JSON object"]
-    if doc.get("schema") != PERF_DIFF_SCHEMA:
+        return ["diff-verdict document is not a JSON object"]
+    if doc.get("schema") != schema:
         errs.append(f"schema is {doc.get('schema')!r}, expected "
-                    f"{PERF_DIFF_SCHEMA!r}")
+                    f"{schema!r}")
     if doc.get("verdict") not in ("pass", "regression"):
         errs.append(f"verdict must be pass|regression, got "
                     f"{doc.get('verdict')!r}")
@@ -311,6 +403,55 @@ def validate_perf_diff(doc) -> list[str]:
     if doc.get("verdict") == "pass" and n_bad:
         errs.append(f"verdict 'pass' but {n_bad} metric entr"
                     f"{'y' if n_bad == 1 else 'ies'} report ok=false")
+    return errs
+
+
+# the mer-count histogram sidecar (cli/histo_mer_database --json,
+# ISSUE 17): the machine-readable twin of the textual spectrum, so
+# the scorecard's coverage-model fit and operators consume it without
+# parsing stdout
+HISTO_SCHEMA = "quorum-tpu-histo/1"
+
+
+def validate_histo(doc) -> list[str]:
+    """Validate a mer-histogram sidecar document: schema stamp, a
+    `bins` list of `[count, n_lowqual, n_highqual]` int rows in
+    strictly increasing count order, and summary stats consistent
+    with the rows."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["histo document is not a JSON object"]
+    if doc.get("schema") != HISTO_SCHEMA:
+        errs.append(f"schema is {doc.get('schema')!r}, "
+                    f"expected {HISTO_SCHEMA!r}")
+    bins = doc.get("bins")
+    if not isinstance(bins, list):
+        errs.append("missing/non-list 'bins' section")
+        return errs
+    prev = -1
+    for i, row in enumerate(bins):
+        if not (isinstance(row, list) and len(row) == 3 and all(
+                isinstance(v, int) and not isinstance(v, bool)
+                and v >= 0 for v in row)):
+            errs.append(f"bins[{i}] must be [count, n_lowqual, "
+                        f"n_highqual] non-negative ints, got {row!r}")
+            continue
+        if row[0] <= prev:
+            errs.append(f"bins[{i}]: count {row[0]} not strictly "
+                        "increasing")
+        prev = row[0]
+    stats = doc.get("stats")
+    if not isinstance(stats, dict):
+        errs.append("missing/non-object 'stats' section")
+    else:
+        for k in ("distinct_total", "distinct_nonempty", "max_count"):
+            v = stats.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"stats[{k!r}] must be a non-negative int")
+        if not _is_number(stats.get("coverage_mode")) \
+                or stats.get("coverage_mode", -1) < 0:
+            errs.append("stats['coverage_mode'] must be a "
+                        "non-negative number")
     return errs
 
 
@@ -522,6 +663,10 @@ def check_file(path: str) -> list[str]:
         doc = None
     if isinstance(doc, dict) and doc.get("schema") == PERF_DIFF_SCHEMA:
         return validate_perf_diff(doc)
+    if isinstance(doc, dict) and doc.get("schema") == QUALITY_DIFF_SCHEMA:
+        return validate_perf_diff(doc, schema=QUALITY_DIFF_SCHEMA)
+    if isinstance(doc, dict) and doc.get("schema") == HISTO_SCHEMA:
+        return validate_histo(doc)
     if isinstance(doc, dict) and doc.get("schema") == FLIGHT_SCHEMA:
         return validate_flight_dump(doc)
     if isinstance(doc, dict) and doc.get("schema") == DEBUG_BUNDLE_SCHEMA:

@@ -127,6 +127,16 @@ _declare(
     "Stable per-host identity for `--metrics-push-url` fleet shards "
     "(telemetry/push.py).")
 _declare(
+    "QUORUM_QUALITY_EWMA_ALPHA", "float", "0.2",
+    "Smoothing factor for the quality scorecard's EWMA drift "
+    "baselines in (0, 1]; higher adapts faster but pages less "
+    "(telemetry/quality.py).")
+_declare(
+    "QUORUM_QUALITY_WINDOW_READS", "int", "2048",
+    "Minimum reads_in delta before the quality scorecard closes a "
+    "rate window and refreshes the quality_* gauges the drift alert "
+    "rules read (telemetry/quality.py).")
+_declare(
     "QUORUM_REPLAY_CACHE_BYTES", "size", "6G",
     "Budget for the driver's stage-1 replay capture (k/M/G/T "
     "suffixes); past it stage 2 re-reads FASTQ from disk.")

@@ -344,7 +344,8 @@ def test_observability_survives_bad_rules_file(tmp_path):
     with observability(str(mp), alert_rules=str(bad),
                        stage="test") as obs:
         assert obs.alerts is not None
-        assert len(obs.alerts.rules) == len(alerts.DEFAULT_RULES)
+        assert len(obs.alerts.rules) == (len(alerts.DEFAULT_RULES)
+                                         + len(alerts.DEFAULT_QUALITY_RULES))
     doc = json.load(open(mp))
     assert doc["counters"]["alert_rule_errors_total"] >= 1
     assert doc["meta"]["alert_rules"]  # defaults active

@@ -1,0 +1,355 @@
+"""Correction-quality scorecard (telemetry/quality.py, ISSUE 17):
+the shared bucketing clamp, the edit-log tally, windowed rates and
+EWMA drift under a deterministic feed, the default drift alert rules
+firing and healing under a mocked clock, the pure `quality` section
+(byte-deterministic across two golden pipeline runs), the coverage
+model, schema validation, and the quality_diff accuracy gate."""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.models import error_correct as ec_mod
+from quorum_tpu.telemetry import alerts, quality, registry_for
+from quorum_tpu.telemetry.alerts import AlertEngine
+from quorum_tpu.telemetry.quality import (QualityScorecard, bounded,
+                                          coverage_from_histo,
+                                          position_bucket,
+                                          predicted_anchor_rate,
+                                          section_from_doc,
+                                          summarize_results)
+from quorum_tpu.telemetry.schema import (QUALITY_DIFF_SCHEMA,
+                                         validate_histo,
+                                         validate_metrics,
+                                         validate_perf_diff,
+                                         validate_quality)
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+READS = os.path.join(HERE, "golden", "reads.fastq")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# bucketing + tallies
+# ---------------------------------------------------------------------------
+
+def test_bounded_clamp_and_position_bucket():
+    assert bounded(-3, 10) == 0
+    assert bounded(5, 10) == 5
+    assert bounded(99, 10) == 10
+    assert position_bucket(0) == 0
+    assert position_bucket(7) == 0
+    assert position_bucket(8) == 1
+    # arbitrarily long reads fold into the last spectrum bucket —
+    # fixed cardinality no matter the input
+    assert position_bucket(10 ** 9) == quality.SPECTRUM_BUCKETS - 1
+
+
+def test_tally_log_counts_and_buckets():
+    o = ec_mod.new_outcome()
+    ns = ec_mod._tally_log("3:sub:A-G 17:sub:C-T 93:3_trunc", o)
+    ns += ec_mod._tally_log("5:5_trunc", o)
+    assert ns == 2  # only substitutions feed the per-read histogram
+    assert o["subs"] == 2 and o["t3"] == 1 and o["t5"] == 1
+    assert o["sub_pos"] == {0: 1, 2: 1}
+    assert o["t3_pos"] == {11: 1}
+    assert o["t5_pos"] == {0: 1}
+    # garbage entries are ignored, not crashed on
+    before = dict(o)
+    assert ec_mod._tally_log("x:sub:A-G nonsense 9:mystery", o) == 0
+    assert o == before
+    # the maxe clamp render_result applies before observing the
+    # substitutions_per_read histogram
+    assert bounded(ns, 1) == 1
+
+
+def test_summarize_results_matches_render_contract():
+    results = [
+        (">r1 3:sub:A-G 9:3_trunc\nACGT\n", ""),
+        ("", "2 no anchor mer found\n"),          # skipped: log line
+        (">r3 1:5_trunc\nAC\n", ""),
+        (">r4\nACGT\n", ""),                      # clean read
+    ]
+    assert summarize_results(results) == {
+        "reads": 4, "corrected": 3, "skipped": 1,
+        "subs": 1, "t3": 1, "t5": 1}
+
+
+# ---------------------------------------------------------------------------
+# windowed rates + drift
+# ---------------------------------------------------------------------------
+
+def _fed_registry():
+    reg = registry_for(None, force=True)
+    sc = QualityScorecard(reg, alpha=0.5, window_reads=1)
+    ec_mod.precreate_outcome_counters(reg)
+    return reg, sc
+
+
+def test_scorecard_windows_rates_and_drift():
+    reg, sc = _fed_registry()
+    assert sc.tick() is False          # no reads yet: no window
+    reg.counter("reads_in").inc(10)
+    reg.counter("reads_corrected").inc(9)
+    reg.counter("reads_skipped").inc(1)
+    reg.counter("skipped_contaminant").inc(1)
+    reg.counter("substitutions").inc(18)
+    assert sc.tick() is True
+    doc = reg.as_dict()
+    g = doc["gauges"]
+    assert g["quality_corrections_per_read"] == 2.0
+    assert g["quality_skip_rate"] == 0.1
+    assert g["quality_contam_rate"] == 0.1
+    assert g["quality_anchor_rate"] == 1.0
+    # the first window SEEDS the EWMA baseline: drift stays 0, so a
+    # short run that only ever closes one window cannot page
+    assert g["quality_drift_score"] == 0.0
+
+    # second window: a contaminant burst — every read skipped
+    reg.counter("reads_in").inc(10)
+    reg.counter("reads_skipped").inc(10)
+    reg.counter("skipped_contaminant").inc(10)
+    assert sc.tick() is True
+    g2 = reg.as_dict()["gauges"]
+    assert g2["quality_contam_rate"] == 1.0
+    assert g2["quality_drift_score"] > 4.0  # past the default rule
+
+
+def test_scorecard_coverage_ratio_against_header_prediction():
+    reg, sc = _fed_registry()
+    reg.set_meta(coverage_mean=8.0)
+    reg.counter("reads_in").inc(100)
+    reg.counter("reads_corrected").inc(100)
+    assert sc.tick() is True
+    doc = reg.as_dict()
+    # observed anchor rate 1.0 vs predicted 1 - e^-8 ~ 0.99966
+    assert doc["gauges"]["quality_coverage_ratio"] == pytest.approx(
+        1.0 / predicted_anchor_rate(8.0), abs=1e-3)
+    cov = doc["quality"]["coverage"]
+    assert cov["predicted_mean"] == 8.0
+    assert cov["predicted_anchor_rate"] == predicted_anchor_rate(8.0)
+
+
+def test_scorecard_window_respects_min_reads():
+    reg = registry_for(None, force=True)
+    sc = QualityScorecard(reg, window_reads=100)
+    ec_mod.precreate_outcome_counters(reg)
+    reg.counter("reads_in").inc(5)
+    assert sc.tick() is False           # below the window floor
+    assert sc.tick(final=True) is True  # the final write flushes it
+    with pytest.raises(ValueError):
+        QualityScorecard(registry_for(None, force=True), alpha=0.0)
+
+
+# ---------------------------------------------------------------------------
+# default drift rules under a mocked clock
+# ---------------------------------------------------------------------------
+
+def test_quality_rules_fire_and_heal_with_mocked_clock(tmp_path):
+    ev = str(tmp_path / "ev.jsonl")
+    reg = registry_for(None, events_path=ev, force=True)
+    QualityScorecard(reg, window_reads=1)
+    clk = Clock()
+    eng = AlertEngine(reg, alerts.merge_rules(
+        alerts.DEFAULT_QUALITY_RULES), now=clk)
+    # the scorecard pre-creates every gauge at its QUIET value
+    # (rates 0, ratios 1.0), so a data-plane-free run never pages
+    assert eng.evaluate() == []
+    reg.gauge("quality_contam_rate").set(0.5)
+    assert eng.evaluate() == ["contam_spike"]
+    clk.advance(5)
+    assert eng.evaluate() == ["contam_spike"]  # still firing, 1 event
+    reg.gauge("quality_contam_rate").set(0.0)
+    assert eng.evaluate() == []                # healed
+    reg.gauge("quality_drift_score").set(9.0)
+    assert eng.evaluate() == ["quality_drift"]
+    reg.gauge("quality_drift_score").set(0.0)
+    reg.gauge("quality_coverage_ratio").set(0.3)
+    assert eng.evaluate() == ["coverage_drop"]
+    assert reg.counter("alerts_fired_total").value == 3
+    states = [json.loads(line) for line in open(ev)
+              if json.loads(line).get("event") == "alert"]
+    contam = [e["state"] for e in states if e["rule"] == "contam_spike"]
+    assert contam == ["firing", "healed"]
+
+
+# ---------------------------------------------------------------------------
+# the pure quality section
+# ---------------------------------------------------------------------------
+
+def test_section_is_pure_function_of_the_document():
+    reg, sc = _fed_registry()
+    reg.counter("reads_in").inc(10)
+    reg.counter("reads_corrected").inc(9)
+    reg.counter("reads_skipped").inc(1)
+    reg.counter("substitutions").inc(18)
+    reg.histogram("sub_pos_bucket").observe(0)
+    reg.histogram("sub_pos_bucket").observe(12)
+    reg.histogram("substitutions_per_read").observe(2)
+    sc.tick()
+    doc = reg.as_dict()
+    assert validate_metrics(doc) == []
+    # recomputing the section from the serialized document (minus the
+    # section itself) reproduces it exactly — no hidden state
+    body = {k: v for k, v in doc.items() if k != "quality"}
+    assert section_from_doc(body) == doc["quality"]
+    # and serialization is stable across snapshots
+    assert (json.dumps(doc["quality"], sort_keys=True)
+            == json.dumps(reg.as_dict()["quality"], sort_keys=True))
+    # pre-created skip-reason slugs land as zeros, not absences
+    assert doc["quality"]["skip_reasons"] == {
+        "contaminant": 0, "homopolymer": 0, "no_anchor": 0, "other": 0}
+    assert doc["quality"]["sub_pos_spectrum"] == {"0": 1, "12": 1}
+
+
+def test_validate_quality_rejects_tampering():
+    reg, sc = _fed_registry()
+    sc.tick(final=True)
+    q = reg.as_dict()["quality"]
+    assert validate_quality(q) == []
+    bad = dict(q, substitutions=-1)
+    assert any("substitutions" in e for e in validate_quality(bad))
+    bad = dict(q, rates={})
+    assert validate_quality(bad)
+    bad = dict(q, schema="nope/9")
+    assert any("schema" in e for e in validate_quality(bad))
+
+
+# ---------------------------------------------------------------------------
+# coverage model
+# ---------------------------------------------------------------------------
+
+def test_coverage_from_histo_finds_mode_past_valley():
+    bins = [[1, 100, 500], [2, 10, 50], [3, 0, 30],
+            [4, 0, 60], [5, 0, 20]]
+    assert coverage_from_histo(bins) == 4.0
+    # monotone decreasing = error-dominated: no valley, no fit
+    assert coverage_from_histo([[1, 0, 9], [2, 0, 5], [3, 0, 1]]) == 0.0
+    assert coverage_from_histo([]) == 0.0
+    # low-quality-only bins are excluded from the fit
+    assert coverage_from_histo([[1, 9, 0], [2, 5, 0]]) == 0.0
+    assert predicted_anchor_rate(0) == 0.0
+    assert predicted_anchor_rate(8.0) == pytest.approx(0.999665,
+                                                       abs=1e-6)
+
+
+def test_validate_histo_sidecar():
+    from quorum_tpu.cli.histo_mer_database import histo_doc
+    import numpy as np
+    out = np.zeros((6, 2), dtype=np.int64)
+    out[1] = (3, 40)
+    out[4] = (0, 60)
+    doc = histo_doc(out)
+    assert validate_histo(doc) == []
+    assert doc["bins"] == [[1, 3, 40], [4, 0, 60]]
+    assert doc["stats"]["coverage_mode"] == 4.0
+    bad = dict(doc, bins=[[4, 0, 60], [1, 3, 40]])  # not ascending
+    assert validate_histo(bad)
+
+
+# ---------------------------------------------------------------------------
+# the accuracy gate (tools/quality_diff.py)
+# ---------------------------------------------------------------------------
+
+def _mini_doc():
+    reg, sc = _fed_registry()
+    reg.counter("reads_in").inc(10)
+    reg.counter("reads_corrected").inc(9)
+    reg.counter("reads_skipped").inc(1)
+    reg.counter("skipped_no_anchor").inc(1)
+    reg.counter("substitutions").inc(18)
+    reg.histogram("sub_pos_bucket").observe(2)
+    sc.tick(final=True)
+    return reg.as_dict()
+
+
+def test_quality_diff_pins_accuracy_exactly(tmp_path):
+    qd = importlib.import_module("quality_diff")
+    doc = _mini_doc()
+    m = tmp_path / "m.json"
+    m.write_text(json.dumps(doc))
+    base = str(tmp_path / "base.json")
+    assert qd.write_baseline(base, {"golden": str(m)}) == 0
+    verdict_path = str(tmp_path / "v.json")
+    assert qd.run_baseline(base, {"golden": str(m)}, verdict_path,
+                           quiet=True) == 0
+    verdict = json.loads(open(verdict_path).read())
+    assert validate_perf_diff(verdict,
+                              schema=QUALITY_DIFF_SCHEMA) == []
+    # ANY accuracy movement fails: one extra substitution
+    doc2 = json.loads(json.dumps(doc))
+    doc2["counters"]["substitutions"] += 1
+    del doc2["quality"]  # force recomputation from the counters
+    m2 = tmp_path / "m2.json"
+    m2.write_text(json.dumps(doc2))
+    assert qd.run_baseline(base, {"golden": str(m2)},
+                           str(tmp_path / "v2.json"), quiet=True) == 1
+    # a vanished document fails like a wrong one
+    assert qd.run_baseline(base, {}, None, quiet=True) == 1
+
+
+def test_quality_diff_profile_paths():
+    qd = importlib.import_module("quality_diff")
+    doc = _mini_doc()
+    prof = qd.profile_from_quality(doc["quality"])
+    assert prof["counts.reads"] == 10.0
+    assert prof["counts.substitutions"] == 18.0
+    assert prof["rates.skip_rate"] == 0.1
+    assert prof["skip_reasons.no_anchor"] == 1.0
+    # one occupied spectrum bucket: all mass past the midpoint of
+    # bucket range [2..2] -> tail_frac 0 (2 > 2//2 is False... the
+    # single-bucket case: bucket 2 > max//2=1, so the whole mass is
+    # "tail")
+    assert prof["spectrum.tail_frac"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# golden pipeline: byte determinism (the CI acceptance, in-process)
+# ---------------------------------------------------------------------------
+
+def test_golden_scorecard_byte_determinism(tmp_path):
+    db = str(tmp_path / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, READS])
+    assert rc == 0
+    sections = []
+    for i in (1, 2):
+        out = str(tmp_path / f"o{i}")
+        m = str(tmp_path / f"m{i}.json")
+        rc = ec_cli.main(["-p", "4", db, READS, "-o", out,
+                          "--metrics", m])
+        assert rc == 0
+        with open(m) as f:
+            doc = json.load(f)
+        assert validate_metrics(doc) == []
+        sections.append(doc["quality"])
+    assert (json.dumps(sections[0], sort_keys=True)
+            == json.dumps(sections[1], sort_keys=True))
+    q = sections[0]
+    assert q["reads"] == 242 and q["corrected"] == 241
+    assert q["substitutions"] == 227
+    assert q["skip_reasons"]["no_anchor"] == 1
+    # the spectrum carries real per-cycle mass, bounded cardinality
+    assert q["sub_pos_spectrum"]
+    assert all(int(k) < quality.SPECTRUM_BUCKETS
+               for k in q["sub_pos_spectrum"])
+    assert sum(q["substitutions_per_read"].values()) == 241

@@ -159,6 +159,39 @@ def test_serve_empty_and_bad_input(warm_stack):
     assert r.status == 400
 
 
+@pytest.fixture(scope="module")
+def offline_quality_doc(golden_db, tmp_path_factory):
+    """The offline CLI's final metrics document — with its `quality`
+    section — over the same golden input the serve tests POST."""
+    d = tmp_path_factory.mktemp("serve_q")
+    out = str(d / "off")
+    m = str(d / "m.json")
+    rc = ec_cli.main(["-p", "4", golden_db, READS, "-o", out,
+                      "--metrics", m])
+    assert rc == 0
+    with open(m) as f:
+        return json.load(f)
+
+
+def test_serve_quality_header_matches_offline_doc(warm_stack,
+                                                  offline_quality_doc):
+    """ISSUE 17 parity: the per-request X-Quorum-Quality tally for
+    the full golden input equals the offline run's final `quality`
+    section. The header is decoded from the same rendered text the
+    client receives (quality.summarize_results), so serve and
+    offline cannot disagree about correction quality."""
+    _reg, _engine, server = warm_stack
+    client = ServeClient(port=server.port)
+    r = client.correct(open(READS).read(), want_log=True)
+    assert r.status == 200
+    q = offline_quality_doc["quality"]
+    assert r.quality == {
+        "reads": q["reads"], "corrected": q["corrected"],
+        "skipped": q["skipped"], "subs": q["substitutions"],
+        "t3": q["truncations_3p"], "t5": q["truncations_5p"]}
+    assert r.quality["reads"] == 242 and r.quality["subs"] == 227
+
+
 def test_reload_rollback_and_swap_real_engine(warm_stack, offline,
                                               tmp_path):
     """Acceptance (ISSUE 7): POST /reload with a corrupt DB leaves the

@@ -92,6 +92,9 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     PREFILTER_COUNTERS,
     PUSH_COUNTERS,
     PUSH_META,
+    QUALITY_COUNTERS,
+    QUALITY_GAUGES,
+    QUALITY_HISTOGRAMS,
     SERVE_FEATURE_COUNTERS,
     SERVE_REQUIRED_COUNTERS,
     SERVE_REQUIRED_HISTOGRAMS,
@@ -380,6 +383,44 @@ def _check_autotune_meta(doc: dict) -> list[str]:
     return []
 
 
+def _check_quality_names(doc: dict) -> list[str]:
+    """Correction-quality requirements (ISSUE 17), two dispatches:
+
+    * meta.quality (a QualityScorecard was installed by
+      observability()) -> the windowed quality_* gauges must be
+      present (pre-created at quiet values) and the document must
+      carry a schema-valid top-level `quality` section (the schema
+      validator already checked its shape if present — here we
+      require its presence).
+    * meta.stage in (error_correct, serve) — a stage-2 data plane —
+      -> the full outcome surface: every skipped_<slug> counter (the
+      PR-7 zero-count lesson) and the quality histograms, all
+      pre-created by models/error_correct.precreate_outcome_counters.
+    """
+    errs = []
+    meta = doc.get("meta", {})
+    if meta.get("quality"):
+        why = f"meta.quality={meta.get('quality')!r}"
+        for name in QUALITY_GAUGES:
+            if name not in doc.get("gauges", {}):
+                errs.append(f"document with {why} missing gauge "
+                            f"{name!r}")
+        if not isinstance(doc.get("quality"), dict):
+            errs.append(f"document with {why} missing its top-level "
+                        "'quality' section")
+    if meta.get("stage") in ("error_correct", "serve"):
+        why = f"meta.stage={meta.get('stage')!r}"
+        for name in QUALITY_COUNTERS:
+            if name not in doc.get("counters", {}):
+                errs.append(f"document with {why} missing counter "
+                            f"{name!r}")
+        for name in QUALITY_HISTOGRAMS:
+            if name not in doc.get("histograms", {}):
+                errs.append(f"document with {why} missing histogram "
+                            f"{name!r}")
+    return errs
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -439,6 +480,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_autotune_meta(doc)
         problems = problems + _check_compile_names(doc)
         problems = problems + _check_flight_names(doc)
+        problems = problems + _check_quality_names(doc)
     return problems
 
 

@@ -1,0 +1,397 @@
+#!/usr/bin/env python
+"""Correction-ACCURACY regression verdicts over quality scorecards
+(ISSUE 17): the accuracy twin of tools/perf_diff.py. perf_diff fails
+CI when the pipeline gets slow; quality_diff fails CI when it gets
+WRONG — fewer corrections, a shifted substitution spectrum, a
+contaminant surge, an anchor rate the DB's coverage model says is
+too low.
+
+The golden pipeline is DETERMINISTIC (fixed reads, seeded build), so
+unlike the cliff-wide perf tolerances the committed baseline pins
+every quality metric EXACTLY (min == max == value): any movement in
+what the pipeline corrects is a contract violation, not noise.
+
+Modes:
+
+* **Golden gate** (what ci/tier1.sh runs)::
+
+      python tools/quality_diff.py --golden \\
+          --baseline QUALITY_BASELINE.json --out verdict.json
+
+  Builds the golden DB (tests/golden), runs error-correct TWICE,
+  asserts the two runs' `quality` sections are byte-identical
+  (sort_keys JSON — the scorecard is a pure function of the counters,
+  so any divergence is nondeterminism in the data plane itself), then
+  judges run 1's scorecard against the committed baseline. Exit 1 on
+  any regression or determinism break, 2 on a bad baseline/pipeline.
+
+  `--seed-regression floor|contam` injects a known accuracy bug into
+  the golden runs (a misapplied stage-2 presence floor, or the golden
+  reads fed back as the contaminant screen) — ci/tier1.sh uses it as
+  the negative test proving the gate actually fails when accuracy
+  moves.
+
+* **Artifact gate**::
+
+      python tools/quality_diff.py --baseline QUALITY_BASELINE.json \\
+          golden=/tmp/metrics.json
+
+  Judges existing metrics documents (KEY=PATH, like perf_diff). A
+  document without a `quality` section has one recomputed from its
+  counters/histograms (telemetry/quality.section_from_doc) — the
+  scorecard is derivable from any data-plane metrics document.
+
+* **Baseline generation**: `--write-baseline QUALITY_BASELINE.json`
+  (with --golden or KEY=PATH documents) regenerates the committed
+  contract. Review the diff before committing — a baseline update is
+  an accuracy-change ACKNOWLEDGEMENT, not a refresh.
+
+Metric names are flat paths over the quality section::
+
+    counts.reads  counts.corrected  counts.skipped
+    counts.substitutions  counts.truncations_3p  counts.truncations_5p
+    rates.<name>          skip_reasons.<slug>
+    coverage.predicted_mean  coverage.predicted_anchor_rate
+    spectrum.tail_frac    (substitution mass in the 3' half of the
+                           occupied position spectrum — the Illumina
+                           3'-decay signature as one number)
+
+The verdict document (`quorum-tpu-quality-diff/1`) shares perf_diff's
+verdict shape and is validated by tools/metrics_check.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from perf_diff import check_metric  # noqa: E402
+
+BASELINE_SCHEMA = "quorum-tpu-quality-baseline/1"
+GOLDEN_READS = os.path.join(_REPO, "tests", "golden", "reads.fastq")
+
+
+def _verdict_schema() -> str:
+    from quorum_tpu.telemetry.schema import QUALITY_DIFF_SCHEMA
+    return QUALITY_DIFF_SCHEMA
+
+
+def quality_section(doc: dict) -> dict:
+    """The quality section of an artifact: embedded (a scorecard run),
+    the document itself (a bare section), or recomputed from the
+    counters/histograms — the scorecard is a pure function of the
+    data-plane metrics, so any error-correct/serve document yields
+    one."""
+    from quorum_tpu.telemetry import quality
+    if isinstance(doc.get("quality"), dict):
+        return doc["quality"]
+    if doc.get("schema") == quality.QUALITY_SCHEMA:
+        return doc
+    if isinstance(doc.get("counters"), dict):
+        return quality.section_from_doc(doc)
+    raise ValueError("no quality section and no counters to "
+                     "recompute one from")
+
+
+def profile_from_quality(q: dict) -> dict[str, float]:
+    """Flat metric paths over one quality section."""
+    prof: dict[str, float] = {}
+    for k in ("reads", "corrected", "skipped", "substitutions",
+              "truncations_3p", "truncations_5p"):
+        prof[f"counts.{k}"] = float(q.get(k, 0))
+    for k, v in q.get("rates", {}).items():
+        prof[f"rates.{k}"] = float(v)
+    for k, v in q.get("skip_reasons", {}).items():
+        prof[f"skip_reasons.{k}"] = float(v)
+    cov = q.get("coverage")
+    if isinstance(cov, dict):
+        for k in ("predicted_mean", "predicted_anchor_rate"):
+            if isinstance(cov.get(k), (int, float)):
+                prof[f"coverage.{k}"] = float(cov[k])
+    spec = []
+    for k, v in q.get("sub_pos_spectrum", {}).items():
+        try:
+            spec.append((int(k), int(v)))
+        except (TypeError, ValueError):
+            continue
+    total = sum(n for _, n in spec)
+    if total > 0:
+        mx = max(b for b, _ in spec)
+        tail = sum(n for b, n in spec if b > mx // 2)
+        prof["spectrum.tail_frac"] = round(tail / total, 6)
+    return prof
+
+
+def extract_quality_profile(path: str) -> dict[str, float]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("not a JSON object")
+    return profile_from_quality(quality_section(doc))
+
+
+# -- golden pipeline --------------------------------------------------------
+
+def _write_contam_fasta(path: str) -> None:
+    """The golden reads themselves as a contaminant screen — the
+    worst-case seeded regression: every read is a contaminant hit."""
+    lines = []
+    with open(GOLDEN_READS) as f:
+        raw = f.read().splitlines()
+    for i in range(0, len(raw) - 3, 4):
+        lines.append(f">contam_{i // 4}")
+        lines.append(raw[i + 1])
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def run_golden(workdir: str,
+               seed_regression: str | None = None) -> list[str]:
+    """Build the golden DB, run error-correct twice; returns the two
+    metrics-document paths. `seed_regression` injects a known
+    accuracy bug into BOTH runs (the gate must catch it; the
+    determinism check alone must not)."""
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    db = os.path.join(workdir, "golden.db")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, GOLDEN_READS])
+    if rc:
+        raise RuntimeError(f"create_mer_database rc={rc}")
+    extra: list[str] = []
+    if seed_regression == "floor":
+        # a misapplied stage-2 presence floor: every trusted mer
+        # filtered, anchors vanish, corrections collapse
+        extra = ["--presence-floor", "64"]
+    elif seed_regression == "contam":
+        contam = os.path.join(workdir, "contam.fa")
+        _write_contam_fasta(contam)
+        extra = ["--contaminant", contam]
+    paths = []
+    for i in (1, 2):
+        out = os.path.join(workdir, f"corrected_{i}.fa")
+        m = os.path.join(workdir, f"metrics_{i}.json")
+        rc = ec_cli.main(["-p", "4", db, GOLDEN_READS, "-o", out,
+                          "--metrics", m] + extra)
+        if rc:
+            raise RuntimeError(f"error_correct run {i} rc={rc}")
+        paths.append(m)
+    return paths
+
+
+def check_determinism(path_a: str, path_b: str) -> str | None:
+    """None when the two documents' quality sections serialize
+    byte-identically (sort_keys JSON); else a one-line diagnosis."""
+    with open(path_a) as f:
+        qa = quality_section(json.load(f))
+    with open(path_b) as f:
+        qb = quality_section(json.load(f))
+    sa = json.dumps(qa, sort_keys=True)
+    sb = json.dumps(qb, sort_keys=True)
+    if sa == sb:
+        return None
+    pa, pb = profile_from_quality(qa), profile_from_quality(qb)
+    moved = sorted(k for k in pa.keys() | pb.keys()
+                   if pa.get(k) != pb.get(k))
+    return ("quality sections differ between identical runs "
+            f"(nondeterministic data plane); moved: "
+            f"{moved if moved else 'distribution keys'}")
+
+
+# -- verdicts ---------------------------------------------------------------
+
+def _emit(verdict: dict, out: str | None, quiet: bool) -> None:
+    if not quiet:
+        for key, dv in verdict["docs"].items():
+            for name, entry in dv.get("metrics", {}).items():
+                mark = "ok " if entry["ok"] else "REG"
+                val = entry.get("value")
+                base = entry.get("baseline")
+                print(f"[quality_diff] {mark} {key}:{name} = "
+                      f"{val if val is not None else '-'}"
+                      + (f" (baseline {base})" if base is not None
+                         else "")
+                      + ("" if entry["ok"]
+                         else f" -- {entry.get('status')}"))
+    for msg in verdict["regressions"]:
+        print(f"[quality_diff] REGRESSION {msg}", file=sys.stderr)
+    print(f"[quality_diff] verdict: {verdict['verdict']} "
+          f"({verdict['checked']} metric(s) checked, "
+          f"{len(verdict['regressions'])} regression(s))")
+    if out:
+        from quorum_tpu.telemetry.registry import atomic_write
+        atomic_write(out, json.dumps(verdict, indent=1) + "\n")
+
+
+def run_baseline(baseline_path: str, docs: dict[str, str],
+                 out: str | None, quiet: bool = False,
+                 pre_regressions: list[str] | None = None) -> int:
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"quality_diff: {baseline_path}: {e}", file=sys.stderr)
+        return 2
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        print(f"quality_diff: {baseline_path} is not a "
+              f"{BASELINE_SCHEMA} document", file=sys.stderr)
+        return 2
+    verdict = {
+        "schema": _verdict_schema(),
+        "baseline": os.path.basename(baseline_path),
+        "verdict": "pass",
+        "checked": 0,
+        "regressions": list(pre_regressions or []),
+        "docs": {},
+    }
+    for key, spec in baseline.get("docs", {}).items():
+        path = docs.get(key)
+        dv: dict = {"metrics": {}}
+        verdict["docs"][key] = dv
+        if path is None:
+            if spec.get("optional"):
+                dv["status"] = "not supplied (optional)"
+                continue
+            dv["status"] = "document not supplied"
+            verdict["regressions"].append(f"{key}: document not "
+                                          "supplied")
+            continue
+        try:
+            prof = extract_quality_profile(path)
+        except (OSError, ValueError) as e:
+            dv["status"] = str(e)
+            verdict["regressions"].append(f"{key}: {e}")
+            continue
+        dv["path"] = path
+        for name, mspec in spec.get("metrics", {}).items():
+            entry = check_metric(name, mspec, prof.get(name))
+            dv["metrics"][name] = entry
+            verdict["checked"] += 1
+            if not entry["ok"]:
+                verdict["regressions"].append(
+                    f"{key}: {name}: {entry.get('status')}")
+    if verdict["regressions"]:
+        verdict["verdict"] = "regression"
+    _emit(verdict, out, quiet)
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+def write_baseline(out: str, docs: dict[str, str]) -> int:
+    baseline = {
+        "schema": BASELINE_SCHEMA,
+        "meta": {
+            "note": "accuracy contract for the golden pipeline "
+                    "(tools/quality_diff.py): the run is "
+                    "deterministic, so every metric is pinned "
+                    "EXACTLY — updating this file acknowledges an "
+                    "accuracy change",
+        },
+        "docs": {},
+    }
+    for key, path in sorted(docs.items()):
+        prof = extract_quality_profile(path)
+        metrics = {}
+        for name in sorted(prof):
+            v = round(prof[name], 6)
+            # exact pin: absolute min == max == value works for zero
+            # baselines too, where ratio bounds are meaningless
+            metrics[name] = {"value": v, "min": v, "max": v}
+        baseline["docs"][key] = {"metrics": metrics}
+    from quorum_tpu.telemetry.registry import atomic_write
+    atomic_write(out, json.dumps(baseline, indent=1) + "\n")
+    n = sum(len(d["metrics"]) for d in baseline["docs"].values())
+    print(f"[quality_diff] wrote baseline {out} "
+          f"({n} metric(s) over {len(docs)} document(s)) — review "
+          "before committing")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Accuracy regression verdicts over quality "
+                    "scorecards: golden-pipeline gate (--golden) or "
+                    "existing-artifact gate (KEY=PATH pairs)")
+    p.add_argument("docs", nargs="*", metavar="KEY=PATH",
+                   help="Metrics documents to judge (ignored with "
+                        "--golden, which produces its own)")
+    p.add_argument("--golden", action="store_true",
+                   help="Build the golden DB, run error-correct "
+                        "twice, assert the quality sections are "
+                        "byte-identical, judge run 1 as document key "
+                        "'golden'")
+    p.add_argument("--seed-regression", choices=("floor", "contam"),
+                   default=None,
+                   help="With --golden: inject a known accuracy bug "
+                        "(misapplied presence floor / golden reads as "
+                        "the contaminant screen) — the gate must "
+                        "fail, proving it catches accuracy movement")
+    p.add_argument("--baseline", metavar="path", default=None,
+                   help="Baseline contract JSON "
+                        f"({BASELINE_SCHEMA})")
+    p.add_argument("--write-baseline", metavar="path", default=None,
+                   help="Generate the baseline contract instead of "
+                        "judging")
+    p.add_argument("--out", metavar="path", default=None,
+                   help="Write the verdict document "
+                        "(quorum-tpu-quality-diff/1) here")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Only print regressions and the verdict")
+    args = p.parse_args(argv)
+
+    if args.baseline and args.write_baseline:
+        p.error("--baseline and --write-baseline are exclusive")
+    if not args.baseline and not args.write_baseline:
+        p.error("one of --baseline / --write-baseline is required")
+
+    docs: dict[str, str] = {}
+    pre_regressions: list[str] = []
+    workdir = None
+    try:
+        if args.golden:
+            workdir = tempfile.mkdtemp(prefix="quality_diff.")
+            try:
+                m1, m2 = run_golden(workdir, args.seed_regression)
+            except (RuntimeError, OSError) as e:
+                print(f"quality_diff: golden pipeline failed: {e}",
+                      file=sys.stderr)
+                return 2
+            diag = check_determinism(m1, m2)
+            if diag is None:
+                print("[quality_diff] determinism: quality sections "
+                      "of both golden runs are byte-identical")
+            else:
+                pre_regressions.append(f"golden: {diag}")
+            docs["golden"] = m1
+        for item in args.docs:
+            key, sep, path = item.partition("=")
+            if not sep or not key or not path:
+                p.error(f"expected KEY=PATH, got {item!r}")
+            docs[key] = path
+        if not docs:
+            p.error("nothing to judge: supply KEY=PATH documents "
+                    "or --golden")
+        if args.write_baseline:
+            if pre_regressions:
+                print(f"quality_diff: refusing to write a baseline "
+                      f"from a nondeterministic run: "
+                      f"{pre_regressions}", file=sys.stderr)
+                return 2
+            return write_baseline(args.write_baseline, docs)
+        return run_baseline(args.baseline, docs, args.out,
+                            quiet=args.quiet,
+                            pre_regressions=pre_regressions)
+    finally:
+        if workdir is not None:
+            import shutil
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

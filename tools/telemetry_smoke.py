@@ -2,7 +2,7 @@
 """Device-truth + push-transport + alerting-loop smoke for CI
 (ISSUES 10 + 11, ci/tier1.sh).
 
-Five gates in one tool:
+Seven gates in one tool:
 
 1. **Profiled golden run**: build the mer database from the committed
    golden reads with `--profile` + `--metrics` + `--trace-spans` AND
@@ -45,6 +45,20 @@ Five gates in one tool:
    (`meta.autotune_profile` stamped into its document) and an
    explicit lever env var still wins over the profile.
 
+6. **Contaminant burst -> contam_spike -> sealed dump** (ISSUE 17):
+   the golden reads fed back as the contaminant screen make the
+   quality scorecard's windowed contam-rate gauge cross the default
+   `contam_spike` rule end-to-end — the alert fires into the events
+   stream, and the rule's `dump: true` leaves a SEALED flight dump
+   whose trigger names the rule (the quality trajectory of a dying
+   run, ISSUE 16's black box fed by ISSUE 17's scorecard).
+
+7. **Serve quality-header parity** (ISSUE 17): every 200 response's
+   `X-Quorum-Quality` per-request summary, summed over all requests,
+   must reconcile EXACTLY with the drained serve document's
+   scorecard — the header and the document are the same tallies
+   through the same render path.
+
 Artifacts land in --out-dir:
   telemetry_metrics.json  — the profiled stage-1 document
                             (metrics_check gates the devtrace + push
@@ -55,6 +69,9 @@ Artifacts land in --out-dir:
   telemetry_serve_metrics.json — the burned serve document
   telemetry_autotune_metrics.json — the profile-applied stage run
   autotune_profile.json / autotune_lines.json — the derived profile
+  telemetry_quality_metrics.json(+.events.jsonl, +.flight.json)
+                          — the contaminant-burst run + its dump
+  telemetry_serve_quality_metrics.json — the header-parity serve run
 
 Exit 0 = all checks passed.
 """
@@ -426,10 +443,149 @@ def main(argv=None) -> int:
     print(f"[telemetry_smoke] autotune: profile {profile_path} "
           f"applied (meta stamped), env override wins")
 
+    # -- 6: contaminant burst -> contam_spike fires + flight dump -----
+    # the standing accuracy alarm end-to-end (ISSUE 17): feed the
+    # golden reads back as the contaminant screen, so the data plane
+    # skips (nearly) everything as contaminant hits; the quality
+    # scorecard's windowed contam-rate gauge crosses the default
+    # `contam_spike` rule, whose dump:true leaves a sealed flight dump
+    # naming the rule — the quality trajectory of a dying run
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+
+    quality_metrics = os.path.join(
+        out_dir, "telemetry_quality_metrics.json")
+    quality_events = os.path.join(
+        out_dir, "telemetry_quality_metrics.events.jsonl")
+    quality_dump = os.path.join(
+        out_dir, "telemetry_quality_metrics.flight.json")
+    contam_fa = os.path.join(out_dir, "contam.fa")
+    with open(reads) as f:
+        raw = f.read().splitlines()
+    with open(contam_fa, "w") as f:
+        for i in range(0, len(raw) - 3, 4):
+            f.write(f">c{i // 4}\n{raw[i + 1]}\n")
+    print("[telemetry_smoke] contaminant burst: golden reads as the "
+          "screen, window=64 reads")
+    os.environ["QUORUM_QUALITY_WINDOW_READS"] = "64"
+    try:
+        rc = ec_cli.main(
+            ["-p", "4", db, reads,
+             "-o", os.path.join(out_dir, "contam_out.fa"),
+             "--batch-size", "64", "--contaminant", contam_fa,
+             "--metrics", quality_metrics,
+             "--metrics-interval", "0.05"])
+    finally:
+        os.environ.pop("QUORUM_QUALITY_WINDOW_READS", None)
+    if rc != 0:
+        return _fail(f"contaminant-burst run rc={rc}")
+    with open(quality_metrics) as f:
+        qdoc = json.load(f)
+    qsec = qdoc.get("quality", {})
+    if qsec.get("rates", {}).get("contam_rate", 0) <= 0.2:
+        return _fail(f"contam_rate="
+                     f"{qsec.get('rates', {}).get('contam_rate')!r} "
+                     "did not cross the contam_spike threshold")
+    if qsec.get("skip_reasons", {}).get("contaminant", 0) < 1:
+        return _fail("skip_reasons.contaminant empty despite the "
+                     "seeded burst")
+    qstates = []
+    with open(quality_events) as f:
+        for line in f:
+            obj = json.loads(line)
+            if obj.get("event") == "alert" \
+                    and obj.get("rule") == "contam_spike":
+                qstates.append(obj["state"])
+    if "firing" not in qstates:
+        return _fail(f"contam_spike never fired (events: {qstates})")
+    if qdoc.get("counters", {}).get("alerts_fired_total", 0) < 1:
+        return _fail("alerts_fired_total did not count the "
+                     "contam_spike firing")
+    if not os.path.exists(quality_dump):
+        return _fail("contam_spike dump:true left no flight dump "
+                     f"at {quality_dump}")
+    with open(quality_dump) as f:
+        fdoc = json.load(f)
+    if fdoc.get("trigger", {}).get("site") != "contam_spike":
+        return _fail(f"flight dump names site "
+                     f"{fdoc.get('trigger', {}).get('site')!r}, "
+                     "expected 'contam_spike'")
+    if "crc32c" not in fdoc:
+        return _fail("flight dump is not sealed (no crc32c)")
+    if metrics_check.main(["-q", quality_metrics, quality_dump]) != 0:
+        return _fail("contaminant-burst artifacts failed "
+                     "metrics_check")
+    print(f"[telemetry_smoke] contam burst: contam_rate="
+          f"{qsec['rates']['contam_rate']} fired contam_spike, "
+          f"sealed dump names the rule -> {quality_dump}")
+
+    # -- 7: serve X-Quorum-Quality reconciles with the final doc ------
+    # every 200 response carries a per-request quality summary; the
+    # sums across all requests must equal the drained serve document's
+    # scorecard exactly (same render path, same tallies — ISSUE 17)
+    serve_q_metrics = os.path.join(
+        out_dir, "telemetry_serve_quality_metrics.json")
+    port = _free_port()
+    rc_box2: dict = {}
+
+    def run_quality_server():
+        rc_box2["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-batch", "64",
+             "--max-wait-ms", "2", "-p", "4",
+             "--metrics", serve_q_metrics, db])
+
+    t2 = threading.Thread(target=run_quality_server, daemon=True)
+    t2.start()
+    client2 = ServeClient(port=port, timeout=300.0)
+    deadline = time.perf_counter() + 60
+    while True:
+        try:
+            client2.healthz()
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                return _fail("quality serve never came up")
+            time.sleep(0.1)
+    tot = {"reads": 0, "corrected": 0, "skipped": 0,
+           "subs": 0, "t3": 0, "t5": 0}
+    n_req = 0
+    for start in range(0, len(raw) - 3, 4 * 96):
+        body = "\n".join(raw[start:start + 4 * 96]) + "\n"
+        r = client2.correct(body)
+        if r.status != 200:
+            return _fail(f"quality serve request status={r.status}")
+        if not isinstance(r.quality, dict):
+            return _fail("200 response carries no X-Quorum-Quality "
+                         "header")
+        n_req += 1
+        for k in tot:
+            tot[k] += int(r.quality.get(k, 0))
+    client2.quiesce()
+    t2.join(timeout=90)
+    if t2.is_alive() or rc_box2.get("rc") != 0:
+        return _fail(f"quality serve drain failed "
+                     f"(alive={t2.is_alive()} rc={rc_box2.get('rc')})")
+    with open(serve_q_metrics) as f:
+        sqdoc = json.load(f)
+    sq = sqdoc.get("quality", {})
+    pairs = (("reads", "reads"), ("corrected", "corrected"),
+             ("skipped", "skipped"), ("subs", "substitutions"),
+             ("t3", "truncations_3p"), ("t5", "truncations_5p"))
+    for hk, dk in pairs:
+        if tot[hk] != sq.get(dk):
+            return _fail(f"header sum {hk}={tot[hk]} != serve "
+                         f"document quality.{dk}={sq.get(dk)!r}")
+    if metrics_check.main(["-q", serve_q_metrics]) != 0:
+        return _fail("quality serve document failed metrics_check")
+    print(f"[telemetry_smoke] serve quality: {n_req} request(s), "
+          f"header sums reconcile with the final document "
+          f"({tot['reads']} reads, {tot['subs']} subs)")
+
     print("[telemetry_smoke] OK: devtrace attribution rendered, fleet "
           "document aggregated, outage survived, stall alert "
           "fired+healed, SLO burn surfaced without flipping "
-          "liveness, autotune profile round-tripped")
+          "liveness, autotune profile round-tripped, contaminant "
+          "burst fired contam_spike with a sealed dump, serve "
+          "quality headers reconciled")
     return 0
 
 

@@ -45,6 +45,12 @@ optionally only the last `--last-s SECONDS` before the trigger — with the
 triggering thread's rows marked and its Python stack printed in
 full. Dumps are auto-detected by schema even without the flag.
 
+`--quality` (ISSUE 17) renders each metrics document's correction-
+quality scorecard instead of the timer tables: headline counts, the
+data-plane rates, the skip-reason breakdown, and the bucketed
+distributions (substitution-position spectrum per read cycle,
+substitutions per read, truncation cycles) as ascii bars.
+
 This is the quick look a BENCH run's time budget needs; for the
 timeline view load the `.trace.json` twin in Perfetto or
 `chrome://tracing`.
@@ -293,6 +299,83 @@ def partition_table(path: str, events: list[dict]) -> None:
               f"{secs:>9.3f} {pct:>8.1f}")
 
 
+def _qbar(n: int, peak: int, width: int = 40) -> str:
+    if peak <= 0 or n <= 0:
+        return ""
+    return "#" * max(1, int(round(width * n / peak)))
+
+
+def _quality_count_map(q: dict, key: str) -> list[tuple[int, int]]:
+    """A quality count map as (numeric key, count) rows, ascending;
+    the 'overflow' spillover key (Histogram.MAX_KEYS) sorts last."""
+    rows = []
+    for k, v in q.get(key, {}).items():
+        try:
+            rows.append((int(k), int(v)))
+        except (TypeError, ValueError):
+            rows.append((1 << 30, int(v)))
+    rows.sort()
+    return rows
+
+
+def render_quality(mpath: str, doc: dict) -> int:
+    """The correction-quality scorecard of one metrics document
+    (ISSUE 17): headline counts, the data-plane rates, the skip-reason
+    breakdown, and the bucketed distributions (substitution-position
+    spectrum per read cycle, substitutions per read, truncation
+    cycles) as ascii bars. Returns 1 when the document carries no
+    `quality` section."""
+    q = doc.get("quality")
+    if not isinstance(q, dict):
+        print(f"{mpath}: no quality section (produced by --metrics "
+              "runs of the error-correct/serve data plane; "
+              "tools/quality_diff.py can recompute one)",
+              file=sys.stderr)
+        return 1
+    print(f"\n== quality: {mpath} (schema {q.get('schema')}) ==")
+    print(f"reads {q.get('reads', 0)}  "
+          f"corrected {q.get('corrected', 0)}  "
+          f"skipped {q.get('skipped', 0)}  "
+          f"subs {q.get('substitutions', 0)}  "
+          f"3'trunc {q.get('truncations_3p', 0)}  "
+          f"5'trunc {q.get('truncations_5p', 0)}")
+    rates = q.get("rates", {})
+    if rates:
+        print("rates:")
+        for k in sorted(rates):
+            print(f"  {k:<22} {float(rates[k]):>10.6f}")
+    cov = q.get("coverage")
+    if isinstance(cov, dict):
+        print(f"coverage model: predicted_mean "
+              f"{cov.get('predicted_mean')}  predicted_anchor_rate "
+              f"{cov.get('predicted_anchor_rate')}")
+    reasons = q.get("skip_reasons", {})
+    if reasons:
+        total = sum(int(v) for v in reasons.values())
+        print("skip reasons:")
+        for k in sorted(reasons):
+            n = int(reasons[k])
+            pct = 100.0 * n / total if total > 0 else 0.0
+            print(f"  {k:<16} {n:>8} {pct:>6.1f}%")
+    per_bucket = int(q.get("spectrum_cycles_per_bucket", 1) or 1)
+    for key, label, scale in (
+            ("sub_pos_spectrum", "cycle", per_bucket),
+            ("trunc_cycle_3p", "cycle", per_bucket),
+            ("trunc_cycle_5p", "cycle", per_bucket),
+            ("substitutions_per_read", "subs/read", 1)):
+        rows = _quality_count_map(q, key)
+        if not rows:
+            continue
+        peak = max(n for _, n in rows)
+        print(f"{key} ({label} per row"
+              + (f", {scale} cycles/bucket" if scale > 1 else "")
+              + "):")
+        for b, n in rows:
+            head = "overflow" if b >= (1 << 30) else str(b * scale)
+            print(f"  {head:>9} {n:>8} {_qbar(n, peak)}")
+    return 0
+
+
 FLIGHT_SCHEMA = "quorum-tpu-flight/1"
 
 
@@ -393,6 +476,12 @@ def main(argv=None) -> int:
                    help="With --flight: only the last SECONDS of the "
                         "ring timeline before the trigger (default: "
                         "the full ring)")
+    p.add_argument("--quality", action="store_true",
+                   help="Render each metrics document's correction-"
+                        "quality scorecard (counts, rates, skip "
+                        "reasons, position spectrum) instead of the "
+                        "timer tables; a metrics FILE without a "
+                        "quality section is an error")
     p.add_argument("--device", metavar="PROFILE_DIR", default=None,
                    help="Parse the jax.profiler trace in this "
                         "--profile directory and print the device-"
@@ -427,11 +516,19 @@ def main(argv=None) -> int:
             # then the aggregate's own tables
             fleet_table(path, doc)
             docs.append(doc)
-            render_metrics_doc(path, doc)
+            if args.quality:
+                if render_quality(path, doc):
+                    return 1
+            else:
+                render_metrics_doc(path, doc)
         elif isinstance(doc, dict) and ("counters" in doc
                                         or "timers" in doc):
             docs.append(doc)
-            render_metrics_doc(path, doc)
+            if args.quality:
+                if render_quality(path, doc):
+                    return 1
+            else:
+                render_metrics_doc(path, doc)
         else:
             try:
                 events = load_events(path)
