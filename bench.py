@@ -819,6 +819,59 @@ def main():
             reads_homopolymer=int(homo_mask.sum()),
         ))
 
+    # (4) coverage ramp over time (ISSUE 18): the live-ingestion
+    # story measured offline — one FIXED probe set corrected against
+    # databases built from growing prefixes of the same read stream.
+    # Each point is one epoch of the live tier: accuracy climbs as
+    # coverage accumulates, and the per-point lines let the ledger
+    # plot quality-vs-coverage. Same -s for every point so the table
+    # geometry (and the compiled executables) stay constant.
+    try:
+        g_v = rngr.integers(0, 4, size=100_000, dtype=np.int8)
+        c_v, q_v, s_v, e_v = synth_reads(rngr, g_v, 2 * BATCH,
+                                         READ_LEN, ERR_RATE)
+        n_probe = max(1, BATCH // 2)
+        probe_fq = f"{tmp}/ramp_probe.fastq"
+        write_fastq(probe_fq, c_v[:n_probe], q_v[:n_probe])
+        size_v = int((len(g_v) + e_v.sum() * K * 1.3) * 1.25) + 500_000
+        for frac in (0.25, 0.5, 1.0):
+            n_pref = max(n_probe, int(len(c_v) * frac))
+            pref_fq = f"{tmp}/ramp_prefix.fastq"
+            write_fastq(pref_fq, c_v[:n_pref], q_v[:n_pref])
+            dbv = f"{tmp}/ramp_{int(frac * 100)}_db.qdb"
+            ho_v: dict = {}
+            t0 = time.perf_counter()
+            run_cli(cdb_cli.main,
+                    ["-s", str(size_v), "-m", str(K), "-b", "7",
+                     "-q", "38", "-o", dbv,
+                     "--batch-size", str(BATCH), pref_fq],
+                    f"coverage_ramp {frac}: create_database",
+                    handoff=ho_v)
+            s1_v = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run_cli(ec_cli.main,
+                    ["-o", f"{tmp}/ramp_out",
+                     "--batch-size", str(BATCH), dbv, probe_fq],
+                    f"coverage_ramp {frac}: error_correct",
+                    db=ho_v.get("db"))
+            s2_v = time.perf_counter() - t0
+            recs_v = parse_fasta(f"{tmp}/ramp_out.fa")
+            acc_v = accuracy_triple(recs_v, g_v, s_v[:n_probe],
+                                    e_v[:n_probe], c_v[:n_probe])
+            print(metric_line(
+                "regime_coverage_ramp",
+                prefix_reads=n_pref,
+                coverage=round(n_pref * READ_LEN / len(g_v), 2),
+                probe_reads=n_probe,
+                stage1_gb_h=round(
+                    n_pref * READ_LEN / s1_v * 3600 / 1e9, 3),
+                stage2_gb_h=round(
+                    n_probe * READ_LEN / s2_v * 3600 / 1e9, 3),
+                **acc_v,
+            ))
+    except Exception as e:  # noqa: BLE001 — reported, not fatal
+        print(metric_line("regime_coverage_ramp", error=str(e)[:200]))
+
     # the quorum DRIVER end to end (parse-once replay + in-process
     # table handoff): the user-facing wall clock for raw reads ->
     # corrected fasta, same executables as the stages above (cached)

@@ -113,6 +113,17 @@
 # `contam_spike` with a sealed flight dump naming the rule, serve
 # quality-header parity) rides the telemetry smoke above.
 #
+# ISSUE 18 adds the live-ingestion gate: tools/live_smoke.py — a
+# quorum-serve started with --ingest and NO database boots on an
+# empty live table, the golden reads stream in as seq-stamped gzipped
+# /ingest chunks, epoch snapshots seal and swap DURING the stream
+# (--epoch-reads boundaries) plus a final forced /epoch, and the
+# served corrections are byte-identical to tests/golden/expected.fa
+# (warm request recompiles nothing); the drain commits the live-table
+# checkpoint and a metrics document with meta.live_ingest, which
+# metrics_check gates (requiring the ingest/epoch counter surface),
+# alongside a --prom lint of the mid-run /metrics scrape.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -124,6 +135,7 @@
 #        SKIP_FLIGHT_SMOKE=1  skips the flight-recorder gate.
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
 #        SKIP_QUALITY_DIFF=1  skips the accuracy-regression gate.
+#        SKIP_LIVE_SMOKE=1    skips the live-ingestion gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
 #        SKIP_COMPILE_SENTINEL=1  skips the runtime compile sentinel
@@ -481,6 +493,32 @@ else
     fi
 fi
 
+live_rc=0
+if [ "${SKIP_LIVE_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: live smoke skipped (SKIP_LIVE_SMOKE=1)"
+else
+    # the live-ingestion gate (ISSUE 18): streamed gzipped /ingest
+    # chunks, epoch swaps mid-stream, end-state parity with the
+    # offline pipeline, checkpointed drain
+    echo "== golden live-ingestion run =="
+    LIVE_DIR=$(mktemp -d /tmp/live_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "${FLIGHT_DIR:-}" "${PERF_DIR:-}" "${QUAL_DIR:-}" "$LIVE_DIR"' EXIT
+    env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/live_smoke.py \
+        --out-dir "$LIVE_DIR" || live_rc=$?
+    if [ "$live_rc" -eq 0 ]; then
+        echo "== metrics_check gates (live) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$LIVE_DIR/live_metrics.json" || live_rc=1
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py --prom \
+            "$LIVE_DIR/live_scrape.prom" || live_rc=1
+    fi
+    if [ "$live_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: live-ingestion gate FAILED (rc=$live_rc)" >&2
+    fi
+fi
+
 if [ "$qlint_rc" -ne 0 ]; then exit "$qlint_rc"; fi
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
@@ -493,4 +531,5 @@ if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
 if [ "$flight_rc" -ne 0 ]; then exit "$flight_rc"; fi
 if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
 if [ "$quality_rc" -ne 0 ]; then exit "$quality_rc"; fi
+if [ "$live_rc" -ne 0 ]; then exit "$live_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

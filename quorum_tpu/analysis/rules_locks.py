@@ -40,6 +40,7 @@ from .core import Finding, call_name, dotted, rule
 SCOPE = (
     "quorum_tpu/serve/batcher.py",
     "quorum_tpu/serve/server.py",
+    "quorum_tpu/serve/ingest.py",
     "quorum_tpu/serve/admission.py",
     "quorum_tpu/telemetry/export.py",
     "quorum_tpu/telemetry/alerts.py",
@@ -55,6 +56,10 @@ SCOPE = (
 LOCK_ORDER = (
     "server.CorrectionHTTPServer._reload_lock",
     "server.CorrectionHTTPServer._req_lock",
+    # the ingest dispatcher's queue lock: HTTP handlers enqueue under
+    # it, and the worker calls swap_engine (batcher lock) from its
+    # epoch path — so it ranks outside the batcher, never inside
+    "ingest.IngestDispatcher._lock",
     "batcher.Batcher._lock",
     "admission.TokenBucketQuota._lock",
     "alerts.AlertEngine._lock",

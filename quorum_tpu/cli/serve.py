@@ -141,11 +141,75 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-reload", action="store_true",
                    help="Disable POST /reload (hot DB/contaminant/"
                         "config swap); it answers 501")
+    # live ingestion tier (ISSUE 18). Geometry flags are long-only:
+    # -m/-s/-q already mean min-count/skip/qual-cutoff-value on this
+    # CLI (quorum_error_correct_reads parity), so the stage-1 short
+    # spellings cannot be reused here.
+    p.add_argument("--ingest", action="store_true",
+                   help="Run the live ingestion tier: POST /ingest "
+                        "streams FASTQ chunks into a mutable counting "
+                        "table while /correct serves from the last "
+                        "sealed epoch snapshot (the db positional is "
+                        "omitted; the service boots on the live "
+                        "table, resumed from --live-dir if a "
+                        "checkpoint exists)")
+    p.add_argument("--live-dir", metavar="dir", default=None,
+                   help="Directory for epoch snapshots and the "
+                        "live-table checkpoint (required with "
+                        "--ingest)")
+    p.add_argument("--ingest-mer-len", metavar="k", type=int,
+                   default=24,
+                   help="Live table mer length (default 24)")
+    p.add_argument("--ingest-bits", metavar="b", type=int, default=7,
+                   help="Live table counter bits (default 7)")
+    p.add_argument("--ingest-size", metavar="size", default="16M",
+                   help="Initial live table capacity in entries "
+                        "(k/M/G suffixes; grows by doubling like the "
+                        "offline build; default 16M)")
+    p.add_argument("--ingest-qual-thresh", metavar="q", type=int,
+                   default=None,
+                   help="Quality threshold for a high-quality mer "
+                        "(stage-1 --min-qual-value; required with "
+                        "--ingest)")
+    p.add_argument("--epoch-reads", metavar="n", type=int, default=0,
+                   help="Seal + swap a new epoch snapshot after every "
+                        "n ingested reads (0 = only --epoch-interval-s"
+                        " and POST /epoch trigger epochs)")
+    p.add_argument("--epoch-interval-s", metavar="s", type=float,
+                   default=0.0,
+                   help="Seal + swap a new epoch at most every s "
+                        "seconds when new reads arrived (0 = off)")
+    p.add_argument("--live-checkpoint-every", metavar="n", type=int,
+                   default=0,
+                   help="Commit a crash-safe live-table checkpoint "
+                        "(table planes + ingest cursor) every n "
+                        "chunks; a killed service resumes without "
+                        "re-ingesting (default 0 = only at drain)")
+    p.add_argument("--live-floor-initial", metavar="f", type=int,
+                   default=1,
+                   help="Presence floor applied to EARLY epoch "
+                        "snapshots, when coverage is too thin to "
+                        "trust once-seen mers (default 1 = off)")
+    p.add_argument("--live-floor-final", metavar="f", type=int,
+                   default=1,
+                   help="Presence floor once coverage reaches "
+                        "--live-floor-ramp (default 1)")
+    p.add_argument("--live-floor-ramp", metavar="cov", type=float,
+                   default=0.0,
+                   help="Mean HQ coverage at which the epoch floor "
+                        "finishes ramping from initial to final "
+                        "(0 = floor pinned at final)")
+    p.add_argument("--ingest-queue-chunks", metavar="n", type=int,
+                   default=16,
+                   help="Bounded ingest chunk queue; a full queue "
+                        "answers 429 + Retry-After (default 16)")
     # observability (same surface as the other CLIs; --metrics
     # writes the final document on drain)
     add_observability_args(p, metrics=True)
     faults.add_fault_args(p)
-    p.add_argument("db", help="Mer database")
+    p.add_argument("db", nargs="?", default=None,
+                   help="Mer database (omitted with --ingest: the "
+                        "service boots on the live table)")
     return p
 
 
@@ -157,6 +221,25 @@ def main(argv=None) -> int:
     vlog_mod.verbose = args.verbose or vlog_mod.verbose
     faults.setup(args.fault_plan)
 
+    if args.ingest:
+        if args.db is not None:
+            print("--ingest boots on the live table; drop the db "
+                  "argument (use POST /ingest to feed it).",
+                  file=sys.stderr)
+            return 1
+        if not args.live_dir:
+            print("--ingest requires --live-dir (epoch snapshots and "
+                  "the live-table checkpoint live there).",
+                  file=sys.stderr)
+            return 1
+        if args.ingest_qual_thresh is None:
+            print("--ingest requires --ingest-qual-thresh (the "
+                  "stage-1 min-qual-value).", file=sys.stderr)
+            return 1
+    elif args.db is None:
+        print("A mer database is required (or --ingest).",
+              file=sys.stderr)
+        return 1
     if args.qual_cutoff_char is not None and args.qual_cutoff_value is not None:
         print("Switches -q and -Q are conflicting.", file=sys.stderr)
         return 1
@@ -211,10 +294,13 @@ def main(argv=None) -> int:
 
 
 def _make_engine(args, qual_cutoff: int, reg, tracer,
-                 db: str | None = None, **over):
+                 db: str | None = None, verify: str | None = None,
+                 **over):
     """Construct a CorrectionEngine from the CLI flags, with optional
-    reload-time overrides (`db`, `contaminant`, `cutoff`). Looked up
-    through the package attribute so tests can stub the engine."""
+    reload-time overrides (`db`, `contaminant`, `cutoff`) and an
+    explicit `verify` mode (swap paths pin it; see _swap_verify).
+    Looked up through the package attribute so tests can stub the
+    engine."""
     from .. import serve as serve_pkg
     return serve_pkg.CorrectionEngine(
         db or args.db,
@@ -227,8 +313,17 @@ def _make_engine(args, qual_cutoff: int, reg, tracer,
         contaminant=over.get("contaminant", args.contaminant),
         apriori_error_rate=args.apriori_error_rate,
         poisson_threshold=args.poisson_threshold, no_mmap=args.no_mmap,
-        rows=args.max_batch, verify_db=args.verify_db,
+        rows=args.max_batch, verify_db=verify or args.verify_db,
         registry=reg, tracer=tracer)
+
+
+def _swap_verify(args) -> str:
+    """The verification mode for candidate tables about to SWAP into
+    a running server (POST /reload, live-epoch swaps): a corrupted
+    table must not replace a healthy serving one, so even
+    --verify-db=off is raised to sampled scrubbing here (the ROADMAP
+    verify-at-swap item) — boot keeps the user's choice."""
+    return "sample" if args.verify_db == "off" else args.verify_db
 
 
 def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
@@ -237,15 +332,85 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
                          TokenBucketQuota)
 
     reg = obs.registry
-    engine = _make_engine(args, qual_cutoff, reg, obs.tracer)
+    # a serve run that drains before its first request must still
+    # write a gateable document (ingest-only warm-ups make that a
+    # normal lifecycle, not an edge case)
+    from ..telemetry.contract import precreate_serve_metrics
+    precreate_serve_metrics(reg)
+
+    # the config actually serving: starts at the boot flags, advanced
+    # by every successful /reload (and, in --ingest mode, every epoch
+    # swap) — the watchdog's rebuild must reproduce the SERVING
+    # config, not silently revert to boot
+    effective = {"db": args.db, "over": {}}
+
+    dispatcher = None
+    if args.ingest:
+        import os
+
+        from ..ops.poisson import compute_poisson_cutoff
+        from ..serve.ingest import IngestDispatcher
+        from ..serve.live_table import (LiveTableCheckpoint,
+                                        load_or_create)
+        from ..utils import sizes
+
+        os.makedirs(args.live_dir, exist_ok=True)
+        ckpt = LiveTableCheckpoint(args.live_dir)
+        table, cursor = load_or_create(
+            ckpt, args.ingest_mer_len, args.ingest_bits,
+            sizes.parse_size(args.ingest_size),
+            args.ingest_qual_thresh)
+        if cursor >= 0:
+            vlog("Resumed live table from checkpoint: cursor ",
+                 cursor, " (", table.stats.reads, " reads)")
+
+        def _epoch_engine(db_path: str, poisson: dict):
+            """Build the engine for a freshly sealed epoch snapshot:
+            re-resolve the cutoff from the ACCUMULATED stats (the
+            same Poisson parameterization the offline pipeline uses,
+            with -p still winning), sample-verify the candidate
+            (_swap_verify), and warm it to the serving engine's
+            length buckets so the swap costs no cold compile."""
+            cutoff = args.cutoff
+            if cutoff is None:
+                cutoff = compute_poisson_cutoff(
+                    int(poisson["distinct_hq"]),
+                    int(poisson["total_hq"]),
+                    args.apriori_error_rate / 3.0,
+                    args.poisson_threshold / args.apriori_error_rate,
+                ) or 1  # an empty/thin boot table still serves
+            cur = (dispatcher.batcher.current_engine()
+                   if dispatcher is not None
+                   and dispatcher.batcher is not None else None)
+            eng = _make_engine(args, qual_cutoff, reg, obs.tracer,
+                               db=db_path, verify=_swap_verify(args),
+                               cutoff=cutoff)
+            eng.warmup(getattr(cur, "warm_lengths", ())
+                       or warmup_lengths)
+            # the watchdog's rebuild must reproduce THIS epoch
+            effective["db"] = db_path
+            effective["over"] = dict(effective["over"],
+                                     cutoff=cutoff)
+            return eng
+
+        dispatcher = IngestDispatcher(
+            table, ckpt, _epoch_engine, live_dir=args.live_dir,
+            epoch_reads=args.epoch_reads,
+            epoch_interval_s=args.epoch_interval_s,
+            checkpoint_every=args.live_checkpoint_every,
+            queue_chunks=args.ingest_queue_chunks,
+            floor_initial=args.live_floor_initial,
+            floor_final=args.live_floor_final,
+            floor_ramp=args.live_floor_ramp,
+            cursor=cursor, registry=reg, tracer=obs.tracer)
+        # epoch 0: the boot engine is a sealed snapshot of whatever
+        # the (possibly resumed) live table holds right now
+        engine = dispatcher.boot_epoch()
+    else:
+        engine = _make_engine(args, qual_cutoff, reg, obs.tracer)
     if warmup_lengths:
         vlog("Warming ", len(warmup_lengths), " length buckets")
         engine.warmup(warmup_lengths)
-
-    # the config actually serving: starts at the boot flags, advanced
-    # by every successful /reload — the watchdog's rebuild must
-    # reproduce the RELOADED config, not silently revert to boot
-    effective = {"db": args.db, "over": {}}
 
     def _engine_factory(old):
         """Watchdog rebuild: the EFFECTIVE db/config (boot flags plus
@@ -290,8 +455,10 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
         over = dict(effective["over"])
         over.update({k: params[k] for k in ("contaminant", "cutoff")
                      if k in params})
+        # candidate tables are verified BEFORE they can swap in, even
+        # under --verify-db=off (the verify-at-swap fix)
         eng = _make_engine(args, qual_cutoff, reg, obs.tracer,
-                           db=db, **over)
+                           db=db, verify=_swap_verify(args), **over)
         eng.warmup(getattr(cur, "warm_lengths", ()) or warmup_lengths)
         # the build succeeded, so the server WILL swap it in (the
         # engine's rows always match --max-batch): a later watchdog
@@ -313,12 +480,23 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
         reg.set_meta(quota_rps=args.quota_rps)
     if not args.no_reload:
         reg.set_meta(reload=True)
+    if dispatcher is not None:
+        # metrics_check requires the ingest/epoch counter surface in
+        # the final document once this is declared
+        reg.set_meta(live_ingest=True,
+                     ingest_k=args.ingest_mer_len,
+                     epoch_reads=args.epoch_reads,
+                     live_floor_initial=args.live_floor_initial,
+                     live_floor_final=args.live_floor_final,
+                     live_floor_ramp=args.live_floor_ramp)
     server = CorrectionServer(
         batcher, host=args.host, port=args.port,
         deadline_ms=args.deadline_ms, registry=reg,
         drain_grace_s=args.drain_grace_s, quota=quota,
         engine_builder=None if args.no_reload else _engine_builder,
-        alerts=getattr(obs, "alerts", None))
+        alerts=getattr(obs, "alerts", None), ingest=dispatcher)
+    if dispatcher is not None:
+        dispatcher.start(batcher)
 
     def _sigterm(_signum, _frame):
         vlog("SIGTERM: draining")
@@ -338,7 +516,14 @@ def _serve(args, qual_cutoff: int, warmup_lengths: list[int], obs) -> int:
         # an unexpected failure must still free the port; the
         # observability teardown stamps the error document
         server.close()
+        if dispatcher is not None:
+            dispatcher.drain(timeout=5.0)
         raise
+    if dispatcher is not None:
+        # finish queued chunks and commit the final live-table
+        # checkpoint (cursor) so a restart resumes without
+        # re-ingesting
+        dispatcher.drain()
     vlog("Drained; writing final metrics")
     return 0
 

@@ -25,6 +25,12 @@ k-mer counters in PAPERS.md):
   (429 the greedy client) instead of queue order.
 * `client.py`  — a minimal stdlib client plus the
   `quorum-serve-bench` closed-loop load generator.
+* `live_table.py` / `ingest.py` — the live ingestion tier (ISSUE 18):
+  `POST /ingest` streams FASTQ chunks into a mutable LiveTable owned
+  by an IngestDispatcher thread; at epoch boundaries the table is
+  sealed, floored, cutoff-resolved, and swapped into the correction
+  path via the same generation substrate as /reload — in-flight
+  corrections finish on the old epoch, any failure rolls back.
 
 The console entry point is `quorum-serve` (cli/serve.py).
 """
@@ -33,10 +39,14 @@ from .admission import TokenBucketQuota
 from .batcher import (PRIORITIES, DeadlineExceeded, Draining,
                       DynamicBatcher, EngineStepTimeout, QueueFull)
 from .engine import CorrectionEngine
+from .ingest import IngestDispatcher
+from .live_table import LiveTable, LiveTableCheckpoint, epoch_floor
 from .server import CorrectionServer
 
 __all__ = [
     "CorrectionEngine", "DynamicBatcher", "CorrectionServer",
     "QueueFull", "Draining", "DeadlineExceeded", "EngineStepTimeout",
     "TokenBucketQuota", "PRIORITIES",
+    "IngestDispatcher", "LiveTable", "LiveTableCheckpoint",
+    "epoch_floor",
 ]

@@ -18,6 +18,7 @@ artifact.
 from __future__ import annotations
 
 import dataclasses
+import gzip as gzip_mod
 import http.client
 import json
 import threading
@@ -77,13 +78,22 @@ class ServeClient:
         self.timeout = timeout
 
     def _request(self, method: str, path: str, body: bytes = b"",
-                 headers: dict | None = None):
+                 headers: dict | None = None,
+                 gzip_body: bool = False):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
+        hdrs = dict(headers or {})
+        hdrs.setdefault("Accept-Encoding", "gzip")
+        if gzip_body and body:
+            body = gzip_mod.compress(body, compresslevel=1)
+            hdrs["Content-Encoding"] = "gzip"
         try:
-            conn.request(method, path, body=body, headers=headers or {})
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
             data = resp.read()
+            if (resp.headers.get("Content-Encoding", "")
+                    .lower() == "gzip"):
+                data = gzip_mod.decompress(data)
             return resp, data
         finally:
             conn.close()
@@ -93,14 +103,17 @@ class ServeClient:
                 want_log: bool = False,
                 priority: str | None = None,
                 client_id: str | None = None,
-                request_id: str | None = None) -> ServeResult:
+                request_id: str | None = None,
+                gzip_body: bool = False) -> ServeResult:
         """POST /correct. Returns a ServeResult whatever the status —
         callers branch on `.status` (200/429/503/504/...).
         `priority` stamps X-Quorum-Priority (interactive|bulk),
         `client_id` stamps X-Quorum-Client (the quota identity), and
         `request_id` stamps X-Quorum-Request-Id (the trace identity;
         the server generates one when absent — either way the
-        response's id lands in `ServeResult.request_id`)."""
+        response's id lands in `ServeResult.request_id`).
+        `gzip_body=True` gzips the request body (Content-Encoding:
+        gzip); responses are transparently un-gzipped either way."""
         body = (fastq_text.encode()
                 if isinstance(fastq_text, str) else fastq_text)
         path = "/correct" + ("?log=1" if want_log else "")
@@ -113,7 +126,8 @@ class ServeClient:
             headers["X-Quorum-Client"] = client_id
         if request_id is not None:
             headers["X-Quorum-Request-Id"] = request_id
-        resp, data = self._request("POST", path, body, headers)
+        resp, data = self._request("POST", path, body, headers,
+                                   gzip_body=gzip_body)
         rid = resp.headers.get("X-Quorum-Request-Id", "")
         if resp.status != 200:
             retry = float(resp.headers.get("Retry-After", 0) or 0)
@@ -150,6 +164,7 @@ class ServeClient:
                            retry_statuses=(429, 503),
                            priority: str | None = None,
                            client_id: str | None = None,
+                           gzip_body: bool = False,
                            sleep=time.sleep) -> ServeResult:
         """`correct()` with polite retries on 429/503: the server's
         already-parsed Retry-After is honored when present, combined
@@ -162,7 +177,7 @@ class ServeClient:
         backoff = base_backoff_s
         res = self.correct(fastq_text, deadline_ms=deadline_ms,
                            want_log=want_log, priority=priority,
-                           client_id=client_id)
+                           client_id=client_id, gzip_body=gzip_body)
         for _ in range(max_attempts - 1):
             if res.status not in retry_statuses:
                 return res
@@ -170,7 +185,8 @@ class ServeClient:
             backoff = min(backoff * 2, max_backoff_s)
             res = self.correct(fastq_text, deadline_ms=deadline_ms,
                                want_log=want_log, priority=priority,
-                               client_id=client_id)
+                               client_id=client_id,
+                               gzip_body=gzip_body)
         return res
 
     def reload(self, params: dict | None = None) -> tuple[int, dict]:
@@ -180,6 +196,41 @@ class ServeClient:
         resp, data = self._request(
             "POST", "/reload", body,
             {"Content-Type": "application/json"})
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {}
+        return resp.status, doc
+
+    def ingest(self, fastq_text: str | bytes,
+               seq: int | None = None,
+               gzip_body: bool = False) -> tuple[int, dict]:
+        """POST /ingest — (status_code, ack). `seq` stamps
+        X-Quorum-Ingest-Seq (the at-least-once dedupe identity: a
+        retransmit of an already-applied seq acks `duplicate: true`
+        without re-counting); omit it to let the server assign the
+        next one. 200 acks carry seq/reads/cursor/generation."""
+        body = (fastq_text.encode()
+                if isinstance(fastq_text, str) else fastq_text)
+        headers = {"Content-Type": "text/plain"}
+        if seq is not None:
+            headers["X-Quorum-Ingest-Seq"] = str(seq)
+        resp, data = self._request("POST", "/ingest", body, headers,
+                                   gzip_body=gzip_body)
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {}
+        if resp.status != 200 and "retry_after_s" not in doc:
+            retry = float(resp.headers.get("Retry-After", 0) or 0)
+            if retry:
+                doc["retry_after_s"] = retry
+        return resp.status, doc
+
+    def epoch(self) -> tuple[int, dict]:
+        """POST /epoch — force an epoch boundary now (seal + swap).
+        (status_code, body); 200 carries the new epoch/generation."""
+        resp, data = self._request("POST", "/epoch")
         try:
             doc = json.loads(data.decode() or "{}")
         except ValueError:
@@ -224,6 +275,143 @@ def _percentile(sorted_vals: list[float], p: float) -> float:
     return sorted_vals[i]
 
 
+_QKEYS = ("reads", "corrected", "skipped", "subs", "t3", "t5")
+
+
+def _ingest_bench(args, records, bodies, metric_line) -> int:
+    """`--ingest` mode: the main thread streams the input file as
+    seq-stamped /ingest chunks while `--concurrency` workers
+    interleave /correct requests against whichever epoch is serving.
+    Each observed engine-generation change (an epoch swap) closes a
+    `serve_bench_ingest_epoch` ledger line carrying the q_* quality
+    fields accumulated while that epoch served — the
+    corrections-per-read ramp is readable straight off the ledger."""
+    import sys
+
+    chunk_reads = max(1, args.chunk_reads)
+    chunks: list[bytes] = []
+    for i in range(0, len(records), chunk_reads):
+        parts = []
+        for hdr, seq, qual in records[i:i + chunk_reads]:
+            if qual:
+                parts.append(f"@{hdr}\n{seq.decode()}\n+\n"
+                             f"{qual.decode()}\n")
+            else:
+                parts.append(f">{hdr}\n{seq.decode()}\n")
+        chunks.append("".join(parts).encode())
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    q_epoch = dict.fromkeys(_QKEYS, 0)
+    q_total = dict.fromkeys(_QKEYS, 0)
+    corr: dict[int, int] = {}
+    errors = [0]
+
+    def corrector():
+        c = ServeClient(args.host, args.port)
+        rr = 0
+        while not stop.is_set():
+            body = bodies[rr % len(bodies)]
+            rr += 1
+            try:
+                res = c.correct(body, deadline_ms=args.deadline_ms,
+                                priority=args.priority,
+                                client_id=args.client_id,
+                                gzip_body=args.gzip)
+            except OSError:
+                with lock:
+                    errors[0] += 1
+                time.sleep(0.05)
+                continue
+            with lock:
+                corr[res.status] = corr.get(res.status, 0) + 1
+                if res.status == 200 and res.quality:
+                    for k in _QKEYS:
+                        v = int(res.quality.get(k, 0))
+                        q_epoch[k] += v
+                        q_total[k] += v
+            if res.status == 429:
+                time.sleep(max(0.05, res.retry_after_s))
+
+    def flush_epoch(gen: int, reads_at: int) -> None:
+        # close the ledger line for the generation that just stopped
+        # serving: its q_* fields are everything corrected on it
+        with lock:
+            snap = dict(q_epoch)
+            for k in _QKEYS:
+                q_epoch[k] = 0
+        print(metric_line(
+            "serve_bench_ingest_epoch", generation=gen,
+            reads_ingested=reads_at,
+            **{f"q_{k}": snap[k] for k in _QKEYS}))
+
+    client = ServeClient(args.host, args.port)
+    workers = [threading.Thread(target=corrector, daemon=True)
+               for _ in range(max(1, args.concurrency))]
+    for t in workers:
+        t.start()
+    t_start = time.perf_counter()
+    gen_seen: int | None = None
+    reads_sent = chunks_ok = dupes = 0
+    try:
+        for seq_no, chunk in enumerate(chunks):
+            while True:
+                try:
+                    status, ack = client.ingest(chunk, seq=seq_no,
+                                                gzip_body=args.gzip)
+                except OSError:
+                    time.sleep(0.1)
+                    continue
+                if status == 200:
+                    break
+                if status == 429:
+                    time.sleep(max(0.05, float(
+                        ack.get("retry_after_s", 0) or 0)))
+                    continue
+                print(f"ingest seq {seq_no} -> {status}: "
+                      f"{ack.get('error', '')}", file=sys.stderr)
+                return 1
+            chunks_ok += 1
+            if ack.get("duplicate"):
+                dupes += 1
+            else:
+                reads_sent += int(ack.get("reads", 0))
+            gen = int(ack.get("generation", 0))
+            if gen_seen is None:
+                gen_seen = gen
+            elif gen != gen_seen:
+                flush_epoch(gen_seen, reads_sent)
+                gen_seen = gen
+        # seal the tail into a final epoch so the run's ledger covers
+        # every ingested read
+        status, doc = client.epoch()
+        if status == 200 and gen_seen is not None:
+            flush_epoch(gen_seen, reads_sent)
+    finally:
+        stop.set()
+        for t in workers:
+            t.join()
+    wall = time.perf_counter() - t_start
+    live: dict = {}
+    try:
+        live = client.healthz().get("live", {}) or {}
+    except (OSError, RuntimeError, ValueError):
+        pass
+    print(metric_line(
+        "serve_bench_ingest", chunks=len(chunks), chunks_ok=chunks_ok,
+        chunk_reads=chunk_reads, duplicates=dupes,
+        reads_ingested=reads_sent, wall_s=round(wall, 4),
+        reads_per_s=(round(reads_sent / wall, 2) if wall > 0 else 0),
+        epoch=int(live.get("epoch", 0)),
+        coverage=round(float(live.get("coverage", 0.0)), 4),
+        floor=int(live.get("floor", 1)),
+        corrections_ok=corr.get(200, 0),
+        corrections_rejected=corr.get(429, 0),
+        transport_errors=errors[0],
+        **{f"q_{k}": q_total[k] for k in _QKEYS}))
+    return 0 if chunks_ok == len(chunks) else 1
+
+
 def bench_main(argv=None) -> int:
     """Closed-loop load generation against a running quorum-serve."""
     import argparse
@@ -261,6 +449,19 @@ def bench_main(argv=None) -> int:
     p.add_argument("--client-id", default=None,
                    help="Stamp X-Quorum-Client on every request "
                         "(the quota identity)")
+    p.add_argument("--ingest", action="store_true",
+                   help="Live-ingestion mode: stream the input file "
+                        "as seq-stamped /ingest chunks while the "
+                        "workers interleave /correct requests; "
+                        "ledgers q_* quality fields per epoch swap "
+                        "(requires a quorum-serve started with "
+                        "--ingest)")
+    p.add_argument("--chunk-reads", type=int, default=64,
+                   help="Reads per /ingest chunk in --ingest mode "
+                        "(default 64)")
+    p.add_argument("--gzip", action="store_true",
+                   help="gzip request bodies (Content-Encoding: "
+                        "gzip); responses are un-gzipped either way")
     p.add_argument("sequence", help="FASTQ/FASTA file to draw reads from")
     args = p.parse_args(argv)
 
@@ -283,6 +484,9 @@ def bench_main(argv=None) -> int:
             else:
                 parts.append(f">{hdr}\n{seq.decode()}\n")
         bodies.append("".join(parts).encode())
+
+    if args.ingest:
+        return _ingest_bench(args, records, bodies, metric_line)
 
     next_i = [0]
     lock = threading.Lock()
@@ -308,12 +512,14 @@ def bench_main(argv=None) -> int:
                         res = client.correct_with_retry(
                             body, deadline_ms=args.deadline_ms,
                             priority=args.priority,
-                            client_id=args.client_id)
+                            client_id=args.client_id,
+                            gzip_body=args.gzip)
                     else:
                         res = client.correct(
                             body, deadline_ms=args.deadline_ms,
                             priority=args.priority,
-                            client_id=args.client_id)
+                            client_id=args.client_id,
+                            gzip_body=args.gzip)
                 except OSError:
                     with lock:
                         errors[0] += 1

@@ -1,0 +1,410 @@
+"""The ingest dispatcher: one thread that owns the live table
+(ISSUE 18).
+
+`POST /ingest` handlers never touch the build planes — they hand
+parsed FASTQ records to :class:`IngestDispatcher.submit_chunk`, which
+enqueues under the dispatcher lock and blocks until the dedicated
+worker thread has inserted the chunk (natural backpressure: a client
+can't outrun the device). The worker is the sole owner of the
+LiveTable, so inserts, grows, checkpoints, and epoch seals are all
+single-threaded — the concurrency surface is exactly one
+lock-protected queue plus the swap_engine generation substrate the
+epoch path already shares with /reload and the watchdog.
+
+Epoch protocol (the tentpole): at a boundary (`--epoch-reads` worth of
+new reads, or `--epoch-interval-s` with any new reads, or a forced
+`POST /epoch`), the worker seals the table WITHOUT closing it,
+re-resolves the Poisson cutoff from the accumulated stats, applies the
+time-varying presence floor (live_table.epoch_floor — the policy is
+declared in the epoch header), writes the snapshot as a normal v5
+database file under `--live-dir`, builds a fresh CorrectionEngine from
+it (sample-verified — the verify-at-swap fix rides along), and swaps
+it in via `Batcher.swap_engine` with the captured generation:
+in-flight corrections finish on the old epoch (the batcher dispatcher
+captured its engine reference), a superseded or failed swap rolls
+back — the old epoch keeps serving, the orphaned snapshot file is
+removed, and the failure is counted (`epoch_swap_failures_total`) for
+the next boundary to retry.
+
+Durability: every `--live-checkpoint-every` chunks (and once at
+drain) the worker commits a LiveTableCheckpoint carrying the chunk
+cursor. A client that stamps `X-Quorum-Ingest-Seq` gets exactly-once
+inserts across a kill: after resume, re-sent chunks at-or-below the
+restored cursor are acknowledged as duplicates without touching the
+table.
+
+Lock order: `ingest.IngestDispatcher._lock` ranks between the HTTP
+request lock and the batcher lock (analysis/rules_locks.LOCK_ORDER) —
+the worker calls swap_engine and registry updates from OUTSIDE its
+lock anyway; only queue/cursor/stats state lives under it.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+from ..io import db_format
+from ..telemetry import NULL
+from ..telemetry.spans import NULL_TRACER
+from ..utils import faults
+from ..utils.vlog import vlog
+from .batcher import Draining, QueueFull
+from .live_table import LiveTable, LiveTableCheckpoint, epoch_floor
+
+
+class _Chunk:
+    """One queued ingest chunk: records + a done event the submitting
+    HTTP thread blocks on."""
+
+    __slots__ = ("seq", "records", "done", "error")
+
+    def __init__(self, seq: int, records):
+        self.seq = seq
+        self.records = records
+        self.done = threading.Event()
+        self.error: BaseException | None = None
+
+
+class _ForceEpoch:
+    """A forced-epoch request (POST /epoch) awaiting the worker."""
+
+    __slots__ = ("done", "ok", "detail")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.ok = False
+        self.detail: dict = {}
+
+
+class IngestDispatcher:
+    """Owns a LiveTable on a dedicated thread; seals and swaps epoch
+    snapshots into the correction batcher."""
+
+    def __init__(self, table: LiveTable, ckpt: LiveTableCheckpoint,
+                 epoch_builder, *, live_dir: str,
+                 epoch_reads: int = 0, epoch_interval_s: float = 0.0,
+                 checkpoint_every: int = 0, queue_chunks: int = 16,
+                 floor_initial: int = 1, floor_final: int = 1,
+                 floor_ramp: float = 0.0, cursor: int = -1,
+                 keep_epochs: int = 2, registry=NULL,
+                 tracer=NULL_TRACER):
+        self.table = table
+        self.ckpt = ckpt
+        # epoch_builder(db_path, poisson) -> CorrectionEngine: the CLI
+        # closure that resolves the cutoff from the accumulated stats
+        # and sample-verifies the candidate before it can swap in
+        self.epoch_builder = epoch_builder
+        self.live_dir = live_dir
+        self.epoch_reads = int(epoch_reads)
+        self.epoch_interval_s = float(epoch_interval_s)
+        self.checkpoint_every = int(checkpoint_every)
+        self.queue_chunks = int(queue_chunks)
+        self.floor_initial = int(floor_initial)
+        self.floor_final = int(floor_final)
+        self.floor_ramp = float(floor_ramp)
+        self.keep_epochs = int(keep_epochs)
+        self.registry = registry
+        self.tracer = tracer
+        self.batcher = None
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: collections.deque[_Chunk] = collections.deque()
+        self._force: _ForceEpoch | None = None
+        self._cursor = int(cursor)      # last fully-ingested chunk seq
+        self._max_seen = int(cursor)    # dedupe horizon (incl. queued)
+        self._draining = False
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        self._chunks_done = 0
+        self._epoch_n = 0
+        self._epoch_reads_since = 0
+        self._epoch_t0 = time.monotonic()
+        self._floor = self.floor_initial
+        self._coverage = 0.0
+        self._last_epoch_error: str | None = None
+        self._epoch_paths: list[str] = []
+
+        reg = registry
+        reg.counter("ingest_requests_total")
+        reg.counter("ingest_reads_total")
+        reg.counter("epoch_swaps_total")
+        reg.counter("epoch_swap_failures_total")
+        reg.gauge("ingest_cursor").set(self._cursor)
+        reg.gauge("live_floor").set(self._floor)
+
+    # -- boot -------------------------------------------------------------
+    def boot_epoch(self):
+        """Seal and build the boot engine BEFORE the worker thread
+        exists (single-threaded; called by the CLI to construct the
+        server's first engine — possibly from a resumed table)."""
+        path, poisson = self._write_epoch_db()
+        engine = self.epoch_builder(path, poisson)
+        return engine
+
+    def start(self, batcher) -> None:
+        """Attach the correction batcher and start the worker."""
+        self.batcher = batcher
+        self._thread = threading.Thread(target=self._run,
+                                        name="quorum-ingest",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- HTTP-side API ----------------------------------------------------
+    def submit_chunk(self, records, seq: int | None = None) -> dict:
+        """Enqueue one chunk and block until it is inserted (or
+        dropped as a duplicate). Returns the ack document. Raises
+        Draining/QueueFull for the HTTP layer to map to 503/429."""
+        reg = self.registry
+        reg.counter("ingest_requests_total").inc()
+        with self._work:
+            if self._draining or self._stopped:
+                raise Draining()
+            if seq is None:
+                seq = self._max_seen + 1
+            seq = int(seq)
+            if seq <= self._max_seen:
+                # at-or-below the horizon: already ingested (resume)
+                # or already queued — ack without touching the table
+                return {"accepted": True, "duplicate": True,
+                        "seq": seq, "cursor": self._cursor}
+            if len(self._queue) >= self.queue_chunks:
+                raise QueueFull(retry_after=1.0)
+            chunk = _Chunk(seq, records)
+            self._queue.append(chunk)
+            self._max_seen = seq
+            self._work.notify_all()
+        chunk.done.wait()
+        if chunk.error is not None:
+            raise chunk.error
+        with self._lock:
+            return {"accepted": True, "duplicate": False, "seq": seq,
+                    "reads": len(records), "cursor": self._cursor}
+
+    def force_epoch(self, timeout: float = 120.0) -> dict:
+        """Seal + swap now (POST /epoch), regardless of boundaries.
+        Blocks until the worker finishes the attempt."""
+        req = _ForceEpoch()
+        with self._work:
+            if self._stopped:
+                raise Draining()
+            self._force = req
+            self._work.notify_all()
+        if not req.done.wait(timeout):
+            return {"ok": False, "error": "epoch timed out"}
+        return dict(req.detail, ok=req.ok)
+
+    def stats(self) -> dict:
+        """The healthz `live` section."""
+        with self._lock:
+            st = self.table.stats
+            return {
+                "cursor": self._cursor,
+                "queued": len(self._queue),
+                "epoch": self._epoch_n,
+                "floor": self._floor,
+                "coverage": round(self._coverage, 4),
+                "reads": st.reads, "bases": st.bases,
+                "batches": st.batches, "grows": st.grows,
+                "draining": self._draining,
+                "last_epoch_error": self._last_epoch_error,
+            }
+
+    @property
+    def cursor(self) -> int:
+        with self._lock:
+            return self._cursor
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Stop accepting chunks, finish the queue, commit a final
+        checkpoint, and join the worker."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- worker -----------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                while (not self._queue and self._force is None
+                        and not self._draining):
+                    self._work.wait(0.25)
+                if self._draining and not self._queue:
+                    self._stopped = True
+                    force = self._force
+                    self._force = None
+                else:
+                    force = self._force
+                    self._force = None
+                    if force is None and self._queue:
+                        chunk = self._queue[0]
+                    else:
+                        chunk = None
+            if self._stopped:
+                if force is not None:
+                    force.ok, force.detail = self._epoch("drain")
+                    force.done.set()
+                try:
+                    self.ckpt.save(self.table, self.cursor)
+                except Exception as e:  # a failed final snapshot must
+                    # not block shutdown — the previous one resumes
+                    self.registry.counter(
+                        "live_checkpoint_failures_total").inc()
+                    vlog("live: final checkpoint failed: ", e)
+                return
+            if force is not None:
+                force.ok, force.detail = self._epoch("forced")
+                force.done.set()
+                continue
+            if chunk is None:
+                continue
+            self._ingest_one(chunk)
+
+    def _ingest_one(self, chunk: _Chunk) -> None:
+        reg = self.registry
+        try:
+            faults.inject("serve.ingest", batch=chunk.seq)
+            with self.tracer.span("live_ingest_chunk", seq=chunk.seq,
+                                  reads=len(chunk.records)):
+                n = self.table.ingest_records(chunk.records)
+        except BaseException as e:
+            chunk.error = e
+            with self._work:
+                # pull the failed seq back out of the dedupe horizon
+                # so the client's retry isn't dropped as a duplicate
+                self._queue.popleft()
+                self._max_seen = max(
+                    [self._cursor] + [c.seq for c in self._queue])
+            chunk.done.set()
+            return
+        reg.counter("ingest_reads_total").inc(n)
+        with self._work:
+            self._queue.popleft()
+            self._cursor = chunk.seq
+            self._chunks_done += 1
+            self._epoch_reads_since += n
+            chunks_done = self._chunks_done
+            reads_since = self._epoch_reads_since
+        reg.gauge("ingest_cursor").set(chunk.seq)
+        chunk.done.set()
+        if (self.checkpoint_every > 0
+                and chunks_done % self.checkpoint_every == 0):
+            self.ckpt.save(self.table, chunk.seq)
+        if self._boundary_due(reads_since):
+            self._epoch("boundary")
+
+    def _boundary_due(self, reads_since: int) -> bool:
+        if reads_since <= 0:
+            return False
+        if self.epoch_reads > 0 and reads_since >= self.epoch_reads:
+            return True
+        return (self.epoch_interval_s > 0
+                and time.monotonic() - self._epoch_t0
+                >= self.epoch_interval_s)
+
+    # -- epoch ------------------------------------------------------------
+    def _write_epoch_db(self) -> tuple[str, dict]:
+        """Seal the live table into `<live-dir>/epoch-NNNNNN.qdb` with
+        the floor policy and accumulated Poisson stats declared in the
+        header. Single-threaded (worker, or CLI boot)."""
+        state, occ, distinct, total = self.table.seal()
+        cov = self.table.coverage(distinct, total)
+        floor = epoch_floor(self.floor_initial, self.floor_final,
+                            self.floor_ramp, cov)
+        n = self._epoch_n
+        path = os.path.join(self.live_dir, f"epoch-{n:06d}.qdb")
+        extra = {
+            "live_epoch": {
+                "epoch": n,
+                "cursor": self._cursor,
+                "reads": int(self.table.stats.reads),
+                "coverage": cov,
+                "floor": floor,
+                "floor_policy": {"initial": self.floor_initial,
+                                 "final": self.floor_final,
+                                 "ramp": self.floor_ramp},
+            },
+            "poisson_stats": {"distinct_hq": distinct,
+                              "total_hq": total},
+        }
+        if floor > 1:
+            # the PR 13 floor machinery: the engine applies
+            # prefilter.min_obs via ctable.tile_floor on load
+            extra["prefilter"] = {"mode": "live-floor",
+                                  "min_obs": floor}
+        os.makedirs(self.live_dir, exist_ok=True)
+        db_format.write_db(path, state, self.table.meta,
+                           n_entries=occ, extra_header=extra)
+        self._floor = floor
+        self._coverage = cov
+        return path, {"distinct_hq": distinct, "total_hq": total,
+                      "floor": floor, "coverage": cov}
+
+    def _epoch(self, reason: str) -> tuple[bool, dict]:
+        """One epoch attempt: seal → export → build+verify → swap.
+        Any failure rolls back — the old epoch keeps serving."""
+        reg = self.registry
+        self._epoch_n += 1
+        path = None
+        try:
+            with self.tracer.span("live_epoch", epoch=self._epoch_n,
+                                  reason=reason):
+                path, poisson = self._write_epoch_db()
+                # between snapshot build and the swap: an injected
+                # failure here must leave the old epoch serving
+                faults.inject("serve.epoch")
+                expected = self.batcher.generation
+                engine = self.epoch_builder(path, poisson)
+                gen = self.batcher.swap_engine(
+                    engine, expected_generation=expected)
+                if gen < 0:
+                    raise RuntimeError(
+                        "epoch swap superseded by a concurrent "
+                        "engine swap")
+        except Exception as e:
+            self._epoch_n -= 1
+            reg.counter("epoch_swap_failures_total").inc()
+            reg.event("epoch_swap_failed", reason=reason,
+                      error=str(e))
+            vlog("live: epoch swap failed (old epoch keeps "
+                 "serving): ", e)
+            if path is not None:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            with self._lock:
+                self._last_epoch_error = str(e)
+            return False, {"error": str(e)}
+        with self._lock:
+            self._epoch_reads_since = 0
+            self._last_epoch_error = None
+            self._epoch_paths.append(path)
+            stale = (self._epoch_paths[:-self.keep_epochs]
+                     if self.keep_epochs > 0 else [])
+            self._epoch_paths = self._epoch_paths[len(stale):]
+        self._epoch_t0 = time.monotonic()
+        reg.counter("epoch_swaps_total").inc()
+        reg.gauge("live_floor").set(poisson["floor"])
+        reg.event("epoch_swap", epoch=self._epoch_n, reason=reason,
+                  generation=gen, floor=poisson["floor"],
+                  coverage=round(poisson["coverage"], 4),
+                  distinct_hq=poisson["distinct_hq"],
+                  total_hq=poisson["total_hq"], path=path)
+        # older snapshots are dead once current+previous exist (an
+        # in-flight step only ever holds the previous epoch's mmap,
+        # which POSIX keeps alive across the unlink anyway)
+        for p in stale:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        return True, {"epoch": self._epoch_n, "generation": gen,
+                      "floor": poisson["floor"],
+                      "coverage": round(poisson["coverage"], 4),
+                      "path": path}

@@ -1,0 +1,353 @@
+"""Mutable counting table for the live ingestion tier (ISSUE 18).
+
+The batch pipeline's stage 1 assumes the whole input exists before
+counting starts; a sequencer doesn't work that way — reads arrive for
+hours. `LiveTable` is the build-side tile table (ops/ctable.TBuildState)
+kept OPEN: `ingest_records` pushes FASTQ records through the exact
+stage-1 insert wire (fastq.batch_records → packing.pack_reads →
+tile_insert_reads_packed, grow via tile_grow_build) in fixed-shape
+batches, and `seal()` produces an immutable epoch snapshot WITHOUT
+closing the build planes (tile_seal never donates its inputs), so
+ingestion continues while the snapshot is exported, verified, and
+swapped into the correction path.
+
+Three pieces live here, the ingest dispatcher (serve/ingest.py) owns
+the threading around them:
+
+* **LiveTable** — the open build table + running stats. Batch rows are
+  fixed (`QUORUM_INGEST_BATCH` lever) so the fused insert executable
+  compiles once per (geometry, length-bucket), not per chunk size.
+* **epoch_floor** — the time-varying presence floor: the PR 13 floor
+  machinery generalized from a build-time constant to a ramp. Early
+  epochs see thin coverage where a once-seen k-mer is as likely error
+  as signal, so the floor starts at `initial`; as mean HQ coverage
+  approaches `ramp`, the floor steps down linearly to `final`. The
+  policy is declared in every epoch header (`live_epoch.floor_policy`)
+  so a snapshot is self-describing.
+* **LiveTableCheckpoint** — durability, mirroring Stage1Checkpoint
+  byte-for-byte in idiom: one file, sealed JSON header line + raw
+  planes, incremental CRC32C payload digest, streamed tmp-then-rename,
+  `checkpoint.commit` fault site. The cursor it carries is the ingest
+  CHUNK sequence number, not a batch index: a killed service resumes
+  the table at the last committed chunk and acknowledges re-sent
+  chunks at-or-below that cursor as duplicates — exactly-once inserts
+  without re-ingesting.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import fastq, integrity, packing
+from ..io.checkpoint import CheckpointError
+from ..ops import ctable
+from ..utils import faults, levers
+
+LIVE_CKPT_FORMAT = "quorum_tpu_live_ckpt/1"
+
+
+def epoch_floor(initial: int, final: int, ramp: float,
+                coverage: float) -> int:
+    """The presence floor for an epoch sealed at mean HQ `coverage`
+    (total_hq / distinct_hq). Linear ramp from `initial` at coverage 0
+    down to `final` at coverage >= `ramp`; degenerate policies
+    (initial <= final, or no ramp) pin at `final`."""
+    initial = int(initial)
+    final = int(final)
+    if initial <= final or ramp <= 0:
+        return final
+    if coverage >= ramp:
+        return final
+    frac = max(0.0, 1.0 - float(coverage) / float(ramp))
+    return final + int(math.ceil((initial - final) * frac))
+
+
+class LiveStats:
+    """Running ingest totals (the checkpoint persists them, healthz
+    reports them)."""
+
+    def __init__(self):
+        self.reads = 0
+        self.bases = 0
+        self.batches = 0
+        self.grows = 0
+
+    def as_dict(self) -> dict:
+        return {"reads": self.reads, "bases": self.bases,
+                "batches": self.batches, "grows": self.grows}
+
+
+class LiveTable:
+    """An open stage-1 build table that accepts reads forever.
+
+    NOT thread-safe: the ingest dispatcher thread is the sole owner of
+    the build planes; HTTP threads hand it records through a queue
+    (serve/ingest.py) and only ever touch sealed snapshots."""
+
+    def __init__(self, k: int, bits: int, size: int, qual_thresh: int,
+                 *, batch_rows: int | None = None, max_grows: int = 8):
+        if batch_rows is None:
+            batch_rows = int(levers.raw("QUORUM_INGEST_BATCH")
+                             or "256")
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be > 0, got {batch_rows}")
+        self.k = int(k)
+        self.bits = int(bits)
+        self.size = int(size)
+        self.qual_thresh = int(qual_thresh)
+        self.batch_rows = int(batch_rows)
+        self.max_grows = int(max_grows)
+        self.meta = ctable.TileMeta(
+            self.k, self.bits,
+            ctable.tile_rb_for(self.size, self.k, self.bits))
+        self.bstate = ctable.make_tile_build(self.meta)
+        self.stats = LiveStats()
+
+    # -- ingest -----------------------------------------------------------
+    def ingest_records(self, records) -> int:
+        """Insert `records` ((header, seq, qual) tuples) and return the
+        number inserted. Slices into fixed `batch_rows`-row batches —
+        the padding keeps the fused insert executable's signature set
+        to one per length bucket, so a stream of odd-sized chunks
+        never recompiles."""
+        n_in = 0
+        for batch in fastq.batch_records(iter(records),
+                                         self.batch_rows):
+            self._insert_batch(batch)
+            n_in += batch.n
+        return n_in
+
+    def _insert_batch(self, batch) -> None:
+        pk = packing.pack_reads(batch.codes, batch.quals,
+                                batch.lengths,
+                                thresholds=(self.qual_thresh,))
+        bstate, meta = self.bstate, self.meta
+        bstate, full, (chi, clo, q, valid, placed) = \
+            ctable.tile_insert_reads_packed(bstate, meta, pk,
+                                            self.qual_thresh)
+        full = bool(full)
+        if full:
+            pending = jnp.logical_and(valid, jnp.logical_not(placed))
+        for _ in range(self.max_grows + 1):
+            if not full:
+                break
+            # the existing geometry-restart machinery: double the rows
+            # and re-drive only the observations that missed
+            bstate, meta = ctable.tile_grow_build(bstate, meta)
+            self.stats.grows += 1
+            bstate, full, placed = ctable.tile_insert_observations(
+                bstate, meta, chi, clo, q, pending)
+            full = bool(full)
+            pending = jnp.logical_and(pending,
+                                      jnp.logical_not(placed))
+        else:
+            if full:
+                raise RuntimeError("Hash is full")
+        self.bstate, self.meta = bstate, meta
+        self.stats.batches += 1
+        self.stats.reads += int(batch.n)
+        self.stats.bases += int(batch.lengths.sum())
+
+    # -- epoch snapshot ---------------------------------------------------
+    def seal(self):
+        """Non-destructively seal the current contents: returns
+        (TileState, n_occupied, distinct_hq, total_hq). The build
+        planes stay valid — tile_seal reads them without donation, so
+        the next chunk inserts into the same table the snapshot was
+        cut from."""
+        state, dup, occ, distinct, total = ctable.tile_seal(
+            self.bstate, self.meta)
+        if bool(dup):
+            raise RuntimeError(
+                "live table sealed with duplicate keys in one bucket "
+                "(corrupted build state)")
+        return state, int(occ), int(distinct), int(total)
+
+    def coverage(self, distinct: int, total: int) -> float:
+        """Mean HQ multiplicity of the sealed snapshot — the ramp
+        signal epoch_floor consumes."""
+        return (float(total) / float(distinct)) if distinct > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Durability: the live-table snapshot (Stage1Checkpoint's idiom, with
+# a chunk cursor instead of a batch cursor)
+# ---------------------------------------------------------------------------
+
+
+class LiveSnapshot:
+    """A loaded live-table snapshot: host planes + the ingest cursor."""
+
+    def __init__(self, header: dict, tag: np.ndarray, hq: np.ndarray,
+                 lq: np.ndarray):
+        self.header = header
+        self.tag = tag
+        self.hq = hq
+        self.lq = lq
+
+    @property
+    def cursor(self) -> int:
+        return int(self.header["cursor"])
+
+    def check_config(self, k: int, bits: int, qual_thresh: int,
+                     batch_rows: int) -> None:
+        h = self.header
+        want = {"k": k, "bits": bits, "qual_thresh": qual_thresh,
+                "batch_rows": batch_rows}
+        for key, val in want.items():
+            if int(h.get(key, -1)) != int(val):
+                raise CheckpointError(
+                    f"live-table checkpoint was written with {key}="
+                    f"{h.get(key)}, this service uses {val}; refusing "
+                    "to resume (delete the checkpoint to start over)")
+
+
+class LiveTableCheckpoint:
+    """Atomic snapshot file `<live-dir>/live.ckpt`: the open build
+    planes plus the last fully-ingested chunk sequence number."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, "live.ckpt")
+
+    def save(self, table: LiveTable, cursor: int) -> None:
+        """Snapshot after chunk `cursor` is fully inserted. D2H
+        happens here (np.asarray) — the checkpoint is a sync point,
+        which is why `--live-checkpoint-every` is a cadence knob."""
+        os.makedirs(self.dir, exist_ok=True)
+        bstate, meta = table.bstate, table.meta
+        tag = np.ascontiguousarray(np.asarray(bstate.tag,
+                                              dtype=np.uint32))
+        hq = np.ascontiguousarray(np.asarray(bstate.hq,
+                                             dtype=np.uint32))
+        lq = np.ascontiguousarray(np.asarray(bstate.lq,
+                                             dtype=np.uint32))
+        pcrc = integrity.crc32c(tag)
+        pcrc = integrity.crc32c(hq, pcrc)
+        pcrc = integrity.crc32c(lq, pcrc)
+        header = integrity.seal({
+            "format": LIVE_CKPT_FORMAT,
+            "k": meta.k,
+            "bits": meta.bits,
+            "rb_log2": meta.rb_log2,
+            "cursor": int(cursor),
+            "reads": int(table.stats.reads),
+            "bases": int(table.stats.bases),
+            "batches": int(table.stats.batches),
+            "grows": int(table.stats.grows),
+            "qual_thresh": int(table.qual_thresh),
+            "batch_rows": int(table.batch_rows),
+            "tag_shape": list(tag.shape),
+            "acc_len": int(hq.shape[0]),
+            "payload_crc32c": pcrc,
+        })
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header).encode() + b"\n")
+            f.write(tag.tobytes())
+            f.write(hq.tobytes())
+            f.write(lq.tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        integrity.fsync_dir(self.path)
+        faults.inject("checkpoint.commit", path=self.path)
+
+    def load(self) -> LiveSnapshot | None:
+        """The last committed snapshot, or None when there is none. A
+        truncated/corrupt file raises CheckpointError — resuming from
+        garbage must not look like a fresh start."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            line = f.readline(1 << 20)
+            try:
+                header = json.loads(line)
+            except ValueError:
+                raise CheckpointError(
+                    f"corrupt live-table checkpoint '{self.path}' "
+                    "(bad header)") from None
+            if header.get("format") != LIVE_CKPT_FORMAT:
+                raise CheckpointError(
+                    f"'{self.path}' is not a live-table checkpoint "
+                    f"(format={header.get('format')!r})")
+            try:
+                integrity.check_seal(header, "live-table checkpoint",
+                                     self.path)
+            except integrity.IntegrityError as e:
+                raise CheckpointError(str(e)) from None
+            rows, tile = header["tag_shape"]
+            acc = header["acc_len"]
+            want = (rows * tile + 2 * acc) * 4
+            payload = f.read()
+        if len(payload) != want:
+            raise CheckpointError(
+                f"corrupt live-table checkpoint '{self.path}': "
+                f"payload {len(payload)} bytes, want {want}")
+        got = integrity.crc32c(payload)
+        if got != int(header["payload_crc32c"]):
+            integrity.record_error(
+                f"live-table checkpoint '{self.path}': payload digest "
+                f"mismatch (crc32c {got:#010x} != recorded "
+                f"{int(header['payload_crc32c']):#010x})",
+                path=self.path, section="payload")
+            raise CheckpointError(
+                f"live-table checkpoint '{self.path}' failed its "
+                "payload digest; the snapshot is silently corrupted — "
+                "refusing to resume from it (delete it to start over)")
+        integrity.record_verified(len(payload))
+        arr = np.frombuffer(payload, dtype=np.uint32)
+        tag = arr[:rows * tile].reshape(rows, tile)
+        hq = arr[rows * tile:rows * tile + acc]
+        lq = arr[rows * tile + acc:]
+        return LiveSnapshot(header, tag, hq, lq)
+
+    def cursor(self) -> int | None:
+        """Header-only peek at the committed chunk cursor; None when
+        no usable snapshot."""
+        try:
+            if not os.path.exists(self.path):
+                return None
+            with open(self.path, "rb") as f:
+                header = json.loads(f.readline(1 << 20))
+            return int(header["cursor"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def clear(self) -> None:
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def load_or_create(ckpt: LiveTableCheckpoint, k: int, bits: int,
+                   size: int, qual_thresh: int,
+                   *, batch_rows: int | None = None,
+                   max_grows: int = 8) -> tuple[LiveTable, int]:
+    """Resume the live table from `ckpt` when a snapshot exists (the
+    killed-service path), else start fresh. Returns (table, cursor);
+    cursor is -1 for a fresh table (no chunk ingested yet)."""
+    table = LiveTable(k, bits, size, qual_thresh,
+                      batch_rows=batch_rows, max_grows=max_grows)
+    snap = ckpt.load()
+    if snap is None:
+        return table, -1
+    snap.check_config(table.k, table.bits, table.qual_thresh,
+                      table.batch_rows)
+    meta = ctable.TileMeta(table.k, table.bits,
+                           int(snap.header["rb_log2"]))
+    table.meta = meta
+    table.bstate = ctable.TBuildState(
+        jnp.asarray(snap.tag), jnp.asarray(snap.hq),
+        jnp.asarray(snap.lq))
+    table.stats.reads = int(snap.header.get("reads", 0))
+    table.stats.bases = int(snap.header.get("bases", 0))
+    table.stats.batches = int(snap.header.get("batches", 0))
+    table.stats.grows = int(snap.header.get("grows", 0))
+    return table, snap.cursor

@@ -24,6 +24,15 @@ daemon threads, no dependencies), with the service semantics on top:
 * ``POST /quiesce`` — graceful drain: stop admitting, flush in-flight
   batches, then release ``serve_until_drained()`` so the CLI writes
   the final metrics document and exits. SIGTERM takes the same path.
+* ``POST /ingest`` / ``POST /epoch`` — the live ingestion tier
+  (ISSUE 18, serve/ingest.py): FASTQ chunks stream into a mutable
+  LiveTable while /correct keeps serving from the last sealed epoch
+  snapshot; /epoch forces a seal+swap outside the configured
+  boundaries. 501 unless the CLI started with ``--ingest``.
+* gzip transport both ways (stdlib): a request body with
+  ``Content-Encoding: gzip`` is inflated with the size cap applied to
+  the DECOMPRESSED payload; a response to a client advertising
+  ``Accept-Encoding: gzip`` is compressed when big enough to win.
 
 Resilience surface (ISSUE 7):
 
@@ -64,10 +73,12 @@ so clients see queue wait vs device time without server access.
 
 from __future__ import annotations
 
+import gzip as gzip_mod
 import json
 import threading
 import time
 import uuid
+import zlib
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from ..io import fastq
@@ -81,6 +92,10 @@ from .batcher import PRIORITIES, DeadlineExceeded, Draining, QueueFull
 # a request body bigger than this is refused with 413 before parsing
 # (an unbounded read would let one client exhaust host memory)
 MAX_BODY_BYTES = 256 * 1024 * 1024
+
+# responses below this size are sent uncompressed even to a client
+# that accepts gzip: the header overhead beats the savings
+GZIP_MIN_BYTES = 512
 
 
 def request_id_for(headers) -> str:
@@ -118,11 +133,14 @@ class CorrectionServer:
     def __init__(self, batcher, host: str = "127.0.0.1", port: int = 0,
                  deadline_ms: float | None = None, registry=NULL,
                  drain_grace_s: float = 30.0, quota=None,
-                 engine_builder=None, alerts=None):
+                 engine_builder=None, alerts=None, ingest=None):
         import http.server
 
         self.batcher = batcher
         self.registry = registry
+        # ingest dispatcher (serve/ingest.IngestDispatcher, ISSUE 18):
+        # None = POST /ingest and /epoch answer 501
+        self.ingest = ingest
         self.deadline_ms = deadline_ms
         self.drain_grace_s = drain_grace_s
         # admission quota (serve/admission.TokenBucketQuota or None)
@@ -178,6 +196,10 @@ class CorrectionServer:
                 route, _, query = self.path.partition("?")
                 if route == "/correct":
                     outer._handle_correct(self, query)
+                elif route == "/ingest":
+                    outer._handle_ingest(self)
+                elif route == "/epoch":
+                    outer._handle_epoch(self)
                 elif route == "/reload":
                     outer._handle_reload(self)
                 elif route == "/quiesce":
@@ -192,6 +214,15 @@ class CorrectionServer:
                        extra: dict | None = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
+                # response compression (ISSUE 18 satellite): corrected
+                # FASTA compresses ~4x, and the client opted in via
+                # Accept-Encoding — tiny bodies skip it (header
+                # overhead beats the savings)
+                accept = (self.headers.get("Accept-Encoding")
+                          or "").lower()
+                if "gzip" in accept and len(body) >= GZIP_MIN_BYTES:
+                    body = gzip_mod.compress(body, compresslevel=1)
+                    self.send_header("Content-Encoding", "gzip")
                 self.send_header("Content-Length", str(len(body)))
                 # EVERY response echoes the request's trace identity
                 # (generated when the client sent none), so a fleet's
@@ -256,6 +287,39 @@ class CorrectionServer:
             return 413
         return handler.rfile.read(length)
 
+    @staticmethod
+    def _decode_body(handler, body: bytes, limit: int) -> bytes | int:
+        """Apply the request's Content-Encoding (ISSUE 18 satellite:
+        gzip, stdlib only). The size cap applies to the DECOMPRESSED
+        payload — a 1 MiB bomb expanding past `limit` answers 413
+        without ever materializing the expansion; truncated or garbage
+        gzip answers 400, an unknown coding 415. Like _read_body,
+        returns the bytes or the status already sent."""
+        enc = (handler.headers.get("Content-Encoding")
+               or "").strip().lower()
+        if enc in ("", "identity"):
+            return body
+        if enc != "gzip":
+            handler._reply_json(
+                415, {"error": f"unsupported Content-Encoding "
+                               f"{enc!r} (gzip or identity)"})
+            return 415
+        d = zlib.decompressobj(16 + zlib.MAX_WBITS)
+        try:
+            data = d.decompress(body, limit + 1)
+        except zlib.error as e:
+            handler._reply_json(400, {"error": f"bad gzip body: {e}"})
+            return 400
+        if len(data) > limit or d.unconsumed_tail:
+            handler._reply_json(
+                413, {"error": "decompressed body too large"})
+            return 413
+        if not d.eof:
+            handler._reply_json(
+                400, {"error": "bad gzip body: truncated stream"})
+            return 400
+        return data
+
     def _lifecycle(self, rid: str, lane: str, status: int, t_req0: float,
                    reads: int = 0, req=None, admission_us: int | None = None,
                    render_us: int = 0, quality: dict | None = None) -> dict:
@@ -316,6 +380,10 @@ class CorrectionServer:
         body = self._read_body(handler, MAX_BODY_BYTES)
         if isinstance(body, int):
             # _read_body already answered (400 or 413)
+            self._lifecycle(rid, lane, body, t_req0)
+            return
+        body = self._decode_body(handler, body, MAX_BODY_BYTES)
+        if isinstance(body, int):
             self._lifecycle(rid, lane, body, t_req0)
             return
         priority = (handler.headers.get("X-Quorum-Priority")
@@ -457,6 +525,84 @@ class CorrectionServer:
             handler._reply(200, fa.encode(), "text/plain; charset=utf-8",
                            extra=counts)
 
+    # -- live ingestion (ISSUE 18) -----------------------------------------
+    def _handle_ingest(self, handler) -> None:
+        """POST /ingest: FASTQ chunk into the live table. The handler
+        thread blocks until the ingest dispatcher's worker inserted
+        the chunk (backpressure), then acks with the committed cursor.
+        An `X-Quorum-Ingest-Seq` header makes the chunk idempotent:
+        after a kill→resume, re-sent chunks at-or-below the restored
+        cursor ack as duplicates without touching the table."""
+        reg = self.registry
+        rid = handler.request_id
+        if self.ingest is None:
+            handler._reply_json(
+                501, {"error": "live ingestion not configured "
+                               "(start quorum-serve with --ingest)"})
+            return
+        if handler.headers.get("Transfer-Encoding"):
+            handler.close_connection = True  # body left unread
+            handler._reply_json(411, {"error": "Content-Length "
+                                               "required"})
+            return
+        body = self._read_body(handler, MAX_BODY_BYTES)
+        if isinstance(body, int):
+            return
+        body = self._decode_body(handler, body, MAX_BODY_BYTES)
+        if isinstance(body, int):
+            return
+        seq = handler.headers.get("X-Quorum-Ingest-Seq")
+        if seq is not None:
+            try:
+                seq = int(seq)
+            except ValueError:
+                handler._reply_json(
+                    400, {"error": "bad X-Quorum-Ingest-Seq"})
+                return
+        try:
+            records = parse_fastq_text(body)
+        except (ValueError, UnicodeDecodeError) as e:
+            reg.counter("requests_bad_input").inc()
+            handler._reply_json(400, {"error": str(e)})
+            return
+        try:
+            ack = self.ingest.submit_chunk(records, seq=seq)
+        except QueueFull as e:
+            handler._reply_json(
+                429, {"error": "ingest queue full",
+                      "retry_after_s": e.retry_after},
+                extra={"Retry-After": max(1, int(round(e.retry_after)))})
+            return
+        except Draining:
+            handler._reply_json(503, {"error": "draining"},
+                                extra={"Retry-After": 1})
+            return
+        except Exception as e:  # noqa: BLE001 - surfaced as 500
+            reg.event("ingest_failed", request_id=rid, error=str(e))
+            handler._reply_json(500, {"error": str(e)})
+            return
+        ack["generation"] = int(getattr(self.batcher, "generation", 0))
+        handler._reply_json(200, ack)
+
+    def _handle_epoch(self, handler) -> None:
+        """POST /epoch: force an epoch seal+swap now, outside the
+        --epoch-reads / --epoch-interval-s boundaries (the end-of-run
+        'flush everything ingested into the serving table' call)."""
+        if self.ingest is None:
+            handler._reply_json(
+                501, {"error": "live ingestion not configured"})
+            return
+        body = self._read_body(handler, 1 << 20)
+        if isinstance(body, int):
+            return
+        try:
+            res = self.ingest.force_epoch()
+        except Draining:
+            handler._reply_json(503, {"error": "draining"},
+                                extra={"Retry-After": 1})
+            return
+        handler._reply_json(200 if res.get("ok") else 500, res)
+
     # -- hot reload --------------------------------------------------------
     def _handle_reload(self, handler) -> None:
         """POST /reload: build a replacement engine from the JSON body
@@ -556,6 +702,12 @@ class CorrectionServer:
                 self.batcher, "generation", 0)),
             "port": self.port,
         }
+        if self.ingest is not None:
+            # the live-ingestion detail (cursor, epoch, floor,
+            # coverage): clients poll this to watch the ramp, and the
+            # ingest bench ledgers its per-epoch q_* fields off the
+            # generation transitions it sees here
+            h["live"] = self.ingest.stats()
         if self.alerts is not None:
             # SLO burn + firing rules as DETAIL: the status/healthy
             # verdict above is untouched — load balancers keep

@@ -160,6 +160,20 @@ QUALITY_GAUGES = (
     "quality_drift_score",
 )
 
+# The live ingestion surface (ISSUE 18): a serve document whose meta
+# declares `live_ingest` (quorum-serve --ingest) must carry the
+# ingest/epoch counters (pre-created by IngestDispatcher at
+# construction, so a zero-chunk run still proves the tier was armed)
+# and the cursor/floor gauges (set at construction and advanced by
+# the worker).
+LIVE_INGEST_COUNTERS = (
+    "ingest_requests_total",
+    "ingest_reads_total",
+    "epoch_swaps_total",
+    "epoch_swap_failures_total",
+)
+LIVE_INGEST_GAUGES = ("ingest_cursor", "live_floor")
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -169,6 +183,20 @@ SHARD_REQUIRED_GAUGES = ("n_shards", "shard_distinct_min",
                          "shard_distinct_max", "shard_inserts_min",
                          "shard_inserts_max")
 SHARD_REQUIRED_META_LISTS = ("shard_distinct_mers", "shard_inserts")
+
+
+def precreate_serve_metrics(registry) -> None:
+    """Zero-fill the unconditional serve surface on a registry so a
+    serve process that drains before its FIRST /correct request (an
+    ingest-only warm-up period, an operator bounce) still writes a
+    final document metrics_check accepts — the same pre-creation
+    discipline as precreate_outcome_counters. Lazy creation at
+    first-request time remains the writer of record; this only
+    guarantees the names exist at zero."""
+    for name in SERVE_REQUIRED_COUNTERS:
+        registry.counter(name)
+    for name in SERVE_REQUIRED_HISTOGRAMS:
+        registry.histogram(name)
 
 
 def precreated_counter_names() -> tuple[str, ...]:
@@ -190,4 +218,5 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(PREFILTER_COUNTERS)
     names.update(PARTITION_COUNTERS)
     names.update(QUALITY_COUNTERS)
+    names.update(LIVE_INGEST_COUNTERS)
     return tuple(sorted(names))

@@ -133,13 +133,21 @@ SITES: dict[str, str] = {
                    "(serve/server.py); errors map to retryable 503",
     "serve.reload": "inside POST /reload between validation and the "
                     "engine swap (serve/server.py); must roll back",
+    "serve.ingest": "per accepted ingest chunk, before its device "
+                    "insert (serve/ingest.py); carries batch= (the "
+                    "chunk seq — an exit here is the live "
+                    "kill→resume test)",
+    "serve.epoch": "between an epoch snapshot's export and the "
+                   "engine swap (serve/ingest.py); must roll back "
+                   "to the serving epoch",
     "fastq.read": "per parsed record in both FASTQ parsers "
                   "(io/fastq.py, native/binding.py)",
     "db.write": "after a database export commits "
                 "(io/db_format._atomic_db_write); carries path=",
     "checkpoint.commit": "after each stage-1 snapshot / shard "
-                         "payload / manifest commits "
-                         "(io/checkpoint.py); carries path=",
+                         "payload / manifest / live-table snapshot "
+                         "commits (io/checkpoint.py, "
+                         "serve/live_table.py); carries path=",
     "journal.append": "after each stage-2 resume-journal commit "
                       "(io/checkpoint.Stage2Journal); carries path=",
     "partition.commit": "after each partition-pass cursor commit of a "

@@ -112,6 +112,12 @@ _declare(
     "Flight-recorder ring capacity (recent telemetry events, span "
     "edges, dispatch samples retained for the postmortem dump).")
 _declare(
+    "QUORUM_INGEST_BATCH", "int", "256",
+    "Live-ingest insert batch rows (serve/live_table.py): every "
+    "POST /ingest chunk is re-sliced to this fixed row count so the "
+    "fused stage-1 insert compiles once per length bucket, not per "
+    "chunk size.")
+_declare(
     "QUORUM_MULTICHIP_BATCH", "int", "128",
     "Batch rows for `bench.py --multichip` scaling points.")
 _declare(

@@ -1,0 +1,576 @@
+"""The live ingestion tier (ISSUE 18): streaming online correction
+with epoch-swapped tables.
+
+Four contracts under test:
+
+* **Build parity** — a LiveTable fed the golden reads in arbitrary
+  chunk sizes seals to the SAME table payload bytes the offline
+  `quorum_create_database` writes (counts are commutative, the insert
+  wire is the same fused packed insert, and the grow ladder lands on
+  the same final geometry).
+* **Epoch swap semantics** — in-flight /correct batches finish on the
+  OLD epoch while a swap lands; a failed swap (injected `serve.epoch`
+  fault) rolls back completely: generation unchanged, orphan snapshot
+  removed, failure counted, and the next boundary retries cleanly.
+* **Durability** — the live-table checkpoint round-trips planes +
+  cursor + stats, refuses corruption and config drift, and a KILLED
+  service (subprocess, `serve.ingest` exit fault) resumes at the
+  committed cursor: re-sent chunks ack as duplicates, nothing is
+  double-counted, and the end-state epoch snapshot is byte-identical
+  to a fresh table fed the same chunks.
+* **End-state parity** — corrections served from a live-built epoch
+  are byte-identical to the offline build+correct pipeline at the
+  same floor and cutoff.
+"""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.cli import error_correct_reads as ec_cli
+from quorum_tpu.io import db_format, fastq
+from quorum_tpu.io.checkpoint import CheckpointError
+from quorum_tpu.serve import (CorrectionEngine, CorrectionServer,
+                              DynamicBatcher)
+from quorum_tpu.serve.client import ServeClient
+from quorum_tpu.serve.ingest import IngestDispatcher
+from quorum_tpu.serve.live_table import (LiveTable, LiveTableCheckpoint,
+                                         epoch_floor, load_or_create)
+from quorum_tpu.telemetry import registry_for
+from quorum_tpu.utils import faults
+
+HERE = os.path.dirname(__file__)
+GOLDEN = os.path.join(HERE, "golden")
+READS = os.path.join(GOLDEN, "reads.fastq")
+
+# the golden fixture's stage-1 geometry (tests/golden/README): every
+# test shares it so the fused insert/seal executables compile once
+K, BITS, SIZE, QT = 13, 7, 64 * 1024, 38
+
+
+def _records():
+    return list(fastq.iter_records([READS]))
+
+
+# ---------------------------------------------------------------------------
+# epoch_floor: the time-varying presence floor
+# ---------------------------------------------------------------------------
+
+def test_epoch_floor_ramp():
+    # thin coverage -> full initial floor; past the ramp -> final
+    assert epoch_floor(4, 1, 20.0, 0.0) == 4
+    assert epoch_floor(4, 1, 20.0, 20.0) == 1
+    assert epoch_floor(4, 1, 20.0, 50.0) == 1
+    # halfway down the ramp: final + ceil((initial-final) * 1/2)
+    assert epoch_floor(4, 1, 20.0, 10.0) == 1 + math.ceil(3 * 0.5)
+    # degenerate policies pin at final
+    assert epoch_floor(1, 1, 20.0, 0.0) == 1
+    assert epoch_floor(4, 1, 0.0, 0.0) == 1
+    assert epoch_floor(2, 5, 20.0, 0.0) == 5
+    # monotone non-increasing in coverage
+    floors = [epoch_floor(6, 2, 30.0, c) for c in
+              [0.0, 5.0, 10.0, 15.0, 20.0, 25.0, 30.0, 40.0]]
+    assert floors == sorted(floors, reverse=True)
+    assert floors[0] == 6 and floors[-1] == 2
+
+
+# ---------------------------------------------------------------------------
+# build parity: live insert wire == offline stage 1
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def golden_db(tmp_path_factory):
+    db = str(tmp_path_factory.mktemp("live_golden") / "db.jf")
+    rc = cdb_cli.main(["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", db, READS])
+    assert rc == 0
+    return db
+
+
+def test_live_table_build_matches_offline(golden_db, tmp_path):
+    """Feeding the live table the golden reads in deliberately odd
+    chunk sizes seals to the byte-identical table payload the offline
+    build writes: the streaming wire changes WHEN counting happens,
+    never WHAT is counted."""
+    recs = _records()
+    table = LiveTable(K, BITS, SIZE, QT)
+    for i in range(0, len(recs), 37):  # 37 never divides anything
+        table.ingest_records(recs[i:i + 37])
+    assert table.stats.reads == len(recs) == 242
+    state, occ, distinct, total = table.seal()
+    assert occ > 0 and distinct > 0 and total >= distinct
+    live_db = str(tmp_path / "live.qdb")
+    db_format.write_db(live_db, state, table.meta, n_entries=occ)
+    assert (db_format.db_payload_bytes(live_db)
+            == db_format.db_payload_bytes(golden_db))
+
+
+def test_live_table_grows_like_offline(tmp_path):
+    """An undersized live table grows through the same geometry
+    ladder as the offline build and lands on the same payload."""
+    recs = _records()[:100]
+    sub = tmp_path / "sub.fastq"
+    with open(sub, "w") as f:
+        for h, s, q in recs:
+            f.write(f"@{h}\n{s.decode()}\n+\n{q.decode()}\n")
+    off_db = str(tmp_path / "off.jf")
+    rc = cdb_cli.main(["-s", "256", "-m", "13", "-b", "7", "-q", "38",
+                       "-o", off_db, str(sub)])
+    assert rc == 0
+    table = LiveTable(K, BITS, 256, QT)
+    table.ingest_records(recs)
+    assert table.stats.grows >= 1  # 256 entries cannot hold 100 reads
+    state, occ, *_ = table.seal()
+    live_db = str(tmp_path / "live.qdb")
+    db_format.write_db(live_db, state, table.meta, n_entries=occ)
+    assert (db_format.db_payload_bytes(live_db)
+            == db_format.db_payload_bytes(off_db))
+
+
+# ---------------------------------------------------------------------------
+# durability: the live-table checkpoint
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_and_refusals(tmp_path):
+    recs = _records()[:64]
+    table = LiveTable(K, BITS, SIZE, QT)
+    table.ingest_records(recs)
+    ckpt = LiveTableCheckpoint(str(tmp_path))
+    ckpt.save(table, cursor=7)
+    assert ckpt.cursor() == 7
+
+    resumed, cur = load_or_create(ckpt, K, BITS, SIZE, QT)
+    assert cur == 7
+    assert resumed.stats.reads == table.stats.reads
+    assert resumed.stats.bases == table.stats.bases
+    assert resumed.meta.rb_log2 == table.meta.rb_log2
+    for attr in ("tag", "hq", "lq"):
+        assert np.array_equal(
+            np.asarray(getattr(resumed.bstate, attr)),
+            np.asarray(getattr(table.bstate, attr))), attr
+
+    # the resumed table keeps ingesting and seals identically to a
+    # never-killed table fed the same stream
+    more = _records()[64:128]
+    resumed.ingest_records(more)
+    table.ingest_records(more)
+    s1, occ1, *_ = resumed.seal()
+    s2, occ2, *_ = table.seal()
+    assert occ1 == occ2
+    p1 = str(tmp_path / "a.qdb")
+    p2 = str(tmp_path / "b.qdb")
+    db_format.write_db(p1, s1, resumed.meta, n_entries=occ1)
+    db_format.write_db(p2, s2, table.meta, n_entries=occ2)
+    assert (db_format.db_payload_bytes(p1)
+            == db_format.db_payload_bytes(p2))
+
+    # config drift: resuming under different stage-1 parameters must
+    # refuse, not silently mix incompatible counts
+    with pytest.raises(CheckpointError):
+        load_or_create(ckpt, K, BITS, SIZE, QT + 1)
+
+    # payload corruption: a flipped byte fails the digest loudly
+    with open(ckpt.path, "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-4, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CheckpointError):
+        ckpt.load()
+
+    # truncation is refused too (resume-from-garbage must not look
+    # like a fresh start)
+    ckpt.save(table, cursor=9)
+    size = os.path.getsize(ckpt.path)
+    with open(ckpt.path, "r+b") as f:
+        f.truncate(size - 128)
+    with pytest.raises(CheckpointError):
+        ckpt.load()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher semantics (real LiveTable, engine-shaped stubs)
+# ---------------------------------------------------------------------------
+
+class MarkEngine:
+    """Engine-shaped stub whose corrections are tagged with `mark`, so
+    a response proves WHICH epoch served it."""
+
+    def __init__(self, mark, gate=None, rows=1024):
+        self.mark = mark
+        self.gate = gate
+        self.rows = rows
+        self.warm_lengths = ()
+        self.entered = threading.Event()
+
+    @property
+    def compiles(self):
+        return 0
+
+    def warmup(self, lengths):
+        pass
+
+    def step(self, records):
+        self.entered.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30), "test gate never opened"
+        return [(f">{h}:{self.mark}\n{s.decode()}\n", "")
+                for h, s, _q in records]
+
+
+def _mark_stack(tmp_path, gate=None):
+    """A dispatcher over a real LiveTable whose epoch engines are
+    MarkEngine stubs (epoch N serves mark 'epoch-N')."""
+    reg = registry_for(None, force=True)
+    table = LiveTable(K, BITS, SIZE, QT)
+    ckpt = LiveTableCheckpoint(str(tmp_path))
+    built = []
+
+    def builder(path, poisson):
+        assert os.path.exists(path)
+        header = db_format.read_header(path)
+        eng = MarkEngine(f"epoch-{header['live_epoch']['epoch']}")
+        built.append((eng, header, poisson))
+        return eng
+
+    disp = IngestDispatcher(table, ckpt, builder,
+                            live_dir=str(tmp_path), registry=reg)
+    boot = MarkEngine("boot", gate=gate)
+    bat = DynamicBatcher(boot, max_batch=8, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    disp.start(bat)
+    return reg, disp, bat, boot, built
+
+
+def test_ingest_dedupe_and_cursor(tmp_path):
+    recs = _records()
+    _reg, disp, bat, _boot, _built = _mark_stack(tmp_path)
+    try:
+        ack = disp.submit_chunk(recs[:8], seq=3)
+        assert ack == {"accepted": True, "duplicate": False, "seq": 3,
+                       "reads": 8, "cursor": 3}
+        # a retransmit of an applied seq acks duplicate, counts nothing
+        ack2 = disp.submit_chunk(recs[:8], seq=3)
+        assert ack2["duplicate"] is True
+        assert disp.stats()["reads"] == 8
+        # an unstamped chunk gets the next seq past the horizon
+        ack3 = disp.submit_chunk(recs[8:16])
+        assert ack3["seq"] == 4 and ack3["duplicate"] is False
+        assert disp.cursor == 4
+        assert disp.stats()["reads"] == 16
+    finally:
+        disp.drain(timeout=10)
+        bat.drain(timeout=5)
+
+
+def test_inflight_correct_finishes_on_old_epoch(tmp_path):
+    """THE swap semantic: a /correct batch dispatched before the epoch
+    swap completes on the OLD engine; the next batch sees the new
+    one."""
+    gate = threading.Event()
+    _reg, disp, bat, boot, _built = _mark_stack(tmp_path, gate=gate)
+    try:
+        disp.submit_chunk(_records()[:32], seq=0)
+        gen0 = bat.generation
+        fut = bat.submit([("r", b"ACGTACGTACGT", b"IIIIIIIIIIII")])
+        assert boot.entered.wait(5), "in-flight step never dispatched"
+        res = disp.force_epoch(timeout=60)
+        assert res["ok"] is True, res
+        assert res["epoch"] == 1 and bat.generation == gen0 + 1
+        # the in-flight step is STILL blocked on the boot engine; the
+        # swap must not have torn it away
+        gate.set()
+        out = fut.result(timeout=10)
+        assert ":boot" in out[0][0]
+        out2 = bat.submit(
+            [("r2", b"ACGTACGTACGT", b"IIIIIIIIIIII")]).result(timeout=10)
+        assert ":epoch-1" in out2[0][0]
+    finally:
+        gate.set()
+        disp.drain(timeout=10)
+        bat.drain(timeout=5)
+
+
+def test_epoch_swap_failure_rolls_back(tmp_path):
+    """An injected `serve.epoch` fault between snapshot export and the
+    swap leaves the old epoch serving: generation unchanged, orphan
+    snapshot removed, failure counted — and the NEXT boundary
+    succeeds cleanly."""
+    reg, disp, bat, _boot, _built = _mark_stack(tmp_path)
+    try:
+        disp.submit_chunk(_records()[:32], seq=0)
+        gen0 = bat.generation
+        faults.setup('[{"site": "serve.epoch", "action": "error", '
+                     '"message": "injected swap failure", "count": 1}]')
+        try:
+            res = disp.force_epoch(timeout=60)
+        finally:
+            faults.setup("")  # clear the plan whatever happened
+        assert res["ok"] is False
+        assert "injected swap failure" in res["error"]
+        assert bat.generation == gen0
+        assert reg.counter("epoch_swap_failures_total").value == 1
+        assert disp.stats()["last_epoch_error"] is not None
+        # the failed attempt's snapshot file must not linger
+        files = sorted(os.listdir(tmp_path))
+        assert "epoch-000001.qdb" not in files
+        # correction path still answers from the old engine
+        out = bat.submit(
+            [("r", b"ACGTACGTACGT", b"IIIIIIIIIIII")]).result(timeout=10)
+        assert ":boot" in out[0][0]
+        # retry: the same boundary now succeeds and swaps
+        res = disp.force_epoch(timeout=60)
+        assert res["ok"] is True and res["epoch"] == 1
+        assert bat.generation == gen0 + 1
+        assert reg.counter("epoch_swaps_total").value == 1
+        assert disp.stats()["last_epoch_error"] is None
+    finally:
+        disp.drain(timeout=10)
+        bat.drain(timeout=5)
+
+
+def test_epoch_boundary_reads_and_pruning(tmp_path):
+    """--epoch-reads boundaries fire from the ingest path itself, and
+    old snapshots are pruned down to keep_epochs."""
+    reg = registry_for(None, force=True)
+    table = LiveTable(K, BITS, SIZE, QT)
+    ckpt = LiveTableCheckpoint(str(tmp_path))
+    builder = lambda path, poisson: MarkEngine("x")  # noqa: E731
+    disp = IngestDispatcher(table, ckpt, builder,
+                            live_dir=str(tmp_path), epoch_reads=32,
+                            registry=reg)
+    bat = DynamicBatcher(MarkEngine("boot"), max_batch=8,
+                         max_wait_ms=0, queue_requests=8, registry=reg)
+    disp.start(bat)
+    try:
+        recs = _records()
+        for i in range(4):  # 4 x 40 reads, boundary every 32
+            disp.submit_chunk(recs[i * 40:(i + 1) * 40], seq=i)
+        deadline = time.perf_counter() + 30
+        while reg.counter("epoch_swaps_total").value < 2:
+            assert time.perf_counter() < deadline, "no epoch swaps"
+            time.sleep(0.05)
+        st = disp.stats()
+        assert st["epoch"] >= 2
+        epochs = sorted(f for f in os.listdir(tmp_path)
+                        if f.startswith("epoch-"))
+        assert len(epochs) <= 2  # keep_epochs=2 pruning
+    finally:
+        disp.drain(timeout=10)
+        bat.drain(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# kill -> resume (subprocess: the fault exits the PROCESS mid-stream)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_CHILD_SRC = """
+import sys
+sys.path.insert(0, {root!r})
+import quorum_tpu.serve as serve_pkg
+
+class FE:
+    def __init__(self, rows=1024):
+        self.rows = rows
+        self.warm_lengths = ()
+    compiles = 0
+    def warmup(self, lengths):
+        pass
+    def step(self, records):
+        return [(">%s\\n%s\\n" % (h, s.decode()), "")
+                for h, s, _q in records]
+
+serve_pkg.CorrectionEngine = lambda db, **kw: FE(kw.get("rows", 1024))
+from quorum_tpu.cli import serve as serve_cli
+sys.exit(serve_cli.main({args!r}))
+"""
+
+
+def _spawn_live_server(port, live_dir, metrics=None, fault_plan=None):
+    args = ["--port", str(port), "--max-wait-ms", "0",
+            "--ingest", "--live-dir", live_dir,
+            "--ingest-mer-len", str(K), "--ingest-bits", str(BITS),
+            "--ingest-size", "64k", "--ingest-qual-thresh", str(QT),
+            "--live-checkpoint-every", "1"]
+    if metrics:
+        args += ["--metrics", metrics]
+    src = _CHILD_SRC.format(root=os.path.dirname(HERE), args=args)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("QUORUM_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["QUORUM_FAULT_PLAN"] = fault_plan
+    return subprocess.Popen([sys.executable, "-c", src], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_healthz(client, proc, timeout=180):
+    deadline = time.perf_counter() + timeout
+    while True:
+        try:
+            return client.healthz()
+        except (OSError, RuntimeError):
+            assert proc.poll() is None, \
+                f"server died rc={proc.returncode}"
+            assert time.perf_counter() < deadline, "server never up"
+            time.sleep(0.2)
+
+
+def test_ingest_kill_resume_subprocess(tmp_path):
+    """A service killed MID-STREAM (os._exit via the serve.ingest
+    fault site) resumes from its live-table checkpoint: the cursor is
+    restored, re-sent chunks ack as duplicates, nothing double-counts,
+    and the final epoch snapshot is byte-identical to a fresh table
+    fed the same chunks once each."""
+    recs = _records()
+    chunks = [recs[i:i + 41] for i in range(0, len(recs), 41)]
+    assert len(chunks) == 6 and sum(len(c) for c in chunks) == 242
+    texts = ["".join(f"@{h}\n{s.decode()}\n+\n{q.decode()}\n"
+                     for h, s, q in c) for c in chunks]
+    live_dir = str(tmp_path / "live")
+    os.makedirs(live_dir)
+
+    # phase 1: die while ingesting chunk seq 3 (after 3 committed)
+    port = _free_port()
+    plan = json.dumps([{"site": "serve.ingest", "batch": 3,
+                        "action": "exit", "code": 41}])
+    proc = _spawn_live_server(port, live_dir, fault_plan=plan)
+    try:
+        client = ServeClient(port=port)
+        _wait_healthz(client, proc)
+        for seq in range(3):
+            status, ack = client.ingest(texts[seq], seq=seq)
+            assert status == 200 and ack["cursor"] == seq, ack
+        with pytest.raises(OSError):
+            client.ingest(texts[3], seq=3)
+        assert proc.wait(timeout=30) == 41
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # the checkpoint committed after chunk 2 survived the kill
+    assert LiveTableCheckpoint(live_dir).cursor() == 2
+
+    # phase 2: restart; replay ALL chunks (at-least-once client)
+    port = _free_port()
+    metrics = str(tmp_path / "serve.json")
+    proc = _spawn_live_server(port, live_dir, metrics=metrics)
+    try:
+        client = ServeClient(port=port)
+        h = _wait_healthz(client, proc)
+        assert h["live"]["cursor"] == 2, h["live"]
+        assert h["live"]["reads"] == sum(len(c) for c in chunks[:3])
+        for seq in range(6):
+            status, ack = client.ingest(texts[seq], seq=seq,
+                                        gzip_body=True)
+            assert status == 200, ack
+            assert ack["duplicate"] is (seq <= 2), (seq, ack)
+        h = client.healthz()
+        assert h["live"]["cursor"] == 5
+        assert h["live"]["reads"] == 242  # no loss, no double-count
+        status, doc = client.epoch()
+        assert status == 200 and doc["ok"] is True, doc
+        client.quiesce()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # end-state parity: the sealed epoch == a fresh table fed the
+    # same chunks exactly once
+    epoch_db = os.path.join(live_dir, "epoch-000001.qdb")
+    assert os.path.exists(epoch_db)
+    ref = LiveTable(K, BITS, SIZE, QT)
+    for c in chunks:
+        ref.ingest_records(c)
+    state, occ, *_ = ref.seal()
+    ref_db = str(tmp_path / "ref.qdb")
+    db_format.write_db(ref_db, state, ref.meta, n_entries=occ)
+    assert (db_format.db_payload_bytes(epoch_db)
+            == db_format.db_payload_bytes(ref_db))
+
+    # the final metrics document carries the live-ingest surface the
+    # telemetry contract requires under meta.live_ingest
+    with open(metrics) as f:
+        doc = json.load(f)
+    assert doc["meta"]["live_ingest"] is True
+    for c in ("ingest_requests_total", "ingest_reads_total",
+              "epoch_swaps_total", "epoch_swap_failures_total"):
+        assert c in doc["counters"], c
+    for g in ("ingest_cursor", "live_floor"):
+        assert g in doc["gauges"], g
+    assert doc["counters"]["ingest_reads_total"] == sum(
+        len(c) for c in chunks[3:])  # duplicates counted nothing
+    assert doc["counters"]["epoch_swaps_total"] >= 1
+    assert doc["gauges"]["ingest_cursor"] == 5
+
+
+# ---------------------------------------------------------------------------
+# end-state parity: live epoch serves byte-identical corrections
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def offline(golden_db, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("live_off") / "off")
+    rc = ec_cli.main(["-p", "4", golden_db, READS, "-o", out])
+    assert rc == 0
+    with open(out + ".fa") as f:
+        fa = f.read()
+    with open(out + ".log") as f:
+        log = f.read()
+    return fa, log
+
+
+def test_live_end_state_parity_with_offline(offline, tmp_path):
+    """Acceptance: once every read is ingested, /correct answers from
+    the live-built epoch byte-identically to the offline
+    build+correct pipeline at the same floor (1) and cutoff (4)."""
+    reg = registry_for(None, force=True)
+    reg.set_meta(stage="serve")
+    table = LiveTable(K, BITS, SIZE, QT)
+    table.ingest_records(_records())
+    ckpt = LiveTableCheckpoint(str(tmp_path))
+
+    def builder(path, poisson):
+        return CorrectionEngine(path, cutoff=4, rows=64, registry=reg)
+
+    disp = IngestDispatcher(table, ckpt, builder,
+                            live_dir=str(tmp_path), registry=reg)
+    engine = disp.boot_epoch()  # epoch 0 = the fully-ingested table
+    bat = DynamicBatcher(engine, max_batch=64, max_wait_ms=2,
+                         queue_requests=8, registry=reg)
+    disp.start(bat)
+    server = CorrectionServer(bat, port=0, registry=reg, ingest=disp)
+    try:
+        client = ServeClient(port=server.port)
+        assert client.healthz()["live"]["epoch"] == 0
+        r = client.correct(open(READS).read(), want_log=True)
+        assert r.status == 200
+        off_fa, off_log = offline
+        assert r.fa == off_fa      # byte parity, .fa channel
+        assert r.log == off_log    # byte parity, .log channel
+    finally:
+        server.close()
+        disp.drain(timeout=10)
+        bat.drain(timeout=5)

@@ -1168,7 +1168,7 @@ def test_correct_with_retry_honors_retry_after(monkeypatch):
     calls = []
 
     def fake_correct(_body, deadline_ms=None, want_log=False,
-                     priority=None, client_id=None):
+                     priority=None, client_id=None, gzip_body=False):
         calls.append(1)
         return replies[len(calls) - 1]
 
@@ -1485,3 +1485,104 @@ def test_per_lane_depth_and_wait_series():
     text = export_mod.prometheus_text({"serve": doc})
     assert 'lane="bulk"' in text and 'lane="interactive"' in text
     assert export_mod.lint_prometheus_text(text) == []
+
+
+# ---------------------------------------------------------------------------
+# gzip transport (request + response bodies, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _raw_post(port, path, body, headers):
+    """One POST over a fresh connection, no client-side codec help —
+    the raw wire view the ServeClient conveniences would hide."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", path, body=body, headers=dict(headers))
+        resp = conn.getresponse()
+        return resp.status, dict(resp.headers), resp.read()
+    finally:
+        conn.close()
+
+
+def test_serve_gzip_request_and_response_round_trip():
+    """gzip request bodies decode to the identity answer; responses
+    compress only when the client advertises gzip AND the payload
+    clears GZIP_MIN_BYTES; ServeClient does both ends transparently."""
+    import gzip
+
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=64, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        body = "".join(f"@r{i}\nACGTACGT\n+\nIIIIIIII\n"
+                       for i in range(64)).encode()
+        # gzip request, identity response (no Accept-Encoding sent)
+        status, hdrs, want = _raw_post(
+            srv.port, "/correct", gzip.compress(body),
+            {"Content-Encoding": "gzip"})
+        assert status == 200
+        assert "Content-Encoding" not in hdrs
+        assert want.startswith(b">r0\n")
+        # identity request, gzip response (payload > GZIP_MIN_BYTES)
+        status, hdrs, data = _raw_post(
+            srv.port, "/correct", body, {"Accept-Encoding": "gzip"})
+        assert status == 200
+        assert hdrs.get("Content-Encoding") == "gzip"
+        assert gzip.decompress(data) == want
+        # ServeClient compresses the request and inflates the response
+        r = ServeClient(port=srv.port).correct(body, gzip_body=True)
+        assert r.status == 200
+        assert r.fa.encode() == want
+        # a tiny response stays identity even when gzip is accepted
+        status, hdrs, data = _raw_post(
+            srv.port, "/correct", b"@a\nAC\n+\nII\n",
+            {"Accept-Encoding": "gzip"})
+        assert status == 200
+        assert "Content-Encoding" not in hdrs
+        assert data == b">a\nAC\n"
+    finally:
+        srv.close()
+        bat.drain(timeout=5)
+
+
+def test_serve_gzip_rejections(monkeypatch):
+    """Bad codings fail closed: garbage/truncated gzip answer 400, an
+    unknown Content-Encoding 415, and the body cap applies to the
+    DECOMPRESSED size — a small bomb answers 413, not an engine step.
+    /ingest and /epoch answer 501 when --ingest was never configured."""
+    import gzip
+
+    from quorum_tpu.serve import server as server_mod
+
+    reg = registry_for(None, force=True)
+    bat = DynamicBatcher(FakeEngine(), max_batch=64, max_wait_ms=0,
+                         queue_requests=8, registry=reg)
+    srv = CorrectionServer(bat, port=0, registry=reg)
+    try:
+        status, _, _ = _raw_post(srv.port, "/correct", b"not gzip",
+                                 {"Content-Encoding": "gzip"})
+        assert status == 400
+        whole = gzip.compress(b"@a\nAC\n+\nII\n" * 64)
+        status, _, _ = _raw_post(srv.port, "/correct", whole[:-8],
+                                 {"Content-Encoding": "gzip"})
+        assert status == 400
+        status, _, _ = _raw_post(srv.port, "/correct", b"x",
+                                 {"Content-Encoding": "br"})
+        assert status == 415
+        monkeypatch.setattr(server_mod, "MAX_BODY_BYTES", 4096)
+        bomb = gzip.compress(b"@a\nAC\n+\nII\n" * 10000)
+        assert len(bomb) < 4096  # small on the wire, huge inflated
+        status, _, _ = _raw_post(srv.port, "/correct", bomb,
+                                 {"Content-Encoding": "gzip"})
+        assert status == 413
+        monkeypatch.setattr(server_mod, "MAX_BODY_BYTES",
+                            256 * 1024 * 1024)
+        for path in ("/ingest", "/epoch"):
+            status, _, _ = _raw_post(srv.port, path, b"", {})
+            assert status == 501, path
+        # the engine never ran for any of the rejected bodies
+        assert bat.engine.stepped == 0
+    finally:
+        srv.close()
+        bat.drain(timeout=5)

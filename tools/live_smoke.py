@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Golden LIVE-ingestion run for CI (ci/tier1.sh): start quorum-serve
+with `--ingest` (no database — the service boots on an empty live
+table), stream the committed golden reads through seq-stamped gzipped
+`POST /ingest` chunks, and verify the acceptance properties of the
+live tier (ISSUE 18):
+
+  * epoch swaps happen ON the ingest path: `--epoch-reads 64` over 6
+    chunks must seal and swap at least 2 epoch snapshots before the
+    stream ends (plus the final forced `POST /epoch`),
+  * end-state parity: once every read is ingested and the final epoch
+    swapped, `POST /correct` answers byte-identical to
+    tests/golden/expected.fa — the offline build+correct pipeline at
+    the same cutoff (-p 4) and floor (1),
+  * the warm (second) correction recompiles nothing,
+  * a graceful drain commits the live-table checkpoint and writes the
+    final metrics document with `meta.live_ingest`, so
+    `metrics_check.py` requires the ingest/epoch counter surface.
+
+Artifacts land in --out-dir (default: a temp dir):
+  live_metrics.json — the final serve document (metrics_check gates
+                      it; meta.live_ingest pulls in the ingest names)
+  live_scrape.prom  — a /metrics scrape taken mid-run
+
+Exit 0 = all checks passed. Run by ci/tier1.sh after serve_smoke;
+usable by hand for a quick live-tier sanity check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden live-ingestion run: streamed ingest, "
+                    "epoch swaps, end-state parity, drain-with-"
+                    "metrics (ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where live_metrics.json / live_scrape.prom "
+                        "land (default: a temp dir)")
+    p.add_argument("--rows", type=int, default=64,
+                   help="Engine batch rows (default 64: fast CPU "
+                        "compile; production uses 1024+)")
+    p.add_argument("--chunk-reads", type=int, default=41,
+                   help="Reads per /ingest chunk (default 41: 6 "
+                        "chunks over the 242 golden reads)")
+    p.add_argument("--epoch-reads", type=int, default=64,
+                   help="Epoch boundary cadence (default 64: several "
+                        "swaps happen DURING the stream)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="live_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+    live_dir = os.path.join(out_dir, "live")
+
+    from quorum_tpu.cli import serve as serve_cli
+    from quorum_tpu.io import fastq
+    from quorum_tpu.serve.client import ServeClient
+
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    expected_fa = os.path.join(GOLDEN, "expected.fa")
+    metrics_path = os.path.join(out_dir, "live_metrics.json")
+    scrape_path = os.path.join(out_dir, "live_scrape.prom")
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc_box = {}
+
+    def run_server():
+        rc_box["rc"] = serve_cli.main(
+            ["--port", str(port), "--max-batch", str(args.rows),
+             "--max-wait-ms", "2", "-p", "4",
+             "--ingest", "--live-dir", live_dir,
+             "--ingest-mer-len", "13", "--ingest-bits", "7",
+             "--ingest-size", "64k", "--ingest-qual-thresh", "38",
+             "--epoch-reads", str(args.epoch_reads),
+             "--metrics", metrics_path])
+
+    print(f"[live_smoke] starting quorum-serve --ingest on :{port} "
+          f"(epoch every {args.epoch_reads} reads)")
+    t = threading.Thread(target=run_server, daemon=True)
+    t.start()
+    client = ServeClient(port=port, timeout=900.0)
+    deadline = time.perf_counter() + 60
+    while True:
+        try:
+            client.healthz()
+            break
+        except OSError:
+            if time.perf_counter() > deadline:
+                print("[live_smoke] FAIL: server never came up",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+
+    records = list(fastq.iter_records([reads]))
+    n = max(1, args.chunk_reads)
+    chunks = [records[i:i + n] for i in range(0, len(records), n)]
+    print(f"[live_smoke] streaming {len(records)} reads as "
+          f"{len(chunks)} gzipped chunks")
+    for seq, chunk in enumerate(chunks):
+        text = "".join(f"@{h}\n{s.decode()}\n+\n{q.decode()}\n"
+                       for h, s, q in chunk)
+        status, ack = client.ingest(text, seq=seq, gzip_body=True)
+        if status != 200 or ack.get("cursor") != seq:
+            print(f"[live_smoke] FAIL: ingest seq {seq} -> {status} "
+                  f"{ack}", file=sys.stderr)
+            return 1
+
+    # seal the tail so the serving epoch holds EVERY ingested read
+    status, doc = client.epoch()
+    if status != 200 or not doc.get("ok"):
+        print(f"[live_smoke] FAIL: forced epoch -> {status} {doc}",
+              file=sys.stderr)
+        return 1
+    live = client.healthz().get("live", {})
+    if live.get("reads") != len(records):
+        print(f"[live_smoke] FAIL: ingested {live.get('reads')} reads,"
+              f" want {len(records)}", file=sys.stderr)
+        return 1
+    # the forced epoch is one of these; at least 2 must have fired
+    # from the --epoch-reads boundary DURING the stream
+    if live.get("epoch", 0) < 3:
+        print(f"[live_smoke] FAIL: only {live.get('epoch')} epoch "
+              "swaps observed (want stream boundaries + the forced "
+              "one)", file=sys.stderr)
+        return 1
+    print(f"[live_smoke] {live['epoch']} epoch swaps, cursor "
+          f"{live['cursor']}, coverage {live['coverage']}")
+
+    with open(reads) as f:
+        body = f.read()
+    with open(expected_fa) as f:
+        want_fa = f.read()
+
+    print("[live_smoke] cold correction against the final epoch")
+    t0 = time.perf_counter()
+    r1 = client.correct(body)
+    cold_s = time.perf_counter() - t0
+    if r1.status != 200 or r1.fa != want_fa:
+        print(f"[live_smoke] FAIL: cold request status={r1.status} "
+              f"parity={'ok' if r1.fa == want_fa else 'DRIFT'}",
+              file=sys.stderr)
+        return 1
+    compiles1 = client.healthz()["engine_compiles"]
+
+    print("[live_smoke] warm correction")
+    t0 = time.perf_counter()
+    r2 = client.correct(body)
+    warm_s = time.perf_counter() - t0
+    compiles2 = client.healthz()["engine_compiles"]
+    if r2.status != 200 or r2.fa != want_fa:
+        print("[live_smoke] FAIL: warm request parity",
+              file=sys.stderr)
+        return 1
+    if compiles2 != compiles1:
+        print(f"[live_smoke] FAIL: warm request recompiled "
+              f"({compiles1} -> {compiles2})", file=sys.stderr)
+        return 1
+
+    with open(scrape_path, "w") as f:
+        f.write(client.metrics_text())
+    print(f"[live_smoke] scraped /metrics -> {scrape_path}")
+
+    print("[live_smoke] draining via /quiesce")
+    client.quiesce()
+    t.join(timeout=120)
+    if t.is_alive() or rc_box.get("rc") != 0:
+        print(f"[live_smoke] FAIL: drain (alive={t.is_alive()} "
+              f"rc={rc_box.get('rc')})", file=sys.stderr)
+        return 1
+    if not os.path.exists(metrics_path):
+        print("[live_smoke] FAIL: no final metrics document",
+              file=sys.stderr)
+        return 1
+    if not os.path.exists(os.path.join(live_dir, "live.ckpt")):
+        print("[live_smoke] FAIL: drain committed no live-table "
+              "checkpoint", file=sys.stderr)
+        return 1
+    print(f"[live_smoke] OK: {len(chunks)} chunks, {live['epoch']} "
+          f"epochs, parity x2, cold {cold_s:.1f}s, warm {warm_s:.2f}s,"
+          f" final metrics -> {metrics_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

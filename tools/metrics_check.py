@@ -87,6 +87,8 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     FAULT_COUNTERS,
     FLIGHT_COUNTERS,
     INTEGRITY_COUNTERS,
+    LIVE_INGEST_COUNTERS,
+    LIVE_INGEST_GAUGES,
     PARTITION_COUNTERS,
     PARTITION_GAUGE_PREFIX,
     PREFILTER_COUNTERS,
@@ -421,6 +423,26 @@ def _check_quality_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_live_ingest_names(doc: dict) -> list[str]:
+    """A serve document declaring `meta.live_ingest` ran the live
+    ingestion tier (ISSUE 18): the ingest counters and the
+    cursor/floor gauges must exist, or the epoch-swap machinery was
+    silently bypassed."""
+    errs = []
+    meta = doc.get("meta", {})
+    if not meta.get("live_ingest"):
+        return errs
+    why = f"meta.live_ingest={meta.get('live_ingest')!r}"
+    for name in LIVE_INGEST_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter "
+                        f"{name!r}")
+    for name in LIVE_INGEST_GAUGES:
+        if name not in doc.get("gauges", {}):
+            errs.append(f"document with {why} missing gauge {name!r}")
+    return errs
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -481,6 +503,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_compile_names(doc)
         problems = problems + _check_flight_names(doc)
         problems = problems + _check_quality_names(doc)
+        problems = problems + _check_live_ingest_names(doc)
     return problems
 
 
