@@ -124,6 +124,19 @@
 # metrics_check gates (requiring the ingest/epoch counter surface),
 # alongside a --prom lint of the mid-run /metrics scrape.
 #
+# ISSUE 19 adds the resource-exhaustion gate: tools/degrade_smoke.py
+# — an out-of-space OPTIONAL writer (diskfull at checkpoint.commit)
+# must degrade (writer_degraded_total, meta.resource_guard) while the
+# build completes with a table identical to the unfaulted run; an
+# out-of-space REQUIRED writer (diskfull at db.write) must fail fast
+# with the non-retryable DISK_FULL_RC and a sealed flight dump whose
+# trigger names writer db.payload; and a stage-2 run wedged by a
+# sleep fault under --stall-timeout-s must exit the retryable
+# STALL_RC (hard abort in a subprocess) with a stall-kind dump, then
+# --resume to output byte-identical to an unfaulted run. All
+# documents go through metrics_check (which requires the RESOURCE_*
+# counter/gauge surface when meta declares resource_guard).
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -136,6 +149,7 @@
 #        SKIP_PERF_DIFF=1     skips the perf-regression gate.
 #        SKIP_QUALITY_DIFF=1  skips the accuracy-regression gate.
 #        SKIP_LIVE_SMOKE=1    skips the live-ingestion gate.
+#        SKIP_DEGRADE_SMOKE=1 skips the resource-exhaustion gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
 #        SKIP_COMPILE_SENTINEL=1  skips the runtime compile sentinel
@@ -519,6 +533,27 @@ else
     fi
 fi
 
+degrade_rc=0
+if [ "${SKIP_DEGRADE_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: degrade smoke skipped (SKIP_DEGRADE_SMOKE=1)"
+else
+    # the resource-exhaustion gate (ISSUE 19): optional writer ENOSPC
+    # degrades (run completes, table identical), required writer
+    # ENOSPC fails fast (DISK_FULL_RC + sealed disk_full dump naming
+    # db.payload), seeded stall exits STALL_RC then resumes
+    # byte-identical; the tool runs its own metrics_check gates
+    echo "== resource-exhaustion degrade run =="
+    DEG_DIR=$(mktemp -d /tmp/degrade_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "${FLIGHT_DIR:-}" "${PERF_DIR:-}" "${QUAL_DIR:-}" "${LIVE_DIR:-}" "$DEG_DIR"' EXIT
+    timeout -k 10 780 env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/degrade_smoke.py \
+        --out-dir "$DEG_DIR" || degrade_rc=$?
+    if [ "$degrade_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: degrade gate FAILED (rc=$degrade_rc)" >&2
+    fi
+fi
+
 if [ "$qlint_rc" -ne 0 ]; then exit "$qlint_rc"; fi
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
@@ -532,4 +567,5 @@ if [ "$flight_rc" -ne 0 ]; then exit "$flight_rc"; fi
 if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
 if [ "$quality_rc" -ne 0 ]; then exit "$quality_rc"; fi
 if [ "$live_rc" -ne 0 ]; then exit "$live_rc"; fi
+if [ "$degrade_rc" -ne 0 ]; then exit "$degrade_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

@@ -48,6 +48,7 @@ SCOPE = (
     "quorum_tpu/telemetry/flight.py",
     "quorum_tpu/telemetry/registry.py",
     "quorum_tpu/utils/faults.py",
+    "quorum_tpu/utils/resources.py",
     "quorum_tpu/ops/tuning.py",
 )
 
@@ -63,6 +64,12 @@ LOCK_ORDER = (
     "batcher.Batcher._lock",
     "admission.TokenBucketQuota._lock",
     "alerts.AlertEngine._lock",
+    # the resource frame lock: guards the degraded-writer set and the
+    # watchdog beat cursor; degrade()/beat() are called from writer
+    # paths that may hold serve/alert locks, and every registry/
+    # flight call it triggers happens after release — so it ranks
+    # between the feeders above and the telemetry sinks below
+    "resources._lock",
     "export._LIVE_LOCK",
     "spans.SpanTracer._lock",
     # the flight ring: its taps run at the TOP of event()/_record(),

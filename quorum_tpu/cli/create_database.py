@@ -221,7 +221,12 @@ def main(argv=None, handoff: dict | None = None, batches=None,
                          if args.on_bad_read == "quarantine" else None),
     )
     from .observability import observability
+    from ..utils import resources
     rc = 1  # flipped to 0 only on success: any exception leaves 1
+    # the resource-guard frame (ISSUE 19): watch the output and
+    # checkpoint filesystems for the watermark alerts
+    watch = [p for p in (args.output, args.checkpoint_dir,
+                         args.metrics) if p]
     # a failed run (hash-full, busy --metrics-port, or anything
     # uncaught) must still land its metrics document with
     # status=error — monitoring needs a run that FAILED, not one that
@@ -234,8 +239,18 @@ def main(argv=None, handoff: dict | None = None, batches=None,
                        profile=args.profile,
                        push_url=args.metrics_push_url,
                        push_interval=args.metrics_push_interval,
-                       alert_rules=args.alert_rules) as obs:
+                       alert_rules=args.alert_rules,
+                       watch_paths=watch,
+                       stall_timeout_s=args.stall_timeout_s) as obs:
         try:
+            # disk preflight BEFORE the parse/device work: an export
+            # that cannot fit should refuse in seconds, not hours
+            resources.preflight(
+                args.preflight,
+                resources.estimate_stage1_needs(
+                    args.output, cfg.initial_size, cfg.k, cfg.bits,
+                    checkpoint_dir=cfg.checkpoint_dir,
+                    partitions=cfg.partitions))
             create_database_main(args.reads, args.output, cfg,
                                  cmdline=list(sys.argv),
                                  ref_format=args.ref_format,
@@ -249,11 +264,24 @@ def main(argv=None, handoff: dict | None = None, batches=None,
             # real (or injected) IO failures. A CheckpointError or
             # IntegrityError is deterministic — rc 3 tells the
             # driver's retry loop not to back off and re-run a doomed
-            # attempt
+            # attempt. ResourceExhausted (full disk / strict
+            # preflight) is rc 4, also not retried; a watchdog
+            # StallError is rc 75, which IS (resume from checkpoint).
             from ..io.checkpoint import (CheckpointError,
                                          NON_RETRYABLE_RC)
             from ..io.integrity import IntegrityError
-            if isinstance(e, (CheckpointError, IntegrityError)):
+            if isinstance(e, resources.ResourceExhausted):
+                rc = resources.DISK_FULL_RC
+            elif isinstance(e, resources.StallError):
+                rc = resources.STALL_RC
+            elif resources.is_enospc(e):
+                # a bare ENOSPC escaping stage 1 is the DB export
+                # (every optional writer degrades in place): required
+                # — seal the dump naming the writer, do not retry
+                resources.fail_required("db.payload", e,
+                                        path=args.output)
+                rc = resources.DISK_FULL_RC
+            elif isinstance(e, (CheckpointError, IntegrityError)):
                 rc = NON_RETRYABLE_RC
             print(str(e), file=sys.stderr)
             obs.status = "error"

@@ -192,6 +192,8 @@ def main(argv=None, db=None, prepacked=None) -> int:
         on_bad_read=args.on_bad_read,
         verify_db=args.verify_db,
         presence_floor=args.presence_floor,
+        preflight=args.preflight,
+        stall_timeout_s=args.stall_timeout_s,
     )
     try:
         run_error_correct(
@@ -206,6 +208,15 @@ def main(argv=None, db=None, prepacked=None) -> int:
         print(str(e), file=sys.stderr)
         from ..io.checkpoint import CheckpointError, NON_RETRYABLE_RC
         from ..io.integrity import IntegrityError
+        from ..utils import resources
+        # resource-guard rcs (ISSUE 19): a full disk is NOT retried
+        # (rc 4 — it does not empty itself between attempts); a
+        # watchdog stall IS (rc 75, EX_TEMPFAIL — the next attempt
+        # resumes from the journal)
+        if isinstance(e, resources.ResourceExhausted):
+            return resources.DISK_FULL_RC
+        if isinstance(e, resources.StallError):
+            return resources.STALL_RC
         # deterministic refusal (journal/config mismatch, or an
         # artifact that failed its digests): rc 3 so the driver's
         # retry loop fails fast instead of backing off

@@ -85,6 +85,25 @@ def add_observability_args(p: argparse.ArgumentParser,
                         "alerts_firing{rule=} gauges"
                         + ("; forwarded to both stages" if driver
                            else ""))
+    p.add_argument("--preflight", choices=("strict", "warn", "off"),
+                   default="warn",
+                   help="Disk preflight before work starts: compare "
+                        "estimated output/checkpoint bytes against "
+                        "free space on the target filesystems. "
+                        "strict refuses (rc 4, not retried), warn "
+                        "(default) prints one line per short "
+                        "filesystem, off skips the check"
+                        + ("; forwarded to both stages" if driver
+                           else ""))
+    p.add_argument("--stall-timeout-s", metavar="seconds", type=float,
+                   default=0.0,
+                   help="Offline stall watchdog: abort a stage whose "
+                        "batch cursor stops advancing for this long "
+                        "(flight dump kind 'stall', retryable rc 75 "
+                        "so a driver retry resumes from checkpoint); "
+                        "0 = off"
+                        + ("; forwarded to both stages" if driver
+                           else ""))
     if not driver:
         p.add_argument("--metrics-live", action="store_true",
                        help="Force a live metrics registry even with "
@@ -196,6 +215,8 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                   push_url: str | None = None,
                   push_interval: float = 0.0,
                   alert_rules: str | None = None,
+                  watch_paths=(),
+                  stall_timeout_s: float = 0.0,
                   **meta):
     """The one observability lifecycle (ISSUE 3 satellite): registry +
     tracer up front, exposition started inside the umbrella, and a
@@ -223,6 +244,16 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     rules (a bad file is reported loudly and counted, never fatal) —
     attached at the heartbeat cadence and closed BEFORE the final
     write so the document carries the end-of-run alert state.
+
+    `watch_paths` / `stall_timeout_s` (ISSUE 19): the resource-guard
+    frame (utils/resources.py). Watch paths (the run's output /
+    checkpoint / metrics targets) arm the disk/RSS monitor ticker —
+    `disk_free_bytes{path=}` gauges plus the standing watermark alert
+    rules (DEFAULT_RESOURCE_RULES, appended only when the monitor is
+    live); a positive stall timeout arms the offline stall watchdog
+    the stage loops beat via resources.watchdog_beat. The frame also
+    routes the writer degradation ladder's counters to this registry;
+    it stacks/restores exactly like the integrity registry below.
 
     Typical shape::
 
@@ -296,6 +327,11 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                      alerts_mod.DEFAULT_QUALITY_RULES]
         if meta.get("stage") == "serve":
             rule_sets.append(alerts_mod.DEFAULT_SERVE_RULES)
+        if watch_paths:
+            # the resource watermark surface (ISSUE 19): only when
+            # the monitor below will actually publish the gauges the
+            # threshold rules read
+            rule_sets.append(alerts_mod.DEFAULT_RESOURCE_RULES)
         if alert_rules:
             try:
                 rule_sets.append(alerts_mod.load_rules(alert_rules))
@@ -317,6 +353,13 @@ def observability(metrics: str | None = None, interval: float = 0.0,
     # blocks — the driver's stage children — stack and restore
     prev_integrity = integrity.install_registry(
         reg if reg.enabled else None)
+    # the resource-guard frame (ISSUE 19): same stack/restore
+    # discipline — the degradation ladder, disk/RSS monitor, and
+    # stall watchdog are armed for exactly this lifecycle
+    from ..utils import resources as resources_mod
+    resources_token = resources_mod.install(
+        reg, watch_paths=watch_paths, stall_timeout_s=stall_timeout_s,
+        interval_s=(interval if interval and interval > 0 else 5.0))
     try:
         try:
             obs.server = export_mod.start_exposition(
@@ -354,6 +397,7 @@ def observability(metrics: str | None = None, interval: float = 0.0,
                 pass
         obs._finalize(ok=True)
     finally:
+        resources_mod.uninstall(resources_token)
         flight_mod.uninstall(flight_token)
         integrity.install_registry(prev_integrity)
         # span + endpoint teardown on EVERY exit: the Chrome trace of

@@ -23,7 +23,7 @@ import dataclasses
 from ..io import checkpoint as ckpt_mod
 from ..io import integrity as integrity_mod
 from ..io import fastq, packing
-from ..utils import faults, levers
+from ..utils import faults, levers, resources
 from ..models.error_correct import ECOptions, run_error_correct
 
 # EC's default quality cutoff when the driver passes no -q/-Q to it —
@@ -99,6 +99,18 @@ def _run_stage_with_retries(reg, stage: str, attempt_fn, retries: int,
             # re-raises it — surface immediately
             rc = ckpt_mod.NON_RETRYABLE_RC
             cause = f"{type(e).__name__}: {e}"
+        except resources.ResourceExhausted as e:
+            # a required writer hit ENOSPC (or strict preflight
+            # refused) in an in-process stage: already laddered
+            # (sealed flight dump, disk_full event) — map to the
+            # non-retryable rc below
+            rc = resources.DISK_FULL_RC
+            cause = f"{type(e).__name__}: {e}"
+        except resources.StallError as e:
+            # the watchdog aborted a wedged attempt: retryable — the
+            # stage resumes from its checkpoint
+            rc = resources.STALL_RC
+            cause = f"{type(e).__name__}: {e}"
         except (RuntimeError, ValueError, OSError) as e:
             rc = 1
             cause = f"{type(e).__name__}: {e}"
@@ -106,7 +118,11 @@ def _run_stage_with_retries(reg, stage: str, attempt_fn, retries: int,
             reg.set_meta(**{f"{stage}_attempts": attempt + 1})
         if rc == 0:
             return 0
-        if rc == ckpt_mod.NON_RETRYABLE_RC or attempt >= retries:
+        # DISK_FULL_RC joins the non-retryable set: a full disk does
+        # not empty itself between backoff attempts, and every retry
+        # would re-run hours of compute into the same ENOSPC
+        if (rc in (ckpt_mod.NON_RETRYABLE_RC, resources.DISK_FULL_RC)
+                or attempt >= retries):
             if cause:
                 print(f"quorum: {stage} failed: {cause}",
                       file=sys.stderr)
@@ -334,6 +350,12 @@ def main(argv=None) -> int:
     # registries live in this process, so the pushed exposition
     # (render_live) already carries driver + stage1 + stage2 — a
     # per-stage pusher would triple-post the same series
+    # the driver's own resource-guard frame watches the filesystems
+    # its artifacts land on (the in-process stages nest their own
+    # frames over the same paths); the stall watchdog is per-STAGE —
+    # only the stage loops beat, so arming one here would misfire
+    watch = [p for p in (args.prefix + "_mer_database.jf",
+                         args.checkpoint_dir, args.metrics) if p]
     with observability(args.metrics, args.metrics_interval,
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
@@ -342,7 +364,8 @@ def main(argv=None) -> int:
                        profile=args.profile,
                        push_url=args.metrics_push_url,
                        push_interval=args.metrics_push_interval,
-                       alert_rules=args.alert_rules) as obs:
+                       alert_rules=args.alert_rules,
+                       watch_paths=watch) as obs:
         reg = obs.registry
         track_jax_compile_cache(reg)
 
@@ -484,7 +507,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                 "-o", db_file, "--batch-size", str(args.batch_size),
                 "--devices", str(n_devices),
                 "--db-version", str(args.db_version),
-                "--db-layout", args.db_layout]
+                "--db-layout", args.db_layout,
+                "--preflight", args.preflight]
+    if args.stall_timeout_s and args.stall_timeout_s > 0:
+        cdb_argv.extend(["--stall-timeout-s",
+                         str(args.stall_timeout_s)])
     if args.prefilter != "auto":
         cdb_argv.extend(["--prefilter", args.prefilter])
     if args.partitions != 1:
@@ -734,10 +761,18 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         reg.set_meta(stage1_resumed_db=db_file)
     else:
         t_s1 = time.perf_counter()
-        if _run_stage_with_retries(reg, "create_database",
-                                   _stage1_attempt, args.stage_retries,
-                                   args.retry_backoff_ms,
-                                   cursor_fn=_stage1_cursor) != 0:
+        s1_rc = _run_stage_with_retries(reg, "create_database",
+                                        _stage1_attempt,
+                                        args.stage_retries,
+                                        args.retry_backoff_ms,
+                                        cursor_fn=_stage1_cursor)
+        if s1_rc != 0:
+            if s1_rc in (resources.DISK_FULL_RC, resources.STALL_RC):
+                # disk-full / stall rcs carry retry semantics for
+                # OUTER supervisors (cluster schedulers) — propagate
+                print("Creating the mer database failed (out of disk "
+                      "space or stalled).", file=sys.stderr)
+                return s1_rc
             print("Creating the mer database failed. Most likely the "
                   "size passed to the -s switch is too small.",
                   file=sys.stderr)
@@ -774,7 +809,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     ec_common = ["--batch-size", str(args.batch_size),
                  "-t", str(threads), "--devices", str(n_devices),
                  "--verify-db", args.verify_db,
-                 "--render-workers", str(args.render_workers)]
+                 "--render-workers", str(args.render_workers),
+                 "--preflight", args.preflight]
+    if args.stall_timeout_s and args.stall_timeout_s > 0:
+        ec_common.extend(["--stall-timeout-s",
+                          str(args.stall_timeout_s)])
     for flag, val in (("--min-count", args.min_count),
                       ("--skip", args.skip),
                       ("--good", args.anchor),
@@ -841,12 +880,15 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                                           if prepacked_factory else None))
 
         t_s2 = time.perf_counter()
-        if _run_stage_with_retries(reg, "error_correct",
-                                   _stage2_attempt, args.stage_retries,
-                                   args.retry_backoff_ms,
-                                   cursor_fn=_stage2_cursor) != 0:
+        s2_rc = _run_stage_with_retries(reg, "error_correct",
+                                        _stage2_attempt,
+                                        args.stage_retries,
+                                        args.retry_backoff_ms,
+                                        cursor_fn=_stage2_cursor)
+        if s2_rc != 0:
             print("Error correction failed", file=sys.stderr)
-            return 1
+            return (s2_rc if s2_rc in (resources.DISK_FULL_RC,
+                                       resources.STALL_RC) else 1)
         record_stage2(t_s2)
         if replay_store is not None:
             # the corrected output is final — the capture is garbage
@@ -871,7 +913,9 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
                      metrics_interval=args.metrics_interval,
                      metrics_textfile=args.metrics_textfile,
                      metrics_force=args.metrics_port is not None,
-                     trace_spans=ts2, alert_rules=args.alert_rules)
+                     trace_spans=ts2, alert_rules=args.alert_rules,
+                     preflight=args.preflight,
+                     stall_timeout_s=args.stall_timeout_s)
     kwargs = dict(no_discard=True,
                   trim_contaminant=args.trim_contaminant)
     for key, val in (("min_count", args.min_count), ("skip", args.skip),
@@ -893,13 +937,15 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         return 0
 
     t_s2 = time.perf_counter()
-    if _run_stage_with_retries(reg, "error_correct",
-                               _stage2_paired_attempt,
-                               args.stage_retries,
-                               args.retry_backoff_ms,
-                               cursor_fn=_stage2_cursor) != 0:
+    s2_rc = _run_stage_with_retries(reg, "error_correct",
+                                    _stage2_paired_attempt,
+                                    args.stage_retries,
+                                    args.retry_backoff_ms,
+                                    cursor_fn=_stage2_cursor)
+    if s2_rc != 0:
         print("Error correction failed", file=sys.stderr)
-        return 1
+        return (s2_rc if s2_rc in (resources.DISK_FULL_RC,
+                                   resources.STALL_RC) else 1)
     record_stage2(t_s2)
     fa_path = args.prefix + ".fa"
     try:

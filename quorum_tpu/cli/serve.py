@@ -274,6 +274,11 @@ def main(argv=None) -> int:
     # additionally starts the standalone exposition endpoint the
     # other CLIs use, for scrapers that must not share the serving
     # port's queue
+    # the resource-guard frame (ISSUE 19): watch the live-ingest
+    # snapshot/checkpoint directory (the service's only durable
+    # writes) for the watermark alerts
+    watch = [p for p in (getattr(args, "live_dir", None),
+                         args.metrics) if p]
     with observability(args.metrics, args.metrics_interval,
                        port=args.metrics_port,
                        textfile=args.metrics_textfile,
@@ -281,12 +286,16 @@ def main(argv=None) -> int:
                        push_url=args.metrics_push_url,
                        push_interval=args.metrics_push_interval,
                        alert_rules=args.alert_rules,
+                       watch_paths=watch,
                        stage="serve") as obs:
         try:
             rc = _serve(args, qual_cutoff, warmup_lengths, obs)
         except (RuntimeError, ValueError, OSError) as e:
             print(str(e), file=sys.stderr)
             obs.status = "error"
+            from ..utils import resources
+            if isinstance(e, resources.ResourceExhausted):
+                return resources.DISK_FULL_RC
             return 1
         if rc != 0:
             obs.status = "error"

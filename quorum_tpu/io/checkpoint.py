@@ -52,7 +52,7 @@ import os
 import numpy as np
 
 from ..telemetry.registry import atomic_write
-from ..utils import faults
+from ..utils import faults, resources
 from . import integrity
 
 STAGE1_FORMAT = "quorum_tpu_stage1_ckpt/1"
@@ -160,44 +160,50 @@ class Stage1Checkpoint:
         knob. Streamed tmp-then-rename: same atomicity contract as
         atomic_write without materializing a second copy of a
         multi-GB table in RAM."""
-        os.makedirs(self.dir, exist_ok=True)
-        tag = np.ascontiguousarray(np.asarray(bstate.tag, dtype=np.uint32))
-        hq = np.ascontiguousarray(np.asarray(bstate.hq, dtype=np.uint32))
-        lq = np.ascontiguousarray(np.asarray(bstate.lq, dtype=np.uint32))
-        # payload digest: incremental CRC over the planes in write
-        # order, so load can refuse silent corruption (bit rot, torn
-        # sectors) — the length check alone only catches truncation
-        pcrc = integrity.crc32c(tag)
-        pcrc = integrity.crc32c(hq, pcrc)
-        pcrc = integrity.crc32c(lq, pcrc)
-        header = integrity.seal({
-            "format": STAGE1_FORMAT,
-            "k": meta.k,
-            "bits": meta.bits,
-            "rb_log2": meta.rb_log2,
-            "cursor": int(cursor),
-            "reads": int(stats.reads),
-            "bases": int(stats.bases),
-            "batches": int(stats.batches),
-            "grows": int(stats.grows),
-            "qual_thresh": int(cfg.qual_thresh),
-            "batch_size": int(cfg.batch_size),
-            "paths": list(paths),
-            "tag_shape": list(tag.shape),
-            "acc_len": int(hq.shape[0]),
-            "payload_crc32c": pcrc,
-        })
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(json.dumps(header).encode() + b"\n")
-            f.write(tag.tobytes())
-            f.write(hq.tobytes())
-            f.write(lq.tobytes())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        integrity.fsync_dir(self.path)
-        faults.inject("checkpoint.commit", path=self.path)
+        if resources.degraded("stage1.checkpoint"):
+            return
+        with resources.guard("stage1.checkpoint", path=self.path):
+            os.makedirs(self.dir, exist_ok=True)
+            tag = np.ascontiguousarray(
+                np.asarray(bstate.tag, dtype=np.uint32))
+            hq = np.ascontiguousarray(
+                np.asarray(bstate.hq, dtype=np.uint32))
+            lq = np.ascontiguousarray(
+                np.asarray(bstate.lq, dtype=np.uint32))
+            # payload digest: incremental CRC over the planes in write
+            # order, so load can refuse silent corruption (bit rot,
+            # torn sectors) — the length check only catches truncation
+            pcrc = integrity.crc32c(tag)
+            pcrc = integrity.crc32c(hq, pcrc)
+            pcrc = integrity.crc32c(lq, pcrc)
+            header = integrity.seal({
+                "format": STAGE1_FORMAT,
+                "k": meta.k,
+                "bits": meta.bits,
+                "rb_log2": meta.rb_log2,
+                "cursor": int(cursor),
+                "reads": int(stats.reads),
+                "bases": int(stats.bases),
+                "batches": int(stats.batches),
+                "grows": int(stats.grows),
+                "qual_thresh": int(cfg.qual_thresh),
+                "batch_size": int(cfg.batch_size),
+                "paths": list(paths),
+                "tag_shape": list(tag.shape),
+                "acc_len": int(hq.shape[0]),
+                "payload_crc32c": pcrc,
+            })
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(tag.tobytes())
+                f.write(hq.tobytes())
+                f.write(lq.tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            integrity.fsync_dir(self.path)
+            faults.inject("checkpoint.commit", path=self.path)
 
     def load(self) -> Stage1Snapshot | None:
         """The last valid snapshot, or None when there is none. A
@@ -358,6 +364,20 @@ class Stage1ShardedCheckpoint:
         the commit point."""
         from ..ops.ctable import TSLOTS
         from ..parallel.multihost import barrier, process_index
+        # Degradation ladder (ISSUE 19): checkpoints are optional —
+        # on ENOSPC the writer disables itself and the run continues.
+        # NOTE: the degraded flag is process-local; under a true
+        # multi-host run a one-host skip would desync the barriers
+        # below, but sharded saves are single-controller today (every
+        # shard is addressable) so skip and save stay consistent.
+        if resources.degraded("stage1.checkpoint"):
+            return
+        with resources.guard("stage1.checkpoint", path=self.path):
+            self._save_guarded(bstate, meta, cfg, cursor, stats, paths,
+                               TSLOTS, barrier, process_index)
+
+    def _save_guarded(self, bstate, meta, cfg, cursor, stats, paths,
+                      TSLOTS, barrier, process_index) -> None:
         os.makedirs(self.dir, exist_ok=True)
         try:
             old = self._read_manifest()
@@ -585,21 +605,24 @@ class Stage1PartitionCursor:
         `file_crc32c` is the v5 header+payload digest, which excludes
         the trailer line) so load() can verify with one crc32c_file
         pass. atomic_write = the commit point."""
-        os.makedirs(self.dir, exist_ok=True)
-        for rec in completed:
-            # memoized ON the caller's record: the cursor commits
-            # after EVERY pass with the same record objects, and
-            # re-hashing all prior shards each time would be O(P^2)
-            # whole-file reads
-            if "ckpt_file_crc32c" not in rec:
-                rec["ckpt_file_crc32c"] = integrity.crc32c_file(
-                    os.path.join(out_dir, str(rec["path"])))
-        atomic_write(self.path, json.dumps(integrity.seal({
-            "format": STAGE1_PARTITIONS_FORMAT,
-            "identity": identity,
-            "completed": list(completed),
-        })) + "\n")
-        faults.inject("partition.commit", path=self.path)
+        if resources.degraded("partition.cursor"):
+            return
+        with resources.guard("partition.cursor", path=self.path):
+            os.makedirs(self.dir, exist_ok=True)
+            for rec in completed:
+                # memoized ON the caller's record: the cursor commits
+                # after EVERY pass with the same record objects, and
+                # re-hashing all prior shards each time would be
+                # O(P^2) whole-file reads
+                if "ckpt_file_crc32c" not in rec:
+                    rec["ckpt_file_crc32c"] = integrity.crc32c_file(
+                        os.path.join(out_dir, str(rec["path"])))
+            atomic_write(self.path, json.dumps(integrity.seal({
+                "format": STAGE1_PARTITIONS_FORMAT,
+                "identity": identity,
+                "completed": list(completed),
+            })) + "\n")
+            faults.inject("partition.commit", path=self.path)
 
     def load(self, identity: dict, out_dir: str) -> list[dict] | None:
         """The completed-partition records, or None when there is no
@@ -662,23 +685,26 @@ class SketchCheckpoint:
         self.path = os.path.join(directory, "stage1.sketch.ckpt")
 
     def save(self, cells: np.ndarray, identity: dict) -> None:
-        os.makedirs(self.dir, exist_ok=True)
-        cells = np.ascontiguousarray(np.asarray(cells, np.uint8))
-        header = integrity.seal({
-            "format": SKETCH_FORMAT,
-            "identity": identity,
-            "cells": int(cells.shape[0]),
-            "payload_crc32c": integrity.crc32c(cells),
-        })
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(json.dumps(header).encode() + b"\n")
-            f.write(cells.tobytes())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        integrity.fsync_dir(self.path)
-        faults.inject("checkpoint.commit", path=self.path)
+        if resources.degraded("sketch.checkpoint"):
+            return
+        with resources.guard("sketch.checkpoint", path=self.path):
+            os.makedirs(self.dir, exist_ok=True)
+            cells = np.ascontiguousarray(np.asarray(cells, np.uint8))
+            header = integrity.seal({
+                "format": SKETCH_FORMAT,
+                "identity": identity,
+                "cells": int(cells.shape[0]),
+                "payload_crc32c": integrity.crc32c(cells),
+            })
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(cells.tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            integrity.fsync_dir(self.path)
+            faults.inject("checkpoint.commit", path=self.path)
 
     def load(self, identity: dict) -> np.ndarray | None:
         """The sketch cell plane, or None (mismatched identity = a
@@ -887,8 +913,14 @@ class Stage2Journal:
         if self._out is not None and self._log is not None:
             doc["fa_crc32c"] = self._out.crc
             doc["log_crc32c"] = self._log.crc
-        atomic_write(self.path, json.dumps(integrity.seal(doc)) + "\n")
-        faults.inject("journal.append", path=self.path)
+        # REQUIRED writer (ISSUE 19): resumability is part of the
+        # output contract — ENOSPC here seals a flight dump and fails
+        # the run fast (rc DISK_FULL_RC, not retried) instead of
+        # grinding on with an un-journaled partial.
+        with resources.guard("stage2.journal", path=self.path):
+            atomic_write(self.path,
+                         json.dumps(integrity.seal(doc)) + "\n")
+            faults.inject("journal.append", path=self.path)
 
     def batches_done(self) -> int | None:
         """Peek at the journaled batch cursor (driver retry events)."""
@@ -1036,7 +1068,13 @@ class _ReplayWriter:
             self.payloads.append(
                 {"bytes": size, "crc32c": integrity.crc32c_file(path)})
             self.bytes += size
-        except OSError:
+        except OSError as e:
+            # the replay cache was already self-degrading (a failed
+            # capture just means stage 2 re-parses from FASTQ); a full
+            # disk additionally records the ladder event so the run's
+            # telemetry shows WHY the capture vanished (ISSUE 19)
+            if resources.is_enospc(e):
+                resources.degrade("replay.cache", e, path=path)
             self.abort()
             return
         self.n += 1
@@ -1052,15 +1090,21 @@ class _ReplayWriter:
         disk (atomic_write = the commit point)."""
         if not self.ok:
             return False
-        atomic_write(self.cache.manifest_path, json.dumps(
-            integrity.seal({
-                "format": REPLAY_FORMAT,
-                "identity": self.identity,
-                "n_batches": self.n,
-                "bytes": self.bytes,
-                "payloads": self.payloads,
-            })) + "\n")
-        return True
+        committed = False
+        with resources.guard("replay.cache",
+                             path=self.cache.manifest_path):
+            atomic_write(self.cache.manifest_path, json.dumps(
+                integrity.seal({
+                    "format": REPLAY_FORMAT,
+                    "identity": self.identity,
+                    "n_batches": self.n,
+                    "bytes": self.bytes,
+                    "payloads": self.payloads,
+                })) + "\n")
+            committed = True
+        if not committed:  # ENOSPC degraded the writer mid-commit
+            self.abort()
+        return committed
 
 
 class _ReplayReader:

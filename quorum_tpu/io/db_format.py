@@ -111,7 +111,14 @@ def _atomic_db_write(path: str, header: dict, payload: bytes,
     The parent directory is fsync'd after the rename so the committed
     file also survives power loss, not just process death. `trailer`
     (v5), when given, is called with the serialized header line and
-    returns the trailer bytes appended after the payload."""
+    returns the trailer bytes appended after the payload.
+
+    The degradation ladder classifies this writer in its CALLER
+    (ISSUE 19): the stage-1 export wraps it as the required
+    `db.payload` (its entry point maps ENOSPC to DISK_FULL_RC); the
+    live-ingest epoch snapshot wraps it as the optional
+    `epoch.snapshot` (serve/ingest.py degrades and keeps serving) —
+    so the raw OSError propagates from here untouched."""
     tmp = path + ".tmp"
     line = json.dumps(header).encode() + b"\n"
     with open(tmp, "wb") as f:

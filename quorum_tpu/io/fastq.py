@@ -25,7 +25,7 @@ from typing import Iterable, Iterator, Sequence
 import numpy as np
 
 from ..ops import mer
-from ..utils import faults
+from ..utils import faults, resources
 from ..utils.vlog import vlog
 
 # Read-length buckets: batches are padded to the smallest bucket that
@@ -91,20 +91,33 @@ class BadReadPolicy:
 
     def handle(self, path: str, err: Exception, raw_lines) -> None:
         """One malformed record: raise (abort) or record and
-        continue."""
+        continue. The quarantine stream is an *optional* writer on
+        the ISSUE 19 degradation ladder: before this fix a full disk
+        here propagated out of bad-read handling and killed the run —
+        precisely while it was already limping — so now an ENOSPC
+        degrades the stream (writer_degraded_total{writer=
+        quarantine.stream}) and the run keeps its `bad_reads_total`
+        accounting and its primary output."""
         if self.mode == "abort":
             raise err
         with self._lock:
+            # count BEFORE the quarantine write: accounting must
+            # survive a degraded stream
             self.bad += 1
             if self.registry is not None:
                 self.registry.counter("bad_reads_total").inc()
             if (self.mode == "quarantine" and raw_lines
-                    and not self._closed):
-                if self._f is None:
-                    self._f = open(self.quarantine_path, "wb")
-                for ln in raw_lines:
-                    self._f.write(ln)
-                self._f.flush()
+                    and not self._closed
+                    and not resources.degraded("quarantine.stream")):
+                with resources.guard("quarantine.stream",
+                                     path=self.quarantine_path):
+                    faults.inject("quarantine.write",
+                                  path=self.quarantine_path)
+                    if self._f is None:
+                        self._f = open(self.quarantine_path, "wb")
+                    for ln in raw_lines:
+                        self._f.write(ln)
+                    self._f.flush()
         vlog("Bad read in ", path, ": ", err)
 
     def close(self) -> None:
@@ -114,8 +127,13 @@ class BadReadPolicy:
         with self._lock:
             self._closed = True
             if self._f is not None:
-                self._f.close()
-                self._f = None
+                f, self._f = self._f, None
+                # a degraded stream may still hold buffered bytes a
+                # full disk will refuse: closing is quarantine work,
+                # so it degrades rather than killing the teardown
+                with resources.guard("quarantine.stream",
+                                     path=self.quarantine_path):
+                    f.close()
 
 
 def _open(path: str):

@@ -31,7 +31,7 @@ from ..ops import ctable, mer
 from ..ops import sketch as sketch_mod
 from ..telemetry import NULL as NULL_METRICS
 from ..telemetry import NULL_TRACER, observe_dispatch_wait
-from ..utils import faults
+from ..utils import faults, resources
 from ..utils.pipeline import prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -272,6 +272,7 @@ def build_database(
                 continue
             step_i = stats.batches
             faults.inject("stage1.insert", batch=step_i)
+            resources.watchdog_beat("stage1.insert", step_i)
             stats.batches += 1
             stats.reads += batch.n
             nb = int(batch.lengths.sum())
@@ -650,6 +651,7 @@ def _build_database_sharded(paths, cfg: BuildConfig, batches, reg,
                 _resolve(inflight)
                 inflight = None
             faults.inject("stage1.insert", batch=step_i)
+            resources.watchdog_beat("stage1.insert", step_i)
             inflight = _dispatch(batch, pk, wire, step_i)
             step_i += 1
             if not overlap:
@@ -781,6 +783,7 @@ def _run_insert_pass(batches, cfg: BuildConfig, lmeta, sk, smeta,
     for batch, pk in batches:
         step_i = step0 + n_batches
         faults.inject("stage1.insert", batch=step_i)
+        resources.watchdog_beat("stage1.insert", step_i)
         n_batches += 1
         if count_stats:
             stats.batches += 1
@@ -998,6 +1001,7 @@ def _run_partition_pass_sharded(batches, cfg: BuildConfig, rb_local,
     for batch, pk in batches:
         step_i = step0 + n_batches
         faults.inject("stage1.insert", batch=step_i)
+        resources.watchdog_beat("stage1.insert", step_i)
         n_batches += 1
         if count_stats:
             stats.batches += 1

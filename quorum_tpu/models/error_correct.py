@@ -37,7 +37,7 @@ from ..io import db_format, fastq, packing
 from ..ops import ctable
 from ..ops.poisson import compute_poisson_cutoff
 from ..telemetry import observe_dispatch_wait, quality
-from ..utils import faults
+from ..utils import faults, resources
 from ..utils.pipeline import AsyncWriter, ReorderingPool, prefetch
 from ..utils.profiling import StageTimer, trace
 from ..utils.vlog import vlog
@@ -306,6 +306,13 @@ class ECOptions:
     # pipelines are bit-unchanged and prefiltered ones are exactly
     # the floored-full-table run (the parity theorem, ops/sketch)
     presence_floor: int = 0
+    # resource guards (ISSUE 19): --preflight compares estimated
+    # output bytes against free space before the DB load (strict
+    # refuses with rc DISK_FULL_RC, warn prints, off skips);
+    # --stall-timeout-s arms the offline stall watchdog over the
+    # batch cursor (utils/resources.py)
+    preflight: str = "warn"
+    stall_timeout_s: float = 0.0
 
 
 def _open_out(prefix: str | None, suffix: str, default_stream, gzip: bool):
@@ -389,6 +396,11 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
     # writes status=ok itself at the end of _run_ec, which the
     # teardown detects and leaves alone.
     from ..cli.observability import observability
+    # the resource-guard frame (ISSUE 19): watch the output and
+    # metrics filesystems; stage-2 files (not generators) preflight
+    # against their input sizes before the DB upload
+    watch = [p for p in (opts.output and opts.output + ".fa",
+                         opts.metrics) if p]
     with observability(opts.metrics, opts.metrics_interval,
                        port=opts.metrics_port,
                        textfile=opts.metrics_textfile,
@@ -398,16 +410,34 @@ def run_error_correct(db_path: str, sequences: Sequence[str],
                        push_url=opts.metrics_push_url,
                        push_interval=opts.metrics_push_interval,
                        alert_rules=opts.alert_rules,
+                       watch_paths=watch,
+                       stall_timeout_s=opts.stall_timeout_s,
                        stage="error_correct", batch_size=opts.batch_size,
                        no_discard=bool(no_discard)) as obs:
-        return _run_ec(db_path, sequences, cfg_in, opts, obs.registry,
-                       obs.tracer,
-                       qual_cutoff=qual_cutoff, skip=skip, good=good,
-                       anchor_count=anchor_count, min_count=min_count,
-                       window=window, error=error, homo_trim=homo_trim,
-                       trim_contaminant=trim_contaminant,
-                       no_discard=no_discard, records=records, db=db,
-                       prepacked=prepacked)
+        if opts.output and records is None and prepacked is None:
+            resources.preflight(opts.preflight,
+                                resources.estimate_stage2_needs(
+                                    opts.output + ".fa", sequences))
+        try:
+            return _run_ec(db_path, sequences, cfg_in, opts,
+                           obs.registry, obs.tracer,
+                           qual_cutoff=qual_cutoff, skip=skip,
+                           good=good, anchor_count=anchor_count,
+                           min_count=min_count, window=window,
+                           error=error, homo_trim=homo_trim,
+                           trim_contaminant=trim_contaminant,
+                           no_discard=no_discard, records=records,
+                           db=db, prepacked=prepacked)
+        except resources.ResourceExhausted:
+            raise  # already laddered (journal guard / preflight)
+        except OSError as e:
+            if resources.is_enospc(e):
+                # a bare ENOSPC escaping stage 2 is the .fa/.log
+                # output stream (reads cannot ENOSPC): the run's
+                # reason to exist — required, fail fast, no retry
+                raise resources.fail_required("output.stream",
+                                              e) from e
+            raise
 
 
 def _run_ec(db_path: str, sequences: Sequence[str],
@@ -698,6 +728,11 @@ def _run_ec(db_path: str, sequences: Sequence[str],
                         step_i += 1
                         continue
                     faults.inject("stage2.correct", batch=step_i)
+                    # per-batch liveness beat for the offline stall
+                    # watchdog (--stall-timeout-s, ISSUE 19): a
+                    # cursor that stops advancing soft-aborts this
+                    # loop with a StallError -> retryable STALL_RC
+                    resources.watchdog_beat("stage2.correct", step_i)
                     with tracer.span("stage2_batch", step=step_i,
                                      reads=batch.n):
                         # per-batch device-time attribution: dispatch

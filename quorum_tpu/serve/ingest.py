@@ -49,7 +49,8 @@ import time
 from ..io import db_format
 from ..telemetry import NULL
 from ..telemetry.spans import NULL_TRACER
-from ..utils import faults
+from ..utils import faults, levers, resources, sizes
+from ..utils.pipeline import batch_nbytes
 from ..utils.vlog import vlog
 from .batcher import Draining, QueueFull
 from .live_table import LiveTable, LiveTableCheckpoint, epoch_floor
@@ -59,11 +60,12 @@ class _Chunk:
     """One queued ingest chunk: records + a done event the submitting
     HTTP thread blocks on."""
 
-    __slots__ = ("seq", "records", "done", "error")
+    __slots__ = ("seq", "records", "nbytes", "done", "error")
 
     def __init__(self, seq: int, records):
         self.seq = seq
         self.records = records
+        self.nbytes = batch_nbytes(records)
         self.done = threading.Event()
         self.error: BaseException | None = None
 
@@ -113,6 +115,16 @@ class IngestDispatcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queue: collections.deque[_Chunk] = collections.deque()
+        # byte-bounded backpressure (ISSUE 19): alongside the chunk
+        # COUNT bound, a queue over this many queued record bytes
+        # answers 429 + Retry-After — one burst of long reads cannot
+        # balloon RSS past the budget
+        try:
+            self.queue_bytes = sizes.parse_size(
+                levers.raw("QUORUM_INGEST_QUEUE_BYTES") or "512M")
+        except ValueError:
+            self.queue_bytes = sizes.parse_size("512M")
+        self._queued_bytes = 0
         self._force: _ForceEpoch | None = None
         self._cursor = int(cursor)      # last fully-ingested chunk seq
         self._max_seen = int(cursor)    # dedupe horizon (incl. queued)
@@ -174,7 +186,16 @@ class IngestDispatcher:
             if len(self._queue) >= self.queue_chunks:
                 raise QueueFull(retry_after=1.0)
             chunk = _Chunk(seq, records)
+            # admit-into-empty rule: a single chunk bigger than the
+            # whole byte budget must still make progress alone
+            if (self._queue
+                    and self._queued_bytes + chunk.nbytes
+                    > self.queue_bytes):
+                raise QueueFull(retry_after=1.0)
             self._queue.append(chunk)
+            self._queued_bytes += chunk.nbytes
+            reg.gauge("ingest_queue_bytes_max").set_max(
+                self._queued_bytes)
             self._max_seen = seq
             self._work.notify_all()
         chunk.done.wait()
@@ -278,6 +299,7 @@ class IngestDispatcher:
                 # pull the failed seq back out of the dedupe horizon
                 # so the client's retry isn't dropped as a duplicate
                 self._queue.popleft()
+                self._queued_bytes -= chunk.nbytes
                 self._max_seen = max(
                     [self._cursor] + [c.seq for c in self._queue])
             chunk.done.set()
@@ -285,6 +307,7 @@ class IngestDispatcher:
         reg.counter("ingest_reads_total").inc(n)
         with self._work:
             self._queue.popleft()
+            self._queued_bytes -= chunk.nbytes
             self._cursor = chunk.seq
             self._chunks_done += 1
             self._epoch_reads_since += n
@@ -349,6 +372,14 @@ class IngestDispatcher:
         """One epoch attempt: seal → export → build+verify → swap.
         Any failure rolls back — the old epoch keeps serving."""
         reg = self.registry
+        if resources.degraded("epoch.snapshot"):
+            # the ladder disabled epoch snapshots (an earlier ENOSPC
+            # under --live-dir): the serving epoch keeps serving, and
+            # boundaries stop burning a doomed seal+export each time
+            detail = "epoch snapshots disabled (out of space)"
+            with self._lock:
+                self._last_epoch_error = detail
+            return False, {"error": detail}
         self._epoch_n += 1
         path = None
         try:
@@ -368,6 +399,11 @@ class IngestDispatcher:
                         "engine swap")
         except Exception as e:
             self._epoch_n -= 1
+            if resources.is_enospc(e):
+                # optional writer on the ladder (ISSUE 19): disable
+                # epoch snapshots for the rest of the run — the
+                # serving epoch is untouched, ingest keeps counting
+                resources.degrade("epoch.snapshot", e, path=path)
             reg.counter("epoch_swap_failures_total").inc()
             reg.event("epoch_swap_failed", reason=reason,
                       error=str(e))

@@ -46,7 +46,7 @@ import numpy as np
 from ..io import fastq, integrity, packing
 from ..io.checkpoint import CheckpointError
 from ..ops import ctable
-from ..utils import faults, levers
+from ..utils import faults, levers, resources
 
 LIVE_CKPT_FORMAT = "quorum_tpu_live_ckpt/1"
 
@@ -218,7 +218,15 @@ class LiveTableCheckpoint:
     def save(self, table: LiveTable, cursor: int) -> None:
         """Snapshot after chunk `cursor` is fully inserted. D2H
         happens here (np.asarray) — the checkpoint is a sync point,
-        which is why `--live-checkpoint-every` is a cadence knob."""
+        which is why `--live-checkpoint-every` is a cadence knob.
+        Rides the degradation ladder as a stage-1 checkpoint
+        (ISSUE 19): ENOSPC disables snapshots, ingest keeps going."""
+        if resources.degraded("stage1.checkpoint"):
+            return
+        with resources.guard("stage1.checkpoint", path=self.path):
+            self._save_guarded(table, cursor)
+
+    def _save_guarded(self, table: LiveTable, cursor: int) -> None:
         os.makedirs(self.dir, exist_ok=True)
         bstate, meta = table.bstate, table.meta
         tag = np.ascontiguousarray(np.asarray(bstate.tag,

@@ -12,8 +12,8 @@ See registry.py for the model and schema.py for the document formats.
 from . import flight
 from . import quality
 from .alerts import (AlertEngine, DEFAULT_QUALITY_RULES,
-                     DEFAULT_RULES, DEFAULT_SERVE_RULES,
-                     load_rules, merge_rules)
+                     DEFAULT_RESOURCE_RULES, DEFAULT_RULES,
+                     DEFAULT_SERVE_RULES, load_rules, merge_rules)
 from .quality import QualityScorecard
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        NULL, NullRegistry, labeled,
@@ -27,8 +27,9 @@ from .spans import NULL_TRACER, NullTracer, SpanTracer, tracer_for
 
 __all__ = [
     "flight", "quality",
-    "AlertEngine", "DEFAULT_QUALITY_RULES", "DEFAULT_RULES",
-    "DEFAULT_SERVE_RULES", "load_rules", "merge_rules",
+    "AlertEngine", "DEFAULT_QUALITY_RULES", "DEFAULT_RESOURCE_RULES",
+    "DEFAULT_RULES", "DEFAULT_SERVE_RULES", "load_rules",
+    "merge_rules",
     "QualityScorecard",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "NULL",
     "NullRegistry", "labeled", "observe_dispatch_wait", "registry_for",

@@ -171,6 +171,34 @@ DEFAULT_QUALITY_RULES = [
 ]
 
 
+# the resource-exhaustion surface (appended whenever the resource
+# guard's monitor is live, ISSUE 19): standing rules over the
+# monitor's disk gauges and the degradation ladder's counter. The
+# scalar `disk_free_bytes_min` is the minimum across every watched
+# mount (threshold rules are exact-name lookups; the per-path
+# `disk_free_bytes{path=}` gauges are for humans and dashboards).
+# Thresholds are deliberately generic floors, not per-run estimates —
+# the per-run sizing question is preflight's job before work starts.
+DEFAULT_RESOURCE_RULES = [
+    # under ~2 GiB free on some watched mount: the operator still has
+    # time to clean up or move the checkpoint dir before writers fail
+    {"name": "disk_low", "type": "threshold",
+     "metric": "gauges.disk_free_bytes_min", "op": "<",
+     "value": float(2 << 30), "severity": "warn"},
+    # under ~256 MiB: exhaustion is imminent — page, and seal the
+    # flight ring while the process can still write somewhere
+    {"name": "disk_exhausted", "type": "threshold",
+     "metric": "gauges.disk_free_bytes_min", "op": "<",
+     "value": float(256 << 20), "severity": "page", "dump": True},
+    # the degradation ladder disabled an optional writer: the run is
+    # still producing byte-identical primary output, but its
+    # checkpoints/traces/caches are silently gone — never routine
+    {"name": "writer_degraded", "type": "threshold",
+     "metric": "counters.writer_degraded_total", "op": ">", "value": 0,
+     "severity": "warn"},
+]
+
+
 def latency_bucket_us(us) -> int:
     """Quarter-octave log quantization for latency histograms: four
     buckets per power of two, <= ~160 distinct keys from 1 µs to

@@ -174,6 +174,22 @@ LIVE_INGEST_COUNTERS = (
 )
 LIVE_INGEST_GAUGES = ("ingest_cursor", "live_floor")
 
+# The resource-guard surface (ISSUE 19): a document whose meta
+# declares `resource_guard` (utils/resources.install armed a disk
+# monitor over the run's artifact filesystems) must carry the guard
+# counters — pre-created by install() so a clean run still proves the
+# guard was armed (the PR-7 zero-count lesson) — plus the monitor's
+# scalar gauges (published at the synchronous first tick, so they
+# exist even if the run finishes inside one interval). The per-path
+# `disk_free_bytes{path="..."}` labeled gauges ride along: at least
+# one must exist (the watched-path set is run-shaped, so individual
+# paths are not required by name).
+RESOURCE_COUNTERS = ("writer_degraded_total",
+                     "preflight_refusals_total",
+                     "stall_aborts_total")
+RESOURCE_GAUGES = ("disk_free_bytes_min", "host_rss_bytes")
+RESOURCE_GAUGE_PREFIX = "disk_free_bytes{path="
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.
@@ -219,4 +235,5 @@ def precreated_counter_names() -> tuple[str, ...]:
     names.update(PARTITION_COUNTERS)
     names.update(QUALITY_COUNTERS)
     names.update(LIVE_INGEST_COUNTERS)
+    names.update(RESOURCE_COUNTERS)
     return tuple(sorted(names))

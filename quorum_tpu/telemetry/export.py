@@ -36,6 +36,7 @@ import threading
 import time
 import weakref
 
+from ..utils import resources
 from .registry import atomic_write
 
 PREFIX = "quorum_tpu_"
@@ -217,10 +218,15 @@ def render_live() -> str:
 def write_textfile(path: str, text: str | None = None) -> str:
     """Atomically replace `path` with the current live rendering: a
     reader at the rename target can never observe a half-written
-    file."""
-    if text is None:
-        text = render_live()
-    atomic_write(path, text)
+    file. An optional writer on the degradation ladder (ISSUE 19):
+    ENOSPC disables the textfile for the rest of the run — scraping
+    goes stale, the run keeps going."""
+    if resources.degraded("metrics.textfile"):
+        return path
+    with resources.guard("metrics.textfile", path=path):
+        if text is None:
+            text = render_live()
+        atomic_write(path, text)
     return path
 
 

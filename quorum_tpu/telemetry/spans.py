@@ -37,6 +37,7 @@ import os
 import threading
 import time
 
+from ..utils import resources
 from .registry import _scalar, atomic_write
 
 
@@ -127,6 +128,7 @@ class SpanTracer:
         for k, v in attrs.items():
             obj[k] = _scalar(v)
         line = json.dumps(obj) + "\n"
+        enospc = None
         with self._lock:
             if self._closed:
                 # a straggler (producer/render thread) outliving
@@ -138,14 +140,32 @@ class SpanTracer:
                 self._spans.append(obj)
             else:
                 self._dropped += 1
-            if self.path:
-                if self._f is None:
-                    # streaming span JSONL: one line per closed span
-                    # all run long — atomic replace cannot apply to a
-                    # stream; opened once behind the None guard
-                    self._f = open(self.path, "w")  # qlint: disable=raw-artifact-write
-                self._f.write(line)
-                self._f.flush()
+            if self.path and not resources.degraded("trace.spans"):
+                try:
+                    if self._f is None:
+                        # streaming span JSONL: one line per closed
+                        # span all run long — atomic replace cannot
+                        # apply to a stream; opened once behind the
+                        # None guard
+                        self._f = open(self.path, "w")  # qlint: disable=raw-artifact-write
+                    self._f.write(line)
+                    self._f.flush()
+                except OSError as e:
+                    # traces are an optional writer (ISSUE 19): a
+                    # full disk drops the trace, never the run. The
+                    # ladder call happens OUTSIDE self._lock (it logs
+                    # + counts into the registry).
+                    if not resources.is_enospc(e):
+                        raise
+                    enospc = e
+                    if self._f is not None:
+                        try:
+                            self._f.close()
+                        except OSError:
+                            pass
+                        self._f = None
+        if enospc is not None:
+            resources.degrade("trace.spans", enospc, path=self.path)
 
     @contextlib.contextmanager
     def _span(self, kind: str, name: str, step, attrs: dict):
@@ -206,10 +226,13 @@ class SpanTracer:
         """Write the Chrome trace JSON (atomic replace). Returns the
         path written."""
         path = path or self.chrome_path
-        if not path:
+        if not path or resources.degraded("trace.spans"):
             return None
-        atomic_write(path, json.dumps(self.as_chrome_trace()) + "\n")
-        return path
+        with resources.guard("trace.spans", path=path):
+            atomic_write(path,
+                         json.dumps(self.as_chrome_trace()) + "\n")
+            return path
+        return None  # guard swallowed an ENOSPC: trace degraded
 
     def close(self) -> None:
         """Flush + close the JSONL sink and write the Chrome trace.

@@ -34,7 +34,19 @@ Fields per spec:
 * ``count`` — how many consecutive matching calls fire (default 1;
   ``-1`` = every one from ``at`` on).
 * ``action`` — one of:
-  - ``io_error``: raise OSError (a disk/input failure),
+  - ``io_error``: raise OSError (a disk/input failure); with
+    ``errno`` set, raise ``OSError(errno, message)`` so error-class
+    dispatch (the resource ladder's ENOSPC handling, ISSUE 19) sees
+    the exact failure a real filesystem would hand it,
+  - ``diskfull``: simulate a filling disk — every matching call
+    charges the just-committed file's size (or 1 byte at path-less
+    sites) against a ``bytes`` budget; once the cumulative charge
+    exceeds it the call raises ``OSError(ENOSPC)`` and keeps raising
+    (a full disk stays full). Deterministic: the charge sequence is
+    the write sequence. Scope with ``path_prefix`` to fill only one
+    directory (a checkpoint dir, a metrics dir) while the rest of
+    the "disk" stays writable. Combine with ``count: -1`` — the
+    default count=1 stops evaluating after one charge,
   - ``error``: raise FaultError (a RuntimeError — a device-step or
     logic failure the stage error paths already map),
   - ``exit``: ``os._exit(code)`` (default 41) — a hard kill, the
@@ -53,9 +65,15 @@ Fields per spec:
     boundary, so integrity tests (ISSUE 8) inject silent corruption
     instead of hand-editing files. Deterministic per
     (``seed``, site, firing index).
-* ``message`` / ``code`` / ``seconds`` — action parameters.
+* ``message`` / ``code`` / ``seconds`` / ``errno`` — action
+  parameters.
 * ``bytes`` / ``mode`` (``flip``/``zero``) / ``offset`` / ``seed`` —
-  ``corrupt`` parameters.
+  ``corrupt`` parameters (``bytes`` doubles as the ``diskfull``
+  budget).
+* ``path_prefix`` — match only calls whose ``path=`` starts with
+  this prefix (scope a ``diskfull``/``io_error`` to one artifact
+  directory; sites that pass no path never match a path-scoped
+  spec).
 
 Known sites (each is one ``faults.inject(...)`` call on a hot path;
 the disabled cost is a module-global None check):
@@ -90,6 +108,12 @@ the disabled cost is a module-global None check):
   commits (telemetry/flight.py); an ``error`` here tests the
   dump-landed-but-trigger-path-broke case, a ``corrupt`` damages the
   sealed dump fsck must flag.
+* ``quarantine.write`` (``path=``) — before each quarantine-stream
+  append (io/fastq.BadReadPolicy); an ENOSPC here must degrade the
+  optional quarantine writer, never kill the run.
+* ``writer.stream`` (``batch=``, ``path=``) — before each AsyncWriter
+  write to an output stream (utils/pipeline.py); an ``errno=28``
+  ``io_error`` here is the required-output ENOSPC fail-fast case.
 
 Determinism: per-spec hit counters under one lock; the same plan over
 the same input fires at exactly the same points, which is what lets
@@ -157,6 +181,16 @@ SITES: dict[str, str] = {
     "flight.dump": "after a flight-recorder crash dump commits "
                    "(telemetry/flight.FlightRecorder.dump); carries "
                    "path=",
+    "quarantine.write": "before each quarantine-stream append "
+                        "(io/fastq.BadReadPolicy); carries path= — "
+                        "an ENOSPC here must degrade the optional "
+                        "quarantine writer, never kill the run "
+                        "(ISSUE 19)",
+    "writer.stream": "before each AsyncWriter write to an output "
+                     "stream (utils/pipeline.AsyncWriter); carries "
+                     "batch= (the stream index) and path= — an "
+                     "errno=28 io_error here is the required-output "
+                     "ENOSPC fail-fast case (ISSUE 19)",
 }
 
 def render_docs() -> str:
@@ -172,7 +206,8 @@ def render_docs() -> str:
     return "\n".join(lines) + "\n"
 
 
-_ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt")
+_ACTIONS = ("io_error", "error", "exit", "sleep", "hang", "corrupt",
+            "diskfull")
 
 _CORRUPT_MODES = ("flip", "zero")
 
@@ -186,7 +221,7 @@ class FaultSpec:
 
     __slots__ = ("site", "batch", "at", "count", "action", "message",
                  "code", "seconds", "nbytes", "mode", "offset", "seed",
-                 "hits", "fired")
+                 "errno", "path_prefix", "hits", "fired", "charged")
 
     def __init__(self, raw: dict):
         if not isinstance(raw, dict):
@@ -217,9 +252,21 @@ class FaultSpec:
         self.message = raw.get("message")
         self.code = int(raw.get("code", DEFAULT_EXIT_CODE))
         self.seconds = float(raw.get("seconds", 0.05))
-        # corrupt-action parameters (ISSUE 8)
-        self.nbytes = int(raw.get("bytes", 1))
-        if self.nbytes < 1:
+        err = raw.get("errno")
+        self.errno = None if err is None else int(err)
+        if self.errno is not None and self.errno < 1:
+            raise ValueError(f"'errno' must be >= 1: {raw!r}")
+        prefix = raw.get("path_prefix")
+        if prefix is not None and (not prefix
+                                   or not isinstance(prefix, str)):
+            raise ValueError(
+                f"'path_prefix' must be a non-empty string: {raw!r}")
+        self.path_prefix = prefix
+        # corrupt-action parameters (ISSUE 8); `bytes` doubles as the
+        # diskfull budget (ISSUE 19), where 0 = "already full"
+        self.nbytes = int(raw.get("bytes",
+                                  0 if self.action == "diskfull" else 1))
+        if self.nbytes < (0 if self.action == "diskfull" else 1):
             raise ValueError(f"'bytes' must be >= 1: {raw!r}")
         self.mode = raw.get("mode", "flip")
         if self.mode not in _CORRUPT_MODES:
@@ -229,11 +276,16 @@ class FaultSpec:
         off = raw.get("offset")
         self.offset = None if off is None else int(off)
         self.seed = int(raw.get("seed", 0))
-        self.hits = 0   # matching calls seen
-        self.fired = 0  # actions taken
+        self.hits = 0     # matching calls seen
+        self.fired = 0    # actions taken
+        self.charged = 0  # diskfull bytes charged so far
 
-    def matches(self, site: str, batch) -> bool:
+    def matches(self, site: str, batch, path=None) -> bool:
         if site != self.site:
+            return False
+        if self.path_prefix is not None and (
+                path is None
+                or not str(path).startswith(self.path_prefix)):
             return False
         return self.batch is None or (batch is not None
                                       and int(batch) == self.batch)
@@ -283,11 +335,16 @@ class FaultPlan:
         due: list[FaultSpec] = []
         with self._lock:
             for spec in self.specs:
-                if not spec.matches(site, batch):
+                if not spec.matches(site, batch, path):
                     continue
                 spec.hits += 1
                 if spec.should_fire():
                     spec.fired += 1
+                    if spec.action == "diskfull":
+                        # charge under the lock: the cumulative byte
+                        # ledger is shared state, and the charge
+                        # sequence IS the determinism contract
+                        spec.charged += _charge_bytes(path)
                     due.append(spec)
         for spec in due:
             self._act(spec, site, batch, path)
@@ -328,7 +385,19 @@ class FaultPlan:
             self._hang_release.wait()
             return
         if spec.action == "io_error":
+            if spec.errno is not None:
+                raise OSError(spec.errno, msg)
             raise OSError(msg)
+        if spec.action == "diskfull":
+            # the budget holds the first `bytes` bytes; past it, every
+            # matching write fails ENOSPC — full disks stay full
+            if spec.charged > spec.nbytes:
+                import errno as _errno
+                raise OSError(
+                    _errno.ENOSPC,
+                    f"{msg} (diskfull: {spec.charged} bytes charged "
+                    f"> {spec.nbytes} budget)")
+            return
         if spec.action == "error":
             raise FaultError(msg)
         # exit: a hard kill — no cleanup, no atexit, no finally blocks;
@@ -345,6 +414,19 @@ class FaultPlan:
 
     def summary(self) -> str:
         return "; ".join(s.describe() for s in self.specs) or "(empty)"
+
+
+def _charge_bytes(path) -> int:
+    """What one firing `diskfull` call costs: the size of the file the
+    site just committed, or 1 byte at path-less sites (stream writes)
+    — so a budget of 0 is "already full" and N bytes of real artifact
+    traffic exhaust an N-byte budget deterministically."""
+    if path is None:
+        return 1
+    try:
+        return max(1, os.path.getsize(path))
+    except OSError:
+        return 1
 
 
 def _corrupt_file(spec: FaultSpec, site: str, path) -> None:

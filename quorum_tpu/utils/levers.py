@@ -118,11 +118,23 @@ _declare(
     "fused stage-1 insert compiles once per length bucket, not per "
     "chunk size.")
 _declare(
+    "QUORUM_INGEST_QUEUE_BYTES", "size", "512M",
+    "Byte budget for the live-ingest chunk queue (serve/ingest.py) "
+    "alongside --ingest-queue-chunks: a queue over budget answers "
+    "429 + Retry-After, so one burst of long reads cannot balloon "
+    "RSS (ISSUE 19).")
+_declare(
     "QUORUM_MULTICHIP_BATCH", "int", "128",
     "Batch rows for `bench.py --multichip` scaling points.")
 _declare(
     "QUORUM_MULTICHIP_K", "int", "24",
     "Mer length for `bench.py --multichip` scaling points.")
+_declare(
+    "QUORUM_PREFETCH_QUEUE_BYTES", "size", "1G",
+    "Byte budget for the producer prefetch queues (utils/pipeline."
+    "prefetch) alongside their count bound: the producer blocks once "
+    "queued batches exceed it, so RSS tracks the budget instead of "
+    "batch-size x depth (ISSUE 19).")
 _declare(
     "QUORUM_PREFILTER", "str", "off",
     "Default stage-1 singleton-prefilter mode when --prefilter is "
@@ -181,6 +193,11 @@ _declare(
     "QUORUM_VERIFY_SAMPLE_SEED", "int", "(random)",
     "Seed for `--verify-db=sample`'s chunk-scrub selection, so a "
     "sampled verification is reproducible (io/db_format.py).")
+_declare(
+    "QUORUM_WRITER_QUEUE_BYTES", "size", "256M",
+    "Byte budget for the AsyncWriter pending buffer (utils/"
+    "pipeline.AsyncWriter) alongside its count bound: submitters "
+    "block once queued output text exceeds it (ISSUE 19).")
 
 
 # -- readers --------------------------------------------------------------

@@ -19,10 +19,43 @@ import threading
 import time
 from typing import Iterable, Iterator, TypeVar
 
+from . import faults, levers, sizes
+
 T = TypeVar("T")
 
 _STOP = object()
 _FLUSH = object()
+_ITEM = object()
+
+
+def _queue_bytes_budget(lever: str, default: str) -> int:
+    """The byte budget for one bounded queue (ISSUE 19): count bounds
+    alone let one batch of long reads balloon RSS by batch-bytes x
+    depth, so the queues ALSO block on queued bytes. 0 disables."""
+    try:
+        return sizes.parse_size(levers.raw(lever) or default)
+    except ValueError:
+        return sizes.parse_size(default)
+
+
+def batch_nbytes(item) -> int:
+    """Estimated resident bytes of one queued item: numpy/JAX buffers
+    by .nbytes, strings/bytes by length, containers recursively —
+    unknown leaves cost 0, so an unestimable item never deadlocks a
+    byte-bounded queue, it just escapes the budget."""
+    nb = getattr(item, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(item, (str, bytes, bytearray)):
+        return len(item)
+    if isinstance(item, (tuple, list)):
+        return sum(batch_nbytes(x) for x in item)
+    if isinstance(item, dict):
+        return sum(batch_nbytes(v) for v in item.values())
+    return 0
 
 
 def put_or_stop(q: "queue.Queue", item, stop: threading.Event,
@@ -53,15 +86,26 @@ def put_or_stop(q: "queue.Queue", item, stop: threading.Event,
 
 
 def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
-             name: str = "prefetch", tracer=None) -> Iterator[T]:
+             name: str = "prefetch", tracer=None,
+             max_bytes: int | None = None,
+             size_fn=batch_nbytes) -> Iterator[T]:
     """Run `it` in a background thread, buffering up to `depth` items.
     Exceptions in the producer re-raise at the consumption point.
 
+    The buffer is ALSO byte-bounded (ISSUE 19): once queued items
+    exceed `max_bytes` (default: the QUORUM_PREFETCH_QUEUE_BYTES
+    lever; 0 disables) the producer blocks even below `depth` — a
+    count bound alone lets one file of long reads balloon RSS by
+    batch-bytes x depth. At least one item is always admitted, so an
+    over-budget single batch degrades to synchronous, never deadlock.
+
     `metrics` (an enabled telemetry registry, or None) records
     `<name>_queue_depth_max` (items buffered when the consumer asks —
-    depth-of-`depth` means the producer is keeping up) and
+    depth-of-`depth` means the producer is keeping up),
+    `<name>_queue_bytes_max` (the byte high-water of the buffer), and
     `<name>_producer_stall_seconds` (time the producer spent blocked
-    on a full queue, i.e. the consumer was the bottleneck).
+    on a full or over-budget queue, i.e. the consumer was the
+    bottleneck).
 
     `tracer` (an enabled span tracer, or None) records one
     `<name>_produce` span per item on the producer thread — the host
@@ -69,7 +113,13 @@ def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
     trace."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
+    budget = (_queue_bytes_budget("QUORUM_PREFETCH_QUEUE_BYTES", "1G")
+              if max_bytes is None else int(max_bytes))
+    cv = threading.Condition()
+    pending = {"bytes": 0}
     depth_g = metrics.gauge(f"{name}_queue_depth_max") if metrics else None
+    bytes_g = (metrics.gauge(f"{name}_queue_bytes_max")
+               if metrics and budget else None)
     stall_g = (metrics.gauge(f"{name}_producer_stall_seconds")
                if metrics else None)
     if tracer is not None and getattr(tracer, "enabled", False):
@@ -88,10 +138,36 @@ def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
         # bounded put that gives up if the consumer abandoned us
         return put_or_stop(q, item, stop, stall_gauge=stall_g)
 
+    def put_data(item) -> bool:
+        sz = size_fn(item) if budget else 0
+        if budget and sz:
+            t0 = time.perf_counter() if stall_g is not None else 0.0
+            blocked = False
+            with cv:
+                # admit when the buffer is empty even if this single
+                # item exceeds the whole budget
+                while (pending["bytes"] > 0
+                       and pending["bytes"] + sz > budget):
+                    if stop.is_set():
+                        return False
+                    blocked = True
+                    cv.wait(0.2)
+            if blocked and stall_g is not None:
+                stall_g.add(time.perf_counter() - t0)
+        if not put((_ITEM, sz, item)):
+            return False
+        if budget and sz:
+            with cv:
+                pending["bytes"] += sz
+                high = pending["bytes"]
+            if bytes_g is not None:
+                bytes_g.set_max(high)
+        return True
+
     def loop():
         try:
             for item in it:
-                if not put(item):
+                if not put_data(item):
                     return
         except BaseException as e:  # noqa: BLE001 - forwarded to consumer
             put(("__prefetch_error__", e))
@@ -110,12 +186,19 @@ def prefetch(it: Iterable[T], depth: int = 4, metrics=None,
             if (isinstance(item, tuple) and len(item) == 2
                     and item[0] == "__prefetch_error__"):
                 raise item[1]
-            yield item
+            _tag, sz, payload = item
+            if budget and sz:
+                with cv:
+                    pending["bytes"] -= sz
+                    cv.notify_all()
+            yield payload
         t.join()
     finally:
         # consumer abandoned (exception / generator close): release the
         # producer, which may be blocked on a full queue
         stop.set()
+        with cv:
+            cv.notify_all()
 
 
 class ReorderingPool:
@@ -191,17 +274,31 @@ class AsyncWriter:
     like the bounded jflib::pool). `close()` flushes and joins; a
     writer-side exception re-raises there.
 
+    The pending buffer is ALSO byte-bounded (ISSUE 19, the
+    QUORUM_WRITER_QUEUE_BYTES lever): `write` blocks once queued text
+    exceeds the budget, so a slow output disk backpressures the
+    render pool instead of accumulating gigabytes of rendered
+    records in RAM. `writer_queue_bytes_max` records the high-water.
+
     `metrics` (an enabled telemetry registry, or None) records
     `writer_queue_depth_max` — records queued when the caller writes;
     maxsize means output I/O was the bottleneck."""
 
-    def __init__(self, streams, maxsize: int = 64, metrics=None):
+    def __init__(self, streams, maxsize: int = 64, metrics=None,
+                 max_bytes: int | None = None):
         self.streams = list(streams)
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self.err: BaseException | None = None
         self._raised = False
+        self.max_bytes = (_queue_bytes_budget(
+            "QUORUM_WRITER_QUEUE_BYTES", "256M")
+            if max_bytes is None else int(max_bytes))
+        self._cv = threading.Condition()
+        self._pending_bytes = 0
         self._depth_g = (metrics.gauge("writer_queue_depth_max")
                          if metrics else None)
+        self._bytes_g = (metrics.gauge("writer_queue_bytes_max")
+                         if metrics and self.max_bytes else None)
         self.t = threading.Thread(target=self._loop, daemon=True)
         self.t.start()
 
@@ -223,10 +320,17 @@ class AsyncWriter:
                         self.err = e
                 item[1].set()
                 continue
+            i, text = item
+            if self.max_bytes:
+                with self._cv:
+                    self._pending_bytes -= len(text)
+                    self._cv.notify_all()
             if self.err is not None:
                 continue  # drain without writing after a failure
-            i, text = item
             try:
+                faults.inject("writer.stream", batch=i,
+                              path=getattr(self.streams[i], "name",
+                                           None))
                 self.streams[i].write(text)
             except BaseException as e:  # noqa: BLE001 - surfaced in close
                 self.err = e
@@ -248,6 +352,20 @@ class AsyncWriter:
             self._raised = True
             raise self.err  # fail fast, not after gigabases into a dead pipe
         if text:
+            if self.max_bytes:
+                with self._cv:
+                    # always admit into an empty buffer: a single
+                    # over-budget record degrades to synchronous
+                    while (self._pending_bytes > 0
+                           and self._pending_bytes + len(text)
+                           > self.max_bytes):
+                        if self.err is not None:
+                            break  # close() surfaces it
+                        self._cv.wait(0.2)
+                    self._pending_bytes += len(text)
+                    high = self._pending_bytes
+                if self._bytes_g is not None:
+                    self._bytes_g.set_max(high)
             if self._depth_g is not None:
                 self._depth_g.set_max(self.q.qsize() + 1)
             self.q.put((i, text))

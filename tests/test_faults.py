@@ -127,6 +127,65 @@ def test_fault_env_reinstall_keeps_counters(monkeypatch):
         faults.inject("t")
 
 
+def test_fault_io_error_errno(tmp_path):
+    """io_error with errno= raises a REAL errno-classed OSError so
+    error-class-sensitive paths (the resource ladder dispatches on
+    ENOSPC) can be driven (ISSUE 19)."""
+    import errno
+    faults.install(faults.FaultPlan.parse(
+        [{"site": "w", "action": "io_error", "errno": 28,
+          "message": "device full"}]))
+    with pytest.raises(OSError, match="device full") as ei:
+        faults.inject("w")
+    assert ei.value.errno == errno.ENOSPC
+    with pytest.raises(ValueError, match="errno"):
+        faults.FaultPlan.parse([{"site": "w", "action": "io_error",
+                                 "errno": 0}])
+
+
+def test_fault_diskfull_budget_and_persistence(tmp_path):
+    """diskfull charges each matching write against its byte budget
+    and fails ENOSPC once past it — and STAYS failing: full disks do
+    not empty themselves (ISSUE 19)."""
+    import errno
+    f = tmp_path / "a.bin"
+    f.write_bytes(b"x" * 100)
+    faults.install(faults.FaultPlan.parse(
+        [{"site": "w", "action": "diskfull", "bytes": 150,
+          "count": -1}]))
+    faults.inject("w", path=str(f))   # 100 charged: under budget
+    with pytest.raises(OSError) as ei:
+        faults.inject("w", path=str(f))  # 200 charged: full
+    assert ei.value.errno == errno.ENOSPC
+    with pytest.raises(OSError):
+        faults.inject("w", path=str(f))  # stays full
+    # bytes defaults to 0 for diskfull — "already full": first write
+    # fails (a pathless call charges 1 token)
+    faults.install(faults.FaultPlan.parse(
+        [{"site": "w", "action": "diskfull", "count": -1}]))
+    with pytest.raises(OSError) as ei:
+        faults.inject("w", path=str(f))
+    assert ei.value.errno == errno.ENOSPC
+
+
+def test_fault_path_prefix_scoping(tmp_path):
+    """path_prefix scopes a spec to one artifact family: calls with a
+    different path, or no path at all, never match (one full
+    filesystem, not a full machine)."""
+    target = tmp_path / "ck"
+    target.mkdir()
+    (target / "s.ckpt").write_bytes(b"x" * 10)
+    faults.install(faults.FaultPlan.parse(
+        [{"site": "w", "action": "diskfull", "count": -1,
+          "path_prefix": str(target)}]))
+    faults.inject("w")                               # no path: no match
+    faults.inject("w", path=str(tmp_path / "other"))  # other fs: no match
+    with pytest.raises(OSError):
+        faults.inject("w", path=str(target / "s.ckpt"))
+    with pytest.raises(ValueError, match="path_prefix"):
+        faults.FaultPlan.parse([{"site": "w", "path_prefix": ""}])
+
+
 # ---------------------------------------------------------------------------
 # malformed-FASTQ degradation (--on-bad-read)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,414 @@
+"""Resource-exhaustion robustness tests (ISSUE 19): the writer
+degradation ladder (a parameterized ENOSPC sweep over the whole
+WRITERS catalog), disk preflight in all three modes, the monitor
+ticker's gauge surface, the offline stall watchdog's soft abort, the
+metrics_check resource-guard gate, and the end-to-end truths — an
+out-of-space OPTIONAL writer degrades while the run completes
+byte-identically, a kill after the degradation still resumes to the
+same table, and an out-of-space REQUIRED writer fails fast with the
+non-retryable DISK_FULL_RC and a sealed flight dump naming it.
+
+The unit tests drive utils/resources directly under a throwaway
+frame; the end-to-end tests run the real stage-1 CLI over the small
+synthetic dataset the other chaos suites use (shared jit shapes) with
+the `diskfull` fault action standing in for the full filesystem.
+"""
+
+import conftest  # noqa: F401  (pins CPU devices)
+
+import errno
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import pytest
+
+from quorum_tpu.cli import create_database as cdb_cli
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.io import db_format
+from quorum_tpu.telemetry import flight as flight_mod
+from quorum_tpu.telemetry import registry_for
+from quorum_tpu.telemetry.registry import labeled
+from quorum_tpu.utils import faults, resources
+
+from test_error_correct_cli import K, make_dataset
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts and ends without a fault plan or a leaked
+    resource-guard frame."""
+    faults.reset()
+    yield
+    faults.reset()
+    resources._FRAME = resources._Frame(None, None)
+
+
+def _enospc():
+    return OSError(errno.ENOSPC, "no space left on device")
+
+
+# ---------------------------------------------------------------------------
+# the catalog and the errno family
+# ---------------------------------------------------------------------------
+
+def test_writer_catalog_classification():
+    # the required set is the run's reason to exist — growing it is a
+    # semantic change (the driver stops retrying those failures)
+    required = {w for w, c in resources.WRITERS.items()
+                if c == resources.REQUIRED}
+    assert required == {"db.payload", "output.stream", "stage2.journal"}
+    assert all(c in (resources.REQUIRED, resources.OPTIONAL)
+               for c in resources.WRITERS.values())
+    # the rc family stays disjoint from the existing non-retryable rc
+    assert resources.DISK_FULL_RC == 4
+    assert resources.STALL_RC == 75
+    assert ckpt_mod.NON_RETRYABLE_RC not in (resources.DISK_FULL_RC,
+                                             resources.STALL_RC)
+
+
+def test_is_enospc_family():
+    assert resources.is_enospc(_enospc())
+    assert resources.is_enospc(OSError(errno.EDQUOT, "quota"))
+    assert resources.is_enospc(resources.ResourceExhausted("x", "d"))
+    assert not resources.is_enospc(OSError(errno.ENOENT, "missing"))
+    assert not resources.is_enospc(ValueError("nope"))
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder: ENOSPC sweep over every declared writer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("writer", sorted(resources.WRITERS))
+def test_guard_ladders_every_writer(writer):
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg)
+    try:
+        if resources.WRITERS[writer] == resources.REQUIRED:
+            with pytest.raises(resources.ResourceExhausted) as ei:
+                with resources.guard(writer, path="/x/y"):
+                    raise _enospc()
+            assert ei.value.writer == writer
+            assert resources.is_enospc(ei.value)
+            # required writers fail fast, they never degrade
+            assert not resources.degraded(writer)
+            assert reg.counter("writer_degraded_total").value == 0
+        else:
+            with resources.guard(writer, path="/x/y"):
+                raise _enospc()  # swallowed: the writer degrades
+            assert resources.degraded(writer)
+            assert reg.counter("writer_degraded_total").value == 1
+            assert reg.counter(labeled("writer_degraded_total",
+                                       writer=writer)).value == 1
+            # EDQUOT ladders identically; the counter keeps counting
+            # but the first failure detail is retained
+            with resources.guard(writer, path="/x/z"):
+                raise OSError(errno.EDQUOT, "quota exceeded")
+            assert reg.counter("writer_degraded_total").value == 2
+            assert "/x/y" in resources.degraded_writers()[writer]
+    finally:
+        resources.uninstall(tok)
+
+
+def test_guard_passthrough_and_validation():
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg)
+    try:
+        # non-ENOSPC errors pass through untouched, optional or not
+        with pytest.raises(OSError, match="missing"):
+            with resources.guard("trace.spans"):
+                raise OSError(errno.ENOENT, "missing")
+        with pytest.raises(ValueError, match="bad"):
+            with resources.guard("stage2.journal"):
+                raise ValueError("bad")
+        assert not resources.degraded("trace.spans")
+        # a nested guard's ResourceExhausted is not laddered twice
+        with pytest.raises(resources.ResourceExhausted) as ei:
+            with resources.guard("stage1.checkpoint"):
+                raise resources.ResourceExhausted("db.payload", "inner")
+        assert ei.value.writer == "db.payload"
+        assert not resources.degraded("stage1.checkpoint")
+        # undeclared writers are a programming error, loudly
+        with pytest.raises(ValueError, match="undeclared writer"):
+            with resources.guard("not.a.writer"):
+                pass
+    finally:
+        resources.uninstall(tok)
+
+
+def test_frames_nest_and_isolate():
+    reg = registry_for(None, force=True)
+    outer = resources.install(reg)
+    resources.degrade("trace.spans", _enospc())
+    inner = resources.install(reg)
+    # a nested (in-process stage) frame starts with a clean slate
+    assert not resources.degraded("trace.spans")
+    resources.uninstall(inner)
+    assert resources.degraded("trace.spans")
+    resources.uninstall(outer)
+    assert not resources.degraded("trace.spans")
+
+
+# ---------------------------------------------------------------------------
+# preflight
+# ---------------------------------------------------------------------------
+
+def test_preflight_modes(tmp_path, capsys):
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg)
+    target = str(tmp_path / "out.db")
+    huge = shutil.disk_usage(str(tmp_path)).free + (1 << 30)
+    try:
+        with pytest.raises(ValueError, match="--preflight"):
+            resources.preflight("loud", {target: 1})
+        resources.preflight("off", {target: huge})  # silent no-op
+        resources.preflight("strict", {})           # nothing to check
+        resources.preflight("strict", {target: 1024})  # fits
+        resources.preflight("warn", {target: huge})
+        assert "preflight warning" in capsys.readouterr().err
+        assert reg.counter("preflight_refusals_total").value == 0
+        with pytest.raises(resources.ResourceExhausted,
+                           match="preflight refused"):
+            resources.preflight("strict", {target: huge})
+        assert reg.counter("preflight_refusals_total").value == 1
+        # a vanished estimate target is the writer's problem later,
+        # not a preflight crash
+        resources.preflight("strict",
+                            {str(tmp_path / "no" / "dir" / "f"): huge})
+    finally:
+        resources.uninstall(tok)
+
+
+def test_preflight_estimates(tmp_path):
+    small = resources.estimate_table_bytes(1 << 10, 13, 7)
+    big = resources.estimate_table_bytes(1 << 20, 13, 7)
+    assert 0 < small < big
+
+    out = str(tmp_path / "db.jf")
+    needs = resources.estimate_stage1_needs(out, 1 << 16, 13, 7)
+    assert set(needs) == {out}
+    ck = str(tmp_path / "ck")
+    needs = resources.estimate_stage1_needs(out, 1 << 16, 13, 7,
+                                            checkpoint_dir=ck)
+    # ~2 retained snapshots in the checkpoint dir
+    assert needs[ck] == 2 * needs[out]
+
+    fq = tmp_path / "r.fastq"
+    fq.write_bytes(b"x" * 1000)
+    gz = tmp_path / "r2.fastq.gz"
+    gz.write_bytes(b"x" * 1000)
+    out2 = str(tmp_path / "out.fa")
+    needs = resources.estimate_stage2_needs(out2, [str(fq), str(gz)])
+    # 1000 plain + 1000 * 4 (gz expansion), times the 1.2x factor
+    assert needs == {out2: int(5000 * 1.2)}
+    assert resources.estimate_stage2_needs(
+        out2, [str(tmp_path / "missing.fastq")]) == {}
+
+
+# ---------------------------------------------------------------------------
+# the monitor ticker and install() meta discipline
+# ---------------------------------------------------------------------------
+
+def test_install_arms_monitor_and_meta(tmp_path):
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg, watch_paths=(str(tmp_path / "o.db"),
+                                              str(tmp_path / "o.db"),
+                                              "", None))
+    try:
+        assert reg.meta.get("resource_guard") is True
+        # the synchronous first tick published the full gauge surface
+        assert reg.gauge("disk_free_bytes_min").value > 0
+        assert reg.gauge(labeled("disk_free_bytes",
+                                 path=str(tmp_path))).value > 0
+        assert reg.gauge("host_rss_bytes").value > 0
+        # the contract counters exist at zero (PR-7 zero-count lesson)
+        for name in ("writer_degraded_total", "preflight_refusals_total",
+                     "stall_aborts_total"):
+            assert reg.counter(name).value == 0
+    finally:
+        resources.uninstall(tok)
+
+
+def test_install_without_paths_declares_nothing():
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg)
+    try:
+        # no watched paths -> no monitor, so no resource_guard claim
+        # (metrics_check would require gauges that cannot exist)
+        assert "resource_guard" not in reg.meta
+        assert tok.monitor is None and tok.watchdog is None
+    finally:
+        resources.uninstall(tok)
+
+
+# ---------------------------------------------------------------------------
+# the offline stall watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_beat_is_noop_without_frame():
+    resources.watchdog_beat("anywhere", 0)  # no frame: must not raise
+
+
+def test_watchdog_soft_aborts_stalled_thread():
+    reg = registry_for(None, force=True)
+    tok = resources.install(reg, stall_timeout_s=0.3)
+    caught = threading.Event()
+
+    def worker():
+        resources.watchdog_beat("stage2.correct", 0)
+        try:
+            for _ in range(600):  # a wedged step, interruptible
+                time.sleep(0.01)
+        except resources.StallError:
+            # disarm the hard abort before unwinding, as the stage
+            # error paths do by tearing the frame down
+            resources.watchdog_beat("stage2.correct", 1)
+            caught.set()
+
+    try:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=10.0)
+        assert caught.is_set(), "watchdog never delivered StallError"
+        assert reg.counter("stall_aborts_total").value >= 1
+    finally:
+        resources.uninstall(tok)
+
+
+# ---------------------------------------------------------------------------
+# the metrics_check resource-guard gate (schema unit test)
+# ---------------------------------------------------------------------------
+
+def _mc():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import metrics_check
+    return metrics_check
+
+
+def _doc(meta=None, counters=None, gauges=None):
+    return {"schema": "quorum-tpu-metrics/1", "meta": meta or {},
+            "counters": counters or {}, "gauges": gauges or {},
+            "histograms": {}, "timers": {}}
+
+
+def test_metrics_check_requires_resource_surface(tmp_path):
+    mc = _mc()
+    counters = {"writer_degraded_total": 0,
+                "preflight_refusals_total": 0,
+                "stall_aborts_total": 0}
+    gauges = {"disk_free_bytes_min": 1e9, "host_rss_bytes": 1e8,
+              'disk_free_bytes{path="/data"}': 1e9}
+    ok = _doc(meta={"resource_guard": True}, counters=counters,
+              gauges=gauges)
+    assert mc._check_resource_names(ok) == []
+    # undeclared documents are not held to the surface
+    assert mc._check_resource_names(_doc()) == []
+    # 3 missing counters + 2 missing gauges + no labeled gauge
+    errs = mc._check_resource_names(_doc(meta={"resource_guard": True}))
+    assert len(errs) == 6
+    # the labeled per-path gauge is required even with the scalars
+    bare = _doc(meta={"resource_guard": True}, counters=counters,
+                gauges={"disk_free_bytes_min": 1e9,
+                        "host_rss_bytes": 1e8})
+    errs = mc._check_resource_names(bare)
+    assert len(errs) == 1 and "labeled gauge" in errs[0]
+    # end to end through the file checker
+    p = str(tmp_path / "d.json")
+    json.dump(ok, open(p, "w"))
+    assert mc.main([p, "-q"]) == 0
+    json.dump(_doc(meta={"resource_guard": True}), open(p, "w"))
+    assert mc.main([p, "-q"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# end to end: the ladder through the real stage-1 pipeline
+# ---------------------------------------------------------------------------
+
+def _db_entries(path):
+    state, meta, _ = db_format.read_db(path, to_device=False)
+    khi, klo, vals = db_format.db_iterate(state, meta)
+    return sorted(zip(khi.tolist(), klo.tolist(), vals.tolist()))
+
+
+BASE_ARGS = ["-s", "64k", "-m", str(K), "-b", "7", "-q", "38",
+             "--batch-size", "64"]
+
+
+def test_stage1_checkpoint_enospc_degrades_run_completes(tmp_path):
+    """An out-of-space checkpoint writer (optional) degrades: the run
+    completes, the table is byte-identical to an unfaulted build, and
+    the degradation is counted in a document metrics_check accepts."""
+    reads_path, _r, _q = make_dataset(tmp_path)
+    db0 = str(tmp_path / "db0.jf")
+    assert cdb_cli.main(BASE_ARGS + ["-o", db0, reads_path]) == 0
+
+    db1 = str(tmp_path / "db1.jf")
+    ckdir = str(tmp_path / "ck")
+    mpath = str(tmp_path / "m.json")
+    plan = json.dumps([{"site": "checkpoint.commit",
+                        "action": "diskfull", "count": -1}])
+    rc = cdb_cli.main(BASE_ARGS + [
+        "-o", db1, "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--fault-plan", plan, "--metrics", mpath, reads_path])
+    assert rc == 0
+    assert _db_entries(db1) == _db_entries(db0)
+    doc = json.load(open(mpath))
+    assert doc["counters"]["writer_degraded_total"] >= 1
+    assert doc["meta"]["resource_guard"] is True
+    assert _mc().main([mpath, "-q"]) == 0
+
+
+def test_stage1_kill_resume_after_degraded_checkpoints(tmp_path):
+    """Checkpoints that DEGRADE mid-run (disk filled at the third
+    commit) then a kill: the resume — a fresh process, so the writer
+    re-enables — continues from the last GOOD checkpoint and
+    converges on the unfaulted table."""
+    reads_path, _r, _q = make_dataset(tmp_path)
+    db0 = str(tmp_path / "db0.jf")
+    assert cdb_cli.main(BASE_ARGS + ["-o", db0, reads_path]) == 0
+
+    db1 = str(tmp_path / "db1.jf")
+    ckdir = str(tmp_path / "ck")
+    plan = json.dumps([
+        {"site": "checkpoint.commit", "action": "diskfull",
+         "at": 3, "count": -1},
+        {"site": "stage1.insert", "batch": 3, "action": "error"},
+    ])
+    rc = cdb_cli.main(BASE_ARGS + [
+        "-o", db1, "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--fault-plan", plan, reads_path])
+    assert rc == 1
+    assert not os.path.exists(db1)
+    # the checkpoint.commit site fires AFTER the atomic replace, so
+    # the third snapshot itself landed before the injected ENOSPC
+    # degraded the writer: three commits are durable
+    assert ckpt_mod.Stage1Checkpoint(ckdir).cursor() == 3
+
+    rc = cdb_cli.main(BASE_ARGS + [
+        "-o", db1, "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+        "--resume", "--fault-plan", "", reads_path])
+    assert rc == 0
+    assert _db_entries(db1) == _db_entries(db0)
+
+
+def test_stage1_db_export_enospc_fails_fast_with_dump(tmp_path):
+    """An out-of-space DB export (required) is the non-retryable
+    DISK_FULL_RC with a sealed flight dump naming the writer."""
+    reads_path, _r, _q = make_dataset(tmp_path)
+    db1 = str(tmp_path / "db1.jf")
+    mpath = str(tmp_path / "m.json")
+    plan = json.dumps([{"site": "db.write", "action": "diskfull",
+                        "count": -1}])
+    rc = cdb_cli.main(BASE_ARGS + [
+        "-o", db1, "--fault-plan", plan, "--metrics", mpath,
+        reads_path])
+    assert rc == resources.DISK_FULL_RC
+    dump_path = flight_mod.default_out_path(mpath)
+    assert os.path.exists(dump_path)
+    dump = json.load(open(dump_path))
+    assert dump["trigger"]["kind"] == "disk_full"
+    assert dump["trigger"]["site"] == "db.payload"

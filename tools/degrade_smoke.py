@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Degradation-ladder smoke for CI (ISSUE 19, ci/tier1.sh): out of
+space must mean *less telemetry*, never *less output* — and a wedged
+step must die retryably, not hang a CI lane forever.
+
+Three gates in one tool:
+
+1. **Optional writer degrades, run completes**: the golden database
+   build with per-batch checkpoints whose checkpoint filesystem
+   "fills" (fault action ``diskfull`` at ``checkpoint.commit``) must
+   exit 0 with a table identical to an unfaulted build,
+   ``writer_degraded_total`` counted, ``meta.resource_guard``
+   declared, and a final document tools/metrics_check.py accepts
+   (the resource-guard contract gates it).
+
+2. **Required writer fails fast**: the same build with the DB export
+   itself out of space (``diskfull`` at ``db.write``) must exit with
+   the non-retryable ``DISK_FULL_RC`` and seal exactly one flight
+   dump whose trigger is kind ``disk_full`` naming writer
+   ``db.payload`` — the postmortem pinpoints WHICH writer hit the
+   wall.
+
+3. **Stall watchdog, then resume**: a subprocess stage-2 run wedged
+   by a ``sleep`` fault at ``stage2.correct`` under
+   ``--stall-timeout-s`` must exit ``STALL_RC`` (the hard abort — a
+   thread sleeping in native code never sees the soft async raise,
+   which is exactly the wedge the two-stage design exists for) and
+   leave a ``stall``-kind flight dump plus an intact journal; the
+   ``--resume`` rerun must converge on output byte-identical to an
+   unfaulted run.
+
+Artifacts land in --out-dir:
+  degrade_metrics.json         — gate 1's final document
+  diskfull_metrics.json        — gate 2's error document
+  diskfull_metrics.flight.json — gate 2's sealed disk_full dump
+  stall_metrics.flight.json    — gate 3's sealed stall dump
+
+Exit 0 = all gates held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def _fail(msg: str) -> int:
+    print(f"[degrade_smoke] FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _db_entries(path):
+    from quorum_tpu.io import db_format
+    state, meta, _ = db_format.read_db(path, to_device=False)
+    khi, klo, vals = db_format.db_iterate(state, meta)
+    return sorted(zip(khi.tolist(), klo.tolist(), vals.tolist()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Degradation-ladder smoke: an out-of-space "
+                    "optional writer degrades while the run "
+                    "completes byte-identically, a required writer "
+                    "fails fast with DISK_FULL_RC + a sealed dump, "
+                    "and a wedged stage-2 step exits STALL_RC then "
+                    "resumes (ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Artifact directory (default: a temp dir)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="degrade_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    from quorum_tpu.cli import create_database as cdb_cli
+    from quorum_tpu.cli import error_correct_reads as ec_cli
+    from quorum_tpu.telemetry import schema as schema_mod
+    from quorum_tpu.utils import faults, resources
+
+    mc = _load_tool("metrics_check")
+    reads = os.path.join(GOLDEN, "reads.fastq")
+    cdb_args = ["-s", "64k", "-m", "13", "-b", "7", "-q", "38",
+                "--batch-size", "64"]
+
+    # the unfaulted reference build: gates 1 and 2 compare against it
+    db0 = os.path.join(out_dir, "db0.jf")
+    if cdb_cli.main(cdb_args + ["-o", db0, reads]) != 0:
+        return _fail("reference golden build failed")
+
+    # -- gate 1: optional writer degrades, the run completes ----------------
+    print("[degrade_smoke] gate 1: diskfull at checkpoint.commit "
+          "(optional writer)")
+    db1 = os.path.join(out_dir, "db1.jf")
+    ckdir = os.path.join(out_dir, "ck")
+    metrics1 = os.path.join(out_dir, "degrade_metrics.json")
+    faults.install(faults.FaultPlan.parse(
+        {"site": "checkpoint.commit", "action": "diskfull",
+         "count": -1}), "degrade-smoke")
+    try:
+        rc = cdb_cli.main(cdb_args + [
+            "-o", db1, "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1", "--metrics", metrics1, reads])
+    finally:
+        faults.reset()
+    if rc != 0:
+        return _fail(f"gate 1: rc={rc} (an optional writer's ENOSPC "
+                     "must not fail the run)")
+    if _db_entries(db1) != _db_entries(db0):
+        return _fail("gate 1: degraded-checkpoint table differs from "
+                     "the unfaulted build")
+    with open(metrics1) as f:
+        doc = json.load(f)
+    if doc.get("counters", {}).get("writer_degraded_total", 0) < 1:
+        return _fail("gate 1: writer_degraded_total not counted")
+    if doc.get("meta", {}).get("resource_guard") is not True:
+        return _fail("gate 1: final document does not declare "
+                     "meta.resource_guard")
+    if mc.main([metrics1, "-q"]) != 0:
+        return _fail("gate 1: metrics_check rejected the document")
+    print("[degrade_smoke] gate 1: degraded, completed, identical "
+          "table")
+
+    # -- gate 2: required writer fails fast with a sealed dump --------------
+    print("[degrade_smoke] gate 2: diskfull at db.write (required "
+          "writer)")
+    db2 = os.path.join(out_dir, "db2.jf")
+    metrics2 = os.path.join(out_dir, "diskfull_metrics.json")
+    faults.install(faults.FaultPlan.parse(
+        {"site": "db.write", "action": "diskfull", "count": -1}),
+        "degrade-smoke")
+    try:
+        rc = cdb_cli.main(cdb_args + ["-o", db2, "--metrics", metrics2,
+                                      reads])
+    finally:
+        faults.reset()
+    if rc != resources.DISK_FULL_RC:
+        return _fail(f"gate 2: rc={rc} (want the non-retryable "
+                     f"DISK_FULL_RC={resources.DISK_FULL_RC})")
+    dump2 = metrics2[:-len(".json")] + ".flight.json"
+    if not os.path.exists(dump2):
+        return _fail(f"gate 2: no flight dump at {dump2}")
+    with open(dump2) as f:
+        fdoc = json.load(f)
+    errs = schema_mod.validate_flight_dump(fdoc)
+    if errs:
+        return _fail(f"gate 2: dump invalid: {errs[:3]}")
+    trig = fdoc.get("trigger", {})
+    if trig.get("kind") != "disk_full":
+        return _fail(f"gate 2: trigger kind {trig.get('kind')!r} "
+                     "(want 'disk_full')")
+    if trig.get("site") != "db.payload":
+        return _fail(f"gate 2: trigger site {trig.get('site')!r} "
+                     "(want the writer name 'db.payload')")
+    if mc.main([dump2, "-q"]) != 0 or mc.main([metrics2, "-q"]) != 0:
+        return _fail("gate 2: metrics_check rejected the dump or the "
+                     "error document")
+    print("[degrade_smoke] gate 2: DISK_FULL_RC with a dump naming "
+          "db.payload")
+
+    # -- gate 3: stall watchdog aborts retryably, resume converges ----------
+    # Subprocess on purpose: the wedge is a thread blocked in native
+    # sleep, so the watchdog escalates to the hard abort
+    # (os._exit(STALL_RC)) — which must kill the CHILD, not this tool.
+    print("[degrade_smoke] gate 3: seeded stall at stage2.correct "
+          "(subprocess)")
+    ec_args = ["--batch-size", "16", "--checkpoint-every", "1"]
+    prefix0 = os.path.join(out_dir, "out0")
+    if ec_cli.main(ec_args + ["-o", prefix0, db0, reads]) != 0:
+        return _fail("gate 3: reference stage-2 run failed")
+    prefix = os.path.join(out_dir, "out1")
+    metrics3 = os.path.join(out_dir, "stall_metrics.json")
+    plan = json.dumps({"site": "stage2.correct", "batch": 2,
+                       "action": "sleep", "seconds": 30})
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("QUORUM_FAULT_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "quorum_tpu.cli.error_correct_reads"]
+        + ec_args + ["-o", prefix, "--stall-timeout-s", "1",
+                     "--fault-plan", plan, "--metrics", metrics3,
+                     db0, reads],
+        cwd=REPO, env=env, timeout=600,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    if proc.returncode != resources.STALL_RC:
+        return _fail(f"gate 3: rc={proc.returncode} (want the "
+                     f"retryable STALL_RC={resources.STALL_RC}); "
+                     f"stderr tail: {proc.stderr[-500:]}")
+    dump3 = metrics3[:-len(".json")] + ".flight.json"
+    if not os.path.exists(dump3):
+        return _fail(f"gate 3: no stall dump at {dump3}")
+    with open(dump3) as f:
+        sdoc = json.load(f)
+    if sdoc.get("trigger", {}).get("kind") != "stall":
+        return _fail(f"gate 3: trigger kind "
+                     f"{sdoc.get('trigger', {}).get('kind')!r} "
+                     "(want 'stall')")
+    if mc.main([dump3, "-q"]) != 0:
+        return _fail("gate 3: metrics_check rejected the stall dump")
+    # the journal survived the hard abort: resume and converge
+    rc = ec_cli.main(ec_args + ["-o", prefix, "--resume", db0, reads])
+    if rc != 0:
+        return _fail(f"gate 3: --resume rerun rc={rc}")
+    with open(prefix0 + ".fa", "rb") as f:
+        want = f.read()
+    with open(prefix + ".fa", "rb") as f:
+        got = f.read()
+    if got != want:
+        return _fail("gate 3: resumed output differs from the "
+                     "unfaulted run")
+    print("[degrade_smoke] gate 3: STALL_RC, stall dump, resumed "
+          "byte-identical")
+
+    print(f"[degrade_smoke] OK: less telemetry, never less output; "
+          f"artifacts -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,10 @@ counters/gauges with `alerts_firing{rule=}` values in {0, 1} naming
 declared rules; `meta.autotune_profile`, when present, must be a
 non-empty path. A document declaring meta.flight (the flight
 recorder was installed and enabled, ISSUE 16) must carry the
-dump/drop counters (FLIGHT_COUNTERS); flight dump documents
+dump/drop counters (FLIGHT_COUNTERS); one declaring
+meta.resource_guard (utils/resources armed a disk monitor, ISSUE 19)
+must carry the guard counters and monitor gauges (RESOURCE_*);
+flight dump documents
 (quorum-tpu-flight/1) and debug-bundle manifests
 (quorum-tpu-debug-bundle/1) validate through their own schema
 validators, seal recomputed. perf_diff verdict documents
@@ -97,6 +100,9 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     QUALITY_COUNTERS,
     QUALITY_GAUGES,
     QUALITY_HISTOGRAMS,
+    RESOURCE_COUNTERS,
+    RESOURCE_GAUGE_PREFIX,
+    RESOURCE_GAUGES,
     SERVE_FEATURE_COUNTERS,
     SERVE_REQUIRED_COUNTERS,
     SERVE_REQUIRED_HISTOGRAMS,
@@ -443,6 +449,36 @@ def _check_live_ingest_names(doc: dict) -> list[str]:
     return errs
 
 
+def _check_resource_names(doc: dict) -> list[str]:
+    """Resource-guard requirements (ISSUE 19): dispatch on
+    meta.resource_guard — utils/resources.install stamps it when a
+    disk monitor is armed over the run's artifact filesystems, and
+    pre-creates the guard counters, so a missing name means the
+    guard telemetry regressed. The monitor publishes its gauges at a
+    synchronous first tick, so they must exist even in a run that
+    finished inside one poll interval; at least one per-path
+    `disk_free_bytes{path="..."}` labeled gauge must ride along (the
+    path SET is run-shaped, so no individual path is required)."""
+    errs = []
+    meta = doc.get("meta", {})
+    if not meta.get("resource_guard"):
+        return errs
+    why = f"meta.resource_guard={meta.get('resource_guard')!r}"
+    for name in RESOURCE_COUNTERS:
+        if name not in doc.get("counters", {}):
+            errs.append(f"document with {why} missing counter "
+                        f"{name!r}")
+    gauges = doc.get("gauges", {})
+    for name in RESOURCE_GAUGES:
+        if name not in gauges:
+            errs.append(f"document with {why} missing gauge {name!r}")
+    if not any(g.startswith(RESOURCE_GAUGE_PREFIX) for g in gauges):
+        errs.append(f"document with {why} carries no "
+                    f"{RESOURCE_GAUGE_PREFIX}...}} labeled gauge "
+                    "(the disk monitor never ticked)")
+    return errs
+
+
 def _check_serve_names(doc: dict) -> list[str]:
     errs = []
     for name in SERVE_REQUIRED_COUNTERS:
@@ -504,6 +540,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_flight_names(doc)
         problems = problems + _check_quality_names(doc)
         problems = problems + _check_live_ingest_names(doc)
+        problems = problems + _check_resource_names(doc)
     return problems
 
 
