@@ -276,6 +276,141 @@ def run_multichip(ns=(1, 2, 4, 8)):
     return results
 
 
+def run_fleet(pcs=(1, 2), out=None):
+    """Multi-host fleet throughput probe (ISSUE 20): the quorum driver
+    END TO END at `--num-processes pc` for pc in {1, 2} — pc=1 is a
+    plain single-process run, pc=2 is a REAL 2-process fleet over
+    `jax.distributed` (two subprocesses, localhost coordinator), both
+    at the SAME planned geometry (--partitions 2) so the corrected
+    output must be byte-identical across points. FLEET_r*.json carries
+    measured Gbases/hour per process count with parity attested, plus
+    a modeled-vs-measured line built on tools/comm_model.py: the fleet
+    data plane moves ZERO cross-host bytes (stage 1 is partition-
+    binned per host, stage 2 is file-owned per host), so the model
+    predicts linear scaling — the measured ratio shows what the
+    control plane (barriers + KB-scale KV exchanges) actually costs.
+
+    Every point runs in a subprocess (the fleet points must — SPMD
+    over jax.distributed — so pc=1 does too, keeping interpreter
+    startup and compile-cache conditions identical across points)."""
+    from quorum_tpu.utils.jaxcache import enable_cache
+    enable_cache()
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    tmp = "/tmp/quorum_fleet_bench"
+    os.makedirs(tmp, exist_ok=True)
+    rng = np.random.default_rng(5)
+    genome = rng.integers(0, 4, size=120_000, dtype=np.int8)
+    batch = int(os.environ.get("QUORUM_MULTICHIP_BATCH", "128"))
+    k_fl = int(os.environ.get("QUORUM_MULTICHIP_K", str(K)))
+    read_len = 100
+    n_reads = 8 * batch
+    codes, quals, _starts, _errs = synth_reads(rng, genome, n_reads,
+                                               read_len, 0.01)
+    # two input files: the fleet's per-host producer unit is the file
+    half = n_reads // 2
+    fqs = [f"{tmp}/reads_part{i}.fastq" for i in range(2)]
+    write_fastq(fqs[0], codes[:half], quals[:half])
+    write_fastq(fqs[1], codes[half:], quals[half:])
+    bases = n_reads * read_len
+    size = int((len(genome) + bases * 0.01 * k_fl * 1.3) * 1.25) \
+        + 200_000
+    base = ["-s", str(size), "-k", str(k_fl), "-q", "33",
+            "--batch-size", str(batch), "--devices", "1",
+            "--partitions", "2"]
+
+    def launch(pc, prefix):
+        env = dict(os.environ)
+        # a wedged fleet must die loudly inside the bench budget
+        env.setdefault("QUORUM_FLEET_BARRIER_TIMEOUT_S", "300")
+        procs = []
+        if pc == 1:
+            argvs = [base + ["-p", prefix] + fqs]
+        else:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            argvs = [base + ["-p", prefix,
+                             "--coordinator", f"127.0.0.1:{port}",
+                             "--num-processes", str(pc),
+                             "--process-id", str(pid)] + fqs
+                     for pid in range(pc)]
+        for argv in argvs:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "quorum_tpu.cli.quorum"] + argv,
+                env=env))
+        return [p.wait() for p in procs]
+
+    results = {}
+    ref_fa = ref_log = None
+    parity_ok = True
+    for pc in pcs:
+        prefix = f"{tmp}/out_p{pc}"
+        t0 = time.perf_counter()
+        rcs = launch(pc, prefix)
+        dt = time.perf_counter() - t0
+        assert rcs == [0] * pc, \
+            f"quorum driver failed at process_count {pc}: rcs {rcs}"
+        gb_h = round(bases / dt * 3600 / 1e9, 3)
+        fa = open(prefix + ".fa", "rb").read()
+        lg = open(prefix + ".log", "rb").read()
+        if ref_fa is None:
+            ref_fa, ref_log = fa, lg
+        par = fa == ref_fa and lg == ref_log
+        parity_ok = parity_ok and par
+        results[pc] = gb_h
+        print(metric_line(
+            "fleet_throughput", process_count=pc, value=gb_h,
+            unit="Gbases/hour", seconds=round(dt, 2), bases=bases,
+            parity_vs_single=("byte-identical" if par else "MISMATCH")))
+        assert par, (f"process_count {pc} output differs from "
+                     "single-process")
+
+    # modeled-vs-measured: the comm model's replicated-layout point is
+    # the fleet's exactly — zero per-iteration cross-host bytes — so
+    # the per-host device term is the whole per-batch cost and the
+    # fleet model is pc * single-host throughput
+    import importlib.util
+    cm_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "comm_model.py")
+    spec = importlib.util.spec_from_file_location("comm_model", cm_path)
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+    v5e_host_gbh = (cm.V5E_BASES_PER_BATCH
+                    / cm.V5E_DEVICE_S_PER_16K_BATCH * 3600 / 1e9)
+    pc_hi = max(results)
+    measured = (round(results[pc_hi] / results[1], 3)
+                if results.get(1) else None)
+    print(metric_line(
+        "fleet_modeled_vs_measured",
+        modeled_speedup=float(pc_hi), measured_speedup=measured,
+        process_count=pc_hi,
+        modeled_gb_h_v5e_per_host=round(v5e_host_gbh, 1),
+        modeled_gb_h_v5e_fleet=round(pc_hi * v5e_host_gbh, 1),
+        model="tools/comm_model.py replicated layout: zero cross-host "
+              "data-plane bytes (partition-binned stage 1, file-owned "
+              "stage 2); gap vs linear = control plane (barriers + "
+              "KB-scale KV exchanges) + duplicated stage-1 parse"))
+    if out:
+        with open(out, "w") as f:
+            json.dump({
+                "gb_h_by_process_count": results,
+                "bases": bases,
+                "parity": ("byte-identical" if parity_ok
+                           else "MISMATCH"),
+                "modeled_speedup": float(pc_hi),
+                "measured_speedup": measured,
+                "modeled_gb_h_v5e_per_host": round(v5e_host_gbh, 1),
+                "modeled_gb_h_v5e_fleet": round(pc_hi * v5e_host_gbh,
+                                                1),
+            }, f, indent=1)
+            f.write("\n")
+    return results
+
+
 def run_ab():
     """Within-process A/B probes of the round-7 device levers (the
     measurement discipline PERF_NOTES demands: tunnel throughput
@@ -922,6 +1057,11 @@ if __name__ == "__main__":
 
     if "--multichip" in sys.argv[1:]:
         run_multichip()
+    elif "--fleet" in sys.argv[1:]:
+        out = None
+        if "--fleet-out" in sys.argv[1:]:
+            out = sys.argv[sys.argv.index("--fleet-out") + 1]
+        run_fleet(out=out)
     elif "--ab" in sys.argv[1:]:
         run_ab()
     else:

@@ -137,6 +137,17 @@
 # documents go through metrics_check (which requires the RESOURCE_*
 # counter/gauge surface when meta declares resource_guard).
 #
+# ISSUE 20 adds the multi-host fleet gate: tools/fleet_smoke.py — a
+# REAL 2-process CPU fleet (two driver subprocesses over
+# jax.distributed + the coordination-service transport,
+# --coordinator/--num-processes/--process-id) corrects the golden
+# reads split across two input files, byte-compared (database table
+# payload, .fa, .log) against the single-process run at the same
+# planned geometry; then one host is hard-killed mid-stage-1 and a
+# fleet --resume must converge byte-identical. The ONE aggregated
+# fleet document (meta.host_process_count=2, per-host shards,
+# min-reduced resource gauges) goes through metrics_check.
+#
 # Usage: ci/tier1.sh [pytest args...]
 # Env:   SKIP_SERVE_SMOKE=1   skips the serve gate (pytest only).
 #        SKIP_RESUME_SMOKE=1  skips the kill-resume gate.
@@ -150,6 +161,7 @@
 #        SKIP_QUALITY_DIFF=1  skips the accuracy-regression gate.
 #        SKIP_LIVE_SMOKE=1    skips the live-ingestion gate.
 #        SKIP_DEGRADE_SMOKE=1 skips the resource-exhaustion gate.
+#        SKIP_FLEET_SMOKE=1   skips the multi-host fleet gate.
 #        SKIP_QLINT=1         skips quorum-lint AND the QUORUM_TSAN
 #                             sanitizer on the pytest pass.
 #        SKIP_COMPILE_SENTINEL=1  skips the runtime compile sentinel
@@ -554,6 +566,51 @@ else
     fi
 fi
 
+fleet_rc=0
+if [ "${SKIP_FLEET_SMOKE:-0}" = "1" ]; then
+    echo "ci/tier1.sh: fleet smoke skipped (SKIP_FLEET_SMOKE=1)"
+else
+    # the multi-host fleet gate (ISSUE 20): a real 2-process fleet
+    # over jax.distributed, byte parity vs single-process, then a
+    # kill-one-host fleet --resume converging byte-identical; the
+    # aggregated fleet document is gated through metrics_check
+    echo "== golden 2-process fleet run =="
+    FLEET_DIR=$(mktemp -d /tmp/fleet_smoke.XXXXXX)
+    trap 'rm -rf "${SMOKE_DIR:-}" "${RESUME_DIR:-}" "${MC_DIR:-}" "${AB_DIR:-}" "${CHAOS_DIR:-}" "${FSCK_DIR:-}" "${TEL_DIR:-}" "${FLIGHT_DIR:-}" "${PERF_DIR:-}" "${QUAL_DIR:-}" "${LIVE_DIR:-}" "${DEG_DIR:-}" "$FLEET_DIR"' EXIT
+    timeout -k 10 780 env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+        python tools/fleet_smoke.py \
+        --out-dir "$FLEET_DIR" || fleet_rc=$?
+    if [ "$fleet_rc" -eq 0 ]; then
+        echo "== metrics_check gate (fleet) =="
+        env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+            "$FLEET_DIR/fleet_metrics.hosts.json" \
+            "$FLEET_DIR/fleet_metrics.host0000.json" \
+            "$FLEET_DIR/fleet_metrics.host0001.json" || fleet_rc=1
+    fi
+    if [ "$fleet_rc" -eq 0 ]; then
+        # the fleet throughput probe at small shapes — parity is
+        # asserted inside bench.run_fleet; the fresh document is
+        # gated like the bench A/B one (FLEET_r*.json is the same
+        # probe at production shapes)
+        echo "== bench fleet probe =="
+        timeout -k 10 600 env JAX_PLATFORMS=cpu \
+            JAX_COMPILATION_CACHE_DIR=/tmp/quorum_tpu_test_jaxcache \
+            QUORUM_MULTICHIP_BATCH=64 QUORUM_MULTICHIP_K=15 \
+            python bench.py --fleet \
+            > "$FLEET_DIR/bench_fleet.json" || fleet_rc=$?
+        if [ "$fleet_rc" -eq 0 ]; then
+            env JAX_PLATFORMS=cpu python tools/metrics_check.py \
+                --require-metric fleet_throughput \
+                --require-metric fleet_modeled_vs_measured \
+                "$FLEET_DIR/bench_fleet.json" || fleet_rc=1
+        fi
+    fi
+    if [ "$fleet_rc" -ne 0 ]; then
+        echo "ci/tier1.sh: fleet gate FAILED (rc=$fleet_rc)" >&2
+    fi
+fi
+
 if [ "$qlint_rc" -ne 0 ]; then exit "$qlint_rc"; fi
 if [ "$pytest_rc" -ne 0 ]; then exit "$pytest_rc"; fi
 if [ "$serve_rc" -ne 0 ]; then exit "$serve_rc"; fi
@@ -568,4 +625,5 @@ if [ "$perf_rc" -ne 0 ]; then exit "$perf_rc"; fi
 if [ "$quality_rc" -ne 0 ]; then exit "$quality_rc"; fi
 if [ "$live_rc" -ne 0 ]; then exit "$live_rc"; fi
 if [ "$degrade_rc" -ne 0 ]; then exit "$degrade_rc"; fi
+if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
 echo "ci/tier1.sh: ALL GREEN"

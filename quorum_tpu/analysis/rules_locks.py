@@ -50,6 +50,7 @@ SCOPE = (
     "quorum_tpu/utils/faults.py",
     "quorum_tpu/utils/resources.py",
     "quorum_tpu/ops/tuning.py",
+    "quorum_tpu/parallel/fleet.py",
 )
 
 # Lock keys are "<module-stem>.<Class>.<attr>" or "<module-stem>.<name>"
@@ -79,6 +80,12 @@ LOCK_ORDER = (
     # above it and the registry below it
     "flight.FlightRecorder._lock",
     "registry.MetricsRegistry._lock",
+    # the fleet state lock: guards the bring-up singleton, the
+    # exchange epoch counters, and the host-run sanction depth; the
+    # exchange path calls faults.inject (FaultPlan._lock) AFTER
+    # releasing it, and it is never held across a barrier or a
+    # blocking KV get — so it ranks just outside the fault plan
+    "fleet._lock",
     "faults.FaultPlan._lock",
     "tuning._lock",
 )

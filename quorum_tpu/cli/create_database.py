@@ -114,6 +114,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default), skip and count, or quarantine to "
                         "<output>.quarantine.fastq")
     faults.add_fault_args(p)
+    from ..parallel import fleet as fleet_mod
+    fleet_mod.add_fleet_args(p)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("reads", nargs="+", help="Read files")
     return p
@@ -147,6 +149,14 @@ def main(argv=None, handoff: dict | None = None, batches=None,
         print("Mer length must be between 1 and 31", file=sys.stderr)
         return 1
     faults.setup(args.fault_plan)
+    # fleet bring-up BEFORE any jax device use: jax.distributed must
+    # initialize before the backend comes up
+    from ..parallel import fleet as fleet_mod
+    try:
+        flt = fleet_mod.ensure_initialized(args)
+    except (RuntimeError, ValueError) as e:
+        print(f"quorum_create_database: {e}", file=sys.stderr)
+        return 1
     from ..parallel.tile_sharded import resolve_devices_and_batch
     try:
         devices, batch_size = resolve_devices_and_batch(
@@ -164,6 +174,17 @@ def main(argv=None, handoff: dict | None = None, batches=None,
         print(f"--partitions must be a power of two in [1, 256], "
               f"got {P}", file=sys.stderr)
         return 1
+    if flt is not None:
+        # the fleet stage-1 is partition-binned: plan P up to a power
+        # of two >= the process count so every host owns >= 1 pass
+        P = fleet_mod.plan_partitions(P, flt.num_processes)
+        if P != args.partitions:
+            vlog_mod.vlog("Fleet build: raising --partitions to ", P,
+                          " (", flt.num_processes, " processes)")
+        if args.ref_format:
+            print("--ref-format does not compose with a multi-host "
+                  "fleet (no sharded manifest)", file=sys.stderr)
+            return 1
     if prefilter != "off" and devices > 1:
         if auto:
             # an env/profile-resolved default the user never asked
@@ -222,6 +243,11 @@ def main(argv=None, handoff: dict | None = None, batches=None,
     )
     from .observability import observability
     from ..utils import resources
+    if flt is not None and args.metrics:
+        # hosts share one filesystem in CI (and may on NFS pods):
+        # per-host metrics documents get per-host paths
+        args.metrics = fleet_mod.host_scoped_path(args.metrics,
+                                                  flt.process_id)
     rc = 1  # flipped to 0 only on success: any exception leaves 1
     # the resource-guard frame (ISSUE 19): watch the output and
     # checkpoint filesystems for the watermark alerts
@@ -243,6 +269,10 @@ def main(argv=None, handoff: dict | None = None, batches=None,
                        watch_paths=watch,
                        stall_timeout_s=args.stall_timeout_s) as obs:
         try:
+            if flt is not None:
+                obs.registry.set_meta(
+                    host_process_count=flt.num_processes,
+                    host_process_index=flt.process_id)
             # disk preflight BEFORE the parse/device work: an export
             # that cannot fit should refuse in seconds, not hours
             resources.preflight(

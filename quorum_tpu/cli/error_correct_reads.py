@@ -124,9 +124,46 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default), skip and count, or quarantine to "
                         "<prefix>.quarantine.fastq")
     faults.add_fault_args(p)
+    from ..parallel import fleet as fleet_mod
+    fleet_mod.add_fleet_args(p)
     p.add_argument("db", help="Mer database")
     p.add_argument("sequence", nargs="+", help="Input sequence")
     return p
+
+
+def _run_fleet(args, opts, flt, ec_kwargs) -> None:
+    """Fleet stage 2 (ISSUE 20): input files shard across hosts by
+    the verified host plan; each host corrects its files one at a time
+    into `<prefix>.fleet<NNNN>` segments (NNNN = the GLOBAL file
+    index), and process 0 concatenates the segments in file order —
+    so the merged `.fa`/`.log` are byte-identical to a single-process
+    run (correction output is a pure per-read stream; batch
+    composition cannot change a read's rendered bytes). Hosts with no
+    files of their own still hit both barriers."""
+    import dataclasses
+    import os
+
+    from ..models.error_correct import run_error_correct
+    from ..parallel import fleet as fleet_mod
+    from ..parallel import multihost
+
+    owner = multihost.verified_host_plan(args.sequence)
+    mine = [gi for gi, h in enumerate(owner) if h == flt.process_id]
+    for gi in mine:
+        seg_opts = {"output": fleet_mod.segment_prefix(args.output, gi)}
+        if opts.metrics:
+            # per-SEGMENT metrics file: segment indices are globally
+            # disjoint (one owner per file), so no host marker needed
+            root, ext = os.path.splitext(opts.metrics)
+            seg_opts["metrics"] = f"{root}.seg{gi:04d}{ext}"
+        with fleet_mod.host_run():
+            run_error_correct(
+                args.db, [args.sequence[gi]], None,
+                dataclasses.replace(opts, **seg_opts), **ec_kwargs)
+    flt.barrier("stage2_segments")
+    if flt.process_id == 0:
+        fleet_mod.fleet_merge(args.output, len(args.sequence))
+    flt.barrier("stage2_merge")
 
 
 def main(argv=None, db=None, prepacked=None) -> int:
@@ -158,6 +195,24 @@ def main(argv=None, db=None, prepacked=None) -> int:
     )
 
     faults.setup(args.fault_plan)
+    # fleet bring-up BEFORE any jax device use
+    from ..parallel import fleet as fleet_mod
+    try:
+        flt = fleet_mod.ensure_initialized(args)
+    except (RuntimeError, ValueError) as e:
+        print(f"quorum_error_correct_reads: {e}", file=sys.stderr)
+        return 1
+    fleet_run = flt is not None and db is None and prepacked is None
+    if fleet_run:
+        if args.output is None:
+            print("a fleet correction needs -o PREFIX (per-host "
+                  "output segments merge under it)", file=sys.stderr)
+            return 1
+        if args.gzip:
+            print("--gzip does not compose with a fleet run: "
+                  "concatenated gzip members are not byte-identical "
+                  "to a single-stream file", file=sys.stderr)
+            return 1
     from ..parallel.tile_sharded import resolve_devices_and_batch
     try:
         devices, batch_size = resolve_devices_and_batch(
@@ -195,15 +250,21 @@ def main(argv=None, db=None, prepacked=None) -> int:
         preflight=args.preflight,
         stall_timeout_s=args.stall_timeout_s,
     )
+    ec_kwargs = dict(
+        qual_cutoff=qual_cutoff, skip=args.skip, good=args.good,
+        anchor_count=args.anchor_count, min_count=args.min_count,
+        window=args.window, error=args.error, homo_trim=args.homo_trim,
+        trim_contaminant=args.trim_contaminant,
+        no_discard=args.no_discard,
+    )
     try:
-        run_error_correct(
-            args.db, args.sequence, None, opts,
-            qual_cutoff=qual_cutoff, skip=args.skip, good=args.good,
-            anchor_count=args.anchor_count, min_count=args.min_count,
-            window=args.window, error=args.error, homo_trim=args.homo_trim,
-            trim_contaminant=args.trim_contaminant,
-            no_discard=args.no_discard, db=db, prepacked=prepacked,
-        )
+        if fleet_run:
+            _run_fleet(args, opts, flt, ec_kwargs)
+        else:
+            run_error_correct(
+                args.db, args.sequence, None, opts,
+                db=db, prepacked=prepacked, **ec_kwargs,
+            )
     except (RuntimeError, ValueError, OSError) as e:
         print(str(e), file=sys.stderr)
         from ..io.checkpoint import CheckpointError, NON_RETRYABLE_RC

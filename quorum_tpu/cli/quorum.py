@@ -279,6 +279,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "min(4, cores)); output is byte-identical for "
                         "any N")
     faults.add_fault_args(p)
+    from ..parallel import fleet as fleet_mod
+    fleet_mod.add_fleet_args(p)
     p.add_argument("--debug", action="store_true",
                    help="Display debugging information")
     p.add_argument("--version", action="version", version=VERSION)
@@ -331,6 +333,22 @@ def main(argv=None) -> int:
     # from the QUORUM_FAULT_PLAN env var instead
     faults.setup(args.fault_plan)
 
+    # fleet bring-up (ISSUE 20) BEFORE observability or any jax
+    # device use: jax.distributed must initialize before the backend
+    from ..parallel import fleet as fleet_mod
+    try:
+        flt = fleet_mod.ensure_initialized(args)
+    except (RuntimeError, ValueError) as e:
+        print(f"quorum: {e}", file=sys.stderr)
+        return 1
+    metrics_base = args.metrics
+    if flt is not None and args.metrics:
+        # hosts share one filesystem in CI (and may on NFS pods):
+        # each host's own documents land under a per-host path; the
+        # ONE aggregated fleet document keeps the original base
+        args.metrics = fleet_mod.host_scoped_path(args.metrics,
+                                                  flt.process_id)
+
     # driver telemetry: the run manifest (resolved config, jax
     # backend/devices, compile-cache hits) plus per-child timings;
     # the listener must attach BEFORE the stages compile anything.
@@ -375,7 +393,10 @@ def main(argv=None) -> int:
             reg_.gauge("jax_cache_misses").set(max(0, reqs - hits))
 
         obs.at_exit(_cache_gauges)
-        rc = _main_inner(args, reg, obs.tracer, cache_dir)
+        if flt is not None and reg.enabled:
+            reg.set_meta(host_process_count=flt.num_processes,
+                         host_process_index=flt.process_id)
+        rc = _main_inner(args, reg, obs.tracer, cache_dir, flt)
         if rc != 0:
             obs.status = "error"
         elif reg.enabled:
@@ -383,12 +404,13 @@ def main(argv=None) -> int:
             # telemetry ROADMAP item has wanted since PR 2: every run
             # lands ONE job-level aggregated document (per-host shards
             # under `hosts`; a single host on a local --devices mesh is
-            # simply a one-shard reduce). Collective + symmetric, so
-            # this is also where a future multi-host driver merges.
+            # simply a one-shard reduce). Collective + symmetric: on a
+            # fleet every host calls it, and process 0 writes the one
+            # document at the ORIGINAL --metrics base.
             try:
                 from ..parallel import multihost
-                hosts_path = (_stage_path(args.metrics, "hosts")
-                              if args.metrics else None)
+                hosts_path = (_stage_path(metrics_base, "hosts")
+                              if metrics_base else None)
                 reg.set_meta(metrics_hosts=hosts_path)
                 multihost.aggregate_metrics(reg, path=hosts_path)
             except Exception as e:  # noqa: BLE001 - reporting only
@@ -397,7 +419,7 @@ def main(argv=None) -> int:
     return rc
 
 
-def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
+def _main_inner(args, reg, driver_tracer, cache_dir, flt=None) -> int:
     if not re.match(r"^\d+[kMGT]?$", args.size):
         print(f"Invalid size '{args.size}'. It must be a number, maybe "
               "followed by a suffix (like k, M, G for thousand, million "
@@ -412,18 +434,24 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         return 1
 
     import jax
-    if jax.process_count() > 1:
-        # the driver is single-controller by design: its build state is
-        # host-local and both stages write one output path. Local
-        # scale-out is --devices N (this PR); multi-HOST needs a
-        # global mesh fed by parallel.multihost with per-host output
-        # prefixes (the stage CLIs refuse too, but the driver must
-        # refuse BEFORE handing them its own batches, which would
-        # bypass their checks).
-        print("quorum: multi-host runs are not wired yet — use "
-              "--devices N for local scale-out; multi-host needs "
-              "parallel.multihost input sharding + per-host outputs",
-              file=sys.stderr)
+    from ..parallel import fleet as fleet_mod
+    if flt is None:
+        flt = fleet_mod.active()
+    if jax.process_count() > 1 and flt is None:
+        # multi-host without the fleet bring-up: per-host driver runs
+        # would race on one output path. The fleet tier (ISSUE 20)
+        # owns the orchestration — require its flags.
+        print("quorum: multi-host runs need the fleet flags "
+              "(--coordinator/--num-processes/--process-id, or the "
+              "QUORUM_FLEET_* levers) so the driver can shard input "
+              "and merge per-host outputs", file=sys.stderr)
+        return 1
+    if flt is not None and args.paired_files:
+        # paired mode streams ONE interleaved record stream through
+        # correction — there is no per-file decomposition to shard
+        print("quorum: --paired-files does not compose with a "
+              "multi-host fleet yet; run unpaired or drop the fleet "
+              "flags", file=sys.stderr)
         return 1
 
     # --devices: resolve once, forward the RESOLVED count to both
@@ -446,6 +474,14 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         print(f"quorum: --partitions must be a power of two in "
               f"[1, 256], got {P}", file=sys.stderr)
         return 1
+    if flt is not None:
+        # fleet stage 1 is partition-binned: plan P up to a power of
+        # two >= the process count so every host owns >= 1 pass
+        planned = fleet_mod.plan_partitions(P, flt.num_processes)
+        if planned != P:
+            vlog("Fleet run: raising --partitions to ", planned,
+                 " (", flt.num_processes, " processes)")
+        P = args.partitions = planned
     if args.prefilter not in ("auto", "off") and n_devices > 1:
         print("quorum: --prefilter composes with --devices 1 today; "
               "use --partitions for multi-pass capacity over a mesh",
@@ -559,7 +595,11 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     # iterator mid-stream (a partition-geometry restart) must never
     # leave a TRUNCATED cache that stage 2 would silently replay as
     # the whole input (ISSUE 14 review)
-    cache_state = {"bytes": 0, "ok": not args.paired_files,
+    # on a fleet the RAM replay cache is off: stage 2 corrects
+    # PER-FILE segments (each host re-reads only its own files), so a
+    # full-input replay would feed every host every read
+    cache_ok = not args.paired_files and flt is None
+    cache_state = {"bytes": 0, "ok": cache_ok,
                    "writer": None, "complete": False}
     # with --checkpoint-dir the replay cache ALSO streams to disk
     # (io/checkpoint.ReplayCache), so a later --resume run feeds
@@ -573,6 +613,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     }
     replay_store = (ckpt_mod.ReplayCache(args.checkpoint_dir)
                     if args.checkpoint_dir and not args.paired_files
+                    and flt is None
                     else None)
 
     def _cached_batches():
@@ -674,12 +715,15 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     def _stage1_cursor():
         if not args.checkpoint_dir:
             return None
+        # on a fleet, stage 1 scopes its checkpoint artifacts per
+        # host (models/create_database); peek at THIS host's cursor
+        ck_dir = (flt.host_scoped_dir(args.checkpoint_dir)
+                  if flt is not None else args.checkpoint_dir)
         if args.partitions > 1:
-            return ckpt_mod.Stage1PartitionCursor(
-                args.checkpoint_dir).cursor()
+            return ckpt_mod.Stage1PartitionCursor(ck_dir).cursor()
         cls = (ckpt_mod.Stage1ShardedCheckpoint if n_devices > 1
                else ckpt_mod.Stage1Checkpoint)
-        return cls(args.checkpoint_dir).cursor()
+        return cls(ck_dir).cursor()
 
     def _stage1_attempt(attempt: int) -> int:
         # every attempt gets a FRESH shared producer and replay cache
@@ -691,7 +735,7 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
         handoff.clear()
         reads_cache.clear()
         cache_state["bytes"] = 0
-        cache_state["ok"] = not args.paired_files
+        cache_state["ok"] = cache_ok
         cache_state["complete"] = False
         cache_state["writer"] = (
             replay_store.start(replay_identity, _replay_cap())
@@ -753,8 +797,17 @@ def _main_inner(args, reg, driver_tracer, cache_dir) -> int:
     # exists and validates, and no partial checkpoint is pending):
     # reuse it instead of recounting — the point of resuming. Stage 2
     # then reloads the table and re-reads the inputs from disk.
-    if (args.resume and os.path.exists(db_file)
-            and _stage1_cursor() is None and _stage1_db_reusable()):
+    skip_s1 = (args.resume and os.path.exists(db_file)
+               and _stage1_cursor() is None and _stage1_db_reusable())
+    if flt is not None and args.resume:
+        # the skip decision must be COLLECTIVE: one host skipping
+        # stage 1 while another rebuilds would deadlock the rebuild's
+        # record exchange. Any host that can't reuse forces a rebuild
+        # everywhere (the database file lives on the shared prefix,
+        # but partial per-host checkpoints may not agree).
+        votes = flt.exchange_json("stage1_skip", bool(skip_s1))
+        skip_s1 = all(votes)
+    if skip_s1:
         vlog("Resume: reusing existing mer database ", db_file)
         reg.event("stage_skipped", stage="create_database",
                   reason="resume: database exists")

@@ -366,11 +366,15 @@ class Stage1ShardedCheckpoint:
         from ..parallel.multihost import barrier, process_index
         # Degradation ladder (ISSUE 19): checkpoints are optional —
         # on ENOSPC the writer disables itself and the run continues.
-        # NOTE: the degraded flag is process-local; under a true
-        # multi-host run a one-host skip would desync the barriers
-        # below, but sharded saves are single-controller today (every
-        # shard is addressable) so skip and save stay consistent.
-        if resources.degraded("stage1.checkpoint"):
+        # The degraded flag is process-local; on a fleet a one-host
+        # skip would desync the barriers below, so the skip decision
+        # is COLLECTIVE: any degraded host makes every host skip
+        # (checkpoints are best-effort, barrier agreement is not).
+        deg = bool(resources.degraded("stage1.checkpoint"))
+        from ..parallel import fleet
+        if fleet.active() is not None:
+            deg = any(fleet.exchange_json("stage1_ckpt_degraded", deg))
+        if deg:
             return
         with resources.guard("stage1.checkpoint", path=self.path):
             self._save_guarded(bstate, meta, cfg, cursor, stats, paths,
@@ -441,10 +445,19 @@ class Stage1ShardedCheckpoint:
                 except OSError:
                     pass
 
-    def load(self) -> Stage1ShardedSnapshot | None:
+    def load(self, shards=None) -> Stage1ShardedSnapshot | None:
         """The last committed snapshot, or None when there is none. Any
         shard missing, truncated, or disagreeing with the manifest
-        (generation, cursor, geometry) raises CheckpointError."""
+        (generation, cursor, geometry) raises CheckpointError.
+
+        `shards` (an iterable of shard ids, default all) restores a
+        SUBSET — the non-addressable-mesh case (ISSUE 20): a fleet
+        host restores only the shards its local devices hold, each
+        digest-verified against the one shared manifest, and the
+        returned planes concatenate those shards in id order. Cursor
+        agreement across hosts rides `fleet_agreement`, not this
+        method — the manifest is one file, so every subset restores
+        at the manifest's single cursor or refuses."""
         manifest = self._read_manifest()
         if manifest is None:
             return None
@@ -454,8 +467,15 @@ class Stage1ShardedCheckpoint:
         acc_local = int(manifest["acc_local"])
         from ..ops.ctable import TILE
         want_payload = (rows_local * TILE + 2 * acc_local) * 4
+        want_shards = range(S) if shards is None else sorted(
+            int(s) for s in shards)
+        for s in want_shards:
+            if not 0 <= s < S:
+                raise CheckpointError(
+                    f"sharded stage-1 restore asked for shard {s} of "
+                    f"a {S}-shard snapshot")
         tags, hqs, lqs = [], [], []
-        for s in range(S):
+        for s in want_shards:
             p = self._shard_path(s, gen)
             if not os.path.exists(p):
                 raise CheckpointError(
@@ -493,9 +513,54 @@ class Stage1ShardedCheckpoint:
             hqs.append(arr[rows_local * TILE:rows_local * TILE
                            + acc_local])
             lqs.append(arr[rows_local * TILE + acc_local:])
+        if not tags:
+            # a host whose local devices hold no shard of this table
+            # still restores the manifest (cursor agreement) with
+            # empty planes
+            return Stage1ShardedSnapshot(
+                manifest, np.zeros((0, TILE), np.uint32),
+                np.zeros(0, np.uint32), np.zeros(0, np.uint32))
         return Stage1ShardedSnapshot(
             manifest, np.concatenate(tags, axis=0),
             np.concatenate(hqs), np.concatenate(lqs))
+
+    # the manifest fields a fleet restore must agree on before any
+    # host reuses its shard subset: a digest mismatch means hosts see
+    # DIFFERENT committed snapshots (torn replication, divergent
+    # checkpoint dirs) and splicing their restores would mix cursors
+    _AGREEMENT_FIELDS = ("gen", "cursor", "k", "bits", "rb_log2",
+                         "n_shards", "batch_size", "qual_thresh")
+
+    def fleet_agreement(self, exchange=None) -> dict | None:
+        """Collective manifest-agreement check (ISSUE 20): every host
+        digests the load-bearing manifest fields and exchanges the
+        digest; any divergence (including one host seeing no manifest
+        at all) raises CheckpointError LOUDLY rather than letting
+        hosts resume from different cursors. Returns the agreed
+        manifest (None everywhere when no host has one). `exchange`
+        is a test seam — `(tag, obj) -> list` — defaulting to the
+        fleet KV exchange; single-process runs short-circuit."""
+        import hashlib
+        if exchange is None:
+            from ..parallel import fleet
+            if fleet.active() is None:
+                return self._read_manifest()
+            exchange = fleet.exchange_json
+        manifest = self._read_manifest()
+        if manifest is None:
+            digest = None
+        else:
+            fields = {k: manifest.get(k) for k in self._AGREEMENT_FIELDS}
+            digest = hashlib.sha256(
+                json.dumps(fields, sort_keys=True).encode()).hexdigest()
+        peers = exchange("stage1_ckpt_agreement", digest)
+        if any(d != digest for d in peers):
+            raise CheckpointError(
+                "sharded stage-1 fleet restore: hosts disagree on the "
+                f"committed snapshot (digests {peers}); every host "
+                "must restore the same generation and cursor — "
+                "refusing to resume from divergent checkpoints")
+        return manifest
 
     def cursor(self) -> int | None:
         """Header-only peek at the committed batch cursor (driver

@@ -419,14 +419,20 @@ def _default_batches(paths, cfg: BuildConfig, reg, tracer,
             yield b, pk
     import jax as _jax
     if _jax.process_count() > 1:
-        # per-host runs of this CLI would write racing PARTIAL
-        # tables / race on one output path. Multi-host stage 1 =
-        # global mesh + the sharded build fed by
-        # parallel/multihost.read_batches_multihost.
-        raise RuntimeError(
-            "multi-host build requires the sharded pipeline over a "
-            "global mesh fed by parallel.multihost, not this "
-            "single-controller CLI")
+        from ..parallel import fleet as _fleet
+        if _fleet.active() is None:
+            # per-host runs of this CLI would write racing PARTIAL
+            # tables / race on one output path. Multi-host stage 1 =
+            # the fleet tier (parallel/fleet bring-up + the
+            # partition-binned build: every host streams the full
+            # input and runs only its owned passes) or the sharded
+            # pipeline fed by parallel.multihost.
+            raise RuntimeError(
+                "multi-host build requires the fleet tier "
+                "(--coordinator/--num-processes/--process-id, "
+                "parallel.fleet) or the sharded pipeline over a "
+                "global mesh fed by parallel.multihost, not bare "
+                "per-host runs of this single-controller CLI")
     policy = None
     if cfg.on_bad_read != "abort":
         if quiet:
@@ -1083,6 +1089,13 @@ def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
     if cfg.prefilter != "off" and cfg.devices > 1:
         raise ValueError(
             "--prefilter composes with --devices 1 today")
+    from ..parallel import fleet as fleet_mod
+    flt = fleet_mod.active()
+    if flt is not None and P < flt.num_processes:
+        raise ValueError(
+            f"fleet build needs --partitions >= the process count "
+            f"({flt.num_processes}); the CLIs plan this via "
+            "fleet.plan_partitions")
     factory = _resolve_batches_factory(paths, cfg, batches,
                                        batches_factory, reg, tracer)
     S = cfg.devices
@@ -1103,10 +1116,15 @@ def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
     rb_local = max(rb_req - g, ctable.min_tile_rb_log2(cfg.k, cfg.bits),
                    4 + owner_bits)
     rb_local = min(rb_local, 24 + owner_bits)
-    cursor = (ckpt_mod.Stage1PartitionCursor(cfg.checkpoint_dir)
-              if cfg.checkpoint_dir else None)
-    sk_ck = (ckpt_mod.SketchCheckpoint(cfg.checkpoint_dir)
-             if cfg.checkpoint_dir and cfg.prefilter == "two-pass"
+    # on a fleet, hosts share one filesystem in CI (and may on NFS
+    # pods): every checkpoint artifact gets a per-host subdirectory
+    ckpt_dir = (flt.host_scoped_dir(cfg.checkpoint_dir)
+                if flt is not None and cfg.checkpoint_dir
+                else cfg.checkpoint_dir)
+    cursor = (ckpt_mod.Stage1PartitionCursor(ckpt_dir)
+              if ckpt_dir else None)
+    sk_ck = (ckpt_mod.SketchCheckpoint(ckpt_dir)
+             if ckpt_dir and cfg.prefilter == "two-pass"
              else None)
     smeta = (sketch_mod.SketchMeta(
         sketch_mod.cells_log2_for(cfg.initial_size))
@@ -1178,6 +1196,14 @@ def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
         gmeta = _global_export_meta(cfg, rb_local + g)
         step0 = 0
         for p in range(P):
+            if flt is not None and not flt.owns_pass(p):
+                # partition-binned fleet decomposition: host h runs
+                # only passes p % num_processes == h. A pass's shard
+                # file depends only on (input stream, geometry, p), so
+                # which host runs it cannot change its bytes — and the
+                # owned bins are disjoint, so there is zero cross-host
+                # insert traffic (the KMC-2 property).
+                continue
             if p in completed:
                 continue
             t_pass = time.perf_counter()
@@ -1253,44 +1279,95 @@ def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
                             out_dir)
             else:
                 faults.inject("partition.commit", path=rec["path"])
-        # manifest records proper: the cursor's per-pass stat fields
-        # stay checkpoint-local
-        keep = ("path", "shard", "n_entries", "value_bytes",
-                "file_crc32c")
-        return ([{k: completed[p][k] for k in keep}
-                 for p in range(P)], gmeta)
+        return completed, gmeta
 
     with trace(cfg.profile):
         for _ in range(cfg.max_grows + 1):
+            grew = None
+            completed = {}
             try:
-                recs, gmeta = _attempt(rb_local)
-                break
+                completed, gmeta = _attempt(rb_local)
             except _PartitionGrew as e:
-                vlog("Partition pass overflowed at local rb_log2=",
-                     rb_local, "; restarting all passes at ",
-                     e.rb_local)
-                reg.counter("hash_grows").inc()
-                reg.event("partition_geometry_grow",
-                          rb_local_before=rb_local,
-                          rb_local_after=e.rb_local)
-                stats.grows += 1
-                stats.distinct = 0
-                stats.poisson_distinct_hq = 0
-                stats.poisson_total_hq = 0
-                stats.prefilter_dropped = 0
-                stats.prefilter_dropped_hq = 0
-                stats.prefilter_false_pass = 0
-                # the input accounting restarts with the passes: a
-                # partial first attempt must not freeze reads/bases
-                # at a prefix (count_stats keys off batches == 0)
-                stats.reads = 0
-                stats.bases = 0
-                stats.batches = 0
-                rb_local = e.rb_local
-                if cursor is not None:
-                    cursor.clear()
+                grew = e.rb_local
+            if flt is not None:
+                # the fleet grow vote: every host posts the local
+                # geometry it needs (its current one when it finished
+                # clean) and adopts the max, so pass files from
+                # different geometries can never meet in one manifest
+                agreed = flt.grow_vote(
+                    rb_local if grew is None else grew)
+                if agreed > rb_local:
+                    grew = agreed
+            if grew is None:
+                break
+            vlog("Partition pass overflowed at local rb_log2=",
+                 rb_local, "; restarting all passes at ", grew)
+            reg.counter("hash_grows").inc()
+            reg.event("partition_geometry_grow",
+                      rb_local_before=rb_local,
+                      rb_local_after=grew)
+            stats.grows += 1
+            stats.distinct = 0
+            stats.poisson_distinct_hq = 0
+            stats.poisson_total_hq = 0
+            stats.prefilter_dropped = 0
+            stats.prefilter_dropped_hq = 0
+            stats.prefilter_false_pass = 0
+            # the input accounting restarts with the passes: a
+            # partial first attempt must not freeze reads/bases
+            # at a prefix (count_stats keys off batches == 0)
+            stats.reads = 0
+            stats.bases = 0
+            stats.batches = 0
+            rb_local = grew
+            if cursor is not None:
+                cursor.clear()
         else:
             raise RuntimeError("Hash is full")
+    if flt is not None:
+        # exchange the per-pass records: every host learns every
+        # shard file (the ONE fleet manifest names them all), the
+        # ownership plan is verified exact-cover, and the global
+        # header stats are recomputed from the records. Posting
+        # records also proves each host's shard files are durable
+        # before process 0 commits the manifest.
+        docs = flt.exchange_json(
+            "partition_records",
+            {str(p): completed[p] for p in sorted(completed)})
+        merged_recs: dict[int, dict] = {}
+        for doc in docs:
+            for key, r in doc.items():
+                p_g = int(key)
+                if p_g in merged_recs:
+                    raise RuntimeError(
+                        f"fleet partition exchange: pass {p_g} "
+                        "exported by two hosts — the ownership plan "
+                        "diverged; refusing to seal a manifest over "
+                        "racing shard files")
+                merged_recs[p_g] = r
+        missing = [p_g for p_g in range(P) if p_g not in merged_recs]
+        if missing:
+            raise RuntimeError(
+                f"fleet partition exchange: passes {missing} exported "
+                "by no host — the ownership plan diverged")
+        completed = merged_recs
+        stats.distinct = sum(
+            int(r["n_entries"]) for r in completed.values())
+        stats.poisson_distinct_hq = sum(
+            int(r.get("distinct_hq", 0)) for r in completed.values())
+        stats.poisson_total_hq = sum(
+            int(r.get("total_hq", 0)) for r in completed.values())
+        stats.prefilter_false_pass = sum(
+            int(r.get("false_pass", 0)) for r in completed.values())
+        stats.prefilter_dropped = sum(
+            int(r.get("dropped", 0)) for r in completed.values())
+        stats.prefilter_dropped_hq = sum(
+            int(r.get("dropped_hq", 0)) for r in completed.values())
+    # manifest records proper: the cursor's per-pass stat fields
+    # stay checkpoint-local
+    keep = ("path", "shard", "n_entries", "value_bytes",
+            "file_crc32c")
+    recs = [{k: completed[p][k] for k in keep} for p in range(P)]
     if smeta is not None:
         # full-table Poisson stats: each dropped hq singleton would
         # have been one distinct hq mer of count 1 (exact — a dropped
@@ -1298,10 +1375,17 @@ def _build_database_partitioned(paths, cfg: BuildConfig, output: str,
         stats.poisson_distinct_hq += stats.prefilter_dropped_hq
         stats.poisson_total_hq += stats.prefilter_dropped_hq
     # every shard is durable: the manifest is the commit point, and
-    # the pass-granular checkpoint artifacts die with it
-    db_format.write_db_manifest(output, recs, gmeta, P, cmdline,
-                                db_version=cfg.db_version,
-                                extra_header=stats.db_extra_header())
+    # the pass-granular checkpoint artifacts die with it. On a fleet
+    # there is ONE manifest — process 0 commits it (the record
+    # exchange above already proved every host's shards durable), and
+    # the barrier keeps other hosts from racing into stage 2 before
+    # the commit lands.
+    if flt is None or flt.process_id == 0:
+        db_format.write_db_manifest(output, recs, gmeta, P, cmdline,
+                                    db_version=cfg.db_version,
+                                    extra_header=stats.db_extra_header())
+    if flt is not None:
+        flt.barrier("stage1_manifest")
     if cursor is not None:
         cursor.clear()
     if sk_ck is not None:
@@ -1351,6 +1435,12 @@ def create_database_main(
             "--ref-format supports neither --partitions nor "
             "--prefilter (the reference format carries no manifest "
             "or prefilter declaration)")
+    from ..parallel import fleet as fleet_mod
+    if fleet_mod.active() is not None and cfg.partitions < 2:
+        raise ValueError(
+            "a fleet build is partition-binned: it needs "
+            "--partitions >= the fleet process count (the CLIs plan "
+            "this via fleet.plan_partitions)")
     if cfg.partitions > 1:
         # the minimizer-partitioned multi-pass build (ISSUE 14):
         # exports ARE per-pass (sharded manifest), peak table memory

@@ -36,6 +36,7 @@ from ..io import contaminant as contaminant_mod
 from ..io import db_format, fastq, packing
 from ..ops import ctable
 from ..ops.poisson import compute_poisson_cutoff
+from ..parallel import fleet
 from ..telemetry import observe_dispatch_wait, quality
 from ..utils import faults, resources
 from ..utils.pipeline import AsyncWriter, ReorderingPool, prefetch
@@ -642,15 +643,19 @@ def _run_ec(db_path: str, sequences: Sequence[str],
             # quorum-driver replay: stage 1 already parsed AND packed
             # these reads (run_quorum); skip the second disk parse
             src = None
-        elif jax.process_count() > 1:
+        elif jax.process_count() > 1 and not fleet.in_host_run():
             # per-host runs of the single-chip CLI would race on one
-            # output path; multi-host stage 2 = global mesh +
-            # tile_sharded.correct_step(_routed) with per-host output
-            # prefixes, fed by parallel/multihost
+            # output path. The fleet tier (parallel/fleet) runs this
+            # path per host with DISJOINT per-file output segments
+            # under fleet.host_run() and merges them in order; bare
+            # multi-host stage 2 otherwise needs the sharded pipeline
             raise RuntimeError(
-                "multi-host correction requires the sharded pipeline "
-                "(parallel.tile_sharded.correct_step + "
-                "parallel.multihost), not the single-chip CLI")
+                "multi-host correction requires the fleet tier "
+                "(--coordinator/--num-processes/--process-id, whose "
+                "orchestration owns per-host output segments) or the "
+                "sharded pipeline (parallel.tile_sharded.correct_step "
+                "+ parallel.multihost), not bare per-host runs of the "
+                "single-chip CLI")
         else:
             src = fastq.read_batches(sequences, opts.batch_size,
                                      threads=opts.threads,

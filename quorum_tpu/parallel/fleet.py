@@ -1,0 +1,386 @@
+"""Multi-host fleet bring-up and exchange (ISSUE 20).
+
+PR 9's sharded DB format, PR 5's per-shard checkpoints, PR 10's fleet
+metrics documents, and PR 13's partitioned build all stand ready for a
+fleet to compose them; this module is the missing bring-up layer. It
+owns three things:
+
+* **Bring-up**: ``--coordinator``/``--num-processes``/``--process-id``
+  (or their ``QUORUM_FLEET_*`` env levers) feed
+  ``jax.distributed.initialize`` exactly once per process
+  (:func:`ensure_initialized`), after which :func:`active` hands every
+  layer the fleet topology.
+
+* **Transport**: named sub-barriers and JSON/bytes exchanges that ride
+  the jax *coordination service* (the distributed-runtime KV store and
+  ``wait_at_barrier``) when a coordinator is up, falling back to XLA
+  collectives otherwise. The coordination service is the right
+  transport for control-plane traffic: it works on the CPU backend
+  (where XLA multiprocess collectives are unimplemented — the 2-process
+  CI fleet), and on TPU pods it keeps tiny manifests and votes off the
+  ICI. Barrier and key names are one-shot in the coordination service,
+  so every name carries a monotonic per-tag epoch; SPMD symmetry keeps
+  the counters agreed across hosts.
+
+* **Planning**: pass ownership for the partition-binned stage-1 build
+  (host h owns partition passes ``p % num_processes == h`` — disjoint
+  key ranges, zero cross-host inserts, the KMC-2 decomposition), the
+  grow vote that keeps rehash geometry agreed fleet-wide, and the
+  order-preserving :func:`fleet_merge` that concatenates per-host
+  stage-2 output segments back into the byte-identical single-process
+  ``.fa``/``.log``.
+
+Stage 1 on a fleet is partition-binned: every host streams the FULL
+input (a partition pass's shard file depends only on the input stream
+and the geometry, so global insertion order — and therefore byte
+identity — is preserved no matter which host runs the pass), and each
+host runs only the passes it owns at 1/P table memory. Stage 2 shards
+input FILES across hosts (multihost.host_shard_paths); each host
+corrects its files into ``<prefix>.fleet<NNNN>`` segments and process 0
+merges them in global file order.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import json
+import shutil
+import threading
+
+from ..utils import faults, levers
+
+# Lock rank: "fleet._lock" in analysis/rules_locks.LOCK_ORDER. Guards
+# the singleton context, the epoch counters, and the host-run sanction
+# depth; never held across a barrier or a blocking KV get.
+_lock = threading.Lock()
+_state: "FleetContext | None" = None
+_epochs: dict[str, int] = {}
+_host_run_depth = 0
+
+_KV_PREFIX = "quorum_fleet"
+
+
+def coord_client():
+    """The jax coordination-service client (DistributedRuntimeClient)
+    when ``jax.distributed`` is initialized, else None. This is the
+    fleet's control-plane transport: ``wait_at_barrier`` +
+    ``key_value_set``/``blocking_key_value_get`` work on every backend
+    (XLA multiprocess collectives do not exist on CPU)."""
+    try:  # jax internal, but the only handle to the coordination KV
+        from jax._src import distributed
+    except Exception:  # pragma: no cover - jax always has it today
+        return None
+    return getattr(distributed.global_state, "client", None)
+
+
+def timeout_ms() -> int:
+    """Fleet barrier/exchange timeout in milliseconds
+    (QUORUM_FLEET_BARRIER_TIMEOUT_S; default 600s). A host that never
+    shows up turns into a loud timeout error instead of a silent
+    wedge."""
+    try:
+        s = float(levers.raw("QUORUM_FLEET_BARRIER_TIMEOUT_S") or 600)
+    except ValueError:
+        s = 600.0
+    return max(1000, int(s * 1000))
+
+
+def _next_epoch(tag: str) -> int:
+    """Monotonic per-tag counter: coordination-service barrier and key
+    names are one-shot, so every use of a logical name gets a fresh
+    epoch suffix. SPMD symmetry (every host performs the same sequence
+    of fleet operations) keeps the counters agreed across hosts."""
+    with _lock:
+        n = _epochs.get(tag, 0)
+        _epochs[tag] = n + 1
+        return n
+
+
+def barrier_uid(name: str) -> str:
+    """The one-shot coordination-service barrier id for logical
+    barrier `name` (epoch-suffixed; see :func:`_next_epoch`)."""
+    return f"{_KV_PREFIX}/b/{name}#{_next_epoch('b/' + name)}"
+
+
+def exchange_bytes(tag: str, payload: bytes,
+                   process_index: int | None = None,
+                   process_count: int | None = None) -> list[bytes]:
+    """Allgather `payload` across the fleet via the coordination KV
+    store: every host posts its value under a per-epoch key and
+    blocking-reads every peer's. Returns payloads in process-index
+    order. Single-process: the identity. Values ride base64 (the KV
+    store holds strings)."""
+    import base64
+
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc <= 1:
+        return [payload]
+    epoch = _next_epoch("x/" + tag)
+    # the fleet fault site: a plan injects here to kill/fail a host at
+    # a deterministic exchange, the hook fleet_smoke's kill test uses
+    faults.inject("fleet.exchange", batch=epoch)
+    c = coord_client()
+    if c is None:  # pragma: no cover - needs hosts without coordinator
+        raise RuntimeError(
+            f"fleet exchange '{tag}' with process_count={pc} but no "
+            "coordination service is up — initialize the fleet via "
+            "--coordinator/--num-processes/--process-id (parallel."
+            "fleet.ensure_initialized)")
+    base = f"{_KV_PREFIX}/x/{tag}#{epoch}"
+    c.key_value_set(f"{base}/{pi}", base64.b64encode(payload).decode())
+    out = []
+    for i in range(pc):
+        val = c.blocking_key_value_get(f"{base}/{i}", timeout_ms())
+        out.append(base64.b64decode(val))
+    return out
+
+
+def exchange_json(tag: str, obj) -> list:
+    """Allgather a JSON-serializable value; the list of every host's
+    value in process-index order (JSON round-trip: dict keys come back
+    as strings)."""
+    return [json.loads(b.decode()) for b in
+            exchange_bytes(tag, json.dumps(obj, sort_keys=True).encode())]
+
+
+def broadcast_text(tag: str, text: str | None) -> str:
+    """Process 0's `text`, delivered to every host via the
+    coordination KV store (non-zero hosts pass anything, typically
+    their own view for symmetry). Single-process: the identity."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return text if text is not None else ""
+    epoch = _next_epoch("bc/" + tag)
+    c = coord_client()
+    if c is None:  # pragma: no cover - needs hosts without coordinator
+        raise RuntimeError(
+            f"fleet broadcast '{tag}' needs the coordination service; "
+            "initialize via parallel.fleet.ensure_initialized")
+    key = f"{_KV_PREFIX}/bc/{tag}#{epoch}"
+    if jax.process_index() == 0:
+        c.key_value_set(key, text if text is not None else "")
+    return c.blocking_key_value_get(key, timeout_ms())
+
+
+class FleetContext:
+    """The fleet topology plus the planning/exchange conveniences the
+    build and correction layers call. One per process, installed by
+    :func:`ensure_initialized`."""
+
+    def __init__(self, num_processes: int, process_id: int,
+                 coordinator: str | None = None):
+        self.num_processes = int(num_processes)
+        self.process_id = int(process_id)
+        self.coordinator = coordinator
+
+    # -- transport ----------------------------------------------------
+    def barrier(self, name: str) -> None:
+        """Named fleet sub-barrier, riding multihost.barrier (which
+        routes through the coordination service when it is up)."""
+        from . import multihost
+        multihost.barrier(f"fleet:{name}")
+
+    def exchange_json(self, tag: str, obj) -> list:
+        return exchange_json(tag, obj)
+
+    def grow_vote(self, rb_local: int) -> int:
+        """The fleet rehash vote: every host posts the local-geometry
+        log2 it needs (its current one when it finished clean); the
+        fleet adopts the max, so every host restarts at the same grown
+        geometry — partition pass files from different geometries can
+        never end up under one manifest."""
+        return max(int(v) for v in
+                   self.exchange_json("grow_vote", int(rb_local)))
+
+    # -- planning -----------------------------------------------------
+    def owns_pass(self, p: int) -> bool:
+        """Partition-pass ownership: host h runs passes
+        ``p % num_processes == h`` (P is planned to a power of two
+        >= num_processes, so every host owns at least one pass)."""
+        return p % self.num_processes == self.process_id
+
+    def host_scoped_dir(self, base: str) -> str:
+        """Per-host subdirectory of a shared checkpoint/cache dir, so
+        hosts on one filesystem (the CI fleet, NFS pods) never race on
+        each other's cursors."""
+        return os.path.join(base, f"host{self.process_id:04d}")
+
+
+def host_scoped_path(path: str, process_id: int) -> str:
+    """Per-host variant of a shared output path (metrics documents):
+    ``out.json`` -> ``out.host0000.json``. Idempotent: the driver
+    scopes its --metrics base and forwards derived per-stage paths to
+    the in-process stage CLIs, which scope again — a path already
+    carrying this host's marker passes through unchanged."""
+    marker = f".host{process_id:04d}"
+    if marker in os.path.basename(path):
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}{marker}{ext}"
+
+
+def segment_prefix(prefix: str, global_index: int) -> str:
+    """The per-file stage-2 output prefix for global input file
+    `global_index`: ``<prefix>.fleet<NNNN>``. Merge order is global
+    file order, which is what makes the merged ``.fa``/``.log``
+    byte-identical to the single-process run."""
+    return f"{prefix}.fleet{global_index:04d}"
+
+
+def fleet_merge(prefix: str, n_segments: int,
+                suffixes=(".fa", ".log"),
+                keep_segments: bool = False) -> None:
+    """Order-preserving merge of per-host stage-2 output segments:
+    for each suffix, concatenate ``<prefix>.fleet<i><suffix>`` for
+    i in 0..n_segments-1 into ``<prefix><suffix>`` (tmp-then-rename,
+    fsynced — the merged file is the durable artifact). Input file i's
+    reads appear exactly where a single-process run would put them,
+    because correction output is a pure per-read stream. A missing
+    segment is a hard error: merging around it would silently drop
+    that file's reads."""
+    for suffix in suffixes:
+        out_path = prefix + suffix
+        tmp = out_path + ".fleet_merge.tmp"
+        with open(tmp, "wb") as out:
+            for gi in range(n_segments):
+                seg = segment_prefix(prefix, gi) + suffix
+                if not os.path.exists(seg):
+                    out.close()
+                    os.remove(tmp)
+                    raise RuntimeError(
+                        f"fleet_merge: missing output segment '{seg}' "
+                        f"(expected {n_segments} segments for "
+                        f"'{out_path}'); refusing to merge a partial "
+                        "fleet output")
+                with open(seg, "rb") as f:
+                    shutil.copyfileobj(f, out)
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, out_path)
+    if not keep_segments:
+        for gi in range(n_segments):
+            for suffix in suffixes:
+                try:
+                    os.remove(segment_prefix(prefix, gi) + suffix)
+                except OSError:
+                    pass
+
+
+def plan_partitions(requested: int, num_processes: int) -> int:
+    """The fleet partition count: the next power of two at or above
+    both the requested ``--partitions`` and the process count, so
+    every host owns at least one pass and the pass->host mapping
+    stays balanced."""
+    n = max(int(requested) if requested else 1, int(num_processes), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def add_fleet_args(parser) -> None:
+    """The fleet bring-up flags, shared by all three CLIs."""
+    g = parser.add_argument_group("multi-host fleet")
+    g.add_argument(
+        "--coordinator", metavar="HOST:PORT", default=None,
+        help="jax.distributed coordinator address; presence (or the "
+             "QUORUM_FLEET_COORDINATOR lever) turns on the multi-host "
+             "fleet tier")
+    g.add_argument(
+        "--num-processes", type=int, default=None, metavar="N",
+        help="total processes in the fleet (QUORUM_FLEET_NUM_PROCESSES)")
+    g.add_argument(
+        "--process-id", type=int, default=None, metavar="I",
+        help="this process's rank in [0, N) (QUORUM_FLEET_PROCESS_ID)")
+
+
+def active() -> FleetContext | None:
+    """The installed fleet context, or None in a single-process run."""
+    return _state
+
+
+def ensure_initialized(args=None) -> FleetContext | None:
+    """Idempotent fleet bring-up: resolve the coordinator flags (CLI
+    args first, then the QUORUM_FLEET_* levers), call
+    ``jax.distributed.initialize`` exactly once, and install the
+    :class:`FleetContext` singleton. Without a coordinator this is a
+    no-op returning None — the single-process paths never pay for the
+    fleet tier."""
+    global _state
+    with _lock:
+        if _state is not None:
+            return _state
+    coord = getattr(args, "coordinator", None) \
+        or levers.raw("QUORUM_FLEET_COORDINATOR")
+    nproc = getattr(args, "num_processes", None)
+    if nproc is None:
+        nproc = int(levers.raw("QUORUM_FLEET_NUM_PROCESSES") or 0)
+    pid = getattr(args, "process_id", None)
+    if pid is None:
+        val = levers.raw("QUORUM_FLEET_PROCESS_ID")
+        pid = int(val) if val not in (None, "") else -1
+    import jax
+
+    if not coord or int(nproc) <= 1:
+        # a harness may have initialized jax.distributed itself;
+        # adopt its topology so the fleet paths still engage
+        if coord_client() is not None and jax.process_count() > 1:
+            ctx = FleetContext(jax.process_count(), jax.process_index())
+            with _lock:
+                _state = ctx
+            return ctx
+        return None
+    if int(pid) < 0 or int(pid) >= int(nproc):
+        raise ValueError(
+            f"--process-id must be in [0, {nproc}), got {pid}")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(nproc),
+                               process_id=int(pid))
+    ctx = FleetContext(int(nproc), int(pid), coordinator=coord)
+    with _lock:
+        _state = ctx
+    return ctx
+
+
+def global_mesh(axis: str = "hosts"):
+    """A 1-D mesh over EVERY host's devices (the pjit/PartitionSpec
+    global-table path; the partition-binned build does not need it,
+    but mesh-compiled stages do). Device order is jax.devices() —
+    identical on every host by construction."""
+    import jax
+    import numpy as np
+
+    return jax.sharding.Mesh(np.asarray(jax.devices()), (axis,))
+
+
+@contextlib.contextmanager
+def host_run():
+    """Marks a fleet-sanctioned HOST-LOCAL run (one host correcting
+    its own stage-2 file segment). The single-chip correction path
+    refuses process_count > 1 — per-host runs would race on one
+    output — except inside this context, where the fleet orchestration
+    owns the per-host output prefixes and the merge."""
+    global _host_run_depth
+    with _lock:
+        _host_run_depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _host_run_depth -= 1
+
+
+def in_host_run() -> bool:
+    return _host_run_depth > 0
+
+
+def _reset_for_tests() -> None:
+    """Drop the singleton and counters (unit tests only; real
+    processes initialize at most once)."""
+    global _state, _host_run_depth
+    with _lock:
+        _state = None
+        _host_run_depth = 0
+        _epochs.clear()

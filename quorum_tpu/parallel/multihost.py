@@ -49,10 +49,21 @@ def barrier(name: str = "quorum_barrier") -> None:
     process, so single-controller code paths (the local `--devices N`
     mesh) pay nothing; on a multi-host mesh it is the synchronization
     the sharded checkpoint protocol needs between the shard writes
-    and the manifest commit."""
-    if jax.process_count() > 1:  # pragma: no cover - needs real hosts
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+    and the manifest commit.
+
+    Transport: the jax coordination service when it is up (works on
+    every backend — XLA multiprocess collectives are unimplemented on
+    CPU, where the 2-process CI fleet runs), sync_global_devices
+    otherwise."""
+    if jax.process_count() > 1:
+        from . import fleet
+        c = fleet.coord_client()
+        if c is not None:
+            c.wait_at_barrier(fleet.barrier_uid(name),
+                              fleet.timeout_ms())
+        else:  # pragma: no cover - needs hosts without coordinator
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
 
 
 def host_shard_paths(paths: Sequence[str],
@@ -80,6 +91,23 @@ def host_shard_paths(paths: Sequence[str],
           else process_count)
     if pc <= 1:
         return list(paths)
+    owner, sizes = host_plan(paths, pc)
+    # plan agreement across hosts (real multi-host only; callers that
+    # pass explicit index/count are computing a hypothetical plan)
+    if (process_index is None and process_count is None
+            and jax.process_count() > 1):
+        _verify_plan_hash(paths, sizes, owner)
+    return [p for i, p in enumerate(paths) if owner[i] == pi]
+
+
+def host_plan(paths: Sequence[str],
+              process_count: int) -> tuple[list[int], list[int]]:
+    """The deterministic file->host assignment behind
+    host_shard_paths: `(owner, sizes)` with owner[i] the producing
+    host of paths[i]. The fleet stage-2 merge needs the full owner map
+    (not just this host's subset) to place every output segment in
+    global file order."""
+    pc = int(process_count)
 
     def size_of(p):
         try:
@@ -96,27 +124,42 @@ def host_shard_paths(paths: Sequence[str],
         h = min(range(pc), key=lambda j: (load[j], j))
         owner[i] = h
         load[h] += sizes[i] or 1
-    # plan agreement across hosts (real multi-host only; callers that
-    # pass explicit index/count are computing a hypothetical plan)
-    if (process_index is None and process_count is None
-            and jax.process_count() > 1):  # pragma: no cover - hosts
+    return owner, sizes
+
+
+def verified_host_plan(paths: Sequence[str]) -> list[int]:
+    """The full file->host owner map for the REAL process topology,
+    plan-hash-verified across hosts. The fleet stage-2 merge consumes
+    this: segment i of the merged output is paths[i]'s correction, no
+    matter which host produced it."""
+    owner, sizes = host_plan(paths, jax.process_count())
+    if jax.process_count() > 1:
         _verify_plan_hash(paths, sizes, owner)
-    return [p for i, p in enumerate(paths) if owner[i] == pi]
+    return owner
 
 
-def _verify_plan_hash(paths, sizes, owner) -> None:  # pragma: no cover
+def _verify_plan_hash(paths, sizes, owner, _broadcast=None) -> None:
     """Broadcast process 0's plan digest and require every host to
-    have computed the same one."""
+    have computed the same one — via the coordination-service KV when
+    it is up (the CI fleet transport), else the XLA collective.
+    `_broadcast` is a test seam: (digest_hex) -> process 0's
+    digest_hex."""
     import hashlib
 
-    from jax.experimental import multihost_utils
-
     digest = hashlib.sha256(json.dumps(
-        [list(paths), list(sizes), list(owner)]).encode()).digest()
-    mine = np.frombuffer(digest, np.uint8)
-    theirs = np.asarray(
-        multihost_utils.broadcast_one_to_all(mine)).astype(np.uint8)
-    if not np.array_equal(mine, theirs):
+        [list(paths), list(sizes), list(owner)]).encode()).hexdigest()
+    if _broadcast is not None:
+        theirs = _broadcast(digest)
+    else:  # pragma: no cover - needs real hosts
+        from . import fleet
+        if fleet.coord_client() is not None:
+            theirs = fleet.broadcast_text("host_plan", digest)
+        else:
+            from jax.experimental import multihost_utils
+            mine = np.frombuffer(bytes.fromhex(digest), np.uint8)
+            theirs = np.asarray(multihost_utils.broadcast_one_to_all(
+                mine)).astype(np.uint8).tobytes().hex()
+    if digest != theirs:
         raise RuntimeError(
             "host_shard_paths: input plan disagrees with process 0 "
             "(stat results differ across hosts — attribute-cache lag "
@@ -196,7 +239,16 @@ def merge_host_docs(docs: Sequence[dict]) -> dict:
             merged["counters"][k] = merged["counters"].get(k, 0) + v
         for k, v in d.get("gauges", {}).items():
             cur = merged["gauges"].get(k)
-            merged["gauges"][k] = v if cur is None else max(cur, v)
+            if cur is None:
+                merged["gauges"][k] = v
+            elif k.startswith("disk_free_bytes"):
+                # free-space gauges (ISSUE 19 resource telemetry)
+                # aggregate by MIN: the fleet-level number an operator
+                # acts on is the tightest host's headroom — a max
+                # would hide the host about to hit ENOSPC
+                merged["gauges"][k] = min(cur, v)
+            else:
+                merged["gauges"][k] = max(cur, v)
         for k, h in d.get("histograms", {}).items():
             m = merged["histograms"].setdefault(
                 k, {"count": 0, "sum": 0, "counts": {}})
@@ -232,7 +284,12 @@ def _allgather_bytes(payload: bytes) -> list[bytes]:
     plane). Single-process: the identity."""
     if jax.process_count() == 1:
         return [payload]
-    from jax.experimental import multihost_utils
+    from . import fleet
+    if fleet.coord_client() is not None:
+        # coordination-service transport: works on the CPU backend
+        # (the CI fleet) and keeps metrics documents off the ICI
+        return fleet.exchange_bytes("multihost.allgather", payload)
+    from jax.experimental import multihost_utils  # pragma: no cover
 
     n = np.asarray([len(payload)], np.int32)
     lens = np.asarray(

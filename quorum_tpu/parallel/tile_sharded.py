@@ -79,7 +79,11 @@ def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
 
 
 def make_mesh(n_devices: int, devices=None) -> Mesh:
-    devs = devices if devices is not None else jax.devices()[:n_devices]
+    # local_devices, not devices: this is the HOST-LOCAL mesh — on a
+    # multi-host fleet jax.devices() is global and slicing it would
+    # hand host 1 a device it cannot address
+    devs = (devices if devices is not None
+            else jax.local_devices()[:n_devices])
     if len(devs) < n_devices:
         raise ValueError(f"need {n_devices} devices, have {len(devs)}")
     return Mesh(np.asarray(devs[:n_devices]), (AXIS,))
@@ -93,7 +97,7 @@ def resolve_devices(spec) -> int:
     exactly that many. 1 is the single-chip path; anything larger
     must be a power of two (the leading-bit shard layout) and
     actually present."""
-    avail = len(jax.devices())
+    avail = len(jax.local_devices())  # per-host count on a fleet
     # auto/all must never pick an unusable count: round DOWN to the
     # largest power of two the leading-bit layout can shard over
     pow2 = 1 << (avail.bit_length() - 1)

@@ -190,6 +190,22 @@ RESOURCE_COUNTERS = ("writer_degraded_total",
 RESOURCE_GAUGES = ("disk_free_bytes_min", "host_rss_bytes")
 RESOURCE_GAUGE_PREFIX = "disk_free_bytes{path="
 
+# The multi-host fleet surface (ISSUE 20): a document whose meta
+# declares `host_process_count > 1` is the ONE aggregated fleet
+# document multihost.aggregate_metrics writes on process 0. It must
+# carry the per-host shard documents under top-level `hosts` (exactly
+# host_process_count of them, meta.aggregated_hosts agreeing), the
+# fleet-reduced resource gauges (free-space gauges min-reduced across
+# hosts — see merge_host_docs — so the document reports the TIGHTEST
+# disk anywhere in the fleet), and, for every host shard whose meta
+# declares compile_sentinel, at least one per-site
+# `compiles{site="..."}` counter in that shard (a sentinel host whose
+# compile ledger vanished is a host whose compile telemetry was
+# dropped, not a host that compiled nothing — stage CLIs always jit).
+FLEET_META = ("host_process_count", "aggregated_hosts")
+FLEET_GAUGES = RESOURCE_GAUGES
+FLEET_COMPILE_PREFIX = "compiles{site="
+
 # The sharded (--devices N) metric surface (ISSUE 5): a stage-1
 # document built over more than one shard must carry the per-shard
 # telemetry parallel/tile_sharded.record_shard_metrics writes.

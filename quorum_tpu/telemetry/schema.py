@@ -55,6 +55,46 @@ def _is_number(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+def _validate_fleet_shape(doc: dict) -> list[str]:
+    """Structural fleet consistency (ISSUE 20): a document whose meta
+    declares `host_process_count > 1` was aggregated from a multi-host
+    fleet run — its `hosts` section must carry exactly one shard per
+    process, and every shard's own meta.host_process_index must be a
+    distinct in-range process id (two shards claiming one index means
+    a host's document was overwritten; a missing index means one was
+    never collected). Name-level requirements (resource gauges,
+    compile ledgers) live in tools/metrics_check.py."""
+    errs: list[str] = []
+    meta = doc.get("meta", {})
+    pc = meta.get("host_process_count")
+    if pc is None:
+        return errs
+    if not isinstance(pc, int) or isinstance(pc, bool) or pc < 1:
+        return [f"meta.host_process_count must be a positive "
+                f"integer, got {pc!r}"]
+    if pc <= 1:
+        return errs
+    hosts = doc.get("hosts", {})
+    if len(hosts) != pc:
+        errs.append(f"meta.host_process_count={pc} but {len(hosts)} "
+                    "host shard(s) present")
+    indices = []
+    for hk in sorted(hosts):
+        hmeta = hosts[hk].get("meta", {}) if isinstance(
+            hosts[hk], dict) else {}
+        idx = hmeta.get("host_process_index")
+        if not isinstance(idx, int) or isinstance(idx, bool) \
+                or not 0 <= idx < pc:
+            errs.append(f"hosts[{hk!r}]: meta.host_process_index "
+                        f"{idx!r} is not a process id in [0, {pc})")
+        else:
+            indices.append(idx)
+    if len(set(indices)) != len(indices):
+        errs.append("duplicate meta.host_process_index across host "
+                    "shards (one host's document overwrote another's)")
+    return errs
+
+
 def validate_metrics(doc, _nested: bool = False) -> list[str]:
     """Validate a final metrics document (optionally carrying a
     multi-host `hosts` section of per-host shard documents). Returns
@@ -93,6 +133,7 @@ def validate_metrics(doc, _nested: bool = False) -> list[str]:
             for hk, hdoc in doc["hosts"].items():
                 errs.extend(f"hosts[{hk!r}]: {e}" for e in
                             validate_metrics(hdoc, _nested=True))
+            errs.extend(_validate_fleet_shape(doc))
     if not _nested and "events" in doc:
         if not isinstance(doc["events"], list):
             errs.append("events is not a list")

@@ -191,6 +191,10 @@ SITES: dict[str, str] = {
                      "batch= (the stream index) and path= — an "
                      "errno=28 io_error here is the required-output "
                      "ENOSPC fail-fast case (ISSUE 19)",
+    "fleet.exchange": "before each multi-host fleet KV exchange "
+                      "(parallel/fleet.exchange_bytes); carries "
+                      "batch= (the per-tag epoch) — an exit here is "
+                      "the kill-one-host fleet resume test",
 }
 
 def render_docs() -> str:

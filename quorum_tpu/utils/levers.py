@@ -99,6 +99,24 @@ _declare(
     "env fallback behind `--fault-plan`, how subprocesses under test "
     "inherit a plan (utils/faults.py).")
 _declare(
+    "QUORUM_FLEET_BARRIER_TIMEOUT_S", "float", "600",
+    "Multi-host fleet barrier/exchange timeout in seconds (parallel/"
+    "fleet.py): a host that never reaches a fleet barrier or KV "
+    "exchange turns into a loud timeout instead of a silent wedge.")
+_declare(
+    "QUORUM_FLEET_COORDINATOR", "str", "(none)",
+    "jax.distributed coordinator address (HOST:PORT) — the env "
+    "fallback behind the CLIs' --coordinator flag; presence turns on "
+    "the multi-host fleet tier (parallel/fleet.ensure_initialized).")
+_declare(
+    "QUORUM_FLEET_NUM_PROCESSES", "int", "0",
+    "Total fleet process count — the env fallback behind "
+    "--num-processes (parallel/fleet.ensure_initialized).")
+_declare(
+    "QUORUM_FLEET_PROCESS_ID", "int", "(unset)",
+    "This process's fleet rank in [0, N) — the env fallback behind "
+    "--process-id (parallel/fleet.ensure_initialized).")
+_declare(
     "QUORUM_FLIGHT", "bool", "1",
     "The always-on flight recorder (telemetry/flight.py): 0 disables "
     "the ring taps and crash dumps entirely (the perf A/B control).")

@@ -1,0 +1,239 @@
+"""Multi-host fleet tier unit tests (ISSUE 20): partition planning,
+pass ownership, the order-preserving stage-2 segment merge, host
+scoping of shared paths, bring-up idempotence, the host-run sanction,
+and the sharded-checkpoint fleet agreement check. The live 2-process
+fleet (real coordination service, byte-identity, kill-one-host
+resume) is exercised end-to-end by tools/fleet_smoke.py in tier 1;
+these tests pin the pure planning/merge logic every host computes
+independently."""
+
+import os
+
+import numpy as np
+import pytest
+
+import conftest
+from quorum_tpu.io import checkpoint as ckpt_mod
+from quorum_tpu.models.create_database import BuildConfig, BuildStats
+from quorum_tpu.parallel import fleet
+from quorum_tpu.parallel import tile_sharded as ts
+
+
+# ---------------------------------------------------------------------------
+# partition planning and pass ownership
+# ---------------------------------------------------------------------------
+
+def test_plan_partitions_power_of_two_floor():
+    # next power of two >= max(requested, processes, 1)
+    assert fleet.plan_partitions(1, 1) == 1
+    assert fleet.plan_partitions(0, 1) == 1
+    assert fleet.plan_partitions(1, 2) == 2
+    assert fleet.plan_partitions(2, 2) == 2
+    assert fleet.plan_partitions(4, 2) == 4
+    assert fleet.plan_partitions(3, 5) == 8
+    assert fleet.plan_partitions(8, 3) == 8
+    assert fleet.plan_partitions(9, 2) == 16
+
+
+def test_owns_pass_partitions_cover_disjoint():
+    """Every pass has exactly one owner; every host owns >= 1 pass
+    whenever P >= num_processes (which plan_partitions guarantees)."""
+    for pc in (1, 2, 3, 4):
+        P = fleet.plan_partitions(4, pc)
+        ctxs = [fleet.FleetContext(pc, h) for h in range(pc)]
+        for p in range(P):
+            owners = [h for h, c in enumerate(ctxs) if c.owns_pass(p)]
+            assert owners == [p % pc]
+        for c in ctxs:
+            assert any(c.owns_pass(p) for p in range(P))
+
+
+def test_grow_vote_single_process_identity():
+    assert fleet.FleetContext(1, 0).grow_vote(7) == 7
+
+
+def test_grow_vote_adopts_fleet_max(monkeypatch):
+    monkeypatch.setattr(fleet, "exchange_json",
+                        lambda tag, obj: [obj, 9, 6])
+    assert fleet.FleetContext(3, 0).grow_vote(7) == 9
+
+
+# ---------------------------------------------------------------------------
+# host scoping of shared paths
+# ---------------------------------------------------------------------------
+
+def test_host_scoped_path_and_idempotence():
+    assert fleet.host_scoped_path("m.json", 1) == "m.host0001.json"
+    assert fleet.host_scoped_path("/a/b/m.jsonl", 0) == \
+        "/a/b/m.host0000.jsonl"
+    # the driver scopes its base, then forwards DERIVED per-stage
+    # paths to the in-process stage CLIs, which scope again
+    once = fleet.host_scoped_path("m.json", 2)
+    assert fleet.host_scoped_path(once, 2) == once
+    derived = "m.host0002.stage1.json"
+    assert fleet.host_scoped_path(derived, 2) == derived
+    # a DIFFERENT host's marker does not suppress scoping
+    assert fleet.host_scoped_path("m.host0001.json", 2) == \
+        "m.host0001.host0002.json"
+
+
+def test_host_scoped_dir():
+    c = fleet.FleetContext(2, 1)
+    assert c.host_scoped_dir("/ck") == "/ck/host0001"
+
+
+# ---------------------------------------------------------------------------
+# the order-preserving stage-2 segment merge
+# ---------------------------------------------------------------------------
+
+def _write_segments(tmp_path, n, suffixes=(".fa", ".log")):
+    prefix = str(tmp_path / "out")
+    for gi in range(n):
+        for s in suffixes:
+            with open(fleet.segment_prefix(prefix, gi) + s, "wb") as f:
+                f.write(f"seg{gi}{s};".encode())
+    return prefix
+
+
+def test_fleet_merge_preserves_global_file_order(tmp_path):
+    prefix = _write_segments(tmp_path, 3)
+    fleet.fleet_merge(prefix, 3)
+    assert open(prefix + ".fa", "rb").read() == \
+        b"seg0.fa;seg1.fa;seg2.fa;"
+    assert open(prefix + ".log", "rb").read() == \
+        b"seg0.log;seg1.log;seg2.log;"
+    # segments are consumed by default
+    assert not [p for p in os.listdir(str(tmp_path)) if ".fleet" in p]
+
+
+def test_fleet_merge_keep_segments(tmp_path):
+    prefix = _write_segments(tmp_path, 2)
+    fleet.fleet_merge(prefix, 2, keep_segments=True)
+    assert os.path.exists(fleet.segment_prefix(prefix, 0) + ".fa")
+    assert open(prefix + ".fa", "rb").read() == b"seg0.fa;seg1.fa;"
+
+
+def test_fleet_merge_missing_segment_is_hard_error(tmp_path):
+    prefix = _write_segments(tmp_path, 3)
+    os.remove(fleet.segment_prefix(prefix, 1) + ".fa")
+    with pytest.raises(RuntimeError, match="missing output segment"):
+        fleet.fleet_merge(prefix, 3)
+    # no partial merged output left behind (tmp cleaned up)
+    assert not os.path.exists(prefix + ".fa")
+    assert not [p for p in os.listdir(str(tmp_path))
+                if p.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# bring-up and the host-run sanction
+# ---------------------------------------------------------------------------
+
+def test_ensure_initialized_single_process_noop(monkeypatch):
+    for var in ("QUORUM_FLEET_COORDINATOR",
+                "QUORUM_FLEET_NUM_PROCESSES",
+                "QUORUM_FLEET_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    fleet._reset_for_tests()
+    assert fleet.ensure_initialized() is None
+    assert fleet.active() is None
+
+
+def test_ensure_initialized_rejects_bad_process_id(monkeypatch):
+    fleet._reset_for_tests()
+    # resolving flags must fail loudly BEFORE jax.distributed runs
+    class A:
+        coordinator = "127.0.0.1:1"
+        num_processes = 2
+        process_id = 2
+    with pytest.raises(ValueError, match=r"process-id must be in"):
+        fleet.ensure_initialized(A())
+    fleet._reset_for_tests()
+
+
+def test_exchange_bytes_single_process_identity():
+    assert fleet.exchange_bytes("t", b"x", process_index=0,
+                                process_count=1) == [b"x"]
+
+
+def test_global_mesh_spans_local_devices_single_process():
+    # single-process: jax.devices() IS the local set, so the fleet's
+    # global mesh is a 1-D mesh over it under the named axis
+    import jax
+
+    mesh = fleet.global_mesh("hosts")
+    assert mesh.axis_names == ("hosts",)
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_host_run_nesting():
+    assert not fleet.in_host_run()
+    with fleet.host_run():
+        assert fleet.in_host_run()
+        with fleet.host_run():
+            assert fleet.in_host_run()
+        assert fleet.in_host_run()
+    assert not fleet.in_host_run()
+
+
+# ---------------------------------------------------------------------------
+# sharded-checkpoint fleet generalization (io/checkpoint)
+# ---------------------------------------------------------------------------
+
+K, BATCH = 16, 64
+
+
+def _saved_sharded_ckpt(tmp_path):
+    mesh = ts.make_mesh(2, conftest.cpu_devices(2))
+    meta = ts.TileShardedMeta(k=K, bits=7, rb_log2=6, n_shards=2)
+    bstate = ts.make_build_state(meta, mesh)
+    cfg = BuildConfig(k=K, bits=7, qual_thresh=53, batch_size=BATCH,
+                      devices=2)
+    stats = BuildStats(reads=10, bases=480, batches=3)
+    ck = ckpt_mod.Stage1ShardedCheckpoint(str(tmp_path))
+    ck.save(bstate, meta, cfg, 3, stats, ["a.fastq"])
+    return ck, meta, bstate
+
+
+def test_sharded_load_shard_subset(tmp_path):
+    """A fleet host restores only the shards its devices hold; the
+    subset planes equal the matching rows of the full restore."""
+    ck, meta, bstate = _saved_sharded_ckpt(tmp_path)
+    full = ck.load()
+    rows_local = meta.rows // 2
+    for s in (0, 1):
+        part = ck.load(shards=[s])
+        np.testing.assert_array_equal(
+            part.tag, full.tag[s * rows_local:(s + 1) * rows_local])
+        assert part.cursor == full.cursor
+    # empty subset still restores the manifest (cursor agreement)
+    empty = ck.load(shards=[])
+    assert empty.cursor == full.cursor and empty.tag.shape[0] == 0
+    with pytest.raises(ckpt_mod.CheckpointError, match="shard 5"):
+        ck.load(shards=[5])
+
+
+def test_sharded_fleet_agreement(tmp_path):
+    """Hosts agreeing on the committed manifest proceed; any digest
+    divergence (or one host seeing no manifest) refuses LOUDLY."""
+    ck, _, _ = _saved_sharded_ckpt(tmp_path)
+    agreed = ck.fleet_agreement(
+        exchange=lambda tag, digest: [digest, digest])
+    assert agreed is not None and int(agreed["cursor"]) == 3
+    with pytest.raises(ckpt_mod.CheckpointError, match="disagree"):
+        ck.fleet_agreement(
+            exchange=lambda tag, digest: [digest, "deadbeef"])
+    # a host with NO manifest while a peer has one must refuse too
+    other = ckpt_mod.Stage1ShardedCheckpoint(str(tmp_path / "empty"))
+    with pytest.raises(ckpt_mod.CheckpointError, match="disagree"):
+        other.fleet_agreement(
+            exchange=lambda tag, digest: [digest, "somedigest"])
+    # no manifest ANYWHERE is a clean cold start, not an error
+    assert other.fleet_agreement(
+        exchange=lambda tag, digest: [digest, digest]) is None
+
+
+def test_sharded_fleet_agreement_single_process(tmp_path):
+    """Without an active fleet the check is a local manifest read."""
+    fleet._reset_for_tests()
+    ck, _, _ = _saved_sharded_ckpt(tmp_path)
+    assert int(ck.fleet_agreement()["cursor"]) == 3

@@ -2,7 +2,11 @@
 deterministic, partitioning (no file lost, none duplicated), and
 size-balanced; single-process behavior is the identity."""
 
+import json
+import os
+
 import numpy as np
+import pytest
 
 from quorum_tpu.parallel import multihost
 
@@ -174,3 +178,147 @@ def test_aggregate_metrics_single_process_identity(tmp_path):
     assert merged["counters"]["host_reads"] == 7
     assert merged["meta"]["aggregated_hosts"] == 1
     assert merged["hosts"]["0"]["counters"]["host_reads"] == 7
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: fleet host-plan edge cases, plan agreement, gauge reduce
+# ---------------------------------------------------------------------------
+
+def test_host_plan_more_hosts_than_files(tmp_path):
+    """A fleet larger than the input file set: every file still has
+    exactly one owner, surplus hosts get an EMPTY producer share (they
+    must still hit every barrier — fleet orchestration, not the plan,
+    guarantees that), and nothing is double-assigned."""
+    paths = _mk_files(tmp_path, [100, 5000])
+    pc = 5
+    owner, sizes = multihost.host_plan(paths, pc)
+    assert len(owner) == len(paths) and len(sizes) == len(paths)
+    assert all(0 <= h < pc for h in owner)
+    assert len(set(owner)) == len(paths)  # distinct hosts while they last
+    shares = [multihost.host_shard_paths(paths, pi, pc)
+              for pi in range(pc)]
+    assert sorted(p for s in shares for p in s) == sorted(paths)
+    assert sum(1 for s in shares if not s) == pc - len(paths)
+    # an empty share drains immediately as an empty batch stream
+    for pi in range(pc):
+        if not shares[pi]:
+            assert list(multihost.read_batches_multihost(
+                shares[pi], 4)) == []
+
+
+def test_host_plan_uneven_sizes_balance(tmp_path):
+    """One huge file plus many small ones: the greedy plan puts the
+    huge file alone on one host and spreads the rest."""
+    paths = _mk_files(tmp_path, [100_000, 10, 10, 10, 10, 10])
+    owner, sizes = multihost.host_plan(paths, 2)
+    big_host = owner[0]
+    assert all(h != big_host for h in owner[1:])
+
+
+def test_verify_plan_hash_divergence_is_loud(tmp_path):
+    """The defense-in-depth plan agreement: a host whose stat results
+    produced a different plan than process 0's must refuse to shard,
+    never silently double-parse or drop files."""
+    paths = [str(p) for p in _mk_files(tmp_path, [10, 20])]
+    owner, sizes = multihost.host_plan(paths, 2)
+    # agreement: process 0 broadcasts the same digest we computed
+    multihost._verify_plan_hash(paths, sizes, owner,
+                                _broadcast=lambda d: d)
+    with pytest.raises(RuntimeError, match="disagrees with process 0"):
+        multihost._verify_plan_hash(paths, sizes, owner,
+                                    _broadcast=lambda d: "0" * 64)
+
+
+def _doc_with_gauges(pi, gauges):
+    reg = _host_reg(10, 1, {0: 1}, 1.0, pi)
+    for k, v in gauges.items():
+        reg.gauge(k).set(v)
+    return reg.as_dict()
+
+
+def test_merge_host_docs_free_space_gauges_reduce_by_min():
+    """Resource gauges in the fleet aggregate (ISSUE 19 -> 20): free
+    space reduces by MIN (the fleet-level number an operator acts on
+    is the tightest host's headroom), per-path labeled gauges
+    included; RSS keeps the default high-water MAX."""
+    from quorum_tpu.parallel.multihost import merge_host_docs
+    d0 = _doc_with_gauges(0, {
+        "disk_free_bytes_min": 500, "host_rss_bytes": 1000,
+        'disk_free_bytes{path="/ck"}': 800})
+    d1 = _doc_with_gauges(1, {
+        "disk_free_bytes_min": 200, "host_rss_bytes": 3000,
+        'disk_free_bytes{path="/ck"}': 900})
+    g = merge_host_docs([d0, d1])["gauges"]
+    assert g["disk_free_bytes_min"] == 200
+    assert g['disk_free_bytes{path="/ck"}'] == 800
+    assert g["host_rss_bytes"] == 3000
+
+
+def test_push_receiver_fleet_merge_inherits_min_rule():
+    """The push-receiver fleet aggregate rides merge_host_docs, so its
+    free-space gauges min-reduce too."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "push_receiver", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "push_receiver.py"))
+    pr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pr)
+    d0 = _doc_with_gauges(0, {"disk_free_bytes_min": 50})
+    d1 = _doc_with_gauges(1, {"disk_free_bytes_min": 900})
+    merged = pr.merge_fleet({"hostA": d0, "hostB": d1})
+    assert merged["gauges"]["disk_free_bytes_min"] == 50
+    assert merged["meta"]["fleet_hosts"] == ["hostA", "hostB"]
+
+
+def test_fleet_aggregated_document_schema_contract():
+    """The ISSUE 20 fleet-document contract: meta.host_process_count
+    > 1 requires one host shard per process with distinct in-range
+    host_process_index values (telemetry/schema), and the name-level
+    gate (tools/metrics_check) requires the fleet-reduced resource
+    gauges plus each sentinel host's compile ledger."""
+    from quorum_tpu.parallel.multihost import merge_host_docs
+    from quorum_tpu.telemetry import validate_metrics
+
+    def shard(pi):
+        reg = _host_reg(10, 1, {0: 1}, 1.0, pi)
+        reg.set_meta(host_process_count=2, host_process_index=pi,
+                     compile_sentinel=True)
+        reg.gauge("disk_free_bytes_min").set(100 + pi)
+        reg.gauge("host_rss_bytes").set(1000)
+        reg.gauge('disk_free_bytes{path="/o"}').set(50)
+        reg.counter('compiles{site="stage1.insert"}').inc()
+        return reg.as_dict()
+
+    merged = merge_host_docs([shard(0), shard(1)])
+    assert validate_metrics(merged) == []
+
+    # a dropped host shard fails the schema shape check
+    broken = json.loads(json.dumps(merged))
+    del broken["hosts"]["1"]
+    broken["meta"]["aggregated_hosts"] = 1
+    assert any("host shard" in e for e in validate_metrics(broken))
+
+    # duplicate process indices (one host overwrote another) fail
+    dup = json.loads(json.dumps(merged))
+    dup["hosts"]["1"]["meta"]["host_process_index"] = 0
+    assert any("duplicate" in e for e in validate_metrics(dup))
+
+    # name-level gate: the checker requires the reduced gauges and
+    # each sentinel host's compiles{site=} ledger
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "metrics_check", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "metrics_check.py"))
+    mc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mc)
+    assert mc._check_multihost_fleet(merged) == []
+    nogauge = json.loads(json.dumps(merged))
+    del nogauge["gauges"]["disk_free_bytes_min"]
+    assert any("disk_free_bytes_min" in e
+               for e in mc._check_multihost_fleet(nogauge))
+    noledger = json.loads(json.dumps(merged))
+    del noledger["hosts"]["0"]["counters"]['compiles{site="stage1.insert"}']
+    assert any("compile ledger was dropped" in e
+               for e in mc._check_multihost_fleet(noledger))

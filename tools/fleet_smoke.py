@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Golden 2-process CPU fleet run for CI (ci/tier1.sh): the ISSUE 20
+acceptance properties, end to end, on the committed golden reads.
+
+1. Split tests/golden/reads.fastq into two input files, run the
+   `quorum` driver single-process (`--devices 1 --partitions 2` — the
+   geometry a 2-process fleet plans), then run it as a REAL 2-process
+   fleet (two subprocesses, `--coordinator 127.0.0.1:PORT` over
+   `jax.distributed` + the coordination-service KV transport), and
+   assert the database table payload and the corrected `.fa`/`.log`
+   are BYTE-IDENTICAL — a fleet must never change the answer.
+2. Hard-kill one host mid-stage-1 (`os._exit` fault plan on process 1
+   only, per-pass partition cursor checkpoints), relaunch BOTH hosts
+   with `--resume`, and assert the finished fleet output is still
+   byte-identical to the single-process run.
+3. Leave the fleet telemetry in --out-dir for the metrics_check gates
+   that follow:
+     fleet_metrics.hosts.json — the ONE aggregated fleet document
+       (meta.host_process_count=2, per-host shards, min-reduced
+       resource gauges; parallel/multihost.aggregate_metrics)
+
+Exit 0 = all checks passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+KILL_CODE = 43
+BATCH_SIZE = 64  # 242 golden reads split 2 ways -> 2 batches per file
+LAUNCH_TIMEOUT_S = 420
+
+
+def _split_golden(out_dir: str) -> list[str]:
+    """The golden reads as TWO fastq files (4-line records, split at a
+    read boundary) — the fleet's per-host producer unit is the file."""
+    with open(os.path.join(GOLDEN, "reads.fastq"), "rb") as f:
+        lines = f.readlines()
+    assert len(lines) % 4 == 0, "golden fastq is 4-line records"
+    n_reads = len(lines) // 4
+    cut = (n_reads // 2) * 4
+    paths = []
+    for i, chunk in enumerate((lines[:cut], lines[cut:])):
+        p = os.path.join(out_dir, f"reads_part{i}.fastq")
+        with open(p, "wb") as f:
+            f.writelines(chunk)
+        paths.append(p)
+    return paths
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_fleet(argv_common: list[str], reads: list[str],
+                  env_by_pid: dict | None = None) -> list:
+    """Two driver subprocesses forming one fleet; returns the Popen
+    pair (process-id order)."""
+    port = _free_port()
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # a wedged fleet must die loudly inside the CI budget
+        env.setdefault("QUORUM_FLEET_BARRIER_TIMEOUT_S", "120")
+        if env_by_pid and pid in env_by_pid:
+            env.update(env_by_pid[pid])
+        cmd = ([sys.executable, "-m", "quorum_tpu.cli.quorum"]
+               + argv_common
+               + ["--coordinator", f"127.0.0.1:{port}",
+                  "--num-processes", "2", "--process-id", str(pid)]
+               + reads)
+        procs.append(subprocess.Popen(cmd, cwd=REPO, env=env))
+    return procs
+
+
+def _wait_all(procs, timeout=LAUNCH_TIMEOUT_S) -> list[int]:
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=timeout))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs.append(p.wait())
+    return rcs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Golden 2-process CPU fleet run: byte parity vs "
+                    "single-process plus a kill-one-host fleet resume "
+                    "(ci/tier1.sh gate)")
+    p.add_argument("--out-dir", default=None,
+                   help="Where the work files and metrics land "
+                        "(default: a temp dir)")
+    args = p.parse_args(argv)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    reads = _split_golden(out_dir)
+    base = ["-s", "64k", "-k", "13",
+            "--batch-size", str(BATCH_SIZE), "--devices", "1"]
+
+    # -- single-process reference at the fleet's planned geometry -----
+    ref_prefix = os.path.join(out_dir, "ref")
+    print("[fleet_smoke] reference: quorum --devices 1 --partitions 2")
+    from quorum_tpu.cli import quorum as quorum_cli
+    rc = quorum_cli.main(base + ["--partitions", "2",
+                                 "-p", ref_prefix] + reads)
+    if rc != 0:
+        print(f"[fleet_smoke] FAIL: single-process reference rc {rc}",
+              file=sys.stderr)
+        return 1
+    from quorum_tpu.io.db_format import db_payload_bytes
+    ref_db = db_payload_bytes(ref_prefix + "_mer_database.jf")
+    ref_fa = open(ref_prefix + ".fa", "rb").read()
+    ref_log = open(ref_prefix + ".log", "rb").read()
+
+    # -- the 2-process fleet: byte parity -----------------------------
+    fleet_prefix = os.path.join(out_dir, "fleet")
+    metrics = os.path.join(out_dir, "fleet_metrics.json")
+    print("[fleet_smoke] fleet: 2 processes over jax.distributed")
+    rcs = _wait_all(_launch_fleet(
+        base + ["-p", fleet_prefix, "--metrics", metrics], reads))
+    if rcs != [0, 0]:
+        print(f"[fleet_smoke] FAIL: fleet driver rcs {rcs}",
+              file=sys.stderr)
+        return 1
+    if db_payload_bytes(fleet_prefix + "_mer_database.jf") != ref_db:
+        print("[fleet_smoke] FAIL: fleet database payload differs "
+              "from single-process (must be byte-identical)",
+              file=sys.stderr)
+        return 1
+    if (open(fleet_prefix + ".fa", "rb").read() != ref_fa
+            or open(fleet_prefix + ".log", "rb").read() != ref_log):
+        print("[fleet_smoke] FAIL: fleet .fa/.log differ from "
+              "single-process (must be byte-identical)",
+              file=sys.stderr)
+        return 1
+    print(f"[fleet_smoke] parity OK ({len(ref_fa)} fa bytes, "
+          f"{len(ref_db)} db payload bytes)")
+
+    # the ONE aggregated fleet document (process 0 wrote it at the
+    # original --metrics base)
+    hosts_doc_path = os.path.join(out_dir, "fleet_metrics.hosts.json")
+    if not os.path.exists(hosts_doc_path):
+        print("[fleet_smoke] FAIL: no aggregated fleet document at "
+              f"{hosts_doc_path}", file=sys.stderr)
+        return 1
+    doc = json.load(open(hosts_doc_path))
+    if (doc.get("meta", {}).get("host_process_count") != 2
+            or len(doc.get("hosts", {})) != 2):
+        print("[fleet_smoke] FAIL: aggregated document does not carry "
+              "2 host shards with meta.host_process_count=2",
+              file=sys.stderr)
+        return 1
+
+    # -- kill one host mid-stage-1, fleet --resume --------------------
+    kill_prefix = os.path.join(out_dir, "killed")
+    ckdir = os.path.join(out_dir, "ck")
+    plan = json.dumps([{"site": "stage1.insert", "batch": 1,
+                        "action": "exit", "code": KILL_CODE}])
+    kill_args = base + ["-p", kill_prefix, "--checkpoint-dir", ckdir,
+                        "--checkpoint-every", "1"]
+    print(f"[fleet_smoke] killing host 1 mid-stage-1 ({plan})")
+    procs = _launch_fleet(
+        kill_args, reads,
+        env_by_pid={1: {"QUORUM_FAULT_PLAN": plan,
+                        # the survivor must time out fast once its
+                        # peer is dead, not burn the CI budget
+                        "QUORUM_FLEET_BARRIER_TIMEOUT_S": "120"}})
+    try:
+        rc1 = procs[1].wait(timeout=LAUNCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        procs[1].kill()
+        rc1 = procs[1].wait()
+    if rc1 != KILL_CODE:
+        _wait_all(procs)
+        print(f"[fleet_smoke] FAIL: killed host exited {rc1}, want "
+              f"{KILL_CODE}", file=sys.stderr)
+        return 1
+    # the survivor is blocked on its dead peer: take it down
+    procs[0].terminate()
+    try:
+        procs[0].wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait()
+    print("[fleet_smoke] host 1 killed at stage-1 batch 1; survivor "
+          "reaped; relaunching fleet with --resume")
+    rcs = _wait_all(_launch_fleet(kill_args + ["--resume"], reads))
+    if rcs != [0, 0]:
+        print(f"[fleet_smoke] FAIL: fleet resume rcs {rcs}",
+              file=sys.stderr)
+        return 1
+    if db_payload_bytes(kill_prefix + "_mer_database.jf") != ref_db:
+        print("[fleet_smoke] FAIL: resumed fleet database differs "
+              "from single-process", file=sys.stderr)
+        return 1
+    if (open(kill_prefix + ".fa", "rb").read() != ref_fa
+            or open(kill_prefix + ".log", "rb").read() != ref_log):
+        print("[fleet_smoke] FAIL: resumed fleet .fa/.log differ "
+              "from single-process", file=sys.stderr)
+        return 1
+
+    print("[fleet_smoke] OK: 2-process fleet parity and kill-one-host "
+          f"resume byte-identical; metrics -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

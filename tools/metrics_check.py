@@ -32,7 +32,10 @@ whose meta declares a `--profile` directory must carry the
 device-truth devtrace metrics (DEVTRACE_*, ISSUE 10); one declaring
 `metrics_push_url` must carry the push-transport counters (PUSH_*);
 and a push-receiver fleet aggregate (meta.fleet) must carry per-host
-shards matching meta.fleet_hosts. A document declaring alert rules
+shards matching meta.fleet_hosts. A multi-host fleet run's aggregated
+document (meta.host_process_count > 1, ISSUE 20) must carry exactly
+one host shard per process under `hosts`, the min-reduced resource
+gauges, and each sentinel host's per-site compile counters. A document declaring alert rules
 active (meta.alert_rules, ISSUE 11) must carry the alert engine's
 counters/gauges with `alerts_firing{rule=}` values in {0, 1} naming
 declared rules; `meta.autotune_profile`, when present, must be a
@@ -88,6 +91,9 @@ from quorum_tpu.telemetry.contract import (  # noqa: E402,F401
     DEVTRACE_HISTOGRAMS,
     DEVTRACE_META,
     FAULT_COUNTERS,
+    FLEET_COMPILE_PREFIX,
+    FLEET_GAUGES,
+    FLEET_META,
     FLIGHT_COUNTERS,
     INTEGRITY_COUNTERS,
     LIVE_INGEST_COUNTERS,
@@ -167,7 +173,20 @@ def _check_memfrugal_names(doc: dict) -> list[str]:
                 errs.append(f"document with meta.partitions={parts} "
                             f"missing counter {name!r}")
         gauges = doc.get("gauges", {})
+        # a PER-HOST fleet shard (ISSUE 20) runs only the passes it
+        # owns (p % host_process_count == host_process_index), so
+        # only those gauges can exist in it; the aggregated document
+        # merges the full set and is held to every partition
+        try:
+            pc = int(meta.get("host_process_count") or 1)
+            pi = int(meta.get("host_process_index") or 0)
+        except (TypeError, ValueError):
+            pc, pi = 1, 0
+        fleet_shard = pc > 1 and "hosts" not in doc \
+            and "aggregated_hosts" not in meta
         for p in range(parts):
+            if fleet_shard and p % pc != pi:
+                continue
             gname = f'{PARTITION_GAUGE_PREFIX}"{p}"}}'
             if gname not in gauges:
                 errs.append(
@@ -340,6 +359,64 @@ def _check_fleet_doc(doc: dict) -> list[str]:
         errs.append(
             f"fleet document meta.fleet_hosts={names!r} does not "
             f"match hosts keys {sorted(hosts)}")
+    return errs
+
+
+def _check_multihost_fleet(doc: dict) -> list[str]:
+    """Multi-host fleet requirements (ISSUE 20): dispatch on
+    meta.host_process_count > 1 — the ONE aggregated document
+    multihost.aggregate_metrics writes on process 0 of a fleet run.
+    It must carry exactly one host shard per process under `hosts`,
+    the fleet-reduced resource gauges (free space min-reduced across
+    hosts, so the document reports the tightest disk anywhere in the
+    fleet), and — for every host shard declaring compile_sentinel —
+    that host's per-site compiles{site=...} counters (a sentinel host
+    with no ledger is a host whose compile telemetry was dropped)."""
+    meta = doc.get("meta", {})
+    try:
+        pc = int(meta.get("host_process_count") or 1)
+    except (TypeError, ValueError):
+        return ["meta.host_process_count is not an integer"]
+    if pc <= 1:
+        return []
+    if "hosts" not in doc and "aggregated_hosts" not in meta:
+        # a PER-HOST shard document (the host-scoped --metrics files
+        # each fleet process writes) also carries host_process_count;
+        # the aggregate contract applies to the one merged document,
+        # which CI gates by name (fleet_metrics.hosts.json)
+        return []
+    errs = []
+    why = f"meta.host_process_count={pc}"
+    for name in FLEET_META:
+        if name not in meta:
+            errs.append(f"fleet document ({why}) missing meta.{name}")
+    hosts = doc.get("hosts")
+    if not isinstance(hosts, dict) or len(hosts) != pc:
+        errs.append(
+            f"fleet document ({why}) must carry exactly {pc} host "
+            f"shard(s) under 'hosts', got "
+            f"{sorted(hosts) if isinstance(hosts, dict) else hosts!r}")
+        hosts = {}
+    for name in FLEET_GAUGES:
+        if name not in doc.get("gauges", {}):
+            errs.append(f"fleet document ({why}) missing fleet-"
+                        f"reduced gauge {name!r}")
+    for hname in sorted(hosts):
+        hdoc = hosts[hname]
+        if not isinstance(hdoc, dict):
+            errs.append(f"fleet host shard {hname!r} is not a "
+                        "document")
+            continue
+        if not hdoc.get("meta", {}).get("compile_sentinel"):
+            continue
+        hcounters = hdoc.get("counters", {})
+        if not any(c.startswith(FLEET_COMPILE_PREFIX)
+                   for c in hcounters):
+            errs.append(
+                f"fleet host shard {hname!r} declares "
+                "compile_sentinel but carries no "
+                f"{FLEET_COMPILE_PREFIX}...}} counter (its compile "
+                "ledger was dropped)")
     return errs
 
 
@@ -531,6 +608,7 @@ def _check_with_serve_names(path: str) -> list[str]:
         problems = problems + _check_shard_names(doc)
         problems = problems + _check_memfrugal_names(doc)
         problems = problems + _check_hosts_doc(doc)
+        problems = problems + _check_multihost_fleet(doc)
         problems = problems + _check_devtrace_names(doc)
         problems = problems + _check_push_names(doc)
         problems = problems + _check_fleet_doc(doc)
